@@ -1,0 +1,31 @@
+"""dynamo_tpu: a TPU-native distributed LLM inference serving framework.
+
+Capability-equivalent to NVIDIA Dynamo (reference: /root/reference, see SURVEY.md),
+rebuilt TPU-first:
+
+- Workers are JAX/XLA programs sharded with ``jax.sharding`` over device meshes.
+- Hot kernels (paged attention, KV block gather/scatter, TP relayout) are Pallas.
+- The KV bulk-data plane rides ICI within a pod (sharded device arrays + collectives)
+  and host staging over DCN across pods, instead of NIXL/RDMA.
+- The control plane (discovery with leases + prefix watches), request plane
+  (push messaging), and response plane (direct TCP streams with a framed two-part
+  codec) are self-hosted native services rather than etcd/NATS, with the same
+  semantics (reference: lib/runtime/src/transports/{etcd,nats}.rs).
+
+Package layout:
+  runtime/    distributed runtime: AsyncEngine, pipeline graph, component model,
+              transports (statestore, messaging, tcp, mock)
+  llm/        OpenAI protocol types, SSE codec, preprocessor, detokenizer backend,
+              model deployment card, HTTP service
+  kv/         token-block chained hashing, KV block manager, offload tiers
+  kv_router/  radix-tree prefix indexer, KV-aware scheduler, events, metrics
+  models/     JAX model implementations (Llama family)
+  engine_jax/ the TPU serving engine: continuous batching over paged KV in HBM
+  ops/        Pallas kernels
+  parallel/   mesh / sharding layouts (tp, dp, pp, sp), ring attention wiring
+  native/     C++ components (codec, radix tree, block staging) + ctypes loader
+  sdk/        @service / @endpoint / depends / link Python SDK
+  cli/        `dynamo-run`-style launcher and serve supervisor
+"""
+
+__version__ = "0.1.0"

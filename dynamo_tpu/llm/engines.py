@@ -1,0 +1,74 @@
+"""Built-in fake backends: echo engines.
+
+Deterministic token streams at a configurable rate, used to exercise the full
+serving path (frontend, pipelines, routing, SSE) without a model.
+Reference parity: EchoEngineCore / EchoEngineFull with DYN_TOKEN_ECHO_DELAY_MS,
+default 10 ms/token = 100 tok/s (lib/llm/src/engines.rs:80-178).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import AsyncIterator
+
+from ..runtime.annotated import Annotated
+from ..runtime.engine import AsyncEngine, Context
+from .protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
+
+ECHO_DELAY_ENV = "DYN_TPU_TOKEN_ECHO_DELAY_MS"
+
+
+def _echo_delay_s() -> float:
+    return float(os.environ.get(ECHO_DELAY_ENV, "10")) / 1000.0
+
+
+class EchoEngineCore(AsyncEngine[PreprocessedRequest, Annotated[dict]]):
+    """Token-in/token-out echo: replays the prompt tokens one per tick."""
+
+    def __init__(self, delay_s: float | None = None):
+        self._delay_s = delay_s
+
+    async def generate(
+        self, request: Context[PreprocessedRequest]
+    ) -> AsyncIterator[Annotated[dict]]:
+        delay = self._delay_s if self._delay_s is not None else _echo_delay_s()
+        req = request.data
+        explicit_max = req.stop_conditions.max_tokens
+        max_tokens = explicit_max if explicit_max is not None else len(req.token_ids)
+        emitted = 0
+        for tok in req.token_ids:
+            if request.context.is_stopped or emitted >= max_tokens:
+                break
+            if delay > 0:
+                await asyncio.sleep(delay)
+            emitted += 1
+            yield Annotated.from_data(
+                LLMEngineOutput(token_ids=[tok]).to_dict(), id=request.id
+            )
+        reason = FinishReason.CANCELLED if request.context.is_stopped else (
+            FinishReason.LENGTH
+            if explicit_max is not None and emitted >= explicit_max
+            else FinishReason.EOS
+        )
+        yield Annotated.from_data(LLMEngineOutput.final(reason).to_dict(), id=request.id)
+
+
+class CounterEngine(AsyncEngine):
+    """Streams integers 0..n-1; error injection for HTTP-service tests.
+
+    Reference analogue: the CounterEngine in lib/llm/tests/http-service.rs:41-186.
+    """
+
+    def __init__(self, n: int = 10, fail_at: int | None = None):
+        self._n = n
+        self._fail_at = fail_at
+
+    async def generate(self, request: Context) -> AsyncIterator[Annotated[int]]:
+        for i in range(self._n):
+            if request.context.is_stopped:
+                break
+            if self._fail_at is not None and i == self._fail_at:
+                yield Annotated.from_error(f"injected failure at {i}", id=request.id)
+                return
+            yield Annotated.from_data(i, id=request.id)

@@ -1,0 +1,142 @@
+"""Engine-agnostic internal request/response protocol.
+
+The preprocessor lowers OpenAI requests into a :class:`PreprocessedRequest`
+(token ids + stop conditions + sampling options); engines emit
+:class:`LLMEngineOutput` items which the backend detokenizes into
+:class:`BackendOutput`. Reference parity: lib/llm/src/protocols/common.rs:52-644,
+common/llm_backend.rs:27-126, common/preprocessor.rs:25.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+class FinishReason(str, enum.Enum):
+    EOS = "eos"
+    LENGTH = "length"
+    STOP = "stop"
+    ERROR = "error"
+    CANCELLED = "cancelled"
+
+    def to_openai(self) -> str:
+        if self is FinishReason.LENGTH:
+            return "length"
+        if self is FinishReason.ERROR:
+            return "error"
+        return "stop"
+
+
+@dataclass
+class StopConditions:
+    """Reference: StopConditions (lib/llm/src/protocols/common.rs)."""
+
+    max_tokens: Optional[int] = None
+    stop: list[str] = field(default_factory=list)
+    stop_token_ids: list[int] = field(default_factory=list)
+    min_tokens: Optional[int] = None
+    ignore_eos: bool = False
+
+
+@dataclass
+class SamplingOptions:
+    """Reference: SamplingOptions (lib/llm/src/protocols/common.rs)."""
+
+    n: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    seed: Optional[int] = None
+
+
+@dataclass
+class PreprocessedRequest:
+    """Token-level request handed to an engine (a.k.a. BackendInput).
+
+    Reference: PreprocessedRequest / BackendInput
+    (lib/llm/src/protocols/common/preprocessor.rs:25, common/llm_backend.rs).
+    """
+
+    token_ids: list[int]
+    stop_conditions: StopConditions = field(default_factory=StopConditions)
+    sampling_options: SamplingOptions = field(default_factory=SamplingOptions)
+    eos_token_ids: list[int] = field(default_factory=list)
+    annotations: list[str] = field(default_factory=list)
+    mdc_sum: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        from dataclasses import asdict
+
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PreprocessedRequest":
+        return cls(
+            token_ids=list(d["token_ids"]),
+            stop_conditions=StopConditions(**d.get("stop_conditions", {})),
+            sampling_options=SamplingOptions(**d.get("sampling_options", {})),
+            eos_token_ids=list(d.get("eos_token_ids", [])),
+            annotations=list(d.get("annotations", [])),
+            mdc_sum=d.get("mdc_sum"),
+        )
+
+
+@dataclass
+class LLMEngineOutput:
+    """One streamed step from an engine: newly generated token ids.
+
+    Reference: LLMEngineOutput (lib/llm/src/protocols/common/llm_backend.rs:27-126).
+    `text` is optional engine-side detokenization used only for validation; the
+    canonical text comes from the Backend decoder.
+    """
+
+    token_ids: list[int] = field(default_factory=list)
+    text: Optional[str] = None
+    cum_log_probs: Optional[float] = None
+    finish_reason: Optional[FinishReason] = None
+    # engine-specific side data (e.g. kv hit-rate annotations)
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def final(cls, reason: FinishReason) -> "LLMEngineOutput":
+        return cls(finish_reason=reason)
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {"token_ids": self.token_ids}
+        if self.text is not None:
+            out["text"] = self.text
+        if self.cum_log_probs is not None:
+            out["cum_log_probs"] = self.cum_log_probs
+        if self.finish_reason is not None:
+            out["finish_reason"] = self.finish_reason.value
+        if self.extra:
+            out["extra"] = self.extra
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LLMEngineOutput":
+        fr = d.get("finish_reason")
+        return cls(
+            token_ids=list(d.get("token_ids", [])),
+            text=d.get("text"),
+            cum_log_probs=d.get("cum_log_probs"),
+            finish_reason=FinishReason(fr) if fr else None,
+            extra=dict(d.get("extra", {})),
+        )
+
+
+@dataclass
+class BackendOutput:
+    """Detokenized output leaving the Backend post-processor.
+
+    Reference: BackendOutput (lib/llm/src/protocols/common/llm_backend.rs).
+    """
+
+    token_ids: list[int] = field(default_factory=list)
+    text: Optional[str] = None
+    finish_reason: Optional[FinishReason] = None
+    cum_log_probs: Optional[float] = None

@@ -1,0 +1,19 @@
+"""Distributed runtime: engine abstraction, pipelines, components, transports."""
+
+from .annotated import Annotated, EngineStreamError
+from .engine import AsyncEngine, Context, EngineContext, FnEngine, collect
+from .pipeline import MapOperator, Operator, Pipeline, PipelineBuilder
+
+__all__ = [
+    "Annotated",
+    "AsyncEngine",
+    "Context",
+    "EngineContext",
+    "EngineStreamError",
+    "FnEngine",
+    "MapOperator",
+    "Operator",
+    "Pipeline",
+    "PipelineBuilder",
+    "collect",
+]

@@ -1,0 +1,73 @@
+"""Runtime configuration from environment (`DYN_TPU_*`).
+
+Reference parity: `RuntimeConfig` via figment with `DYN_RUNTIME_`/`DYN_` env
+prefixes (lib/runtime/src/config.rs:26-180).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+ENV_PREFIX = "DYN_TPU_"
+
+_TRUTHY = {"1", "true", "yes", "on"}
+_FALSY = {"0", "false", "no", "off"}
+
+
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    return os.environ.get(ENV_PREFIX + name, default)
+
+
+def env_int(name: str, default: int) -> int:
+    raw = os.environ.get(ENV_PREFIX + name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError as e:
+        raise ValueError(f"{ENV_PREFIX}{name}={raw!r} is not an integer") from e
+
+
+def env_float(name: str, default: float) -> float:
+    raw = os.environ.get(ENV_PREFIX + name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError as e:
+        raise ValueError(f"{ENV_PREFIX}{name}={raw!r} is not a number") from e
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    raw = os.environ.get(ENV_PREFIX + name)
+    if raw is None:
+        return default
+    low = raw.strip().lower()
+    if low in _TRUTHY:
+        return True
+    if low in _FALSY:
+        return False
+    raise ValueError(f"{ENV_PREFIX}{name}={raw!r} is not a boolean")
+
+
+@dataclass
+class RuntimeConfig:
+    """Process-level runtime settings.
+
+    graceful_shutdown_timeout mirrors DYN_WORKER_GRACEFUL_SHUTDOWN_TIMEOUT
+    (lib/runtime/src/worker.rs:59-211).
+    """
+
+    statestore_url: str = field(default_factory=lambda: env_str("STATESTORE", "tcp://127.0.0.1:37901"))
+    messaging_url: str = field(default_factory=lambda: env_str("MESSAGING", "tcp://127.0.0.1:37902"))
+    graceful_shutdown_timeout: float = field(
+        default_factory=lambda: env_float("GRACEFUL_SHUTDOWN_TIMEOUT", 30.0)
+    )
+    response_plane_host: str = field(default_factory=lambda: env_str("RESPONSE_HOST", "127.0.0.1"))
+    response_plane_port: int = field(default_factory=lambda: env_int("RESPONSE_PORT", 0))
+
+    @classmethod
+    def from_settings(cls) -> "RuntimeConfig":
+        return cls()

@@ -1,0 +1,63 @@
+"""Logging init: `DYN_TPU_LOG` filter env, optional JSONL output.
+
+Reference parity: lib/runtime/src/logging.rs:63-344 (`DYN_LOG`, `DYN_LOGGING_JSONL`,
+per-module filter map). Implemented over stdlib logging.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+
+_INITIALIZED = False
+
+
+class JsonlFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": record.created,
+            "level": record.levelname,
+            "target": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exception"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+def init(level: str | None = None) -> None:
+    """Idempotent logging init.
+
+    `DYN_TPU_LOG` accepts either a global level (`info`) or a comma list with
+    per-module overrides (`info,dynamo_tpu.kv_router=debug`).
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+
+    spec = level or os.environ.get("DYN_TPU_LOG", "info")
+    parts = [p.strip() for p in spec.split(",") if p.strip()]
+    root_level = "info"
+    overrides: dict[str, str] = {}
+    for p in parts:
+        if "=" in p:
+            mod, lvl = p.split("=", 1)
+            overrides[mod.strip()] = lvl.strip()
+        else:
+            root_level = p
+
+    handler = logging.StreamHandler(sys.stderr)
+    if os.environ.get("DYN_TPU_LOGGING_JSONL", "").lower() in {"1", "true", "yes"}:
+        handler.setFormatter(JsonlFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+    root = logging.getLogger()
+    root.addHandler(handler)
+    root.setLevel(root_level.upper())
+    for mod, lvl in overrides.items():
+        logging.getLogger(mod).setLevel(lvl.upper())
+    _INITIALIZED = True

@@ -1,0 +1,30 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (no TPU needed): the env vars below must
+be set before jax is first imported. Hardware-requiring tests are marked `tpu`
+(mirroring the reference's marker tiers: pre_merge / gpu, pyproject.toml:164-169).
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import asyncio  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "tpu: requires real TPU hardware")
+    config.addinivalue_line("markers", "slow: long-running test")
+
+
+@pytest.fixture
+def run():
+    """Run a coroutine to completion on a fresh event loop."""
+
+    def _run(coro):
+        return asyncio.run(coro)
+
+    return _run

@@ -1,0 +1,183 @@
+"""Runtime core: engine abstraction, context cancellation, pipeline composition.
+
+Mirrors the reference's in-process pipeline tests (lib/runtime/tests/pipeline.rs).
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import (
+    Annotated,
+    AsyncEngine,
+    Context,
+    FnEngine,
+    MapOperator,
+    Operator,
+    Pipeline,
+    collect,
+)
+from dynamo_tpu.llm.engines import CounterEngine, EchoEngineCore
+from dynamo_tpu.llm.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    StopConditions,
+)
+
+
+def test_context_identity_and_map():
+    ctx = Context({"a": 1}, request_id="req-1")
+    assert ctx.id == "req-1"
+    mapped = ctx.map(lambda d: d["a"])
+    assert mapped.data == 1
+    assert mapped.id == "req-1"  # same engine context propagates
+    assert mapped.context is ctx.context
+
+
+def test_context_stop_kill(run):
+    async def main():
+        ctx = Context(None)
+        assert not ctx.context.is_stopped
+        waiter = asyncio.ensure_future(ctx.context.stopped())
+        await asyncio.sleep(0)
+        ctx.context.stop_generating()
+        await asyncio.wait_for(waiter, 1.0)
+        assert ctx.context.is_stopped and not ctx.context.is_killed
+        ctx.context.kill()
+        assert ctx.context.is_killed
+
+    run(main())
+
+
+def test_fn_engine_stream(run):
+    async def gen(request: Context):
+        for i in range(request.data):
+            yield i * 10
+
+    engine = FnEngine(gen)
+
+    async def main():
+        return await collect(engine.generate(Context(3)))
+
+    assert run(main()) == [0, 10, 20]
+
+
+def test_echo_engine_replays_tokens(run):
+    engine = EchoEngineCore(delay_s=0.0)
+    req = PreprocessedRequest(token_ids=[5, 6, 7])
+
+    async def main():
+        return await collect(engine.generate(Context(req)))
+
+    items = run(main())
+    outs = [LLMEngineOutput.from_dict(a.data) for a in items]
+    assert [o.token_ids for o in outs[:-1]] == [[5], [6], [7]]
+    assert outs[-1].finish_reason == FinishReason.EOS
+
+
+def test_echo_engine_max_tokens(run):
+    engine = EchoEngineCore(delay_s=0.0)
+    req = PreprocessedRequest(
+        token_ids=[1, 2, 3, 4], stop_conditions=StopConditions(max_tokens=2)
+    )
+
+    async def main():
+        return await collect(engine.generate(Context(req)))
+
+    outs = [LLMEngineOutput.from_dict(a.data) for a in run(main())]
+    assert sum(len(o.token_ids) for o in outs) == 2
+    assert outs[-1].finish_reason == FinishReason.LENGTH
+
+
+def test_echo_engine_cancellation(run):
+    engine = EchoEngineCore(delay_s=0.0)
+    req = PreprocessedRequest(token_ids=list(range(100)))
+
+    async def main():
+        ctx = Context(req)
+        seen = []
+        async for a in engine.generate(ctx):
+            seen.append(a)
+            if len(seen) == 3:
+                ctx.context.stop_generating()
+        return seen
+
+    seen = run(main())
+    # 3 data items then the final finish marker
+    assert len(seen) == 4
+
+
+def test_pipeline_operator_composition(run):
+    """Forward transform doubles, backward transform negates."""
+
+    async def gen(request: Context):
+        for i in range(request.data):
+            yield i
+
+    base = FnEngine(gen)
+    engine = (
+        Pipeline()
+        .link(MapOperator(fwd=lambda n: n * 2, bwd=lambda x: -x))
+        .link_engine(base)
+    )
+
+    async def main():
+        return await collect(engine.generate(Context(2)))
+
+    assert run(main()) == [0, -1, -2, -3]
+
+
+def test_pipeline_multi_stage_order(run):
+    """Operators apply forward in link order, backward in reverse order."""
+
+    class Tag(Operator):
+        def __init__(self, tag):
+            self.tag = tag
+
+        async def generate(self, request, next_engine):
+            downstream = request.map(lambda s: s + [f"fwd:{self.tag}"])
+            async for item in next_engine.generate(downstream):
+                yield item + [f"bwd:{self.tag}"]
+
+    async def gen(request: Context):
+        yield list(request.data)
+
+    engine = Pipeline().link(Tag("A")).link(Tag("B")).link_engine(FnEngine(gen))
+
+    async def main():
+        return await collect(engine.generate(Context([])))
+
+    [item] = run(main())
+    assert item == ["fwd:A", "fwd:B", "bwd:B", "bwd:A"]
+
+
+def test_annotated_envelope_roundtrip():
+    a = Annotated.from_data({"x": 1}, id="r1")
+    assert Annotated.from_dict(a.to_dict()).data == {"x": 1}
+    err = Annotated.from_error("boom", id="r1")
+    assert err.is_error and err.error_message() == "boom"
+    with pytest.raises(Exception):
+        err.raise_on_error()
+
+
+def test_counter_engine_error_injection(run):
+    engine = CounterEngine(n=5, fail_at=2)
+
+    async def main():
+        return await collect(engine.generate(Context(None)))
+
+    items = run(main())
+    assert [a.data for a in items[:2]] == [0, 1]
+    assert items[-1].is_error
+
+
+def test_generate_one(run):
+    async def gen(request: Context):
+        yield 1
+        yield 2
+
+    async def main():
+        return await FnEngine(gen).generate_one(Context(None))
+
+    assert run(main()) == 2
