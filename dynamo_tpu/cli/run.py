@@ -1,0 +1,277 @@
+"""Single-binary launcher: `python -m dynamo_tpu.cli.run in=<src> out=<engine> [flags]`.
+
+Input frontends:
+  in=http            OpenAI HTTP frontend (default)
+  in=text            interactive REPL
+  in=batch:FILE      offline JSONL benchmark with TTFT/ITL stats
+  in=dyn://ns.comp.ep  register as a distributed worker endpoint
+Output engines:
+  out=echo_full      OpenAI-level echo (no model files needed)
+  out=echo_core      token-level echo through the preprocessor pipeline
+  out=jax            the JAX TPU engine (requires --model-path)
+  out=dyn://ns.comp.ep  forward to a remote distributed endpoint
+
+Reference parity: launch/dynamo-run (main.rs:220, lib.rs:84-494, opt.rs, flags.rs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import sys
+import time
+from typing import Optional
+
+from ..llm.engines import EchoEngineCore, EchoEngineFull
+from ..llm.http.service import HttpService, ModelManager
+from ..llm.model_card import ModelDeploymentCard
+from ..llm.preprocessor import (
+    ChatPreprocessorOperator,
+    DetokenizeOperator,
+    OpenAIPreprocessor,
+)
+from ..llm.protocols.openai import ChatCompletionRequest, aggregate_chat_chunks
+from ..runtime import Context, Pipeline, collect
+from ..runtime.logging_util import init as init_logging
+
+logger = logging.getLogger(__name__)
+
+
+def parse_io(args: list[str]) -> tuple[str, str, list[str]]:
+    """Extract in=/out= positional specs (reference: opt.rs:23-217)."""
+    in_spec, out_spec, rest = "http", "echo_full", []
+    for a in args:
+        if a.startswith("in="):
+            in_spec = a[3:]
+        elif a.startswith("out="):
+            out_spec = a[4:]
+        else:
+            rest.append(a)
+    return in_spec, out_spec, rest
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dynamo-run", description="dynamo_tpu single-binary launcher"
+    )
+    p.add_argument("--model-path", default=None, help="HF-layout model directory")
+    p.add_argument("--model-name", default=None, help="served model name")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--max-batch-size", type=int, default=8)
+    p.add_argument("--kv-block-size", type=int, default=16)
+    p.add_argument("--max-model-len", type=int, default=None)
+    p.add_argument("--router-mode", choices=["random", "round_robin", "kv"], default="random")
+    p.add_argument("--statestore", default=None, help="statestore url for distributed mode")
+    p.add_argument("--extra-engine-args", default=None, help="JSON file of engine kwargs")
+    return p
+
+
+def build_engine(out_spec: str, flags: argparse.Namespace):
+    """Build the OpenAI-level engine for `out=<spec>`.
+
+    Returns (engine, model_name). The engine takes OpenAI requests and yields
+    Annotated chunk dicts.
+    """
+    card: Optional[ModelDeploymentCard] = None
+    if flags.model_path:
+        card = ModelDeploymentCard.from_local_path(flags.model_path, flags.model_name)
+    model_name = flags.model_name or (card.display_name if card else out_spec)
+
+    if out_spec == "echo_full":
+        return EchoEngineFull(), model_name
+
+    if out_spec == "echo_core":
+        if card is None:
+            raise SystemExit("out=echo_core requires --model-path (tokenizer needed)")
+        pre = OpenAIPreprocessor(card)
+        engine = (
+            Pipeline()
+            .link(ChatPreprocessorOperator(pre))
+            .link(DetokenizeOperator(card, pre.tokenizer))
+            .link_engine(EchoEngineCore())
+        )
+        return engine, model_name
+
+    if out_spec == "jax":
+        if card is None:
+            raise SystemExit("out=jax requires --model-path")
+        from ..engine_jax import build_jax_serving_engine
+
+        extra = {}
+        if flags.extra_engine_args:
+            with open(flags.extra_engine_args) as f:
+                extra = json.load(f)
+        engine = build_jax_serving_engine(
+            card,
+            max_batch_size=flags.max_batch_size,
+            kv_block_size=flags.kv_block_size,
+            max_model_len=flags.max_model_len,
+            tensor_parallel_size=flags.tensor_parallel_size,
+            **extra,
+        )
+        return engine, model_name
+
+    if out_spec.startswith("dyn://"):
+        from ..runtime.distributed import DistributedRuntime, parse_endpoint_path
+
+        ns, comp, ep = parse_endpoint_path(out_spec)
+        drt = DistributedRuntime.from_settings(statestore_url=flags.statestore)
+        client = drt.namespace(ns).component(comp).endpoint(ep).client(flags.router_mode)
+        return client, model_name
+
+    raise SystemExit(f"unknown out= engine: {out_spec!r}")
+
+
+async def run_http(engine, model_name: str, flags: argparse.Namespace) -> None:
+    manager = ModelManager()
+    manager.add_chat_model(model_name, engine)
+    manager.add_completions_model(model_name, engine)
+    service = HttpService(manager, host=flags.host, port=flags.port)
+    logger.info("serving model %r on port %d", model_name, flags.port)
+    await service.run()
+
+
+async def run_text(engine, model_name: str) -> None:
+    """Interactive REPL (reference: input/text.rs)."""
+    print(f"dynamo_tpu REPL — model {model_name!r}. Ctrl-D to exit.")
+    loop = asyncio.get_running_loop()
+    history: list[dict] = []
+    while True:
+        try:
+            line = await loop.run_in_executor(None, lambda: input("user> "))
+        except EOFError:
+            print()
+            return
+        if not line.strip():
+            continue
+        history.append({"role": "user", "content": line})
+        req = ChatCompletionRequest.model_validate(
+            {"model": model_name, "messages": history, "stream": True}
+        )
+        text_out = []
+        sys.stdout.write("assistant> ")
+        async for item in engine.generate(Context(req)):
+            data = item.data if hasattr(item, "data") else item
+            if not data:
+                continue
+            for choice in data.get("choices", []):
+                piece = (choice.get("delta") or {}).get("content")
+                if piece:
+                    text_out.append(piece)
+                    sys.stdout.write(piece)
+                    sys.stdout.flush()
+        print()
+        history.append({"role": "assistant", "content": "".join(text_out)})
+
+
+async def run_batch(engine, model_name: str, batch_file: str) -> None:
+    """Offline benchmark: JSONL prompts in, TTFT/ITL/throughput stats out.
+
+    Reference: input/batch.rs:289.
+    """
+    prompts = []
+    with open(batch_file) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                prompts.append(json.loads(line))
+
+    ttfts, itls, counts = [], [], []
+    t_start = time.perf_counter()
+    for p in prompts:
+        text = p.get("text") or p.get("prompt") or ""
+        max_tokens = p.get("max_tokens")
+        req = ChatCompletionRequest.model_validate(
+            {
+                "model": model_name,
+                "messages": [{"role": "user", "content": text}],
+                "stream": True,
+                **({"max_tokens": max_tokens} if max_tokens else {}),
+            }
+        )
+        t0 = time.perf_counter()
+        first = None
+        last = None
+        n = 0
+        async for item in engine.generate(Context(req)):
+            data = item.data if hasattr(item, "data") else item
+            if not data:
+                continue
+            now = time.perf_counter()
+            if first is None:
+                first = now
+            else:
+                itls.append(now - last)
+            last = now
+            n += 1
+        if first is not None:
+            ttfts.append(first - t0)
+        counts.append(n)
+    elapsed = time.perf_counter() - t_start
+
+    def pct(xs, q):
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+    stats = {
+        "requests": len(prompts),
+        "elapsed_s": round(elapsed, 3),
+        "total_chunks": sum(counts),
+        "ttft_p50_ms": round(pct(ttfts, 0.5) * 1e3, 2),
+        "ttft_p95_ms": round(pct(ttfts, 0.95) * 1e3, 2),
+        "itl_p50_ms": round(pct(itls, 0.5) * 1e3, 2),
+        "itl_p95_ms": round(pct(itls, 0.95) * 1e3, 2),
+        "chunks_per_s": round(sum(counts) / elapsed, 2) if elapsed else 0.0,
+    }
+    print(json.dumps(stats))
+
+
+async def run_endpoint(engine, model_name: str, in_spec: str, flags: argparse.Namespace) -> None:
+    """Register as a distributed worker on dyn://ns.comp.ep."""
+    from ..runtime.distributed import DistributedRuntime, parse_endpoint_path
+
+    ns, comp, ep = parse_endpoint_path(in_spec)
+    drt = DistributedRuntime.from_settings(statestore_url=flags.statestore)
+    component = drt.namespace(ns).component(comp)
+    await component.create_service()
+    endpoint = component.endpoint(ep)
+    await endpoint.serve(engine, model_entry={"name": model_name})
+    logger.info("worker serving %s", in_spec)
+    await drt.wait_closed()
+
+
+async def amain(argv: list[str]) -> None:
+    init_logging()
+    in_spec, out_spec, rest = parse_io(argv)
+    flags = build_parser().parse_args(rest)
+    engine, model_name = build_engine(out_spec, flags)
+
+    if in_spec == "http":
+        await run_http(engine, model_name, flags)
+    elif in_spec == "text":
+        await run_text(engine, model_name)
+    elif in_spec.startswith("batch:"):
+        await run_batch(engine, model_name, in_spec[len("batch:"):])
+    elif in_spec.startswith("dyn://"):
+        await run_endpoint(engine, model_name, in_spec, flags)
+    elif in_spec == "none":
+        await asyncio.Event().wait()
+    else:
+        raise SystemExit(f"unknown in= frontend: {in_spec!r}")
+
+
+def main() -> None:
+    try:
+        asyncio.run(amain(sys.argv[1:]))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -9,7 +9,6 @@ default 10 ms/token = 100 tok/s (lib/llm/src/engines.rs:80-178).
 from __future__ import annotations
 
 import asyncio
-import os
 from typing import AsyncIterator
 
 from ..runtime.annotated import Annotated
@@ -20,7 +19,9 @@ ECHO_DELAY_ENV = "DYN_TPU_TOKEN_ECHO_DELAY_MS"
 
 
 def _echo_delay_s() -> float:
-    return float(os.environ.get(ECHO_DELAY_ENV, "10")) / 1000.0
+    from ..runtime.config import env_float
+
+    return env_float("TOKEN_ECHO_DELAY_MS", 10.0) / 1000.0
 
 
 class EchoEngineCore(AsyncEngine[PreprocessedRequest, Annotated[dict]]):
@@ -52,6 +53,57 @@ class EchoEngineCore(AsyncEngine[PreprocessedRequest, Annotated[dict]]):
             else FinishReason.EOS
         )
         yield Annotated.from_data(LLMEngineOutput.final(reason).to_dict(), id=request.id)
+
+
+class EchoEngineFull(AsyncEngine):
+    """OpenAI-request-in echo: streams the last user message back word by word.
+
+    Needs no tokenizer/model files — the quickest full-path fake backend.
+    Reference: EchoEngineFull (lib/llm/src/engines.rs:80-178).
+    """
+
+    def __init__(self, delay_s: float | None = None):
+        self._delay_s = delay_s
+
+    async def generate(self, request: Context) -> AsyncIterator[Annotated[dict]]:
+        from .protocols.openai import (
+            ChatCompletionRequest,
+            DeltaGenerator,
+            new_request_id,
+        )
+
+        delay = self._delay_s if self._delay_s is not None else _echo_delay_s()
+        req = request.data
+        if isinstance(req, ChatCompletionRequest):
+            text = req.messages[-1].text_content() if req.messages else ""
+            chat = True
+        else:  # CompletionRequest
+            prompt = req.prompt
+            text = prompt if isinstance(prompt, str) else " ".join(map(str, prompt))
+            chat = False
+        gen = DeltaGenerator(new_request_id("chatcmpl" if chat else "cmpl"), req.model, chat=chat)
+        words = text.split()
+        if chat:
+            explicit_max = req.effective_max_tokens()
+        else:
+            explicit_max = req.max_tokens
+        max_tokens = explicit_max if explicit_max is not None else max(len(words), 1)
+
+        emitted = 0
+        for i, word in enumerate(words):
+            if request.context.is_stopped or emitted >= max_tokens:
+                break
+            if delay > 0:
+                await asyncio.sleep(delay)
+            piece = word if i == 0 else " " + word
+            emitted += 1
+            chunk = gen.text_chunk(piece)
+            yield Annotated.from_data(chunk.model_dump(exclude_none=True), id=request.id)
+        reason = FinishReason.CANCELLED if request.context.is_stopped else (
+            FinishReason.LENGTH if emitted >= max_tokens and emitted < len(words) else FinishReason.EOS
+        )
+        final = gen.finish_chunk(reason)
+        yield Annotated.from_data(final.model_dump(exclude_none=True), id=request.id)
 
 
 class CounterEngine(AsyncEngine):

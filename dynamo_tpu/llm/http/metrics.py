@@ -1,0 +1,220 @@
+"""Prometheus-format HTTP service metrics (no external deps).
+
+Counters by model/endpoint/type/status, an inflight gauge, and a
+request-duration histogram, with an RAII-style InflightGuard.
+Reference parity: lib/llm/src/http/service/metrics.rs:36-346.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import defaultdict
+from typing import Iterable, Optional
+
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, label_names: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._values: dict[tuple, float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        with self._lock:
+            self._values[key] += amount
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} counter"
+        with self._lock:
+            items = list(self._values.items())
+        if not items and not self.label_names:
+            items = [((), 0.0)]
+        for key, val in items:
+            labels = dict(zip(self.label_names, key))
+            yield f"{self.name}{_fmt_labels(labels)} {val:g}"
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str, label_names: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._values: dict[tuple, float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels: str) -> None:
+        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        with self._lock:
+            self._values[key] = value
+
+    def add(self, amount: float, **labels: str) -> None:
+        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        with self._lock:
+            self._values[key] += amount
+
+    def get(self, **labels: str) -> float:
+        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        with self._lock:
+            items = list(self._values.items())
+        if not items and not self.label_names:
+            items = [((), 0.0)]
+        for key, val in items:
+            labels = dict(zip(self.label_names, key))
+            yield f"{self.name}{_fmt_labels(labels)} {val:g}"
+
+
+class Histogram:
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        label_names: tuple[str, ...] = (),
+        buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+    ):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self.buckets = tuple(buckets) + (math.inf,)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = defaultdict(float)
+        self._totals: dict[tuple, int] = defaultdict(int)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] += value
+            self._totals[key] += 1
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        with self._lock:
+            keys = list(self._counts.keys())
+            for key in keys:
+                labels = dict(zip(self.label_names, key))
+                for i, b in enumerate(self.buckets):
+                    le = "+Inf" if math.isinf(b) else f"{b:g}"
+                    bl = dict(labels, le=le)
+                    yield f"{self.name}_bucket{_fmt_labels(bl)} {self._counts[key][i]}"
+                yield f"{self.name}_sum{_fmt_labels(labels)} {self._sums[key]:g}"
+                yield f"{self.name}_count{_fmt_labels(labels)} {self._totals[key]}"
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: list = []
+        self._lock = threading.Lock()
+
+    def register(self, metric):
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def render(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+class ServiceMetrics:
+    """The HTTP service metric set (reference: Metrics::new(prefix))."""
+
+    LABELS = ("model", "endpoint", "request_type", "status")
+
+    def __init__(self, prefix: str = "dynamo_frontend"):
+        self.registry = Registry()
+        self.requests = self.registry.register(
+            Counter(f"{prefix}_requests_total", "Total LLM requests", self.LABELS)
+        )
+        self.inflight = self.registry.register(
+            Gauge(f"{prefix}_inflight_requests", "Concurrent in-flight requests", ("model",))
+        )
+        self.duration = self.registry.register(
+            Histogram(f"{prefix}_request_duration_seconds", "Request duration", ("model",))
+        )
+        self.output_tokens = self.registry.register(
+            Counter(f"{prefix}_output_tokens_total", "Streamed output tokens", ("model",))
+        )
+        self.ttft = self.registry.register(
+            Histogram(f"{prefix}_time_to_first_token_seconds", "TTFT", ("model",))
+        )
+
+    def inflight_guard(self, model: str, endpoint: str, request_type: str) -> "InflightGuard":
+        return InflightGuard(self, model, endpoint, request_type)
+
+    def render(self) -> str:
+        return self.registry.render()
+
+
+class InflightGuard:
+    """Context manager: inflight gauge up/down + request counter + duration.
+
+    Reference: InflightGuard RAII (http/service/metrics.rs).
+    """
+
+    def __init__(self, metrics: ServiceMetrics, model: str, endpoint: str, request_type: str):
+        self._m = metrics
+        self.model = model
+        self.endpoint = endpoint
+        self.request_type = request_type
+        self.status = "error"
+        self._start: Optional[float] = None
+        self._first_token_at: Optional[float] = None
+
+    def __enter__(self) -> "InflightGuard":
+        self._start = time.perf_counter()
+        self._m.inflight.add(1, model=self.model)
+        return self
+
+    def mark_ok(self) -> None:
+        self.status = "success"
+
+    def mark_first_token(self) -> None:
+        if self._first_token_at is None and self._start is not None:
+            self._first_token_at = time.perf_counter()
+            self._m.ttft.observe(self._first_token_at - self._start, model=self.model)
+
+    def count_tokens(self, n: int = 1) -> None:
+        self._m.output_tokens.inc(n, model=self.model)
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self._m.inflight.add(-1, model=self.model)
+        if self._start is not None:
+            self._m.duration.observe(time.perf_counter() - self._start, model=self.model)
+        self._m.requests.inc(
+            1,
+            model=self.model,
+            endpoint=self.endpoint,
+            request_type=self.request_type,
+            status=self.status if exc_type is None else "error",
+        )

@@ -1,0 +1,281 @@
+"""OpenAI-compatible HTTP frontend (aiohttp).
+
+Routes: POST /v1/chat/completions, POST /v1/completions, GET /v1/models,
+GET /metrics, GET /health, GET /live. The engine is always called streaming;
+non-streaming requests fold the chunk stream through the aggregators. Client
+disconnects kill the engine context.
+
+Reference parity: HttpService/HttpServiceConfig (lib/llm/src/http/service/
+service_v2.rs:24-130), handlers + monitor_for_disconnects (openai.rs:132-418).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from ...runtime.annotated import Annotated
+from ...runtime.engine import AsyncEngine, Context
+from ..protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    ModelInfo,
+    ModelList,
+    aggregate_chat_chunks,
+    aggregate_completion_chunks,
+)
+from ..protocols.sse import DONE_SENTINEL, SseMessage
+from .metrics import ServiceMetrics
+
+logger = logging.getLogger(__name__)
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class ModelManager:
+    """Registry of model name → engine, per endpoint type.
+
+    Engines registered here speak OpenAI request in, Annotated[chunk dict] out
+    (i.e. a full preprocessor→backend→worker pipeline or an in-process engine).
+    Reference: ModelManager in service_v2.rs.
+    """
+
+    def __init__(self) -> None:
+        self._chat: dict[str, AsyncEngine] = {}
+        self._completions: dict[str, AsyncEngine] = {}
+
+    def add_chat_model(self, name: str, engine: AsyncEngine) -> None:
+        self._chat[name] = engine
+
+    def add_completions_model(self, name: str, engine: AsyncEngine) -> None:
+        self._completions[name] = engine
+
+    def remove_chat_model(self, name: str) -> None:
+        self._chat.pop(name, None)
+
+    def remove_completions_model(self, name: str) -> None:
+        self._completions.pop(name, None)
+
+    def chat_engine(self, name: str) -> AsyncEngine:
+        try:
+            return self._chat[name]
+        except KeyError:
+            raise HttpError(404, f"model {name!r} not found") from None
+
+    def completions_engine(self, name: str) -> AsyncEngine:
+        try:
+            return self._completions[name]
+        except KeyError:
+            raise HttpError(404, f"model {name!r} not found") from None
+
+    def model_names(self) -> list[str]:
+        return sorted(set(self._chat) | set(self._completions))
+
+
+class HttpService:
+    def __init__(
+        self,
+        manager: Optional[ModelManager] = None,
+        host: str = "0.0.0.0",
+        port: int = 8080,
+        metrics_prefix: str = "dynamo_frontend",
+    ):
+        self.manager = manager or ModelManager()
+        self.host = host
+        self.port = port
+        self.metrics = ServiceMetrics(metrics_prefix)
+        self._runner: Optional[web.AppRunner] = None
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.post("/v1/chat/completions", self._chat_completions),
+                web.post("/v1/completions", self._completions),
+                web.get("/v1/models", self._models),
+                web.get("/metrics", self._metrics),
+                web.get("/health", self._health),
+                web.get("/live", self._live),
+            ]
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> int:
+        """Start serving; returns the bound port."""
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        # resolve ephemeral port
+        for sock in site._server.sockets:  # type: ignore[union-attr]
+            self.port = sock.getsockname()[1]
+            break
+        logger.info("HTTP service listening on %s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def run(self, cancel_event: Optional[asyncio.Event] = None) -> None:
+        await self.start()
+        try:
+            if cancel_event is None:
+                while True:
+                    await asyncio.sleep(3600)
+            else:
+                await cancel_event.wait()
+        finally:
+            await self.stop()
+
+    # -- handlers ----------------------------------------------------------
+
+    async def _health(self, _request: web.Request) -> web.Response:
+        return web.json_response({"status": "healthy", "models": self.manager.model_names()})
+
+    async def _live(self, _request: web.Request) -> web.Response:
+        return web.json_response({"live": True})
+
+    async def _metrics(self, _request: web.Request) -> web.Response:
+        return web.Response(text=self.metrics.render(), content_type="text/plain")
+
+    async def _models(self, _request: web.Request) -> web.Response:
+        listing = ModelList(data=[ModelInfo(id=n) for n in self.manager.model_names()])
+        return web.json_response(listing.model_dump())
+
+    async def _chat_completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle_openai(request, chat=True)
+
+    async def _completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle_openai(request, chat=False)
+
+    async def _handle_openai(self, request: web.Request, chat: bool) -> web.StreamResponse:
+        endpoint = "chat/completions" if chat else "completions"
+        try:
+            body = await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return _error_response(400, "invalid JSON body")
+
+        try:
+            oai_req = (
+                ChatCompletionRequest.model_validate(body)
+                if chat
+                else CompletionRequest.model_validate(body)
+            )
+        except Exception as e:  # pydantic.ValidationError
+            return _error_response(400, f"invalid request: {e}")
+
+        try:
+            engine = (
+                self.manager.chat_engine(oai_req.model)
+                if chat
+                else self.manager.completions_engine(oai_req.model)
+            )
+        except HttpError as e:
+            return _error_response(e.status, e.message)
+
+        streaming = bool(oai_req.stream)
+        ctx = Context(oai_req)
+        guard = self.metrics.inflight_guard(
+            oai_req.model, endpoint, "stream" if streaming else "unary"
+        )
+
+        with guard:
+            if streaming:
+                return await self._stream_response(request, engine, ctx, guard)
+            return await self._unary_response(engine, ctx, guard, chat)
+
+    async def _stream_response(
+        self,
+        request: web.Request,
+        engine: AsyncEngine,
+        ctx: Context,
+        guard,
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+
+        try:
+            async for item in engine.generate(ctx):
+                if isinstance(item, Annotated):
+                    if item.is_error:
+                        msg = SseMessage(event="error", data=json.dumps({"message": item.error_message()}))
+                        await resp.write((msg.encode() + "\n\n").encode())
+                        break
+                    if item.data is None:
+                        # annotation/comment event
+                        await resp.write((SseMessage.from_annotated(item).encode() + "\n\n").encode())
+                        continue
+                    payload = item.data
+                else:
+                    payload = item
+                guard.mark_first_token()
+                guard.count_tokens()
+                await resp.write((f"data: {json.dumps(payload)}\n\n").encode())
+            else:
+                guard.mark_ok()
+            await resp.write(f"data: {DONE_SENTINEL}\n\n".encode())
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away: kill the engine context so the worker stops
+            ctx.context.kill()
+            logger.info("client disconnected, killed request %s", ctx.id)
+            raise
+        finally:
+            with _suppress():
+                await resp.write_eof()
+        return resp
+
+    async def _unary_response(
+        self, engine: AsyncEngine, ctx: Context, guard, chat: bool
+    ) -> web.Response:
+        chunks: list[dict] = []
+        try:
+            async for item in engine.generate(ctx):
+                if isinstance(item, Annotated):
+                    if item.is_error:
+                        return _error_response(500, item.error_message() or "engine error")
+                    if item.data is None:
+                        continue
+                    chunks.append(item.data)
+                else:
+                    chunks.append(item)
+                guard.mark_first_token()
+        except HttpError as e:
+            return _error_response(e.status, e.message)
+        if not chunks:
+            return _error_response(500, "engine produced no response")
+        full = aggregate_chat_chunks(chunks) if chat else aggregate_completion_chunks(chunks)
+        guard.mark_ok()
+        guard.count_tokens(sum(len(c.get("choices", [])) for c in chunks))
+        return web.json_response(full.model_dump(exclude_none=True))
+
+
+def _error_response(status: int, message: str) -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": "invalid_request_error" if status < 500 else "internal_error"}},
+        status=status,
+    )
+
+
+class _suppress:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return True

@@ -1,0 +1,327 @@
+"""OpenAI preprocessor: chat-template rendering + tokenization.
+
+Lowers an OpenAI request into the engine-agnostic :class:`PreprocessedRequest`
+(token ids, stop conditions, sampling options), and — as a pipeline operator —
+maps backend outputs back into OpenAI stream chunks on the response path.
+
+Reference parity: OpenAIPreprocessor (lib/llm/src/preprocessor.rs:64-359) and its
+prompt-template formatters (preprocessor/prompt/template/{formatters,oai,tokcfg}.rs).
+Chat templates are rendered with jinja2 against the HF `chat_template` from
+tokenizer_config.json, with the same helper environment HF uses
+(`raise_exception`, `tojson`, strftime_now).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+from typing import AsyncIterator, Optional, Union
+
+import jinja2
+
+from ..runtime.annotated import Annotated
+from ..runtime.engine import AsyncEngine, Context
+from ..runtime.pipeline import Operator
+from .model_card import ModelDeploymentCard
+from .protocols.common import (
+    BackendOutput,
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from .protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    DeltaGenerator,
+    Usage,
+    new_request_id,
+)
+from .tokenizer import HFTokenizer
+
+ANNOTATION_FORMATTED_PROMPT = "formatted_prompt"
+ANNOTATION_TOKEN_IDS = "token_ids"
+
+
+def _raise_exception(message: str):
+    raise jinja2.TemplateError(message)
+
+
+def _tojson(value, indent=None):
+    return json.dumps(value, indent=indent)
+
+
+def _strftime_now(fmt: str) -> str:
+    return datetime.datetime.now().strftime(fmt)
+
+
+class PromptFormatter:
+    """Renders chat messages into a prompt string via the model's chat template."""
+
+    def __init__(self, card: ModelDeploymentCard):
+        if not card.chat_template:
+            raise ValueError(f"model {card.display_name!r} has no chat template")
+        env = jinja2.Environment(
+            loader=jinja2.BaseLoader(),
+            trim_blocks=True,
+            lstrip_blocks=True,
+            extensions=["jinja2.ext.loopcontrols"],
+        )
+        env.globals["raise_exception"] = _raise_exception
+        env.globals["strftime_now"] = _strftime_now
+        env.filters["tojson"] = _tojson
+        self._template = env.from_string(card.chat_template)
+        self._card = card
+
+    def render(
+        self,
+        messages: list[dict],
+        add_generation_prompt: bool = True,
+        tools: Optional[list[dict]] = None,
+    ) -> str:
+        return self._template.render(
+            messages=messages,
+            add_generation_prompt=add_generation_prompt,
+            bos_token=self._card.bos_token or "",
+            eos_token=self._card.eos_token or "",
+            tools=tools,
+        )
+
+
+class OpenAIPreprocessor:
+    """Stateless request lowering: OpenAI request → PreprocessedRequest."""
+
+    def __init__(self, card: ModelDeploymentCard, tokenizer: Optional[HFTokenizer] = None):
+        self.card = card
+        if tokenizer is None:
+            if not card.tokenizer_file:
+                raise ValueError(
+                    f"model {card.display_name!r} has no tokenizer.json "
+                    f"(searched {card.model_path!r})"
+                )
+            tokenizer = HFTokenizer.from_file(card.tokenizer_file)
+        self.tokenizer = tokenizer
+        self.formatter = PromptFormatter(card) if card.chat_template else None
+
+    def preprocess_chat(self, request: ChatCompletionRequest) -> PreprocessedRequest:
+        if self.formatter is None:
+            raise ValueError("chat requests require a chat template")
+        raw = request.nvext.use_raw_prompt if request.nvext else False
+        if raw and request.messages:
+            prompt = request.messages[-1].text_content()
+        else:
+            prompt = self.formatter.render(
+                [m.model_dump(exclude_none=True) for m in request.messages]
+            )
+        token_ids = self.tokenizer.encode(prompt)
+        return self._build(request, prompt, token_ids, request.stop_list())
+
+    def preprocess_completion(self, request: CompletionRequest) -> PreprocessedRequest:
+        prompt = request.prompt
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            token_ids = [int(t) for t in prompt]
+            prompt_text = None
+        else:
+            if isinstance(prompt, list):
+                prompt = "".join(prompt)
+            prompt_text = str(prompt)
+            token_ids = self.tokenizer.encode(prompt_text)
+        return self._build(request, prompt_text, token_ids, request.stop_list())
+
+    def _build(
+        self,
+        request: Union[ChatCompletionRequest, CompletionRequest],
+        prompt: Optional[str],
+        token_ids: list[int],
+        stops: list[str],
+    ) -> PreprocessedRequest:
+        ignore_eos = bool(request.nvext.ignore_eos) if request.nvext else False
+        max_tokens = (
+            request.effective_max_tokens()
+            if isinstance(request, ChatCompletionRequest)
+            else request.max_tokens
+        )
+        # clamp generation to the model context window
+        budget = self.card.context_length - len(token_ids)
+        if max_tokens is None:
+            max_tokens = max(budget, 1)
+        pre = PreprocessedRequest(
+            token_ids=token_ids,
+            stop_conditions=StopConditions(
+                max_tokens=max_tokens,
+                stop=stops,
+                ignore_eos=ignore_eos,
+                min_tokens=getattr(request, "min_tokens", None),
+            ),
+            sampling_options=SamplingOptions(
+                n=request.n,
+                temperature=request.temperature,
+                top_p=request.top_p,
+                top_k=request.top_k,
+                frequency_penalty=request.frequency_penalty,
+                presence_penalty=request.presence_penalty,
+                seed=request.seed,
+            ),
+            eos_token_ids=list(self.card.eos_token_ids),
+            annotations=list((request.nvext.annotations if request.nvext else None) or []),
+            mdc_sum=self.card.mdcsum,
+        )
+        if prompt is not None:
+            pre._formatted_prompt = prompt  # carried for annotations only
+        return pre
+
+
+class ChatPreprocessorOperator(Operator):
+    """Pipeline stage: OpenAI chat request → tokens forward, deltas backward.
+
+    Forward: lower the OpenAI request via :class:`OpenAIPreprocessor` (emitting
+    requested annotations). Backward: wrap detokenized :class:`BackendOutput`
+    items into `chat.completion.chunk` dicts.
+    Reference: OpenAIPreprocessor::into_operator (preprocessor.rs:300-359).
+    """
+
+    def __init__(self, preprocessor: OpenAIPreprocessor, chat: bool = True):
+        self._pre = preprocessor
+        self._chat = chat
+
+    async def generate(
+        self, request: Context[Union[ChatCompletionRequest, CompletionRequest]], next_engine: AsyncEngine
+    ) -> AsyncIterator[Annotated[dict]]:
+        oai_req = request.data
+        if self._chat:
+            pre = self._pre.preprocess_chat(oai_req)
+        else:
+            pre = self._pre.preprocess_completion(oai_req)
+
+        # requested annotations surface as SSE events before data flows
+        if ANNOTATION_FORMATTED_PROMPT in pre.annotations and getattr(pre, "_formatted_prompt", None):
+            yield Annotated.from_annotation(ANNOTATION_FORMATTED_PROMPT, pre._formatted_prompt)
+        if ANNOTATION_TOKEN_IDS in pre.annotations:
+            yield Annotated.from_annotation(ANNOTATION_TOKEN_IDS, pre.token_ids)
+
+        request_id = new_request_id("chatcmpl" if self._chat else "cmpl")
+        gen = DeltaGenerator(request_id, oai_req.model, chat=self._chat)
+        prompt_tokens = len(pre.token_ids)
+        completion_tokens = 0
+
+        include_usage = bool(
+            oai_req.stream_options and oai_req.stream_options.include_usage
+        )
+
+        async for item in next_engine.generate(request.transfer(pre)):
+            if isinstance(item, Annotated):
+                if item.is_error:
+                    yield item
+                    return
+                if item.data is None:
+                    yield item  # pass through annotation events
+                    continue
+                out = item.data
+            else:
+                out = item
+            if not isinstance(out, BackendOutput):
+                raise TypeError(f"expected BackendOutput, got {type(out).__name__}")
+
+            completion_tokens += len(out.token_ids)
+            if out.text:
+                chunk = gen.text_chunk(out.text)
+                yield Annotated.from_data(chunk.model_dump(exclude_none=True), id=request.id)
+            if out.finish_reason is not None:
+                usage = (
+                    Usage(
+                        prompt_tokens=prompt_tokens,
+                        completion_tokens=completion_tokens,
+                        total_tokens=prompt_tokens + completion_tokens,
+                    )
+                    if include_usage
+                    else None
+                )
+                chunk = gen.finish_chunk(out.finish_reason, usage=usage)
+                yield Annotated.from_data(chunk.model_dump(exclude_none=True), id=request.id)
+                return
+
+
+class DetokenizeOperator(Operator):
+    """Pipeline stage: engine token-id stream → detokenized BackendOutput stream.
+
+    Holds the per-request StopSequenceDecoder jail. Reference: Backend
+    (lib/llm/src/backend.rs:63-487).
+    """
+
+    def __init__(self, card: ModelDeploymentCard, tokenizer: Optional[HFTokenizer] = None):
+        self.card = card
+        self.tokenizer = tokenizer or HFTokenizer.from_file(card.tokenizer_file)
+
+    async def generate(
+        self, request: Context[PreprocessedRequest], next_engine: AsyncEngine
+    ) -> AsyncIterator[Annotated[BackendOutput]]:
+        from .protocols.common import LLMEngineOutput
+
+        pre = request.data
+        stop_ids = set(pre.stop_conditions.stop_token_ids)
+        if not pre.stop_conditions.ignore_eos:
+            stop_ids.update(pre.eos_token_ids)
+        decoder = StopSequenceDecoderFactory.create(
+            self.tokenizer, pre.stop_conditions.stop, stop_ids
+        )
+
+        emitted = 0
+        async for item in next_engine.generate(request):
+            ann_id = item.id if isinstance(item, Annotated) else request.id
+            if isinstance(item, Annotated):
+                if item.is_error:
+                    yield item
+                    return
+                if item.data is None:
+                    yield item
+                    continue
+                out = LLMEngineOutput.from_dict(item.data) if isinstance(item.data, dict) else item.data
+            else:
+                out = item
+
+            text_parts: list[str] = []
+            finish: Optional[FinishReason] = out.finish_reason
+            stop_hit = False
+            kept_tokens: list[int] = []
+            for tok in out.token_ids:
+                decision = decoder.step(tok)
+                if decision.text:
+                    text_parts.append(decision.text)
+                if not decision.stopped or decision.stop_token:
+                    kept_tokens.append(tok)
+                if decision.stopped:
+                    finish = FinishReason.STOP if not decision.stop_token else FinishReason.EOS
+                    stop_hit = True
+                    break
+            emitted += len(kept_tokens)
+
+            max_t = pre.stop_conditions.max_tokens
+            if finish is None and max_t is not None and emitted >= max_t:
+                finish = FinishReason.LENGTH
+
+            if finish is not None and not stop_hit:
+                tail = decoder.flush()
+                if tail:
+                    text_parts.append(tail)
+
+            yield Annotated.from_data(
+                BackendOutput(
+                    token_ids=kept_tokens,
+                    text="".join(text_parts) or None,
+                    finish_reason=finish,
+                    cum_log_probs=out.cum_log_probs,
+                ),
+                id=ann_id,
+            )
+            if finish is not None:
+                return
+
+
+class StopSequenceDecoderFactory:
+    @staticmethod
+    def create(tokenizer: HFTokenizer, stops, stop_ids):
+        from .tokenizer import StopSequenceDecoder
+
+        return StopSequenceDecoder(
+            tokenizer, stop_sequences=list(stops), stop_token_ids=list(stop_ids)
+        )

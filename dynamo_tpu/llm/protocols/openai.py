@@ -1,0 +1,308 @@
+"""OpenAI-compatible protocol types: chat completions and completions.
+
+Request/response models (pydantic), per-token delta generators, and stream→full
+aggregators for the non-streaming path. The ``nvext`` extension block is kept
+name-compatible with the reference so existing clients work unchanged.
+Reference parity: lib/llm/src/protocols/openai/{chat_completions,completions}.rs,
+aggregator.rs, delta.rs, nvext.rs.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Literal, Optional, Union
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from .common import FinishReason
+
+
+class NvExt(BaseModel):
+    """NVIDIA-compatible extension block (reference: nvext.rs:193)."""
+
+    model_config = ConfigDict(extra="allow")
+
+    ignore_eos: Optional[bool] = None
+    annotations: Optional[list[str]] = None
+    use_raw_prompt: Optional[bool] = None
+    greed_sampling: Optional[bool] = None
+
+
+class ChatMessage(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+    role: str
+    content: Optional[Union[str, list[dict]]] = None
+    name: Optional[str] = None
+    tool_calls: Optional[list[dict]] = None
+
+    def text_content(self) -> str:
+        if isinstance(self.content, str):
+            return self.content
+        if isinstance(self.content, list):
+            return "".join(
+                part.get("text", "") for part in self.content if part.get("type") == "text"
+            )
+        return ""
+
+
+class StreamOptions(BaseModel):
+    include_usage: Optional[bool] = None
+
+
+class ChatCompletionRequest(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+    model: str
+    messages: list[ChatMessage]
+    stream: bool = False
+    stream_options: Optional[StreamOptions] = None
+    max_tokens: Optional[int] = None
+    max_completion_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None  # common extension
+    n: Optional[int] = None
+    stop: Optional[Union[str, list[str]]] = None
+    seed: Optional[int] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    logprobs: Optional[bool] = None
+    top_logprobs: Optional[int] = None
+    min_tokens: Optional[int] = None  # common extension
+    nvext: Optional[NvExt] = None
+
+    def stop_list(self) -> list[str]:
+        if self.stop is None:
+            return []
+        return [self.stop] if isinstance(self.stop, str) else list(self.stop)
+
+    def effective_max_tokens(self) -> Optional[int]:
+        return self.max_completion_tokens or self.max_tokens
+
+
+class CompletionRequest(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+    model: str
+    prompt: Union[str, list[str], list[int], list[list[int]]]
+    stream: bool = False
+    stream_options: Optional[StreamOptions] = None
+    max_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    n: Optional[int] = None
+    stop: Optional[Union[str, list[str]]] = None
+    seed: Optional[int] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    echo: Optional[bool] = None
+    nvext: Optional[NvExt] = None
+
+    def stop_list(self) -> list[str]:
+        if self.stop is None:
+            return []
+        return [self.stop] if isinstance(self.stop, str) else list(self.stop)
+
+
+class Usage(BaseModel):
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+class ChatDelta(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+    role: Optional[str] = None
+    content: Optional[str] = None
+
+
+class ChatChunkChoice(BaseModel):
+    index: int = 0
+    delta: ChatDelta = Field(default_factory=ChatDelta)
+    finish_reason: Optional[str] = None
+
+
+class ChatCompletionChunk(BaseModel):
+    id: str
+    object: Literal["chat.completion.chunk"] = "chat.completion.chunk"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str = ""
+    choices: list[ChatChunkChoice] = Field(default_factory=list)
+    usage: Optional[Usage] = None
+
+
+class ChatChoice(BaseModel):
+    index: int = 0
+    message: ChatMessage = Field(default_factory=lambda: ChatMessage(role="assistant", content=""))
+    finish_reason: Optional[str] = None
+
+
+class ChatCompletionResponse(BaseModel):
+    id: str
+    object: Literal["chat.completion"] = "chat.completion"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str = ""
+    choices: list[ChatChoice] = Field(default_factory=list)
+    usage: Optional[Usage] = None
+
+
+class CompletionChoice(BaseModel):
+    index: int = 0
+    text: str = ""
+    finish_reason: Optional[str] = None
+
+
+class CompletionChunk(BaseModel):
+    id: str
+    object: Literal["text_completion"] = "text_completion"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str = ""
+    choices: list[CompletionChoice] = Field(default_factory=list)
+    usage: Optional[Usage] = None
+
+
+class CompletionResponse(BaseModel):
+    id: str
+    object: Literal["text_completion"] = "text_completion"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str = ""
+    choices: list[CompletionChoice] = Field(default_factory=list)
+    usage: Optional[Usage] = None
+
+
+class ModelInfo(BaseModel):
+    id: str
+    object: Literal["model"] = "model"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    owned_by: str = "dynamo_tpu"
+
+
+class ModelList(BaseModel):
+    object: Literal["list"] = "list"
+    data: list[ModelInfo] = Field(default_factory=list)
+
+
+def new_request_id(prefix: str = "chatcmpl") -> str:
+    return f"{prefix}-{uuid.uuid4().hex}"
+
+
+# ---------------------------------------------------------------------------
+# Delta generation (reference: delta.rs)
+# ---------------------------------------------------------------------------
+
+
+class DeltaGenerator:
+    """Builds OpenAI chunk objects from detokenized backend text deltas."""
+
+    def __init__(self, request_id: str, model: str, chat: bool = True):
+        self.request_id = request_id
+        self.model = model
+        self.chat = chat
+        self.created = int(time.time())
+        self._first = True
+        self.usage = Usage()
+
+    def text_chunk(self, text: str, index: int = 0):
+        if self.chat:
+            delta = ChatDelta(content=text)
+            if self._first:
+                delta.role = "assistant"
+                self._first = False
+            return ChatCompletionChunk(
+                id=self.request_id,
+                created=self.created,
+                model=self.model,
+                choices=[ChatChunkChoice(index=index, delta=delta)],
+            )
+        return CompletionChunk(
+            id=self.request_id,
+            created=self.created,
+            model=self.model,
+            choices=[CompletionChoice(index=index, text=text)],
+        )
+
+    def finish_chunk(self, reason: FinishReason, index: int = 0, usage: Optional[Usage] = None):
+        fr = reason.to_openai()
+        if self.chat:
+            return ChatCompletionChunk(
+                id=self.request_id,
+                created=self.created,
+                model=self.model,
+                choices=[ChatChunkChoice(index=index, finish_reason=fr)],
+                usage=usage,
+            )
+        return CompletionChunk(
+            id=self.request_id,
+            created=self.created,
+            model=self.model,
+            choices=[CompletionChoice(index=index, text="", finish_reason=fr)],
+            usage=usage,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Stream → full aggregation (reference: aggregator.rs)
+# ---------------------------------------------------------------------------
+
+
+def aggregate_chat_chunks(chunks: list[dict | ChatCompletionChunk]) -> ChatCompletionResponse:
+    """Fold a chunk stream into one chat.completion response."""
+    parsed = [
+        c if isinstance(c, ChatCompletionChunk) else ChatCompletionChunk.model_validate(c)
+        for c in chunks
+    ]
+    if not parsed:
+        raise ValueError("empty chunk stream")
+    by_index: dict[int, ChatChoice] = {}
+    usage: Optional[Usage] = None
+    for chunk in parsed:
+        if chunk.usage is not None:
+            usage = chunk.usage
+        for ch in chunk.choices:
+            agg = by_index.setdefault(
+                ch.index, ChatChoice(index=ch.index, message=ChatMessage(role="assistant", content=""))
+            )
+            if ch.delta.role:
+                agg.message.role = ch.delta.role
+            if ch.delta.content:
+                agg.message.content = (agg.message.content or "") + ch.delta.content
+            if ch.finish_reason:
+                agg.finish_reason = ch.finish_reason
+    first = parsed[0]
+    return ChatCompletionResponse(
+        id=first.id,
+        created=first.created,
+        model=first.model,
+        choices=[by_index[i] for i in sorted(by_index)],
+        usage=usage,
+    )
+
+
+def aggregate_completion_chunks(chunks: list[dict | CompletionChunk]) -> CompletionResponse:
+    parsed = [
+        c if isinstance(c, CompletionChunk) else CompletionChunk.model_validate(c) for c in chunks
+    ]
+    if not parsed:
+        raise ValueError("empty chunk stream")
+    by_index: dict[int, CompletionChoice] = {}
+    usage: Optional[Usage] = None
+    for chunk in parsed:
+        if chunk.usage is not None:
+            usage = chunk.usage
+        for ch in chunk.choices:
+            agg = by_index.setdefault(ch.index, CompletionChoice(index=ch.index, text=""))
+            agg.text += ch.text
+            if ch.finish_reason:
+                agg.finish_reason = ch.finish_reason
+    first = parsed[0]
+    return CompletionResponse(
+        id=first.id,
+        created=first.created,
+        model=first.model,
+        choices=[by_index[i] for i in sorted(by_index)],
+        usage=usage,
+    )

@@ -1,0 +1,130 @@
+"""Server-Sent Events line codec.
+
+Parses and emits SSE messages (``data:``, ``event:``, ``:`` comments, id) and the
+OpenAI ``[DONE]`` sentinel, symmetric with the :class:`Annotated` envelope.
+Reference parity: SseLineCodec / Message / create_message_stream
+(lib/llm/src/protocols/codec.rs:36-295).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Iterable, Optional
+
+from ...runtime.annotated import Annotated
+
+DONE_SENTINEL = "[DONE]"
+
+
+@dataclass
+class SseMessage:
+    data: Optional[str] = None
+    event: Optional[str] = None
+    id: Optional[str] = None
+    comments: list[str] = field(default_factory=list)
+
+    @property
+    def is_done(self) -> bool:
+        return self.data is not None and self.data.strip() == DONE_SENTINEL
+
+    def encode(self) -> str:
+        """Render as an SSE frame (without the trailing blank-line separator)."""
+        lines: list[str] = []
+        for c in self.comments:
+            lines.append(f": {c}")
+        if self.event is not None:
+            lines.append(f"event: {self.event}")
+        if self.id is not None:
+            lines.append(f"id: {self.id}")
+        if self.data is not None:
+            for chunk in self.data.split("\n"):
+                lines.append(f"data: {chunk}")
+        return "\n".join(lines)
+
+    @classmethod
+    def from_annotated(cls, item: Annotated) -> "SseMessage":
+        return cls(
+            data=None if item.data is None else json.dumps(item.data),
+            event=item.event,
+            id=item.id,
+            comments=list(item.comment),
+        )
+
+    def to_annotated(self) -> Annotated:
+        return Annotated(
+            data=None if self.data is None else json.loads(self.data),
+            event=self.event,
+            id=self.id,
+            comment=list(self.comments),
+        )
+
+
+class SseDecoder:
+    """Incremental SSE parser: feed lines, get complete messages.
+
+    A message is terminated by a blank line. Multiple ``data:`` lines concatenate
+    with newlines, per the SSE spec.
+    """
+
+    def __init__(self) -> None:
+        self._data_lines: list[str] = []
+        self._event: Optional[str] = None
+        self._id: Optional[str] = None
+        self._comments: list[str] = []
+
+    def _flush(self) -> Optional[SseMessage]:
+        if not self._data_lines and self._event is None and not self._comments and self._id is None:
+            return None
+        msg = SseMessage(
+            data="\n".join(self._data_lines) if self._data_lines else None,
+            event=self._event,
+            id=self._id,
+            comments=self._comments,
+        )
+        self._data_lines = []
+        self._event = None
+        self._id = None
+        self._comments = []
+        return msg
+
+    def feed_line(self, line: str) -> Optional[SseMessage]:
+        line = line.rstrip("\r\n")
+        if line == "":
+            return self._flush()
+        if line.startswith(":"):
+            self._comments.append(line[1:].lstrip())
+            return None
+        if ":" in line:
+            name, value = line.split(":", 1)
+            value = value.lstrip()
+        else:
+            name, value = line, ""
+        if name == "data":
+            self._data_lines.append(value)
+        elif name == "event":
+            self._event = value
+        elif name == "id":
+            self._id = value
+        # unknown fields are ignored per spec
+        return None
+
+    def feed_lines(self, lines: Iterable[str]) -> list[SseMessage]:
+        out = []
+        for line in lines:
+            msg = self.feed_line(line)
+            if msg is not None:
+                out.append(msg)
+        tail = self._flush()
+        if tail is not None:
+            out.append(tail)
+        return out
+
+
+async def decode_sse_stream(lines: AsyncIterator[str]) -> AsyncIterator[SseMessage]:
+    """Decode an async stream of lines into SSE messages."""
+    decoder = SseDecoder()
+    async for line in lines:
+        msg = decoder.feed_line(line)
+        if msg is not None:
+            yield msg

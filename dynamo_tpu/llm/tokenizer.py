@@ -1,0 +1,183 @@
+"""Tokenizer wrapper: encode, incremental streaming decode, stop-sequence jail.
+
+Wraps HF `tokenizers.Tokenizer` (the same underlying Rust library the reference
+uses) and adds the two serving-side pieces every streaming LLM needs:
+
+- :class:`DecodeStream` — incremental detokenization that never emits half a
+  UTF-8 codepoint or half a multi-token grapheme (prefix/read-offset scheme).
+- :class:`StopSequenceDecoder` — the "jail": text that partially matches a stop
+  string is held back until disambiguated, and matched stop strings are never
+  emitted.
+
+Reference parity: lib/llm/src/tokenizers.rs:91-570 (Encoding, DecodeStream,
+StopSequenceDecoder with jail states).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from tokenizers import Tokenizer
+
+
+class HFTokenizer:
+    """Thin wrapper over a HF fast tokenizer file (tokenizer.json)."""
+
+    def __init__(self, tokenizer: Tokenizer):
+        self._tk = tokenizer
+
+    @classmethod
+    def from_file(cls, path: str) -> "HFTokenizer":
+        return cls(Tokenizer.from_file(path))
+
+    def encode(self, text: str, add_special_tokens: bool = False) -> list[int]:
+        return self._tk.encode(text, add_special_tokens=add_special_tokens).ids
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        return self._tk.decode(list(ids), skip_special_tokens=skip_special_tokens)
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        return self._tk.token_to_id(token)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tk.get_vocab_size()
+
+    def decode_stream(self, skip_special_tokens: bool = True) -> "DecodeStream":
+        return DecodeStream(self, skip_special_tokens=skip_special_tokens)
+
+
+class DecodeStream:
+    """Incremental detokenizer.
+
+    Decodes a growing id sequence and only emits text once it is stable: if the
+    freshly decoded suffix ends in U+FFFD (a partial UTF-8 sequence from a split
+    multi-byte token), emission waits for more tokens.
+    """
+
+    def __init__(self, tokenizer: HFTokenizer, skip_special_tokens: bool = True):
+        self._tk = tokenizer
+        self._skip_special = skip_special_tokens
+        self._ids: list[int] = []
+        self._prefix_offset = 0
+        self._read_offset = 0
+
+    def step(self, token_id: int) -> Optional[str]:
+        """Feed one token id; return newly stable text (or None)."""
+        self._ids.append(token_id)
+        prefix_text = self._tk.decode(
+            self._ids[self._prefix_offset : self._read_offset], self._skip_special
+        )
+        full_text = self._tk.decode(self._ids[self._prefix_offset :], self._skip_special)
+        if full_text.endswith("�"):
+            # partial multi-byte sequence: hold until complete
+            return None
+        new_text = full_text[len(prefix_text) :]
+        self._prefix_offset = self._read_offset
+        self._read_offset = len(self._ids)
+        return new_text if new_text else None
+
+
+class JailState(str, enum.Enum):
+    OPEN = "open"  # text flows freely
+    JAILED = "jailed"  # partial stop-sequence match held back
+    STOPPED = "stopped"  # full stop-sequence matched; stream complete
+
+
+@dataclass
+class StopDecision:
+    text: Optional[str]  # text safe to emit now (None = nothing new)
+    stopped: bool  # a stop sequence fully matched
+    stop_token: bool = False  # stopped because of a stop *token id*
+
+
+class StopSequenceDecoder:
+    """Streaming decode with hidden stop sequences.
+
+    Combines a :class:`DecodeStream` with stop-string matching. Text that could
+    be the beginning of a stop string is "jailed" (withheld); once the match
+    fails it is released, once it completes the stream stops and the stop text
+    itself is never emitted. Reference: StopSequenceDecoder jail states
+    (lib/llm/src/tokenizers.rs).
+    """
+
+    def __init__(
+        self,
+        tokenizer: HFTokenizer,
+        stop_sequences: Sequence[str] = (),
+        stop_token_ids: Sequence[int] = (),
+        hidden: bool = True,
+        skip_special_tokens: bool = True,
+    ):
+        self._decode = DecodeStream(tokenizer, skip_special_tokens)
+        self._stops = [s for s in stop_sequences if s]
+        self._stop_ids = set(stop_token_ids)
+        self._hidden = hidden
+        self._pending = ""  # jailed text
+        self._state = JailState.OPEN
+
+    @property
+    def state(self) -> JailState:
+        return self._state
+
+    def step(self, token_id: int) -> StopDecision:
+        if self._state is JailState.STOPPED:
+            return StopDecision(text=None, stopped=True)
+
+        if token_id in self._stop_ids:
+            self._state = JailState.STOPPED
+            # release whatever was jailed (it was not a stop string after all,
+            # but the request ended on a stop token)
+            text = self._pending or None
+            self._pending = ""
+            return StopDecision(text=text, stopped=True, stop_token=True)
+
+        piece = self._decode.step(token_id)
+        if piece is None:
+            return StopDecision(text=None, stopped=False)
+
+        buf = self._pending + piece
+
+        if self._stops:
+            # full match anywhere in the buffer?
+            earliest = -1
+            for s in self._stops:
+                idx = buf.find(s)
+                if idx != -1 and (earliest == -1 or idx < earliest):
+                    earliest = idx
+            if earliest != -1:
+                self._state = JailState.STOPPED
+                self._pending = ""
+                emit = buf[:earliest] if self._hidden else buf
+                return StopDecision(text=emit or None, stopped=True)
+
+            # partial match at the tail → jail that suffix
+            jail_len = _longest_stop_prefix_suffix(buf, self._stops)
+            if jail_len > 0:
+                emit = buf[:-jail_len]
+                self._pending = buf[-jail_len:]
+                self._state = JailState.JAILED
+                return StopDecision(text=emit or None, stopped=False)
+
+        self._pending = ""
+        self._state = JailState.OPEN
+        return StopDecision(text=buf or None, stopped=False)
+
+    def flush(self) -> Optional[str]:
+        """Release any jailed text at end of stream (no stop ever matched)."""
+        text, self._pending = self._pending, ""
+        return text or None
+
+
+def _longest_stop_prefix_suffix(buf: str, stops: Sequence[str]) -> int:
+    """Length of the longest buffer-suffix that is a proper prefix of any stop."""
+    best = 0
+    for s in stops:
+        max_k = min(len(buf), len(s) - 1)
+        for k in range(max_k, best, -1):
+            if buf.endswith(s[:k]):
+                best = k
+                break
+    return best

@@ -49,15 +49,24 @@ def init(level: str | None = None) -> None:
             root_level = p
 
     handler = logging.StreamHandler(sys.stderr)
-    if os.environ.get("DYN_TPU_LOGGING_JSONL", "").lower() in {"1", "true", "yes"}:
+    from .config import env_bool
+
+    if env_bool("LOGGING_JSONL", False):
         handler.setFormatter(JsonlFormatter())
     else:
         handler.setFormatter(
             logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
         )
+    def _resolve_level(name: str, source: str) -> int:
+        mapped = {"trace": "DEBUG", "warn": "WARNING"}.get(name.lower(), name.upper())
+        resolved = logging.getLevelName(mapped)
+        if not isinstance(resolved, int):
+            raise ValueError(f"unknown log level {name!r} in {source}")
+        return resolved
+
     root = logging.getLogger()
     root.addHandler(handler)
-    root.setLevel(root_level.upper())
+    root.setLevel(_resolve_level(root_level, "DYN_TPU_LOG"))
     for mod, lvl in overrides.items():
-        logging.getLogger(mod).setLevel(lvl.upper())
+        logging.getLogger(mod).setLevel(_resolve_level(lvl, f"DYN_TPU_LOG ({mod})"))
     _INITIALIZED = True

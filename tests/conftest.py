@@ -20,6 +20,15 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running test")
 
 
+@pytest.fixture(scope="session")
+def model_dir(tmp_path_factory):
+    """HF-layout tiny model directory (tokenizer + config), built once."""
+    from .fixtures import build_model_dir
+
+    path = tmp_path_factory.mktemp("tiny-llama")
+    return build_model_dir(str(path))
+
+
 @pytest.fixture
 def run():
     """Run a coroutine to completion on a fresh event loop."""

@@ -1,0 +1,85 @@
+"""Self-contained test fixtures: a tiny byte-level BPE tokenizer and an
+HF-layout model directory (config.json + tokenizer_config.json + tokenizer.json),
+built programmatically so tests need no network or checked-in binary blobs.
+
+Mirrors the reference's checked-in sample-model fixtures
+(lib/llm/tests/data/sample-models/) without copying them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "{{ '<|' + message['role'] + '|>' }}{{ message['content'] }}{{ eos_token }}"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|assistant|>{% endif %}"
+)
+
+_CORPUS = [
+    "hello world this is a tiny tokenizer for tests",
+    "the quick brown fox jumps over the lazy dog",
+    "streaming tokens over the response plane",
+    "café naïve résumé 你好世界 こんにちは",
+    "```python\nprint('hi')\n```",
+    "STOP sequences and <|assistant|> markers",
+    "0123456789 !@#$%^&*()",
+]
+
+
+def build_tokenizer():
+    """Train a tiny byte-level BPE tokenizer in-process."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, decoders, trainers
+
+    tk = Tokenizer(models.BPE(unk_token=None))
+    tk.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tk.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=512,
+        special_tokens=["<s>", "</s>", "<|user|>", "<|assistant|>", "<|system|>"],
+        show_progress=False,
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    tk.train_from_iterator(_CORPUS, trainer)
+    return tk
+
+
+def build_model_dir(path: str, n_layers: int = 2, hidden: int = 64) -> str:
+    """Write an HF-layout model directory with the tiny tokenizer."""
+    os.makedirs(path, exist_ok=True)
+    tk = build_tokenizer()
+    tk.save(os.path.join(path, "tokenizer.json"))
+
+    eos_id = tk.token_to_id("</s>")
+    bos_id = tk.token_to_id("<s>")
+    config = {
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": "llama",
+        "vocab_size": tk.get_vocab_size(),
+        "hidden_size": hidden,
+        "intermediate_size": hidden * 4,
+        "num_hidden_layers": n_layers,
+        "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "head_dim": hidden // 4,
+        "max_position_embeddings": 2048,
+        "rms_norm_eps": 1e-5,
+        "rope_theta": 10000.0,
+        "bos_token_id": bos_id,
+        "eos_token_id": eos_id,
+        "tie_word_embeddings": False,
+    }
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(config, f, indent=1)
+
+    tok_cfg = {
+        "bos_token": "<s>",
+        "eos_token": "</s>",
+        "chat_template": CHAT_TEMPLATE,
+        "model_max_length": 2048,
+    }
+    with open(os.path.join(path, "tokenizer_config.json"), "w") as f:
+        json.dump(tok_cfg, f, indent=1)
+    return path

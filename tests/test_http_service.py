@@ -1,0 +1,157 @@
+"""HTTP OpenAI service: real aiohttp server + client, SSE + unary + metrics.
+
+Mirrors lib/llm/tests/http-service.rs:41-186 (CounterEngine, Prometheus
+assertions, SSE behavior).
+"""
+
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.llm.engines import EchoEngineFull
+from dynamo_tpu.llm.http.service import HttpService, ModelManager
+
+
+@pytest.fixture
+def service():
+    manager = ModelManager()
+    engine = EchoEngineFull(delay_s=0.0)
+    manager.add_chat_model("echo", engine)
+    manager.add_completions_model("echo", engine)
+    return HttpService(manager, host="127.0.0.1", port=0)
+
+
+async def _with_service(service, fn):
+    port = await service.start()
+    try:
+        async with aiohttp.ClientSession() as session:
+            return await fn(session, f"http://127.0.0.1:{port}")
+    finally:
+        await service.stop()
+
+
+def test_models_listing(service, run):
+    async def fn(session, base):
+        async with session.get(f"{base}/v1/models") as resp:
+            assert resp.status == 200
+            body = await resp.json()
+            assert [m["id"] for m in body["data"]] == ["echo"]
+
+    run(_with_service(service, fn))
+
+
+def test_health_and_live(service, run):
+    async def fn(session, base):
+        async with session.get(f"{base}/health") as resp:
+            assert (await resp.json())["status"] == "healthy"
+        async with session.get(f"{base}/live") as resp:
+            assert (await resp.json())["live"] is True
+
+    run(_with_service(service, fn))
+
+
+def test_unary_chat(service, run):
+    async def fn(session, base):
+        async with session.post(
+            f"{base}/v1/chat/completions",
+            json={
+                "model": "echo",
+                "messages": [{"role": "user", "content": "hello world again"}],
+            },
+        ) as resp:
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["object"] == "chat.completion"
+            assert body["choices"][0]["message"]["content"] == "hello world again"
+            assert body["choices"][0]["finish_reason"] == "stop"
+
+    run(_with_service(service, fn))
+
+
+def test_streaming_chat_sse(service, run):
+    async def fn(session, base):
+        async with session.post(
+            f"{base}/v1/chat/completions",
+            json={
+                "model": "echo",
+                "messages": [{"role": "user", "content": "one two three"}],
+                "stream": True,
+            },
+        ) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/event-stream")
+            raw = (await resp.read()).decode()
+        frames = [f for f in raw.split("\n\n") if f.strip()]
+        assert frames[-1] == "data: [DONE]"
+        texts = []
+        for f in frames[:-1]:
+            assert f.startswith("data: ")
+            chunk = json.loads(f[len("data: "):])
+            for ch in chunk["choices"]:
+                piece = ch.get("delta", {}).get("content")
+                if piece:
+                    texts.append(piece)
+        assert "".join(texts) == "one two three"
+
+    run(_with_service(service, fn))
+
+
+def test_unknown_model_404(service, run):
+    async def fn(session, base):
+        async with session.post(
+            f"{base}/v1/chat/completions",
+            json={"model": "nope", "messages": [{"role": "user", "content": "x"}]},
+        ) as resp:
+            assert resp.status == 404
+            assert "not found" in (await resp.json())["error"]["message"]
+
+    run(_with_service(service, fn))
+
+
+def test_invalid_body_400(service, run):
+    async def fn(session, base):
+        async with session.post(
+            f"{base}/v1/chat/completions", data=b"not json"
+        ) as resp:
+            assert resp.status == 400
+        async with session.post(
+            f"{base}/v1/chat/completions", json={"model": "echo"}
+        ) as resp:  # missing messages
+            assert resp.status == 400
+
+    run(_with_service(service, fn))
+
+
+def test_metrics_counters(service, run):
+    async def fn(session, base):
+        for _ in range(3):
+            async with session.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "echo", "messages": [{"role": "user", "content": "hi"}]},
+            ) as resp:
+                assert resp.status == 200
+        async with session.get(f"{base}/metrics") as resp:
+            text = await resp.text()
+        assert (
+            'dynamo_frontend_requests_total{endpoint="chat/completions",model="echo",'
+            'request_type="unary",status="success"} 3' in text
+        )
+        assert "dynamo_frontend_request_duration_seconds_count" in text
+        assert 'dynamo_frontend_inflight_requests{model="echo"} 0' in text
+
+    run(_with_service(service, fn))
+
+
+def test_completions_endpoint(service, run):
+    async def fn(session, base):
+        async with session.post(
+            f"{base}/v1/completions",
+            json={"model": "echo", "prompt": "alpha beta"},
+        ) as resp:
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["object"] == "text_completion"
+            assert body["choices"][0]["text"] == "alpha beta"
+
+    run(_with_service(service, fn))

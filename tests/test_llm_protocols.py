@@ -1,0 +1,152 @@
+"""SSE codec, OpenAI protocol types, aggregators.
+
+Mirrors the reference's aggregator + SSE fixture tests
+(lib/llm/tests/{aggregators.rs,openai_completions.rs}, protocols/codec.rs tests).
+"""
+
+import json
+
+import pytest
+
+from dynamo_tpu.runtime.annotated import Annotated
+from dynamo_tpu.llm.protocols.sse import DONE_SENTINEL, SseDecoder, SseMessage
+from dynamo_tpu.llm.protocols.openai import (
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    ChatChunkChoice,
+    ChatDelta,
+    CompletionChunk,
+    CompletionChoice,
+    aggregate_chat_chunks,
+    aggregate_completion_chunks,
+)
+
+
+class TestSse:
+    def test_roundtrip_data(self):
+        msg = SseMessage(data=json.dumps({"x": 1}), id="r1")
+        encoded = msg.encode()
+        decoder = SseDecoder()
+        out = decoder.feed_lines(encoded.split("\n") + [""])
+        assert len(out) == 1
+        assert json.loads(out[0].data) == {"x": 1}
+        assert out[0].id == "r1"
+
+    def test_multiline_data_concatenates(self):
+        decoder = SseDecoder()
+        msgs = decoder.feed_lines(["data: line1", "data: line2", ""])
+        assert msgs[0].data == "line1\nline2"
+
+    def test_comment_and_event(self):
+        decoder = SseDecoder()
+        msgs = decoder.feed_lines([": keepalive", "event: error", "data: oops", ""])
+        assert msgs[0].event == "error"
+        assert msgs[0].comments == ["keepalive"]
+
+    def test_done_sentinel(self):
+        decoder = SseDecoder()
+        msgs = decoder.feed_lines([f"data: {DONE_SENTINEL}", ""])
+        assert msgs[0].is_done
+
+    def test_annotated_roundtrip(self):
+        ann = Annotated(data={"tok": "hi"}, event="note", id="9", comment=["c"])
+        msg = SseMessage.from_annotated(ann)
+        back = msg.to_annotated()
+        assert back.data == {"tok": "hi"}
+        assert back.event == "note"
+        assert back.comment == ["c"]
+
+    def test_multiple_messages_stream(self):
+        decoder = SseDecoder()
+        lines = ["data: 1", "", "data: 2", "", ": ping", "", "data: 3", ""]
+        msgs = decoder.feed_lines(lines)
+        assert [m.data for m in msgs] == ["1", "2", None, "3"]
+
+
+class TestOpenAITypes:
+    def test_chat_request_parsing(self):
+        req = ChatCompletionRequest.model_validate(
+            {
+                "model": "m",
+                "messages": [{"role": "user", "content": "hi"}],
+                "stop": "END",
+                "max_completion_tokens": 5,
+                "nvext": {"ignore_eos": True},
+            }
+        )
+        assert req.stop_list() == ["END"]
+        assert req.effective_max_tokens() == 5
+        assert req.nvext.ignore_eos is True
+
+    def test_content_parts(self):
+        req = ChatCompletionRequest.model_validate(
+            {
+                "model": "m",
+                "messages": [
+                    {
+                        "role": "user",
+                        "content": [
+                            {"type": "text", "text": "a"},
+                            {"type": "text", "text": "b"},
+                        ],
+                    }
+                ],
+            }
+        )
+        assert req.messages[0].text_content() == "ab"
+
+    def test_aggregate_chat(self):
+        chunks = [
+            ChatCompletionChunk(
+                id="c1",
+                model="m",
+                choices=[ChatChunkChoice(delta=ChatDelta(role="assistant", content="Hel"))],
+            ),
+            ChatCompletionChunk(
+                id="c1", model="m", choices=[ChatChunkChoice(delta=ChatDelta(content="lo"))]
+            ),
+            ChatCompletionChunk(
+                id="c1", model="m", choices=[ChatChunkChoice(finish_reason="stop")]
+            ),
+        ]
+        full = aggregate_chat_chunks(chunks)
+        assert full.choices[0].message.content == "Hello"
+        assert full.choices[0].finish_reason == "stop"
+        assert full.id == "c1"
+
+    def test_aggregate_chat_multi_choice(self):
+        chunks = [
+            ChatCompletionChunk(
+                id="c",
+                model="m",
+                choices=[
+                    ChatChunkChoice(index=0, delta=ChatDelta(content="a")),
+                    ChatChunkChoice(index=1, delta=ChatDelta(content="x")),
+                ],
+            ),
+            ChatCompletionChunk(
+                id="c",
+                model="m",
+                choices=[
+                    ChatChunkChoice(index=1, delta=ChatDelta(content="y"), finish_reason="stop"),
+                    ChatChunkChoice(index=0, delta=ChatDelta(content="b"), finish_reason="stop"),
+                ],
+            ),
+        ]
+        full = aggregate_chat_chunks(chunks)
+        assert [c.message.content for c in full.choices] == ["ab", "xy"]
+
+    def test_aggregate_completions(self):
+        chunks = [
+            CompletionChunk(id="c", model="m", choices=[CompletionChoice(text="foo")]),
+            CompletionChunk(
+                id="c", model="m", choices=[CompletionChoice(text="bar", finish_reason="length")]
+            ),
+        ]
+        full = aggregate_completion_chunks(chunks)
+        assert full.choices[0].text == "foobar"
+        assert full.choices[0].finish_reason == "length"
+
+    def test_aggregate_empty_raises(self):
+        with pytest.raises(ValueError):
+            aggregate_chat_chunks([])

@@ -1,0 +1,188 @@
+"""Preprocessor, tokenizer streaming decode, stop jail, full pipeline to chunks.
+
+Mirrors lib/llm/tests/preprocessor.rs (template goldens) and backend.rs behavior.
+"""
+
+import pytest
+
+from dynamo_tpu.llm.engines import EchoEngineCore
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import (
+    ChatPreprocessorOperator,
+    DetokenizeOperator,
+    OpenAIPreprocessor,
+)
+from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest, aggregate_chat_chunks
+from dynamo_tpu.llm.tokenizer import HFTokenizer, StopSequenceDecoder
+from dynamo_tpu.runtime import Annotated, Context, Pipeline, collect
+
+
+@pytest.fixture(scope="module")
+def card(model_dir):
+    return ModelDeploymentCard.from_local_path(model_dir)
+
+
+@pytest.fixture(scope="module")
+def tokenizer(card):
+    return HFTokenizer.from_file(card.tokenizer_file)
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    from .fixtures import build_model_dir
+
+    return build_model_dir(str(tmp_path_factory.mktemp("tiny-llama-pre")))
+
+
+class TestModelCard:
+    def test_from_local_path(self, card):
+        assert card.chat_template
+        assert card.context_length == 2048
+        assert card.eos_token == "</s>"
+        assert card.eos_token_ids  # from config.json
+        assert card.mdcsum
+        # checksum is stable
+        assert card.checksum() == card.mdcsum
+
+    def test_roundtrip(self, card):
+        d = card.to_dict()
+        back = ModelDeploymentCard.from_dict(d)
+        assert back.mdcsum == card.mdcsum
+
+
+class TestPromptTemplate:
+    def test_render_chat(self, card):
+        pre = OpenAIPreprocessor(card)
+        req = ChatCompletionRequest.model_validate(
+            {
+                "model": "tiny",
+                "messages": [
+                    {"role": "system", "content": "be brief"},
+                    {"role": "user", "content": "hello"},
+                ],
+            }
+        )
+        out = pre.preprocess_chat(req)
+        prompt = out._formatted_prompt
+        assert prompt == "<|system|>be brief</s><|user|>hello</s><|assistant|>"
+        assert out.token_ids
+        assert out.stop_conditions.max_tokens is not None
+
+    def test_max_tokens_clamped_to_context(self, card):
+        pre = OpenAIPreprocessor(card)
+        req = ChatCompletionRequest.model_validate(
+            {"model": "t", "messages": [{"role": "user", "content": "hi"}]}
+        )
+        out = pre.preprocess_chat(req)
+        assert out.stop_conditions.max_tokens <= card.context_length
+
+
+class TestDecodeStream:
+    def test_incremental_matches_full(self, tokenizer):
+        text = "the quick brown fox jumps over the lazy dog"
+        ids = tokenizer.encode(text)
+        stream = tokenizer.decode_stream()
+        parts = [p for p in (stream.step(t) for t in ids) if p]
+        assert "".join(parts) == tokenizer.decode(ids)
+
+    def test_multibyte_utf8_held_until_complete(self, tokenizer):
+        text = "café 你好"
+        ids = tokenizer.encode(text)
+        stream = tokenizer.decode_stream()
+        parts = [p for p in (stream.step(t) for t in ids) if p]
+        joined = "".join(parts)
+        assert "�" not in joined
+        assert joined == tokenizer.decode(ids)
+
+
+class TestStopJail:
+    def test_stop_string_hidden(self, tokenizer):
+        text = "hello STOP world"
+        ids = tokenizer.encode(text)
+        dec = StopSequenceDecoder(tokenizer, stop_sequences=["STOP"])
+        out = []
+        stopped = False
+        for t in ids:
+            d = dec.step(t)
+            if d.text:
+                out.append(d.text)
+            if d.stopped:
+                stopped = True
+                break
+        assert stopped
+        joined = "".join(out)
+        assert "STOP" not in joined
+        assert "world" not in joined
+        assert joined.startswith("hello")
+
+    def test_partial_match_released(self, tokenizer):
+        # "ST" looks like the start of "STOP" but never completes
+        text = "hello ST again"
+        ids = tokenizer.encode(text)
+        dec = StopSequenceDecoder(tokenizer, stop_sequences=["STOP"])
+        out = []
+        for t in ids:
+            d = dec.step(t)
+            if d.text:
+                out.append(d.text)
+            assert not d.stopped
+        tail = dec.flush()
+        if tail:
+            out.append(tail)
+        assert "".join(out) == tokenizer.decode(ids)
+
+    def test_stop_token_id(self, tokenizer):
+        eos = tokenizer.token_to_id("</s>")
+        dec = StopSequenceDecoder(tokenizer, stop_token_ids=[eos])
+        ids = tokenizer.encode("hi")
+        for t in ids:
+            assert not dec.step(t).stopped
+        d = dec.step(eos)
+        assert d.stopped and d.stop_token
+
+
+class TestFullPipeline:
+    def test_chat_to_chunks_via_echo(self, card, run):
+        """OpenAI chat request → preprocess → echo engine → detokenize → chunks."""
+        pre = OpenAIPreprocessor(card)
+        engine = (
+            Pipeline()
+            .link(ChatPreprocessorOperator(pre))
+            .link(DetokenizeOperator(card, pre.tokenizer))
+            .link_engine(EchoEngineCore(delay_s=0.0))
+        )
+        req = ChatCompletionRequest.model_validate(
+            {
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "hello world"}],
+                "stream": True,
+            }
+        )
+
+        items = run(collect(engine.generate(Context(req))))
+        assert all(isinstance(a, Annotated) for a in items)
+        chunks = [a.data for a in items if a.data is not None]
+        full = aggregate_chat_chunks(chunks)
+        # echo replays the rendered prompt (modulo special tokens)
+        assert "hello world" in full.choices[0].message.content
+        assert full.choices[0].finish_reason == "stop"
+
+    def test_annotations_emitted(self, card, run):
+        pre = OpenAIPreprocessor(card)
+        engine = (
+            Pipeline()
+            .link(ChatPreprocessorOperator(pre))
+            .link(DetokenizeOperator(card, pre.tokenizer))
+            .link_engine(EchoEngineCore(delay_s=0.0))
+        )
+        req = ChatCompletionRequest.model_validate(
+            {
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "hi"}],
+                "nvext": {"annotations": ["formatted_prompt", "token_ids"]},
+            }
+        )
+        items = run(collect(engine.generate(Context(req))))
+        events = [a.event for a in items if a.event]
+        assert "formatted_prompt" in events
+        assert "token_ids" in events
