@@ -32,8 +32,8 @@ from ..llm.preprocessor import (
     DetokenizeOperator,
     OpenAIPreprocessor,
 )
-from ..llm.protocols.openai import ChatCompletionRequest, aggregate_chat_chunks
-from ..runtime import Context, Pipeline, collect
+from ..llm.protocols.openai import ChatCompletionRequest
+from ..runtime import Context, Pipeline
 from ..runtime.logging_util import init as init_logging
 
 logger = logging.getLogger(__name__)
@@ -70,11 +70,23 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def build_engine(out_spec: str, flags: argparse.Namespace):
-    """Build the OpenAI-level engine for `out=<spec>`.
+def _token_pipeline(card: ModelDeploymentCard, core_engine, chat: bool):
+    """OpenAI request → preprocess → detokenize → token-level core engine."""
+    pre = OpenAIPreprocessor(card)
+    return (
+        Pipeline()
+        .link(ChatPreprocessorOperator(pre, chat=chat))
+        .link(DetokenizeOperator(card, pre.tokenizer))
+        .link_engine(core_engine)
+    )
 
-    Returns (engine, model_name). The engine takes OpenAI requests and yields
-    Annotated chunk dicts.
+
+def build_engine(out_spec: str, flags: argparse.Namespace):
+    """Build the OpenAI-level engines for `out=<spec>`.
+
+    Returns (chat_engine, completions_engine, model_name). Engines take OpenAI
+    requests and yield Annotated chunk dicts; either may be None if the backend
+    doesn't support that endpoint.
     """
     card: Optional[ModelDeploymentCard] = None
     if flags.model_path:
@@ -82,30 +94,31 @@ def build_engine(out_spec: str, flags: argparse.Namespace):
     model_name = flags.model_name or (card.display_name if card else out_spec)
 
     if out_spec == "echo_full":
-        return EchoEngineFull(), model_name
+        engine = EchoEngineFull()
+        return engine, engine, model_name
 
     if out_spec == "echo_core":
         if card is None:
             raise SystemExit("out=echo_core requires --model-path (tokenizer needed)")
-        pre = OpenAIPreprocessor(card)
-        engine = (
-            Pipeline()
-            .link(ChatPreprocessorOperator(pre))
-            .link(DetokenizeOperator(card, pre.tokenizer))
-            .link_engine(EchoEngineCore())
+        return (
+            _token_pipeline(card, EchoEngineCore(), chat=True),
+            _token_pipeline(card, EchoEngineCore(), chat=False),
+            model_name,
         )
-        return engine, model_name
 
     if out_spec == "jax":
         if card is None:
             raise SystemExit("out=jax requires --model-path")
-        from ..engine_jax import build_jax_serving_engine
+        try:
+            from ..engine_jax import build_jax_serving_engine
+        except ImportError as e:
+            raise SystemExit(f"out=jax unavailable: {e}")
 
         extra = {}
         if flags.extra_engine_args:
             with open(flags.extra_engine_args) as f:
                 extra = json.load(f)
-        engine = build_jax_serving_engine(
+        core = build_jax_serving_engine(
             card,
             max_batch_size=flags.max_batch_size,
             kv_block_size=flags.kv_block_size,
@@ -113,23 +126,32 @@ def build_engine(out_spec: str, flags: argparse.Namespace):
             tensor_parallel_size=flags.tensor_parallel_size,
             **extra,
         )
-        return engine, model_name
+        return (
+            _token_pipeline(card, core, chat=True),
+            _token_pipeline(card, core, chat=False),
+            model_name,
+        )
 
     if out_spec.startswith("dyn://"):
-        from ..runtime.distributed import DistributedRuntime, parse_endpoint_path
+        try:
+            from ..runtime.distributed import DistributedRuntime, parse_endpoint_path
+        except ImportError as e:
+            raise SystemExit(f"out=dyn:// unavailable: {e}")
 
         ns, comp, ep = parse_endpoint_path(out_spec)
         drt = DistributedRuntime.from_settings(statestore_url=flags.statestore)
         client = drt.namespace(ns).component(comp).endpoint(ep).client(flags.router_mode)
-        return client, model_name
+        return client, client, model_name
 
     raise SystemExit(f"unknown out= engine: {out_spec!r}")
 
 
-async def run_http(engine, model_name: str, flags: argparse.Namespace) -> None:
+async def run_http(chat_engine, completions_engine, model_name: str, flags: argparse.Namespace) -> None:
     manager = ModelManager()
-    manager.add_chat_model(model_name, engine)
-    manager.add_completions_model(model_name, engine)
+    if chat_engine is not None:
+        manager.add_chat_model(model_name, chat_engine)
+    if completions_engine is not None:
+        manager.add_completions_model(model_name, completions_engine)
     service = HttpService(manager, host=flags.host, port=flags.port)
     logger.info("serving model %r on port %d", model_name, flags.port)
     await service.run()
@@ -234,7 +256,10 @@ async def run_batch(engine, model_name: str, batch_file: str) -> None:
 
 async def run_endpoint(engine, model_name: str, in_spec: str, flags: argparse.Namespace) -> None:
     """Register as a distributed worker on dyn://ns.comp.ep."""
-    from ..runtime.distributed import DistributedRuntime, parse_endpoint_path
+    try:
+        from ..runtime.distributed import DistributedRuntime, parse_endpoint_path
+    except ImportError as e:
+        raise SystemExit(f"in=dyn:// unavailable: {e}")
 
     ns, comp, ep = parse_endpoint_path(in_spec)
     drt = DistributedRuntime.from_settings(statestore_url=flags.statestore)
@@ -250,16 +275,16 @@ async def amain(argv: list[str]) -> None:
     init_logging()
     in_spec, out_spec, rest = parse_io(argv)
     flags = build_parser().parse_args(rest)
-    engine, model_name = build_engine(out_spec, flags)
+    chat_engine, completions_engine, model_name = build_engine(out_spec, flags)
 
     if in_spec == "http":
-        await run_http(engine, model_name, flags)
+        await run_http(chat_engine, completions_engine, model_name, flags)
     elif in_spec == "text":
-        await run_text(engine, model_name)
+        await run_text(chat_engine, model_name)
     elif in_spec.startswith("batch:"):
-        await run_batch(engine, model_name, in_spec[len("batch:"):])
+        await run_batch(chat_engine, model_name, in_spec[len("batch:"):])
     elif in_spec.startswith("dyn://"):
-        await run_endpoint(engine, model_name, in_spec, flags)
+        await run_endpoint(chat_engine, model_name, in_spec, flags)
     elif in_spec == "none":
         await asyncio.Event().wait()
     else:

@@ -1,0 +1,23 @@
+"""KV-cache domain: token-block hashing, block manager, offload tiers.
+
+Reference parity: dynamo's `lib/tokens` crate (sequence-aware chained block
+hashing, lib/tokens/src/lib.rs:44-58) and `lib/llm/src/kv/` (block manager).
+"""
+
+from dynamo_tpu.kv.tokens import (
+    BLOCK_HASH_SEED,
+    TokenBlock,
+    TokenBlockSequence,
+    compute_block_hash,
+    compute_block_hashes_for_seq,
+    compute_local_block_hash,
+)
+
+__all__ = [
+    "BLOCK_HASH_SEED",
+    "TokenBlock",
+    "TokenBlockSequence",
+    "compute_block_hash",
+    "compute_block_hashes_for_seq",
+    "compute_local_block_hash",
+]

@@ -1,0 +1,173 @@
+"""Sequence-aware chained block hashing over token ids.
+
+This is the canonical content-addressing scheme for KV-cache blocks, shared by
+the worker-side block allocator (which publishes stored/removed events) and the
+router-side prefix indexer (which matches incoming requests against them). Both
+sides MUST agree bit-for-bit, so the scheme is defined once, here.
+
+Scheme (capability parity with dynamo's `lib/tokens/src/lib.rs:44-58,277-300`
+and `lib/llm/src/kv_router/indexer.rs:123`, re-derived not copied):
+
+- tokens are serialized as little-endian u32
+- ``local_hash  = xxh3_64(token_bytes, seed=SEED)`` — identifies block content
+  alone (what an engine's prefix cache keys on)
+- ``sequence_hash = xxh3_64(parent_sequence_hash_le8 || token_bytes, seed=SEED)``
+  — chains from the previous block, so it identifies the content *and its
+  position in the prefix*; the root block chains from the (optional) salt.
+
+The chain makes prefix matching a simple hash-walk: two sequences share a
+prefix of k blocks iff their first k sequence hashes are equal.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import xxhash
+
+# Fixed seed so every process in the deployment derives identical hashes
+# (reference uses xxh3 with seed 1337 in kv_router/indexer.rs:123).
+BLOCK_HASH_SEED = 1337
+
+
+def _token_bytes(tokens: Sequence[int]) -> bytes:
+    return struct.pack(f"<{len(tokens)}I", *tokens)
+
+
+def compute_local_block_hash(tokens: Sequence[int]) -> int:
+    """Content-only hash of one block of tokens."""
+    return xxhash.xxh3_64_intdigest(_token_bytes(tokens), seed=BLOCK_HASH_SEED)
+
+
+def compute_block_hash(tokens: Sequence[int], parent_hash: Optional[int] = None) -> int:
+    """Sequence-aware hash: chains the parent block's sequence hash."""
+    prefix = struct.pack("<Q", parent_hash) if parent_hash is not None else b""
+    return xxhash.xxh3_64_intdigest(prefix + _token_bytes(tokens), seed=BLOCK_HASH_SEED)
+
+
+def compute_block_hashes_for_seq(
+    tokens: Sequence[int], block_size: int, salt: Optional[bytes] = None
+) -> List[int]:
+    """Sequence hashes for every *full* block of ``tokens``.
+
+    This is what the router computes per request to probe the prefix index
+    (reference: compute_block_hash_for_seq, kv_router/indexer.rs:123).
+    """
+    hashes: List[int] = []
+    parent: Optional[int] = None
+    if salt:
+        parent = xxhash.xxh3_64_intdigest(salt, seed=BLOCK_HASH_SEED)
+    for start in range(0, len(tokens) - block_size + 1, block_size):
+        parent = compute_block_hash(tokens[start : start + block_size], parent)
+        hashes.append(parent)
+    return hashes
+
+
+@dataclass(frozen=True)
+class TokenBlock:
+    """One immutable, full block of tokens with its chained identity."""
+
+    tokens: Tuple[int, ...]
+    block_hash: int  # sequence-aware (chained)
+    local_hash: int  # content-only
+    parent_hash: Optional[int]  # previous block's sequence hash (None for root)
+    position: int  # block index within the sequence
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+
+class TokenBlockSequence:
+    """Splits a growing token stream into hashed, chained blocks.
+
+    Supports incremental ``extend`` (the decode loop appends one token at a
+    time) and ``truncate``. Full blocks are immutable once sealed; the tail
+    partial block is kept as a plain list until it fills.
+
+    Reference parity: TokenBlockSequence (lib/tokens/src/lib.rs:221-360).
+    """
+
+    def __init__(
+        self,
+        tokens: Optional[Iterable[int]] = None,
+        block_size: int = 64,
+        salt: Optional[bytes] = None,
+    ):
+        if block_size <= 0:
+            raise ValueError("block_size must be positive")
+        self.block_size = block_size
+        self.salt = salt
+        self._salt_hash: Optional[int] = (
+            xxhash.xxh3_64_intdigest(salt, seed=BLOCK_HASH_SEED) if salt else None
+        )
+        self._blocks: List[TokenBlock] = []
+        self._partial: List[int] = []
+        if tokens is not None:
+            self.extend(tokens)
+
+    # -- views ---------------------------------------------------------------
+
+    @property
+    def blocks(self) -> Tuple[TokenBlock, ...]:
+        return tuple(self._blocks)
+
+    @property
+    def partial_tokens(self) -> Tuple[int, ...]:
+        return tuple(self._partial)
+
+    @property
+    def tokens(self) -> List[int]:
+        out: List[int] = []
+        for b in self._blocks:
+            out.extend(b.tokens)
+        out.extend(self._partial)
+        return out
+
+    def block_hashes(self) -> List[int]:
+        return [b.block_hash for b in self._blocks]
+
+    def __len__(self) -> int:
+        return len(self._blocks) * self.block_size + len(self._partial)
+
+    # -- mutation ------------------------------------------------------------
+
+    def append(self, token: int) -> Optional[TokenBlock]:
+        """Append one token; returns the newly sealed block if one completed."""
+        self._partial.append(token)
+        if len(self._partial) == self.block_size:
+            return self._seal()
+        return None
+
+    def extend(self, tokens: Iterable[int]) -> List[TokenBlock]:
+        """Append many tokens; returns all blocks sealed along the way."""
+        sealed: List[TokenBlock] = []
+        for t in tokens:
+            b = self.append(t)
+            if b is not None:
+                sealed.append(b)
+        return sealed
+
+    def truncate(self, n_tokens: int) -> None:
+        """Shrink the sequence to ``n_tokens`` (drops sealed blocks as needed)."""
+        if n_tokens >= len(self):
+            return
+        all_tokens = self.tokens[:n_tokens]
+        self._blocks.clear()
+        self._partial.clear()
+        self.extend(all_tokens)
+
+    def _seal(self) -> TokenBlock:
+        parent = self._blocks[-1].block_hash if self._blocks else self._salt_hash
+        toks = tuple(self._partial)
+        block = TokenBlock(
+            tokens=toks,
+            block_hash=compute_block_hash(toks, parent),
+            local_hash=compute_local_block_hash(toks),
+            parent_hash=parent,
+            position=len(self._blocks),
+        )
+        self._blocks.append(block)
+        self._partial.clear()
+        return block

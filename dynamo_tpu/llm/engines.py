@@ -15,9 +15,6 @@ from ..runtime.annotated import Annotated
 from ..runtime.engine import AsyncEngine, Context
 from .protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
 
-ECHO_DELAY_ENV = "DYN_TPU_TOKEN_ECHO_DELAY_MS"
-
-
 def _echo_delay_s() -> float:
     from ..runtime.config import env_float
 

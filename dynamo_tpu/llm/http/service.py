@@ -12,6 +12,7 @@ service_v2.rs:24-130), handlers + monitor_for_disconnects (openai.rs:132-418).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import logging
 from typing import Optional
@@ -34,11 +35,7 @@ from .metrics import ServiceMetrics
 logger = logging.getLogger(__name__)
 
 
-class HttpError(Exception):
-    def __init__(self, status: int, message: str):
-        super().__init__(message)
-        self.status = status
-        self.message = message
+from ..protocols.common import HttpError  # noqa: E402  (canonical home; re-exported here)
 
 
 class ModelManager:
@@ -225,8 +222,9 @@ class HttpService:
                     payload = item.data
                 else:
                     payload = item
-                guard.mark_first_token()
-                guard.count_tokens()
+                if _chunk_has_content(payload):
+                    guard.mark_first_token()
+                    guard.count_tokens()
                 await resp.write((f"data: {json.dumps(payload)}\n\n").encode())
             else:
                 guard.mark_ok()
@@ -236,8 +234,15 @@ class HttpService:
             ctx.context.kill()
             logger.info("client disconnected, killed request %s", ctx.id)
             raise
+        except Exception as e:  # headers already sent: error must go in-band
+            logger.exception("engine error mid-stream for request %s", ctx.id)
+            ctx.context.kill()
+            msg = SseMessage(event="error", data=json.dumps({"message": str(e)}))
+            with contextlib.suppress(ConnectionError):
+                await resp.write((msg.encode() + "\n\n").encode())
+                await resp.write(f"data: {DONE_SENTINEL}\n\n".encode())
         finally:
-            with _suppress():
+            with contextlib.suppress(ConnectionError):
                 await resp.write_eof()
         return resp
 
@@ -245,6 +250,7 @@ class HttpService:
         self, engine: AsyncEngine, ctx: Context, guard, chat: bool
     ) -> web.Response:
         chunks: list[dict] = []
+        n_tokens = 0
         try:
             async for item in engine.generate(ctx):
                 if isinstance(item, Annotated):
@@ -255,15 +261,30 @@ class HttpService:
                     chunks.append(item.data)
                 else:
                     chunks.append(item)
-                guard.mark_first_token()
+                if _chunk_has_content(chunks[-1]):
+                    guard.mark_first_token()
+                    n_tokens += 1
         except HttpError as e:
             return _error_response(e.status, e.message)
         if not chunks:
             return _error_response(500, "engine produced no response")
         full = aggregate_chat_chunks(chunks) if chat else aggregate_completion_chunks(chunks)
         guard.mark_ok()
-        guard.count_tokens(sum(len(c.get("choices", [])) for c in chunks))
+        guard.count_tokens(n_tokens)
         return web.json_response(full.model_dump(exclude_none=True))
+
+
+def _chunk_has_content(payload) -> bool:
+    """True if this chunk carries generated content (a token), not just a
+    role/finish frame — keeps output-token metrics and TTFT honest."""
+    if not isinstance(payload, dict):
+        return False
+    for choice in payload.get("choices", []):
+        if (choice.get("delta") or {}).get("content"):
+            return True
+        if choice.get("text"):
+            return True
+    return False
 
 
 def _error_response(status: int, message: str) -> web.Response:
@@ -271,11 +292,3 @@ def _error_response(status: int, message: str) -> web.Response:
         {"error": {"message": message, "type": "invalid_request_error" if status < 500 else "internal_error"}},
         status=status,
     )
-
-
-class _suppress:
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        return True

@@ -26,6 +26,7 @@ from .model_card import ModelDeploymentCard
 from .protocols.common import (
     BackendOutput,
     FinishReason,
+    HttpError,
     PreprocessedRequest,
     SamplingOptions,
     StopConditions,
@@ -143,8 +144,13 @@ class OpenAIPreprocessor:
         )
         # clamp generation to the model context window
         budget = self.card.context_length - len(token_ids)
-        if max_tokens is None:
-            max_tokens = max(budget, 1)
+        if budget <= 0:
+            raise HttpError(
+                400,
+                f"prompt is {len(token_ids)} tokens but the model context window "
+                f"is {self.card.context_length}",
+            )
+        max_tokens = budget if max_tokens is None else min(max_tokens, budget)
         pre = PreprocessedRequest(
             token_ids=token_ids,
             stop_conditions=StopConditions(

@@ -14,6 +14,20 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 
+class HttpError(Exception):
+    """An error with an HTTP status, raisable from any pipeline stage.
+
+    The frontend maps it to a JSON error response (or an in-band SSE error
+    event if headers were already sent). Reference parity: HttpError in
+    lib/bindings/python (SURVEY.md §2.4).
+    """
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
 class FinishReason(str, enum.Enum):
     EOS = "eos"
     LENGTH = "length"

@@ -1,0 +1,26 @@
+"""JAX model implementations for TPU serving.
+
+Models are pure functions over explicit parameter pytrees — no framework
+module state — so they jit/shard cleanly and the serving engine controls
+every buffer. Llama covers the reference's flagship family (the reference
+serves Llama-70B-class models through vLLM; here the model IS the framework's,
+SURVEY.md §6 north star).
+"""
+
+from dynamo_tpu.models.llama import (
+    LlamaConfig,
+    LLAMA_PRESETS,
+    init_params,
+    forward,
+    make_kv_cache,
+    param_shardings,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "LLAMA_PRESETS",
+    "init_params",
+    "forward",
+    "make_kv_cache",
+    "param_shardings",
+]

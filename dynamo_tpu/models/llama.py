@@ -1,0 +1,224 @@
+"""Llama-family decoder in pure-functional JAX with paged KV cache.
+
+Design choices (TPU-first):
+- **Stacked layers + lax.scan**: all L layers' weights are stacked on a leading
+  axis and the decoder scans over them — one compiled layer body regardless of
+  depth, fast compiles even for 80-layer 70B.
+- **Paged KV in HBM**: the cache is a page pool `[L, N, bs, KVH, D]`; the model
+  writes new K/V into pages then attends through block tables (ops/attention.py),
+  so prefill, decode, and prefix-hit prefill are ONE code path with static shapes.
+- **bfloat16 matmuls on the MXU**, float32 norms/softmax/logits.
+- **Logical sharding axes** on every param (parallel/mesh.py) — Megatron-style
+  TP over heads/MLP, vocab-sharded embeddings; XLA inserts the ICI collectives.
+
+Capability parity: the reference serves this family via vLLM workers
+(SURVEY.md §2.9-2.10); here the model is framework-native.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+KVCache = Dict[str, jax.Array]  # {"k": [L,N,bs,KVH,D], "v": ...}
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+
+LLAMA_PRESETS: Dict[str, LlamaConfig] = {
+    # test-size model: tiny but structurally identical (GQA, untied head)
+    "tiny": LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16, rope_theta=10000.0,
+    ),
+    "llama3.2-1b": LlamaConfig(
+        vocab_size=128256, hidden_size=2048, intermediate_size=8192, num_layers=16,
+        num_heads=32, num_kv_heads=8, head_dim=64, tie_embeddings=True,
+    ),
+    "llama3-8b": LlamaConfig(),
+    "llama3-70b": LlamaConfig(
+        hidden_size=8192, intermediate_size=28672, num_layers=80,
+        num_heads=64, num_kv_heads=8, head_dim=128,
+    ),
+}
+
+
+# -- params ------------------------------------------------------------------
+
+def init_params(rng: jax.Array, config: LlamaConfig) -> Params:
+    """Random init with fan-in scaling; layer weights stacked on axis 0."""
+    c = config
+    keys = jax.random.split(rng, 8)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(c.dtype)
+
+    L, E, F = c.num_layers, c.hidden_size, c.intermediate_size
+    params: Params = {
+        "embed": dense(keys[0], (c.vocab_size, E), E),
+        "final_norm": jnp.ones((E,), jnp.float32),
+        "layers": {
+            "attn_norm": jnp.ones((L, E), jnp.float32),
+            "wq": dense(keys[1], (L, E, c.q_dim), E),
+            "wk": dense(keys[2], (L, E, c.kv_dim), E),
+            "wv": dense(keys[3], (L, E, c.kv_dim), E),
+            "wo": dense(keys[4], (L, c.q_dim, E), c.q_dim),
+            "mlp_norm": jnp.ones((L, E), jnp.float32),
+            "w_gate": dense(keys[5], (L, E, F), E),
+            "w_up": dense(keys[6], (L, E, F), E),
+            "w_down": dense(keys[7], (L, F, E), F),
+        },
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = dense(jax.random.fold_in(rng, 99), (E, c.vocab_size), E)
+    return params
+
+
+def param_logical_axes(config: LlamaConfig) -> Params:
+    """Logical sharding axes per param leaf (names resolved by parallel/mesh.py)."""
+    axes: Params = {
+        "embed": ("vocab", "embed"),
+        "final_norm": ("embed",),
+        "layers": {
+            "attn_norm": (None, "embed"),
+            "wq": (None, "embed", "heads"),
+            "wk": (None, "embed", "kv_heads"),
+            "wv": (None, "embed", "kv_heads"),
+            "wo": (None, "heads", "embed"),
+            "mlp_norm": (None, "embed"),
+            "w_gate": (None, "embed", "mlp"),
+            "w_up": (None, "embed", "mlp"),
+            "w_down": (None, "mlp", "embed"),
+        },
+    }
+    if not config.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def param_shardings(config: LlamaConfig, mesh) -> Params:
+    """NamedSharding pytree matching init_params' structure."""
+    from dynamo_tpu.parallel.mesh import logical_to_sharding
+
+    return jax.tree.map(
+        lambda ax: logical_to_sharding(mesh, *ax),
+        param_logical_axes(config),
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def make_kv_cache(
+    config: LlamaConfig, num_blocks: int, block_size: int, dtype: Any = None
+) -> KVCache:
+    """Allocate the paged KV pool: [layers, blocks, block_size, kv_heads, head_dim]."""
+    c = config
+    shape = (c.num_layers, num_blocks, block_size, c.num_kv_heads, c.head_dim)
+    dt = dtype or c.dtype
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+# -- math --------------------------------------------------------------------
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * weight).astype(x.dtype)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding; x: [B, T, H, D], positions: [B, T]."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)  # [D/2]
+    angles = jnp.clip(positions, 0).astype(jnp.float32)[..., None] * freqs  # [B,T,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B,T,1,D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., : d // 2], x[..., d // 2 :]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return rotated.astype(x.dtype)
+
+
+# -- forward -----------------------------------------------------------------
+
+def forward(
+    params: Params,
+    config: LlamaConfig,
+    tokens: jax.Array,  # [B, T] int32; padding rows/cols use position < 0
+    positions: jax.Array,  # [B, T] absolute positions; < 0 = padding
+    kv_cache: KVCache,  # paged pool, updated functionally
+    block_tables: jax.Array,  # [B, max_blocks]
+    *,
+    soft_cap: Optional[float] = None,
+) -> Tuple[jax.Array, KVCache]:
+    """One forward step (prefill if T>1, decode if T==1).
+
+    Writes new K/V into the paged cache, attends through block tables, returns
+    (logits [B, T, vocab] float32, updated cache). Single code path for
+    prefill/decode/prefix-hit keeps everything static-shaped under jit.
+    """
+    from dynamo_tpu.ops.attention import paged_attention, write_kv_to_pages
+
+    c = config
+    b, t = tokens.shape
+    h = params["embed"][jnp.clip(tokens, 0)]  # [B, T, E]
+
+    def layer_body(carry, xs):
+        hidden = carry
+        lp, k_page, v_page = xs  # layer params + this layer's page pool
+
+        x = rms_norm(hidden, lp["attn_norm"], c.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(b, t, c.num_heads, c.head_dim)
+        k = (x @ lp["wk"]).reshape(b, t, c.num_kv_heads, c.head_dim)
+        v = (x @ lp["wv"]).reshape(b, t, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, positions, c.rope_theta)
+        k = apply_rope(k, positions, c.rope_theta)
+
+        k_page, v_page = write_kv_to_pages(k_page, v_page, k, v, positions, block_tables)
+        attn = paged_attention(
+            q, k_page, v_page, block_tables, positions, soft_cap=soft_cap
+        )
+        attn = attn.reshape(b, t, c.q_dim) @ lp["wo"]
+        hidden = hidden + attn
+
+        x = rms_norm(hidden, lp["mlp_norm"], c.rms_norm_eps)
+        gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+        mlp = (gate * (x @ lp["w_up"])) @ lp["w_down"]
+        hidden = hidden + mlp
+        return hidden, (k_page, v_page)
+
+    h, (new_k, new_v) = jax.lax.scan(
+        layer_body, h, (params["layers"], kv_cache["k"], kv_cache["v"])
+    )
+
+    h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
+    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
+    logits = (h @ head).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}

@@ -1,0 +1,11 @@
+"""Hot ops: paged attention, KV page scatter/gather, block copy, TP relayout.
+
+Each op has a pure-jnp reference implementation (always correct, runs on any
+backend) and, where it pays, a Pallas TPU kernel selected at call time.
+These replace the reference's CUDA kernel `block_copy.cu` and its engines'
+paged-attention kernels (SURVEY.md §2.3).
+"""
+
+from dynamo_tpu.ops.attention import paged_attention, write_kv_to_pages
+
+__all__ = ["paged_attention", "write_kv_to_pages"]

@@ -1,0 +1,112 @@
+"""Paged-KV attention and KV page scatter.
+
+The KV cache is a pool of fixed-size pages ("blocks") in HBM:
+``[num_blocks, block_size, num_kv_heads, head_dim]``. A request owns a
+*block table* — the list of physical page ids backing its logical context —
+so sequences grow without reallocation and prefix-shared pages can be reused
+by many requests (the TPU equivalent of the reference's paged/prefix KV,
+SURVEY.md §2.10).
+
+All shapes are static under jit: block tables are padded to a fixed
+max-blocks-per-seq, batch is padded to fixed slot count, masks do the rest.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def write_kv_to_pages(
+    k_cache: jax.Array,  # [num_blocks, block_size, KVH, D]
+    v_cache: jax.Array,
+    k_new: jax.Array,  # [B, T, KVH, D]
+    v_new: jax.Array,
+    positions: jax.Array,  # [B, T] absolute position in sequence; < 0 = padding
+    block_tables: jax.Array,  # [B, max_blocks] physical page ids
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter new K/V vectors into their pages; padding positions are dropped."""
+    num_blocks, block_size = k_cache.shape[0], k_cache.shape[1]
+    b, t = positions.shape
+
+    logical_block = positions // block_size  # [B, T]
+    slot = positions % block_size
+    phys = jnp.take_along_axis(block_tables, jnp.clip(logical_block, 0), axis=1)  # [B, T]
+    flat_idx = phys * block_size + slot
+    # padding → out-of-range index, dropped by scatter mode="drop"
+    flat_idx = jnp.where(positions >= 0, flat_idx, num_blocks * block_size)
+
+    flat_k = k_cache.reshape(num_blocks * block_size, *k_cache.shape[2:])
+    flat_v = v_cache.reshape(num_blocks * block_size, *v_cache.shape[2:])
+    flat_k = flat_k.at[flat_idx.reshape(-1)].set(
+        k_new.reshape(b * t, *k_new.shape[2:]), mode="drop"
+    )
+    flat_v = flat_v.at[flat_idx.reshape(-1)].set(
+        v_new.reshape(b * t, *v_new.shape[2:]), mode="drop"
+    )
+    return flat_k.reshape(k_cache.shape), flat_v.reshape(v_cache.shape)
+
+
+def gather_pages(
+    cache: jax.Array,  # [num_blocks, block_size, KVH, D]
+    block_tables: jax.Array,  # [B, max_blocks]
+) -> jax.Array:
+    """Gather a request's pages into contiguous [B, max_blocks*block_size, KVH, D]."""
+    pages = cache[block_tables]  # [B, MB, bs, KVH, D]
+    b, mb, bs = pages.shape[0], pages.shape[1], pages.shape[2]
+    return pages.reshape(b, mb * bs, *pages.shape[3:])
+
+
+def paged_attention(
+    q: jax.Array,  # [B, T, H, D]
+    k_cache: jax.Array,  # [num_blocks, block_size, KVH, D]
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks]
+    q_positions: jax.Array,  # [B, T] absolute positions of queries; < 0 = padding
+    *,
+    scale: Optional[float] = None,
+    soft_cap: Optional[float] = None,
+) -> jax.Array:
+    """Causal attention of ``q`` against the paged context (reference impl).
+
+    The context for batch row b is the logical sequence laid out by its block
+    table; query at absolute position p attends to context positions <= p
+    (causal, inclusive of the just-written own position). Assumes new K/V were
+    already scattered into the cache, which unifies prefill (T>1), decode (T=1)
+    and prefix-cache-hit prefill (positions offset past the cached prefix).
+
+    Pure-jnp fallback; the Pallas TPU kernel (ops/pallas/paged_attention.py)
+    implements the same contract without materializing the gathered context.
+    """
+    b, t, h, d = q.shape
+    kvh = k_cache.shape[2]
+    if scale is None:
+        scale = d ** -0.5
+
+    k = gather_pages(k_cache, block_tables)  # [B, S, KVH, D]
+    v = gather_pages(v_cache, block_tables)
+    s = k.shape[1]
+
+    if h != kvh:  # GQA: repeat kv heads to query heads
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    scores = jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if soft_cap is not None:
+        scores = jnp.tanh(scores / soft_cap) * soft_cap
+
+    kv_pos = jnp.arange(s)[None, None, :]  # logical context positions
+    causal = kv_pos <= q_positions[:, :, None]  # [B, T, S]
+    valid_q = (q_positions >= 0)[:, :, None]
+    mask = (causal & valid_q)[:, None, :, :]  # [B, 1, T, S]
+
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # rows with no valid keys (padding queries) produce NaN → zero them
+    probs = jnp.where(mask.any(axis=-1, keepdims=True), probs, 0.0)
+    out = jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)

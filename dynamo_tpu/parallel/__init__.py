@@ -1,0 +1,16 @@
+"""Parallelism: device meshes, sharding layouts, collectives.
+
+TPU-native replacement for the reference's engine-delegated TP/PP and
+NCCL/Ray multi-node plumbing (SURVEY.md §2.12): parallelism here is
+first-class — a `jax.sharding.Mesh` with named axes and `NamedSharding`
+annotations, letting XLA insert ICI collectives.
+"""
+
+from dynamo_tpu.parallel.mesh import (
+    MeshConfig,
+    make_mesh,
+    kv_cache_sharding,
+    logical_to_sharding,
+)
+
+__all__ = ["MeshConfig", "make_mesh", "kv_cache_sharding", "logical_to_sharding"]

@@ -1,0 +1,103 @@
+"""Device mesh construction and logical→physical sharding rules.
+
+Axes (superset of the reference's capability; reference delegates TP/PP to
+engines, SURVEY.md §2.12 — here they are native):
+
+- ``dp``: data parallel — batch-slot axis of the continuous batcher
+- ``tp``: tensor parallel — attention heads / MLP intermediate
+- ``sp``: sequence/context parallel — ring-attention axis for long context
+  (a TPU-native extension; the reference has none)
+
+Pipeline parallelism is expressed as a stage dimension over params plus
+`shard_map` ppermute microbatching (see parallel/pipeline.py).
+
+The design follows the standard JAX recipe: pick a mesh, annotate shardings
+with PartitionSpec, let XLA insert the collectives over ICI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DP = "dp"
+AXIS_TP = "tp"
+AXIS_SP = "sp"
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. Total size must equal the number of devices used."""
+
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.tp * self.sp
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return (AXIS_DP, AXIS_SP, AXIS_TP)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.dp, self.sp, self.tp)
+
+
+def make_mesh(config: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh with dp as the outermost (slowest) axis and tp innermost.
+
+    tp is innermost so tensor-parallel collectives (the most latency-sensitive)
+    ride adjacent ICI links; dp crosses the slowest links.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < config.size:
+        raise ValueError(f"mesh needs {config.size} devices, have {len(devs)}")
+    grid = np.asarray(devs[: config.size]).reshape(config.shape)
+    return Mesh(grid, config.axis_names)
+
+
+# -- logical sharding rules --------------------------------------------------
+# Model code annotates arrays with *logical* axis names; this table maps them
+# to mesh axes. Unlisted logical axes are replicated.
+
+_LOGICAL_RULES = {
+    "batch": AXIS_DP,
+    "seq": AXIS_SP,
+    "heads": AXIS_TP,  # attention query heads
+    "kv_heads": AXIS_TP,  # attention kv heads (GQA)
+    "mlp": AXIS_TP,  # MLP intermediate dim
+    "vocab": AXIS_TP,  # embedding/unembedding vocab dim
+    "embed": None,  # model dim: replicated (Megatron-style TP)
+    "kv_blocks": None,  # paged-KV physical block axis: replicated across tp
+}
+
+
+def logical_to_sharding(mesh: Mesh, *logical_axes: Optional[str]) -> NamedSharding:
+    """Map a tuple of logical axis names (or None) to a NamedSharding."""
+    spec = []
+    for ax in logical_axes:
+        if ax is None:
+            spec.append(None)
+            continue
+        if ax not in _LOGICAL_RULES:
+            raise KeyError(f"unknown logical axis {ax!r}")
+        mesh_ax = _LOGICAL_RULES[ax]
+        # Don't shard over an axis the mesh doesn't have (or of size 1).
+        if mesh_ax is not None and mesh_ax in mesh.axis_names and mesh.shape[mesh_ax] > 1:
+            spec.append(mesh_ax)
+        else:
+            spec.append(None)
+    return NamedSharding(mesh, P(*spec))
+
+
+def kv_cache_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for paged KV cache [layers, blocks, block_size, kv_heads, head_dim]:
+    kv heads over tp, physical blocks replicated within a dp group."""
+    return logical_to_sharding(mesh, None, "kv_blocks", None, "kv_heads", None)

@@ -7,8 +7,18 @@ be set before jax is first imported. Hardware-requiring tests are marked `tpu`
 
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the session env pins JAX_PLATFORMS to the TPU plugin
+# (which re-registers itself at interpreter start), but the unit suite must run
+# on the virtual CPU mesh (fast, 8 devices). jax.config.update after import is
+# the only override that sticks.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 

@@ -1,0 +1,83 @@
+"""Tests for sequence-aware chained block hashing (dynamo_tpu.kv.tokens)."""
+
+import pytest
+
+from dynamo_tpu.kv.tokens import (
+    TokenBlockSequence,
+    compute_block_hash,
+    compute_block_hashes_for_seq,
+    compute_local_block_hash,
+)
+
+
+def test_local_hash_is_content_only():
+    assert compute_local_block_hash([1, 2, 3]) == compute_local_block_hash([1, 2, 3])
+    assert compute_local_block_hash([1, 2, 3]) != compute_local_block_hash([1, 2, 4])
+
+
+def test_sequence_hash_chains_parent():
+    h_root = compute_block_hash([1, 2, 3])
+    assert compute_block_hash([1, 2, 3], parent_hash=h_root) != h_root
+    # same content under different parents → different sequence hashes
+    assert compute_block_hash([4, 5], h_root) != compute_block_hash([4, 5], 999)
+
+
+def test_seq_hashes_full_blocks_only():
+    hashes = compute_block_hashes_for_seq(list(range(10)), block_size=4)
+    assert len(hashes) == 2  # 10 tokens → 2 full blocks of 4, partial of 2 ignored
+    # prefix property: first block hash matches a standalone computation
+    assert hashes[0] == compute_block_hash([0, 1, 2, 3])
+    assert hashes[1] == compute_block_hash([4, 5, 6, 7], hashes[0])
+
+
+def test_shared_prefix_shares_hashes():
+    a = compute_block_hashes_for_seq(list(range(16)), 4)
+    b = compute_block_hashes_for_seq(list(range(12)) + [99, 98, 97, 96], 4)
+    assert a[:3] == b[:3]
+    assert a[3] != b[3]
+
+
+def test_salt_perturbs_whole_chain():
+    a = compute_block_hashes_for_seq(list(range(8)), 4)
+    b = compute_block_hashes_for_seq(list(range(8)), 4, salt=b"tenant-1")
+    assert a[0] != b[0] and a[1] != b[1]
+
+
+def test_block_sequence_incremental_matches_batch():
+    tokens = list(range(23))
+    seq = TokenBlockSequence(block_size=4)
+    sealed = []
+    for t in tokens:
+        b = seq.append(t)
+        if b:
+            sealed.append(b)
+    batch = compute_block_hashes_for_seq(tokens, 4)
+    assert [b.block_hash for b in sealed] == batch
+    assert seq.block_hashes() == batch
+    assert len(seq) == 23
+    assert seq.partial_tokens == (20, 21, 22)
+    assert seq.tokens == tokens
+
+
+def test_block_sequence_truncate():
+    seq = TokenBlockSequence(list(range(20)), block_size=4)
+    seq.truncate(10)
+    assert len(seq) == 10
+    assert seq.block_hashes() == compute_block_hashes_for_seq(list(range(10)), 4)
+    # no-op when longer than current length
+    seq.truncate(100)
+    assert len(seq) == 10
+
+
+def test_positions_and_parents():
+    seq = TokenBlockSequence(list(range(12)), block_size=4)
+    blocks = seq.blocks
+    assert [b.position for b in blocks] == [0, 1, 2]
+    assert blocks[0].parent_hash is None
+    assert blocks[1].parent_hash == blocks[0].block_hash
+    assert blocks[2].parent_hash == blocks[1].block_hash
+
+
+def test_invalid_block_size():
+    with pytest.raises(ValueError):
+        TokenBlockSequence(block_size=0)

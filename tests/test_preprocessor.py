@@ -19,19 +19,13 @@ from dynamo_tpu.runtime import Annotated, Context, Pipeline, collect
 
 @pytest.fixture(scope="module")
 def card(model_dir):
+    # model_dir comes from the session-scoped conftest fixture
     return ModelDeploymentCard.from_local_path(model_dir)
 
 
 @pytest.fixture(scope="module")
 def tokenizer(card):
     return HFTokenizer.from_file(card.tokenizer_file)
-
-
-@pytest.fixture(scope="module")
-def model_dir(tmp_path_factory):
-    from .fixtures import build_model_dir
-
-    return build_model_dir(str(tmp_path_factory.mktemp("tiny-llama-pre")))
 
 
 class TestModelCard:
@@ -75,6 +69,28 @@ class TestPromptTemplate:
         )
         out = pre.preprocess_chat(req)
         assert out.stop_conditions.max_tokens <= card.context_length
+
+    def test_explicit_max_tokens_clamped(self, card):
+        from dynamo_tpu.llm.protocols.openai import CompletionRequest
+
+        pre = OpenAIPreprocessor(card)
+        req = CompletionRequest.model_validate(
+            {"model": "t", "prompt": [1, 2, 3], "max_tokens": 10_000_000}
+        )
+        out = pre.preprocess_completion(req)
+        assert out.stop_conditions.max_tokens == card.context_length - 3
+
+    def test_over_length_prompt_rejected(self, card):
+        from dynamo_tpu.llm.protocols.common import HttpError
+        from dynamo_tpu.llm.protocols.openai import CompletionRequest
+
+        pre = OpenAIPreprocessor(card)
+        req = CompletionRequest.model_validate(
+            {"model": "t", "prompt": [7] * (card.context_length + 1)}
+        )
+        with pytest.raises(HttpError) as ei:
+            pre.preprocess_completion(req)
+        assert ei.value.status == 400
 
 
 class TestDecodeStream:
