@@ -70,15 +70,39 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _token_pipeline(card: ModelDeploymentCard, core_engine, chat: bool):
-    """OpenAI request → preprocess → detokenize → token-level core engine."""
+class DispatchEngine:
+    """Routes an OpenAI request to the chat or completions pipeline by shape.
+
+    Used by distributed workers, whose single endpoint receives both kinds
+    (reference: the worker-side pipeline in input/endpoint.rs:35-118).
+    """
+
+    def __init__(self, chat_engine, completions_engine):
+        self._chat = chat_engine
+        self._completions = completions_engine
+
+    def generate(self, request):
+        data = request.data
+        is_chat = hasattr(data, "messages") or (
+            isinstance(data, dict) and "messages" in data
+        )
+        engine = self._chat if is_chat else self._completions
+        return engine.generate(request)
+
+
+def _token_pipelines(card: ModelDeploymentCard, make_core):
+    """(chat, completions) pipelines sharing one preprocessor/tokenizer."""
     pre = OpenAIPreprocessor(card)
-    return (
-        Pipeline()
-        .link(ChatPreprocessorOperator(pre, chat=chat))
-        .link(DetokenizeOperator(card, pre.tokenizer))
-        .link_engine(core_engine)
-    )
+
+    def build(chat: bool):
+        return (
+            Pipeline()
+            .link(ChatPreprocessorOperator(pre, chat=chat))
+            .link(DetokenizeOperator(card, pre.tokenizer))
+            .link_engine(make_core())
+        )
+
+    return build(True), build(False)
 
 
 def build_engine(out_spec: str, flags: argparse.Namespace):
@@ -100,11 +124,8 @@ def build_engine(out_spec: str, flags: argparse.Namespace):
     if out_spec == "echo_core":
         if card is None:
             raise SystemExit("out=echo_core requires --model-path (tokenizer needed)")
-        return (
-            _token_pipeline(card, EchoEngineCore(), chat=True),
-            _token_pipeline(card, EchoEngineCore(), chat=False),
-            model_name,
-        )
+        chat_eng, comp_eng = _token_pipelines(card, EchoEngineCore)
+        return chat_eng, comp_eng, model_name
 
     if out_spec == "jax":
         if card is None:
@@ -126,11 +147,8 @@ def build_engine(out_spec: str, flags: argparse.Namespace):
             tensor_parallel_size=flags.tensor_parallel_size,
             **extra,
         )
-        return (
-            _token_pipeline(card, core, chat=True),
-            _token_pipeline(card, core, chat=False),
-            model_name,
-        )
+        chat_eng, comp_eng = _token_pipelines(card, lambda: core)
+        return chat_eng, comp_eng, model_name
 
     if out_spec.startswith("dyn://"):
         try:
@@ -254,8 +272,10 @@ async def run_batch(engine, model_name: str, batch_file: str) -> None:
     print(json.dumps(stats))
 
 
-async def run_endpoint(engine, model_name: str, in_spec: str, flags: argparse.Namespace) -> None:
-    """Register as a distributed worker on dyn://ns.comp.ep."""
+async def run_endpoint(chat_engine, completions_engine, model_name: str, in_spec: str, flags: argparse.Namespace) -> None:
+    """Register as a distributed worker on dyn://ns.comp.ep (serves both
+    chat and completions requests via shape dispatch)."""
+    engine = DispatchEngine(chat_engine, completions_engine)
     try:
         from ..runtime.distributed import DistributedRuntime, parse_endpoint_path
     except ImportError as e:
@@ -284,7 +304,7 @@ async def amain(argv: list[str]) -> None:
     elif in_spec.startswith("batch:"):
         await run_batch(chat_engine, model_name, in_spec[len("batch:"):])
     elif in_spec.startswith("dyn://"):
-        await run_endpoint(chat_engine, model_name, in_spec, flags)
+        await run_endpoint(chat_engine, completions_engine, model_name, in_spec, flags)
     elif in_spec == "none":
         await asyncio.Event().wait()
     else:

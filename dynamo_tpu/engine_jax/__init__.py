@@ -1,0 +1,22 @@
+"""The TPU serving engine: continuous batching over paged KV in HBM.
+
+This is the framework-native worker the reference delegates to vLLM/SGLang for
+(SURVEY.md §2.5, §2.9): a JAX program with fixed batch slots, bucketed prefill,
+a single jitted decode step, prefix-cache-aware paged block allocation, and
+token streaming across the jit boundary.
+"""
+
+from dynamo_tpu.engine_jax.allocator import BlockAllocator, KvEventSink
+from dynamo_tpu.engine_jax.engine import (
+    EngineConfig,
+    JaxServingEngine,
+    build_jax_serving_engine,
+)
+
+__all__ = [
+    "BlockAllocator",
+    "KvEventSink",
+    "EngineConfig",
+    "JaxServingEngine",
+    "build_jax_serving_engine",
+]

@@ -1,0 +1,229 @@
+"""Host-side paged KV block allocator with prefix caching and event emission.
+
+Owns the mapping from logical sequences to physical pages of the device KV
+pool. Full blocks are content-addressed by their chained sequence hash
+(kv/tokens.py), so a new request whose prompt shares a block-aligned prefix
+with a cached sequence reuses those pages and skips recomputing them.
+
+Lifecycle of a physical block:
+    free → active (refcount ≥ 1, owned by live sequences)
+         → cached (refcount 0 but contents valid; reusable by hash, LRU-evictable)
+         → free (evicted; `removed` event emitted)
+
+Emits stored/removed events to a :class:`KvEventSink` — the same signal the
+reference's engines publish for KV-aware routing (SURVEY.md §3.5); the radix
+indexer consumes them. Capability parity with the reference's block reuse pool
+(lib/llm/src/kv/reuse.rs, prefix_caching in the patched vLLM) — re-designed,
+not ported: single-threaded host logic driven by the engine loop.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Protocol, Sequence, Tuple
+
+from dynamo_tpu.kv.tokens import TokenBlockSequence, compute_block_hashes_for_seq
+
+
+class KvEventSink(Protocol):
+    """Receiver for KV cache events (worker → router)."""
+
+    def blocks_stored(
+        self, parent_hash: Optional[int], blocks: List[Tuple[int, List[int]]]
+    ) -> None:
+        """blocks: [(block_hash, token_ids), ...] in chain order."""
+
+    def blocks_removed(self, block_hashes: List[int]) -> None: ...
+
+
+@dataclass
+class SequenceAllocation:
+    """A live sequence's hold on physical pages."""
+
+    block_ids: List[int]  # physical page ids, logical order
+    token_blocks: TokenBlockSequence  # hashing state (tracks sealed blocks)
+    cached_tokens: int  # prompt tokens served from prefix cache
+    sealed_blocks: int = 0  # how many full blocks have been hashed+registered
+
+
+class BlockAllocator:
+    """Allocates physical pages, reuses prefix-cached ones, evicts LRU.
+
+    All methods are called from the engine's step loop (single thread).
+    """
+
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int,
+        event_sink: Optional[KvEventSink] = None,
+        salt: Optional[bytes] = None,
+    ):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.salt = salt
+        self._sink = event_sink
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._refcount: Dict[int, int] = {}
+        # sequence_hash → block id, for every block whose contents are valid
+        self._by_hash: Dict[int, int] = {}
+        self._hash_of: Dict[int, int] = {}  # block id → sequence hash
+        # refcount-0 blocks with valid contents, LRU order (oldest first)
+        self._cached: "OrderedDict[int, None]" = OrderedDict()
+        # counters for metrics
+        self.hit_tokens = 0
+        self.probe_tokens = 0
+
+    # -- queries -------------------------------------------------------------
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free) + len(self._cached)
+
+    @property
+    def active_blocks(self) -> int:
+        return self.num_blocks - self.free_blocks
+
+    def usage(self) -> float:
+        return self.active_blocks / self.num_blocks if self.num_blocks else 0.0
+
+    def blocks_needed(self, n_tokens: int) -> int:
+        return (n_tokens + self.block_size - 1) // self.block_size
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        # conservative: ignores potential prefix hits
+        return self.blocks_needed(n_tokens) <= self.free_blocks
+
+    # -- allocation ----------------------------------------------------------
+
+    def allocate_sequence(self, token_ids: Sequence[int]) -> Optional[SequenceAllocation]:
+        """Allocate pages for a prompt, reusing prefix-cached blocks.
+
+        Returns None if not enough pages are available (caller re-queues).
+        The last prompt token is never served from cache: its logits are needed
+        to sample the first output token, so at least one position is computed.
+        """
+        seq_hashes = compute_block_hashes_for_seq(token_ids, self.block_size, self.salt)
+        self.probe_tokens += len(token_ids)
+
+        # longest cached prefix (block-aligned, capped so ≥1 token is computed)
+        max_cacheable = min(len(seq_hashes), (len(token_ids) - 1) // self.block_size)
+        reused: List[int] = []
+        for h in seq_hashes[:max_cacheable]:
+            bid = self._by_hash.get(h)
+            if bid is None:
+                break
+            reused.append(bid)
+
+        # acquire matches FIRST so LRU eviction below can't reclaim them
+        for bid in reused:
+            self._acquire(bid)
+
+        n_fresh = self.blocks_needed(len(token_ids)) - len(reused)
+        if not self._reserve_capacity(n_fresh):
+            for bid in reused:  # roll back
+                self._release_one(bid)
+            return None
+
+        block_ids = list(reused) + [self._take_free() for _ in range(n_fresh)]
+        cached_tokens = len(reused) * self.block_size
+        self.hit_tokens += cached_tokens
+
+        # hashing state covers only tokens whose KV exists (the cached prefix);
+        # note_tokens_computed extends it as prefill/decode computes the rest
+        return SequenceAllocation(
+            block_ids=block_ids,
+            token_blocks=TokenBlockSequence(
+                token_ids[:cached_tokens], self.block_size, salt=self.salt
+            ),
+            cached_tokens=cached_tokens,
+            sealed_blocks=len(reused),
+        )
+
+    def grow(self, alloc: SequenceAllocation, n_tokens: int) -> bool:
+        """Ensure capacity for a sequence now ``n_tokens`` long (decode growth)."""
+        needed = self.blocks_needed(n_tokens)
+        while len(alloc.block_ids) < needed:
+            if not self._reserve_capacity(1):
+                return False
+            alloc.block_ids.append(self._take_free())
+        return True
+
+    def note_tokens_computed(self, alloc: SequenceAllocation, token_ids: Sequence[int]) -> None:
+        """Record that KV for these tokens now exists in the sequence's pages.
+
+        Seals any blocks that became full: registers their hashes for reuse and
+        emits a `stored` event (chain order preserved).
+        """
+        sealed = alloc.token_blocks.extend(token_ids)
+        if not sealed:
+            return
+        stored: List[Tuple[int, List[int]]] = []
+        parent = sealed[0].parent_hash
+        for blk in sealed:
+            bid = alloc.block_ids[blk.position]
+            prior = self._hash_of.get(bid)
+            if prior is not None and prior != blk.block_hash:
+                self._unregister(bid)
+            if blk.block_hash not in self._by_hash:
+                self._by_hash[blk.block_hash] = bid
+                self._hash_of[bid] = blk.block_hash
+                stored.append((blk.block_hash, list(blk.tokens)))
+        alloc.sealed_blocks = len(alloc.token_blocks.blocks)
+        if stored and self._sink is not None:
+            self._sink.blocks_stored(parent, stored)
+
+    def free_sequence(self, alloc: SequenceAllocation) -> None:
+        """Release a finished sequence's pages. Hash-registered blocks become
+        reusable cache; unhashed (partial) blocks return to the free list."""
+        for bid in alloc.block_ids:
+            self._release_one(bid)
+        alloc.block_ids = []
+
+    # -- internals -----------------------------------------------------------
+
+    def _release_one(self, bid: int) -> None:
+        rc = self._refcount.get(bid, 0) - 1
+        if rc > 0:
+            self._refcount[bid] = rc
+            return
+        self._refcount.pop(bid, None)
+        if bid in self._hash_of:
+            self._cached[bid] = None
+            self._cached.move_to_end(bid)
+        else:
+            self._free.append(bid)
+
+    def _acquire(self, bid: int) -> None:
+        if bid in self._cached:  # revive from reuse pool
+            del self._cached[bid]
+        self._refcount[bid] = self._refcount.get(bid, 0) + 1
+
+    def _take_free(self) -> int:
+        bid = self._free.pop()
+        self._refcount[bid] = 1
+        return bid
+
+    def _reserve_capacity(self, n: int) -> bool:
+        """Make sure the free list has n entries, evicting LRU cached blocks."""
+        evicted: List[int] = []
+        while len(self._free) < n:
+            if not self._cached:
+                return False
+            bid, _ = self._cached.popitem(last=False)  # oldest
+            h = self._hash_of.pop(bid)
+            del self._by_hash[h]
+            evicted.append(h)
+            self._free.append(bid)
+        if evicted and self._sink is not None:
+            self._sink.blocks_removed(evicted)
+        return True
+
+    def _unregister(self, bid: int) -> None:
+        h = self._hash_of.pop(bid, None)
+        if h is not None:
+            self._by_hash.pop(h, None)
+            if self._sink is not None:
+                self._sink.blocks_removed([h])
+        self._cached.pop(bid, None)

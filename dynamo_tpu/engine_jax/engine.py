@@ -1,0 +1,513 @@
+"""The continuous-batching JAX serving engine.
+
+Architecture (TPU-first, cf. SURVEY.md §7 stage 4):
+
+- **Fixed batch slots**: `max_slots` decode lanes; a request occupies one slot
+  from first token to finish. All decode steps run ONE jitted function with
+  static shapes — no recompilation, ever.
+- **Bucketed prefill**: prompt suffixes are padded to power-of-two buckets, so
+  at most log2(max_len) prefill variants compile.
+- **Paged KV**: allocator (allocator.py) maps sequences onto a page pool in
+  HBM with content-addressed prefix reuse; the model writes-then-attends
+  through block tables (models/llama.py), making prefix hits free.
+- **In-jit sampling** (sampling.py): only token ids cross to host per step.
+- **Step loop on a dedicated thread**: jax dispatch blocks, asyncio must not.
+  Tokens stream to requesters via `loop.call_soon_threadsafe` into per-request
+  asyncio queues — this is how tokens cross the jit/async boundary.
+
+The engine implements the framework AsyncEngine interface (token-in/token-out,
+like the reference's ExecutionContext engines, SURVEY.md §2.5) so it slots into
+the same pipelines as the echo engines and remote clients.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Deque, Dict, List, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine_jax.allocator import BlockAllocator, KvEventSink, SequenceAllocation
+from dynamo_tpu.engine_jax.sampling import sample_tokens
+from dynamo_tpu.llm.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.models.llama import LlamaConfig, forward, make_kv_cache
+from dynamo_tpu.runtime.annotated import Annotated
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    max_slots: int = 8
+    kv_block_size: int = 16
+    max_model_len: int = 2048
+    num_kv_blocks: Optional[int] = None  # default: 1.5× what max_slots need
+    min_prefill_bucket: int = 16
+    # decode steps per device dispatch: each dispatch scans this many
+    # forward+sample steps in one jitted call, amortizing host↔device latency
+    # (critical when dispatch rides a network tunnel). Tokens past a stop
+    # condition are discarded host-side; worst case wastes decode_steps-1
+    # token computations per finished request.
+    decode_steps: int = 1
+
+    def resolve_num_blocks(self) -> int:
+        if self.num_kv_blocks is not None:
+            return self.num_kv_blocks
+        per_seq = math.ceil(self.max_model_len / self.kv_block_size)
+        return int(self.max_slots * per_seq * 3 // 2)
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return math.ceil(self.max_model_len / self.kv_block_size)
+
+    def prefill_buckets(self) -> List[int]:
+        buckets = []
+        b = self.min_prefill_bucket
+        while b < self.max_model_len:
+            buckets.append(b)
+            b *= 2
+        buckets.append(self.max_model_len)
+        return buckets
+
+
+class _Seq:
+    """One in-flight request's host-side state."""
+
+    __slots__ = (
+        "ctx", "request", "prompt", "alloc", "slot", "out_queue", "loop",
+        "generated", "max_tokens", "eos_ids", "ignore_eos", "temperature",
+        "top_k", "top_p", "seed", "enqueue_t", "first_token_t",
+    )
+
+    def __init__(self, ctx: Context, request: PreprocessedRequest, loop) -> None:
+        self.ctx = ctx
+        self.request = request
+        self.prompt: List[int] = list(request.token_ids)
+        self.alloc: Optional[SequenceAllocation] = None
+        self.slot: Optional[int] = None
+        self.out_queue: asyncio.Queue = asyncio.Queue()
+        self.loop = loop
+        self.generated: List[int] = []
+        sc = request.stop_conditions
+        self.max_tokens = sc.max_tokens if sc.max_tokens is not None else 2**30
+        self.eos_ids: Set[int] = set(request.eos_token_ids or [])
+        self.ignore_eos = bool(sc.ignore_eos)
+        so = request.sampling_options
+        self.temperature = so.temperature if so.temperature is not None else 0.0
+        self.top_k = so.top_k if so.top_k is not None else 0
+        self.top_p = so.top_p if so.top_p is not None else 1.0
+        self.seed = so.seed if so.seed is not None else 0
+        self.enqueue_t = time.perf_counter()
+        self.first_token_t: Optional[float] = None
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt) + len(self.generated)
+
+    def emit(self, item) -> None:
+        self.loop.call_soon_threadsafe(self.out_queue.put_nowait, item)
+
+
+_FINISHED = object()  # sentinel closing a request's output queue
+
+
+class JaxServingEngine(AsyncEngine):
+    """Continuous-batching paged-KV engine over a jitted Llama step."""
+
+    def __init__(
+        self,
+        model_config: LlamaConfig,
+        params: Any,
+        engine_config: EngineConfig = EngineConfig(),
+        mesh=None,
+        event_sink: Optional[KvEventSink] = None,
+        cache_dtype: Any = None,
+    ):
+        self.model_config = model_config
+        self.config = engine_config
+        self.params = params
+        self.mesh = mesh
+        self.num_blocks = engine_config.resolve_num_blocks()
+        self.allocator = BlockAllocator(
+            self.num_blocks, engine_config.kv_block_size, event_sink=event_sink
+        )
+
+        cache = make_kv_cache(
+            model_config, self.num_blocks, engine_config.kv_block_size,
+            dtype=cache_dtype or model_config.dtype,
+        )
+        if mesh is not None:
+            from dynamo_tpu.parallel.mesh import kv_cache_sharding
+
+            sh = kv_cache_sharding(mesh)
+            cache = {k: jax.device_put(v, sh) for k, v in cache.items()}
+        self.cache = cache
+
+        S = engine_config.max_slots
+        MB = engine_config.max_blocks_per_seq
+        self._slots: List[Optional[_Seq]] = [None] * S
+        self._tables = np.zeros((S, MB), np.int32)
+        self._last_tokens = np.zeros((S,), np.int32)
+        self._positions = np.full((S,), -1, np.int32)
+        self._temp = np.zeros((S,), np.float32)
+        self._topk = np.zeros((S,), np.int32)
+        self._topp = np.ones((S,), np.float32)
+        self._seeds = np.zeros((S,), np.int32)
+
+        self._base_key = jax.random.PRNGKey(0)
+        self._step_counter = 0
+
+        self._pending: Deque[_Seq] = deque()
+        self._cond = threading.Condition()
+        self._shutdown = False
+        self._thread: Optional[threading.Thread] = None
+
+        # stats
+        self.total_requests = 0
+        self.total_generated_tokens = 0
+        self.total_prompt_tokens = 0
+
+        self._decode_fn = self._build_decode_fn()
+        self._prefill_fns: Dict[int, Any] = {}  # bucket → compiled fn
+
+    # -- jitted step functions ----------------------------------------------
+
+    def _build_decode_fn(self):
+        cfg = self.model_config
+        k_steps = self.config.decode_steps
+        max_pos = self.config.max_model_len - 1
+
+        def decode(params, cache, tokens, positions, tables, step_key, seeds, temp, topk, topp):
+            # tokens/positions: [S]; tables: [S, MB]. Scans k_steps forward+
+            # sample iterations, feeding each sampled token back in — one
+            # dispatch yields [S, k_steps] tokens.
+            def body(carry, k):
+                toks, pos, cache = carry
+                logits, cache = forward(
+                    params, cfg, toks[:, None], pos[:, None], cache, tables
+                )
+                kk = jax.random.fold_in(step_key, k)
+                keys = jax.vmap(lambda s: jax.random.fold_in(kk, s))(seeds)
+                nxt = sample_tokens(logits[:, 0], keys, temp, topk, topp)
+                new_pos = jnp.where(pos >= 0, jnp.minimum(pos + 1, max_pos), -1)
+                return (nxt, new_pos, cache), nxt
+
+            (_, _, cache), out = jax.lax.scan(
+                body, (tokens, positions, cache), jnp.arange(k_steps)
+            )
+            return out.T, cache  # [S, k_steps]
+
+        return jax.jit(decode, donate_argnums=(1,))
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+        cfg = self.model_config
+
+        def prefill(params, cache, tokens, positions, table, sample_at, key, temp, topk, topp):
+            # tokens/positions: [1, bucket]; table: [1, MB]
+            logits, cache = forward(params, cfg, tokens, positions, cache, table)
+            last = logits[:, sample_at]  # [1, V]
+            next_token = sample_tokens(last, key[None], temp[None], topk[None], topp[None])
+            return next_token[0], cache
+
+        fn = jax.jit(prefill, donate_argnums=(1,))
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    # -- AsyncEngine interface ----------------------------------------------
+
+    async def generate(
+        self, request: Context[PreprocessedRequest]
+    ) -> AsyncIterator[Annotated[dict]]:
+        req = request.data
+        if not isinstance(req, PreprocessedRequest):
+            req = PreprocessedRequest.from_dict(req)
+        if len(req.token_ids) > self.config.max_model_len - 1:
+            yield Annotated.from_error(
+                f"prompt is {len(req.token_ids)} tokens; engine max_model_len "
+                f"is {self.config.max_model_len}"
+            )
+            return
+
+        self._ensure_thread()
+        seq = _Seq(request, req, asyncio.get_running_loop())
+        with self._cond:
+            self._pending.append(seq)
+            self._cond.notify()
+
+        while True:
+            item = await seq.out_queue.get()
+            if item is _FINISHED:
+                return
+            yield item
+
+    # -- engine thread -------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._step_loop, name="jax-engine-step", daemon=True
+            )
+            self._thread.start()
+
+    def close(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _step_loop(self) -> None:
+        try:
+            while True:
+                with self._cond:
+                    while (
+                        not self._shutdown
+                        and not self._pending
+                        and not any(self._slots)
+                    ):
+                        self._cond.wait()
+                    if self._shutdown:
+                        return
+                self._admit()
+                self._decode_step()
+        except Exception:
+            logger.exception("engine step loop crashed")
+            # fail every in-flight request rather than hanging clients
+            for seq in list(self._slots) + list(self._pending):
+                if seq is not None:
+                    seq.emit(Annotated.from_error("engine internal error"))
+                    seq.emit(_FINISHED)
+
+    # -- scheduling ----------------------------------------------------------
+
+    def _admit(self) -> None:
+        """Move pending requests into free slots; run their prefill."""
+        while True:
+            with self._cond:
+                if not self._pending:
+                    return
+                free = [i for i, s in enumerate(self._slots) if s is None]
+                if not free:
+                    return
+                seq = self._pending.popleft()
+            if seq.ctx.context.is_stopped:
+                seq.emit(Annotated.from_data(LLMEngineOutput.final(FinishReason.CANCELLED).to_dict()))
+                seq.emit(_FINISHED)
+                continue
+            alloc = self.allocator.allocate_sequence(seq.prompt)
+            if alloc is None:
+                if not any(self._slots):
+                    # nothing running will ever free blocks: impossible request
+                    seq.emit(Annotated.from_error(
+                        f"prompt needs {self.allocator.blocks_needed(len(seq.prompt))} "
+                        f"KV blocks; pool has {self.num_blocks}"
+                    ))
+                    seq.emit(_FINISHED)
+                    continue
+                with self._cond:
+                    self._pending.appendleft(seq)  # retry when blocks free up
+                return
+            seq.alloc = alloc
+            seq.slot = free[0]
+            self._slots[seq.slot] = seq
+            self.total_requests += 1
+            self.total_prompt_tokens += len(seq.prompt)
+            self._run_prefill(seq)
+
+    def _run_prefill(self, seq: _Seq) -> None:
+        cfg = self.config
+        alloc = seq.alloc
+        suffix = seq.prompt[alloc.cached_tokens :]
+        n = len(suffix)
+        bucket = next(b for b in cfg.prefill_buckets() if b >= n)
+
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = suffix
+        positions = np.full((1, bucket), -1, np.int32)
+        positions[0, :n] = np.arange(alloc.cached_tokens, alloc.cached_tokens + n)
+        table = np.zeros((1, cfg.max_blocks_per_seq), np.int32)
+        table[0, : len(alloc.block_ids)] = alloc.block_ids
+
+        self._step_counter += 1
+        step_key = jax.random.fold_in(self._base_key, self._step_counter)
+        key = jax.random.fold_in(step_key, seq.seed)
+
+        fn = self._prefill_fn(bucket)
+        next_token, self.cache = fn(
+            self.params, self.cache, tokens, positions, table,
+            n - 1,
+            key,
+            jnp.float32(seq.temperature), jnp.int32(seq.top_k), jnp.float32(seq.top_p),
+        )
+        tok = int(next_token)
+        self.allocator.note_tokens_computed(alloc, suffix)
+        seq.first_token_t = time.perf_counter()
+        self._emit_token(seq, tok)
+
+    def _decode_step(self) -> None:
+        active = [s for s in self._slots if s is not None]
+        if not active:
+            return
+        k_steps = self.config.decode_steps
+        # cancellation + capacity checks before the step
+        for seq in active:
+            if seq.ctx.context.is_stopped:
+                self._finish(seq, FinishReason.CANCELLED)
+                continue
+            # the chunk writes KV at positions total_len-1 .. total_len-2+k
+            need = min(seq.total_len - 1 + k_steps, self.config.max_model_len)
+            if not self.allocator.grow(seq.alloc, need):
+                self._preempt(seq)
+        active = [s for s in self._slots if s is not None]
+        if not active:
+            return
+
+        cfg = self.config
+        for i in range(cfg.max_slots):
+            seq = self._slots[i]
+            if seq is None:
+                self._positions[i] = -1
+                self._last_tokens[i] = 0
+                continue
+            self._positions[i] = seq.total_len - 1
+            self._last_tokens[i] = seq.generated[-1] if seq.generated else seq.prompt[-1]
+            self._tables[i, :] = 0
+            self._tables[i, : len(seq.alloc.block_ids)] = seq.alloc.block_ids
+            self._temp[i] = seq.temperature
+            self._topk[i] = seq.top_k
+            self._topp[i] = seq.top_p
+            self._seeds[i] = seq.seed & 0x7FFFFFFF
+
+        self._step_counter += 1
+        step_key = jax.random.fold_in(self._base_key, self._step_counter)
+        next_tokens, self.cache = self._decode_fn(
+            self.params, self.cache,
+            jnp.asarray(self._last_tokens), jnp.asarray(self._positions),
+            jnp.asarray(self._tables), step_key, jnp.asarray(self._seeds),
+            jnp.asarray(self._temp), jnp.asarray(self._topk), jnp.asarray(self._topp),
+        )
+        next_np = np.asarray(jax.device_get(next_tokens))  # [S, k_steps]
+
+        for i in range(cfg.max_slots):
+            seq = self._slots[i]
+            if seq is None:
+                continue
+            # fed tokens this chunk: last accepted token, then each output fed
+            # back. KV is registered only for fed tokens on the accepted path.
+            fed = seq.generated[-1] if seq.generated else seq.prompt[-1]
+            for k in range(k_steps):
+                self.allocator.note_tokens_computed(seq.alloc, [fed])
+                tok = int(next_np[i, k])
+                self._emit_token(seq, tok)
+                if self._slots[i] is not seq:  # finished/preempted mid-chunk
+                    break
+                fed = tok
+
+    def _emit_token(self, seq: _Seq, tok: int) -> None:
+        seq.generated.append(tok)
+        self.total_generated_tokens += 1
+        finish: Optional[FinishReason] = None
+        if tok in seq.eos_ids and not seq.ignore_eos:
+            finish = FinishReason.EOS
+        elif len(seq.generated) >= seq.max_tokens:
+            finish = FinishReason.LENGTH
+        elif seq.total_len >= self.config.max_model_len:
+            finish = FinishReason.LENGTH
+
+        seq.emit(Annotated.from_data(
+            LLMEngineOutput(token_ids=[tok]).to_dict(), id=seq.ctx.id
+        ))
+        if finish is not None:
+            self._finish(seq, finish)
+
+    def _finish(self, seq: _Seq, reason: FinishReason) -> None:
+        if seq.slot is not None:
+            self._slots[seq.slot] = None
+            seq.slot = None
+        if seq.alloc is not None:
+            self.allocator.free_sequence(seq.alloc)
+            seq.alloc = None
+        seq.emit(Annotated.from_data(LLMEngineOutput.final(reason).to_dict(), id=seq.ctx.id))
+        seq.emit(_FINISHED)
+
+    def _preempt(self, seq: _Seq) -> None:
+        """Out of KV blocks mid-decode: recompute-preempt (free pages, requeue
+        with prompt := prompt + generated so far, prefix cache softens the hit)."""
+        logger.warning("preempting request %s (out of KV blocks)", seq.ctx.id)
+        if seq.slot is not None:
+            self._slots[seq.slot] = None
+            seq.slot = None
+        self.allocator.free_sequence(seq.alloc)
+        seq.prompt = seq.prompt + seq.generated
+        # keep generated list (continues streaming after re-admission)
+        seq.alloc = None
+        with self._cond:
+            self._pending.append(seq)
+
+    # -- metrics -------------------------------------------------------------
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """ForwardPassMetrics-equivalent (reference kv_router/protocols.rs:42-54)."""
+        active = sum(1 for s in self._slots if s is not None)
+        probe = max(self.allocator.probe_tokens, 1)
+        return {
+            "request_active_slots": active,
+            "request_total_slots": self.config.max_slots,
+            "kv_active_blocks": self.allocator.active_blocks,
+            "kv_total_blocks": self.num_blocks,
+            "num_requests_waiting": len(self._pending),
+            "gpu_cache_usage_perc": self.allocator.usage(),
+            "gpu_prefix_cache_hit_rate": self.allocator.hit_tokens / probe,
+        }
+
+
+def build_jax_serving_engine(
+    card,
+    max_batch_size: int = 8,
+    kv_block_size: int = 16,
+    max_model_len: Optional[int] = None,
+    tensor_parallel_size: int = 1,
+    num_kv_blocks: Optional[int] = None,
+    seed: int = 0,
+    event_sink: Optional[KvEventSink] = None,
+    decode_steps: int = 4,
+) -> JaxServingEngine:
+    """CLI/SDK entry: model + engine from a ModelDeploymentCard."""
+    from dynamo_tpu.engine_jax.weights import config_from_card, load_params
+    from dynamo_tpu.models.llama import param_shardings
+    from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    model_config = config_from_card(card)
+    params = load_params(card, model_config, seed=seed)
+
+    mesh = None
+    if tensor_parallel_size > 1:
+        mesh = make_mesh(MeshConfig(tp=tensor_parallel_size))
+        params = jax.device_put(params, param_shardings(model_config, mesh))
+
+    engine_config = EngineConfig(
+        max_slots=max_batch_size,
+        kv_block_size=kv_block_size,
+        max_model_len=max_model_len or min(card.context_length, 4096),
+        num_kv_blocks=num_kv_blocks,
+        decode_steps=decode_steps,
+    )
+    return JaxServingEngine(
+        model_config, params, engine_config, mesh=mesh, event_sink=event_sink
+    )

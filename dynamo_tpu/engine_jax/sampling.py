@@ -1,0 +1,49 @@
+"""In-jit token sampling: greedy / temperature / top-k / top-p, per-slot.
+
+Sampling runs inside the jitted step so only the sampled token ids (a few
+bytes) cross the device→host boundary per step — never the [slots, vocab]
+logits. All parameters are per-slot vectors so one compiled function serves
+any mix of requests.
+
+Encoding of "disabled": temperature <= 0 → greedy; top_k <= 0 → no top-k;
+top_p >= 1 → no top-p.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(
+    logits: jax.Array,  # [B, V] float32
+    keys: jax.Array,  # [B] PRNG keys (per-slot, honors per-request seeds)
+    temperature: jax.Array,  # [B]
+    top_k: jax.Array,  # [B] int32
+    top_p: jax.Array,  # [B]
+) -> jax.Array:
+    """Sample one token per row. Returns [B] int32."""
+    b, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+
+    # sort once (desc); both top-k and top-p masks derive from the sorted view
+    order = jnp.argsort(scaled, axis=-1)[:, ::-1]
+    sorted_logits = jnp.take_along_axis(scaled, order, axis=-1)
+
+    ranks = jnp.arange(v)[None, :]
+    k_eff = jnp.where(top_k > 0, top_k, v)[:, None]
+    keep_k = ranks < k_eff
+
+    probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    # keep tokens until cumulative prob exceeds p (always keep the first)
+    keep_p = (cum - probs_sorted) < jnp.clip(top_p, 0.0, 1.0)[:, None]
+
+    keep = keep_k & keep_p
+    masked_sorted = jnp.where(keep, sorted_logits, -jnp.inf)
+    choice_in_sorted = jax.vmap(jax.random.categorical)(keys, masked_sorted)  # [B]
+    sampled = jnp.take_along_axis(order, choice_in_sorted[:, None], axis=1)[:, 0]
+
+    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)

@@ -1,0 +1,113 @@
+"""Model config resolution and weight loading for the JAX engine.
+
+Maps an HF-layout model directory (config.json + *.safetensors) onto the
+framework's stacked-layer parameter pytree (models/llama.py). Directories
+without weight files get deterministic random init — enough for echo-free
+serving-path tests and synthetic benchmarks.
+
+Reference analogue: model resolution in launch/dynamo-run (hub.rs,
+model_card/create.rs:41-143); actual weight loading lives in the delegated
+engines there — here it is framework-native.
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.models.llama import LlamaConfig, init_params
+
+logger = logging.getLogger(__name__)
+
+
+def config_from_card(card: ModelDeploymentCard, dtype: Any = jnp.bfloat16) -> LlamaConfig:
+    """Derive a LlamaConfig from the card's HF config.json contents."""
+    mc = card.model_config or {}
+    hidden = int(mc.get("hidden_size", 4096))
+    heads = int(mc.get("num_attention_heads", 32))
+    return LlamaConfig(
+        vocab_size=int(mc.get("vocab_size", 128256)),
+        hidden_size=hidden,
+        intermediate_size=int(mc.get("intermediate_size", 4 * hidden)),
+        num_layers=int(mc.get("num_hidden_layers", 32)),
+        num_heads=heads,
+        num_kv_heads=int(mc.get("num_key_value_heads", heads)),
+        head_dim=int(mc.get("head_dim", hidden // heads)),
+        rope_theta=float(mc.get("rope_theta", 500000.0)),
+        rms_norm_eps=float(mc.get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(mc.get("tie_word_embeddings", False)),
+        dtype=dtype,
+    )
+
+
+def _hf_tensors(model_path: str) -> Optional[Dict[str, np.ndarray]]:
+    files = sorted(glob.glob(os.path.join(model_path, "*.safetensors")))
+    if not files:
+        return None
+    from safetensors import safe_open
+
+    out: Dict[str, np.ndarray] = {}
+    for f in files:
+        with safe_open(f, framework="np") as sf:
+            for name in sf.keys():
+                out[name] = sf.get_tensor(name)
+    return out
+
+
+def load_params(card: ModelDeploymentCard, config: LlamaConfig, seed: int = 0):
+    """Load HF llama weights into the stacked pytree, or random-init."""
+    tensors = _hf_tensors(card.model_path) if card.model_path else None
+    if tensors is None:
+        logger.info("no safetensors found for %s: random-initializing", card.display_name)
+        return init_params(jax.random.PRNGKey(seed), config)
+    return params_from_hf(tensors, config)
+
+
+def params_from_hf(tensors: Dict[str, np.ndarray], config: LlamaConfig):
+    """HF llama naming → framework pytree (transposed to [in, out] layout)."""
+    c = config
+    dt = c.dtype
+
+    def get(name: str) -> np.ndarray:
+        return tensors[name]
+
+    def lin(name: str) -> np.ndarray:
+        # HF nn.Linear stores [out, in]; we use [in, out]
+        return np.ascontiguousarray(get(name).T)
+
+    def stack(fmt: str, transform) -> jnp.ndarray:
+        return jnp.asarray(
+            np.stack([transform(fmt.format(i)) for i in range(c.num_layers)]), dt
+        )
+
+    params = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), dt),
+        "final_norm": jnp.asarray(get("model.norm.weight"), jnp.float32),
+        "layers": {
+            "attn_norm": jnp.asarray(
+                np.stack([get(f"model.layers.{i}.input_layernorm.weight") for i in range(c.num_layers)]),
+                jnp.float32,
+            ),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight", lin),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight", lin),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight", lin),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight", lin),
+            "mlp_norm": jnp.asarray(
+                np.stack([get(f"model.layers.{i}.post_attention_layernorm.weight") for i in range(c.num_layers)]),
+                jnp.float32,
+            ),
+            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight", lin),
+            "w_up": stack("model.layers.{}.mlp.up_proj.weight", lin),
+            "w_down": stack("model.layers.{}.mlp.down_proj.weight", lin),
+        },
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = jnp.asarray(np.ascontiguousarray(get("lm_head.weight").T), dt)
+    return params

@@ -1,0 +1,40 @@
+"""KV-aware routing: radix-tree prefix indexer, scheduler, events, recorder.
+
+Routes each request to the worker holding the longest cached prefix of its
+prompt, balanced against load — the reference's flagship routing feature
+(lib/llm/src/kv_router/, SURVEY.md §2.3). Workers publish stored/removed
+block events; the indexer maintains a global prefix tree over chained block
+hashes; the scheduler scores `2*overlap − usage − load`.
+"""
+
+from dynamo_tpu.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    KvCacheEventData,
+    RemovedBlocks,
+    RouterEvent,
+    StoredBlock,
+    StoredBlocks,
+)
+from dynamo_tpu.kv_router.indexer import KvIndexer, OverlapScores, RadixTree
+from dynamo_tpu.kv_router.scheduler import DefaultWorkerSelector, KvScheduler, WorkerSelector
+from dynamo_tpu.kv_router.router import KvRouter
+from dynamo_tpu.kv_router.recorder import KvRecorder
+
+__all__ = [
+    "ForwardPassMetrics",
+    "KvCacheEvent",
+    "KvCacheEventData",
+    "RemovedBlocks",
+    "RouterEvent",
+    "StoredBlock",
+    "StoredBlocks",
+    "KvIndexer",
+    "OverlapScores",
+    "RadixTree",
+    "DefaultWorkerSelector",
+    "KvScheduler",
+    "WorkerSelector",
+    "KvRouter",
+    "KvRecorder",
+]

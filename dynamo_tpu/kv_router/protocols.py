@@ -1,0 +1,108 @@
+"""KV event and metrics protocol types.
+
+Wire-format parity with the reference's event scheme (kv_router/protocols.rs:
+19-125): workers emit `stored` events carrying the chain (parent hash + per-
+block sequence hash + tokens hash) and `removed` events carrying hashes.
+All hashes are the sequence-aware chained xxh3 values from kv/tokens.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Union
+
+
+@dataclass(frozen=True)
+class StoredBlock:
+    block_hash: int  # sequence-aware chained hash (ExternalSequenceBlockHash)
+    tokens_hash: int  # content-only hash (LocalBlockHash)
+
+
+@dataclass(frozen=True)
+class StoredBlocks:
+    parent_hash: Optional[int]
+    blocks: List[StoredBlock]
+
+    def to_dict(self) -> dict:
+        return {
+            "type": "stored",
+            "parent_hash": self.parent_hash,
+            "blocks": [
+                {"block_hash": b.block_hash, "tokens_hash": b.tokens_hash}
+                for b in self.blocks
+            ],
+        }
+
+
+@dataclass(frozen=True)
+class RemovedBlocks:
+    block_hashes: List[int]
+
+    def to_dict(self) -> dict:
+        return {"type": "removed", "block_hashes": list(self.block_hashes)}
+
+
+KvCacheEventData = Union[StoredBlocks, RemovedBlocks]
+
+
+@dataclass(frozen=True)
+class KvCacheEvent:
+    event_id: int
+    data: KvCacheEventData
+
+    def to_dict(self) -> dict:
+        return {"event_id": self.event_id, "data": self.data.to_dict()}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "KvCacheEvent":
+        data = d["data"]
+        if data["type"] == "stored":
+            payload: KvCacheEventData = StoredBlocks(
+                parent_hash=data.get("parent_hash"),
+                blocks=[
+                    StoredBlock(b["block_hash"], b["tokens_hash"])
+                    for b in data["blocks"]
+                ],
+            )
+        else:
+            payload = RemovedBlocks(block_hashes=list(data["block_hashes"]))
+        return cls(event_id=d["event_id"], data=payload)
+
+
+@dataclass(frozen=True)
+class RouterEvent:
+    """A KV cache event attributed to a worker (kv_router/indexer.rs RouterEvent)."""
+
+    worker_id: str
+    event: KvCacheEvent
+
+    def to_dict(self) -> dict:
+        return {"worker_id": self.worker_id, "event": self.event.to_dict()}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RouterEvent":
+        return cls(worker_id=d["worker_id"], event=KvCacheEvent.from_dict(d["event"]))
+
+
+@dataclass
+class ForwardPassMetrics:
+    """Worker load snapshot (reference kv_router/protocols.rs:42-54)."""
+
+    request_active_slots: int = 0
+    request_total_slots: int = 1
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 1
+    num_requests_waiting: int = 0
+    gpu_cache_usage_perc: float = 0.0
+    gpu_prefix_cache_hit_rate: float = 0.0
+    data_parallel_rank: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        from dataclasses import asdict
+
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ForwardPassMetrics":
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in d.items() if k in known})

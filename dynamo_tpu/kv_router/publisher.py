@@ -1,0 +1,77 @@
+"""Worker-side KV event publishing and metrics.
+
+`KvEventPublisher` adapts the engine allocator's event sink (engine_jax/
+allocator.py KvEventSink) into RouterEvents delivered to a transport-agnostic
+`publish` callable — in-process queue, messaging plane, or recorder.
+Reference parity: KvEventPublisher / KvMetricsPublisher
+(kv_router/publisher.rs:34-140; the C-ABI path in lib/bindings/c).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from dynamo_tpu.kv.tokens import compute_local_block_hash
+from dynamo_tpu.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    RemovedBlocks,
+    RouterEvent,
+    StoredBlock,
+    StoredBlocks,
+)
+
+
+class KvEventPublisher:
+    """Implements the allocator's KvEventSink protocol; emits RouterEvents."""
+
+    def __init__(self, worker_id: str, publish: Callable[[RouterEvent], None]):
+        self.worker_id = worker_id
+        self._publish = publish
+        self._ids = itertools.count()
+
+    def blocks_stored(
+        self, parent_hash: Optional[int], blocks: List[Tuple[int, List[int]]]
+    ) -> None:
+        data = StoredBlocks(
+            parent_hash=parent_hash,
+            blocks=[
+                StoredBlock(block_hash=h, tokens_hash=compute_local_block_hash(toks))
+                for h, toks in blocks
+            ],
+        )
+        self._publish(RouterEvent(self.worker_id, KvCacheEvent(next(self._ids), data)))
+
+    def blocks_removed(self, block_hashes: List[int]) -> None:
+        data = RemovedBlocks(block_hashes=list(block_hashes))
+        self._publish(RouterEvent(self.worker_id, KvCacheEvent(next(self._ids), data)))
+
+
+class KvMetricsPublisher:
+    """Worker-side load metrics holder; `snapshot_from` pulls from an engine.
+
+    The serving layer periodically calls `refresh(engine)` and transports the
+    snapshot to aggregators (reference: watch channel + load_metrics endpoint).
+    """
+
+    def __init__(self, worker_id: str):
+        self.worker_id = worker_id
+        self._lock = threading.Lock()
+        self._current = ForwardPassMetrics()
+
+    def refresh(self, engine) -> ForwardPassMetrics:
+        snap = engine.metrics_snapshot()
+        m = ForwardPassMetrics.from_dict(snap)
+        with self._lock:
+            self._current = m
+        return m
+
+    def publish(self, metrics: ForwardPassMetrics) -> None:
+        with self._lock:
+            self._current = metrics
+
+    def current(self) -> ForwardPassMetrics:
+        with self._lock:
+            return self._current

@@ -1,0 +1,68 @@
+"""JSONL recorder/replayer for router events.
+
+Capture production KV event streams and replay them against an indexer
+offline (reference: KvRecorder / Recorder<T>, kv_router/recorder.rs,
+recorder.rs:38-674). Rotation by line count keeps files bounded.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Iterator, Optional
+
+from dynamo_tpu.kv_router.protocols import RouterEvent
+
+
+class KvRecorder:
+    def __init__(self, path: str, max_lines_per_file: int = 100_000):
+        self.path = path
+        self.max_lines = max_lines_per_file
+        self._lines = 0
+        self._generation = 0
+        self._fh = open(self._current_path(), "a", encoding="utf-8")
+
+    def _current_path(self) -> str:
+        if self._generation == 0:
+            return self.path
+        base, ext = os.path.splitext(self.path)
+        return f"{base}.{self._generation}{ext}"
+
+    def record(self, event: RouterEvent) -> None:
+        line = json.dumps({"ts": time.time(), "event": event.to_dict()})
+        self._fh.write(line + "\n")
+        self._lines += 1
+        if self._lines >= self.max_lines:
+            self._rotate()
+
+    def _rotate(self) -> None:
+        self._fh.close()
+        self._generation += 1
+        self._lines = 0
+        self._fh = open(self._current_path(), "a", encoding="utf-8")
+
+    def flush(self) -> None:
+        self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.close()
+
+    @staticmethod
+    def replay(path: str) -> Iterator[RouterEvent]:
+        """Yield events from a recording (single file)."""
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                yield RouterEvent.from_dict(d["event"])
+
+    @staticmethod
+    def replay_into(path: str, apply: Callable[[RouterEvent], None]) -> int:
+        n = 0
+        for ev in KvRecorder.replay(path):
+            apply(ev)
+            n += 1
+        return n

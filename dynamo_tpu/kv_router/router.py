@@ -1,0 +1,61 @@
+"""KvRouter: indexer + scheduler glued into a schedulable unit.
+
+`schedule(token_ids)` → the worker that minimizes cost given prefix overlap
+and load. Consumes RouterEvents (worker KV deltas) and metrics updates.
+Reference parity: KvRouter (kv_router.rs:57-170) — the event-plane plumbing
+(subscription to workers) lives in the distributed runtime layer, keeping
+this class transport-free and unit-testable.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+from dynamo_tpu.kv_router.indexer import KvIndexer
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, RouterEvent
+from dynamo_tpu.kv_router.scheduler import (
+    KvScheduler,
+    SchedulingDecision,
+    WorkerSelector,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class KvRouter:
+    def __init__(
+        self,
+        block_size: int,
+        selector: Optional[WorkerSelector] = None,
+        salt: Optional[bytes] = None,
+    ):
+        self.block_size = block_size
+        self.indexer = KvIndexer(block_size, salt=salt)
+        self.scheduler = KvScheduler(selector)
+
+    # -- event/metrics ingestion (wired to transports by the runtime layer) --
+
+    def apply_event(self, event: RouterEvent) -> None:
+        self.indexer.apply_event(event)
+
+    def update_worker_metrics(self, worker_id: str, metrics: ForwardPassMetrics) -> None:
+        self.scheduler.update_worker(worker_id, metrics)
+
+    def remove_worker(self, worker_id: str) -> None:
+        self.indexer.remove_worker(worker_id)
+        self.scheduler.remove_worker(worker_id)
+
+    # -- scheduling ----------------------------------------------------------
+
+    def schedule(self, token_ids: Sequence[int]) -> Optional[SchedulingDecision]:
+        """Pick a worker for this prompt; None if no workers registered."""
+        overlaps = self.indexer.find_matches_for_request(token_ids)
+        isl_blocks = (len(token_ids) + self.block_size - 1) // self.block_size
+        decision = self.scheduler.schedule(overlaps, isl_blocks)
+        if decision is not None:
+            logger.debug(
+                "scheduled %d tokens → %s (overlap=%d blocks, logit=%.3f)",
+                len(token_ids), decision.worker_id, decision.overlap_blocks, decision.logit,
+            )
+        return decision

@@ -1,0 +1,110 @@
+"""KV-aware worker selection.
+
+Cost function parity with the reference's DefaultWorkerSelector
+(kv_router/scheduler.rs:237): ``logit = 2*overlap_blocks − gpu_cache_usage −
+normalized_active_slots``, highest wins, ties broken randomly. After each
+selection the chosen worker's predicted load is bumped so a burst of
+identical requests spreads out (scheduler.rs:207 process_worker_selection).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional, Protocol, Tuple
+
+from dynamo_tpu.kv_router.indexer import OverlapScores
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+
+
+@dataclass
+class SchedulingDecision:
+    worker_id: str
+    overlap_blocks: int
+    logit: float
+
+
+class WorkerSelector(Protocol):
+    """Pluggable selection policy (reference WorkerSelector trait, kv_router.rs)."""
+
+    def select_worker(
+        self,
+        workers: Dict[str, ForwardPassMetrics],
+        overlaps: OverlapScores,
+        isl_blocks: int,
+    ) -> Optional[SchedulingDecision]: ...
+
+
+class DefaultWorkerSelector:
+    def __init__(self, rng: Optional[random.Random] = None):
+        self._rng = rng or random.Random()
+
+    def select_worker(
+        self,
+        workers: Dict[str, ForwardPassMetrics],
+        overlaps: OverlapScores,
+        isl_blocks: int,
+    ) -> Optional[SchedulingDecision]:
+        if not workers:
+            return None
+        best: list[Tuple[str, float, int]] = []
+        best_logit = float("-inf")
+        for wid, m in workers.items():
+            overlap = overlaps.get(wid, 0)
+            slots_norm = (
+                m.request_active_slots / m.request_total_slots
+                if m.request_total_slots
+                else 0.0
+            )
+            logit = 2.0 * overlap - m.gpu_cache_usage_perc - slots_norm
+            if logit > best_logit + 1e-9:
+                best_logit = logit
+                best = [(wid, logit, overlap)]
+            elif abs(logit - best_logit) <= 1e-9:
+                best.append((wid, logit, overlap))
+        wid, logit, overlap = self._rng.choice(best)
+        return SchedulingDecision(worker_id=wid, overlap_blocks=overlap, logit=logit)
+
+
+class KvScheduler:
+    """Tracks per-worker load state and applies the selector.
+
+    Between metric refreshes (pushed by the metrics aggregator), each selection
+    optimistically bumps the chosen worker's predicted slots/blocks so
+    back-to-back requests don't pile onto one worker.
+    """
+
+    def __init__(self, selector: Optional[WorkerSelector] = None):
+        self._selector = selector or DefaultWorkerSelector()
+        self._workers: Dict[str, ForwardPassMetrics] = {}
+        self._lock = threading.Lock()
+
+    def update_worker(self, worker_id: str, metrics: ForwardPassMetrics) -> None:
+        with self._lock:
+            self._workers[worker_id] = metrics
+
+    def remove_worker(self, worker_id: str) -> None:
+        with self._lock:
+            self._workers.pop(worker_id, None)
+
+    def worker_ids(self) -> list:
+        with self._lock:
+            return list(self._workers)
+
+    def schedule(
+        self, overlaps: OverlapScores, isl_blocks: int
+    ) -> Optional[SchedulingDecision]:
+        with self._lock:
+            decision = self._selector.select_worker(self._workers, overlaps, isl_blocks)
+            if decision is not None:
+                m = self._workers.get(decision.worker_id)
+                if m is not None:
+                    m.request_active_slots += 1
+                    new_blocks = max(isl_blocks - decision.overlap_blocks, 0)
+                    m.kv_active_blocks += new_blocks
+                    if m.kv_total_blocks:
+                        m.gpu_cache_usage_perc = min(
+                            m.kv_active_blocks / m.kv_total_blocks, 1.0
+                        )
+            return decision

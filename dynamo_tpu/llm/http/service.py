@@ -198,6 +198,19 @@ class HttpService:
         ctx: Context,
         guard,
     ) -> web.StreamResponse:
+        # pull the first item BEFORE sending headers, so validation errors
+        # (e.g. over-length prompts) still surface as proper HTTP status codes
+        stream = engine.generate(ctx)
+        if hasattr(stream, "__await__"):
+            stream = await stream
+        it = stream.__aiter__()
+        try:
+            first_item = await it.__anext__()
+        except StopAsyncIteration:
+            first_item = None
+        except HttpError as e:
+            return _error_response(e.status, e.message)
+
         resp = web.StreamResponse(
             status=200,
             headers={
@@ -208,8 +221,14 @@ class HttpService:
         )
         await resp.prepare(request)
 
+        async def _rest():
+            if first_item is not None:
+                yield first_item
+            async for i in it:
+                yield i
+
         try:
-            async for item in engine.generate(ctx):
+            async for item in _rest():
                 if isinstance(item, Annotated):
                     if item.is_error:
                         msg = SseMessage(event="error", data=json.dumps({"message": item.error_message()}))

@@ -30,13 +30,19 @@ def write_kv_to_pages(
     """Scatter new K/V vectors into their pages; padding positions are dropped."""
     num_blocks, block_size = k_cache.shape[0], k_cache.shape[1]
     b, t = positions.shape
+    max_blocks = block_tables.shape[1]
 
     logical_block = positions // block_size  # [B, T]
     slot = positions % block_size
-    phys = jnp.take_along_axis(block_tables, jnp.clip(logical_block, 0), axis=1)  # [B, T]
+    phys = jnp.take_along_axis(
+        block_tables, jnp.clip(logical_block, 0, max_blocks - 1), axis=1
+    )  # [B, T]
     flat_idx = phys * block_size + slot
-    # padding → out-of-range index, dropped by scatter mode="drop"
-    flat_idx = jnp.where(positions >= 0, flat_idx, num_blocks * block_size)
+    # padding or out-of-table positions → out-of-range index, dropped by the
+    # scatter (mode="drop"); without this, XLA's clamping would silently write
+    # into the wrong physical page
+    valid = (positions >= 0) & (logical_block < max_blocks)
+    flat_idx = jnp.where(valid, flat_idx, num_blocks * block_size)
 
     flat_k = k_cache.reshape(num_blocks * block_size, *k_cache.shape[2:])
     flat_v = v_cache.reshape(num_blocks * block_size, *v_cache.shape[2:])

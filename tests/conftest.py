@@ -10,15 +10,19 @@ import os
 # Force, don't setdefault: the session env pins JAX_PLATFORMS to the TPU plugin
 # (which re-registers itself at interpreter start), but the unit suite must run
 # on the virtual CPU mesh (fast, 8 devices). jax.config.update after import is
-# the only override that sticks.
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+# the only override that sticks. Escape hatch for hardware runs
+# (`pytest -m tpu`): DYN_TPU_TESTS_REAL=1 leaves the platform alone.
+if os.environ.get("DYN_TPU_TESTS_REAL") != "1":
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
-import jax  # noqa: E402
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 

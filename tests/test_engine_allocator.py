@@ -1,0 +1,117 @@
+"""BlockAllocator: prefix reuse, refcounts, LRU eviction, event emission."""
+
+from typing import List, Optional, Tuple
+
+from dynamo_tpu.engine_jax.allocator import BlockAllocator
+
+
+class SinkRecorder:
+    def __init__(self):
+        self.stored: List[Tuple[Optional[int], list]] = []
+        self.removed: List[int] = []
+
+    def blocks_stored(self, parent_hash, blocks):
+        self.stored.append((parent_hash, blocks))
+
+    def blocks_removed(self, hashes):
+        self.removed.extend(hashes)
+
+
+def test_allocate_and_free_roundtrip():
+    a = BlockAllocator(num_blocks=8, block_size=4)
+    alloc = a.allocate_sequence(list(range(10)))  # 3 blocks
+    assert alloc is not None
+    assert len(alloc.block_ids) == 3
+    assert alloc.cached_tokens == 0
+    assert a.active_blocks == 3
+    a.free_sequence(alloc)
+    assert a.active_blocks == 0
+
+
+def test_prefix_reuse_after_compute():
+    sink = SinkRecorder()
+    a = BlockAllocator(num_blocks=8, block_size=4, event_sink=sink)
+    alloc = a.allocate_sequence(list(range(10)))
+    a.note_tokens_computed(alloc, list(range(10)))  # seals blocks 0,1
+    assert len(sink.stored) == 1
+    assert len(sink.stored[0][1]) == 2  # two sealed blocks
+    a.free_sequence(alloc)
+
+    # same prompt again: both full blocks hit, partial recomputed
+    alloc2 = a.allocate_sequence(list(range(10)))
+    assert alloc2.cached_tokens == 8
+    assert alloc2.block_ids[:2] == alloc.block_ids[:2] or alloc2.cached_tokens == 8
+    a.free_sequence(alloc2)
+
+
+def test_no_full_prompt_cache_hit():
+    """Even a fully-block-aligned cached prompt must leave ≥1 token to compute."""
+    a = BlockAllocator(num_blocks=8, block_size=4)
+    alloc = a.allocate_sequence(list(range(8)))
+    a.note_tokens_computed(alloc, list(range(8)))
+    a.free_sequence(alloc)
+    alloc2 = a.allocate_sequence(list(range(8)))
+    assert alloc2.cached_tokens == 4  # only the first block; last token computed
+
+
+def test_shared_prefix_refcount():
+    a = BlockAllocator(num_blocks=8, block_size=4)
+    al1 = a.allocate_sequence(list(range(12)))
+    a.note_tokens_computed(al1, list(range(12)))
+    al2 = a.allocate_sequence(list(range(12)) + [99])
+    assert al2.cached_tokens == 12
+    shared = al1.block_ids[:3]
+    assert al2.block_ids[:3] == shared
+    # freeing the first sequence must not free shared blocks for reuse-eviction
+    a.free_sequence(al1)
+    assert set(shared) <= set(al2.block_ids)
+    a.free_sequence(al2)
+    assert a.active_blocks == 0
+
+
+def test_lru_eviction_emits_removed():
+    sink = SinkRecorder()
+    a = BlockAllocator(num_blocks=4, block_size=4, event_sink=sink)
+    al1 = a.allocate_sequence(list(range(8)))
+    a.note_tokens_computed(al1, list(range(8)))
+    a.free_sequence(al1)  # 2 cached blocks
+    al2 = a.allocate_sequence([50, 51, 52, 53, 54, 55, 56, 57])
+    a.note_tokens_computed(al2, [50, 51, 52, 53, 54, 55, 56, 57])
+    # pool is 4: al2 needed 2 fresh, pool had 2 free + 2 cached → no eviction yet
+    al3 = a.allocate_sequence([60, 61, 62, 63, 64])  # needs 2 more → evict cached
+    assert al3 is not None
+    assert sink.removed, "eviction should emit removed events"
+    a.free_sequence(al2)
+    a.free_sequence(al3)
+
+
+def test_allocation_failure_returns_none():
+    a = BlockAllocator(num_blocks=2, block_size=4)
+    al1 = a.allocate_sequence(list(range(8)))
+    assert al1 is not None
+    assert a.allocate_sequence(list(range(8, 16))) is None
+    a.free_sequence(al1)
+    assert a.allocate_sequence(list(range(8, 16))) is not None
+
+
+def test_grow():
+    a = BlockAllocator(num_blocks=4, block_size=4)
+    alloc = a.allocate_sequence([1, 2, 3])
+    assert len(alloc.block_ids) == 1
+    assert a.grow(alloc, 9)  # 3 blocks now
+    assert len(alloc.block_ids) == 3
+    assert a.grow(alloc, 16)
+    assert not a.grow(alloc, 17)  # pool exhausted
+
+
+def test_decode_sealing_registers_blocks():
+    sink = SinkRecorder()
+    a = BlockAllocator(num_blocks=8, block_size=4, event_sink=sink)
+    alloc = a.allocate_sequence([1, 2, 3])
+    a.note_tokens_computed(alloc, [1, 2, 3])
+    assert not sink.stored  # partial block: nothing sealed
+    a.grow(alloc, 5)
+    a.note_tokens_computed(alloc, [4])  # seals first block
+    assert len(sink.stored) == 1
+    a.note_tokens_computed(alloc, [5])
+    assert len(sink.stored) == 1  # second block still partial

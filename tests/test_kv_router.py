@@ -1,0 +1,195 @@
+"""KV router stack: radix tree, indexer, scheduler cost fn, router end-to-end,
+publisher adapter, recorder replay."""
+
+import random
+
+import pytest
+
+from dynamo_tpu.engine_jax.allocator import BlockAllocator
+from dynamo_tpu.kv.tokens import compute_block_hashes_for_seq
+from dynamo_tpu.kv_router.indexer import KvIndexer, RadixTree
+from dynamo_tpu.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    RemovedBlocks,
+    RouterEvent,
+    StoredBlock,
+    StoredBlocks,
+)
+from dynamo_tpu.kv_router.publisher import KvEventPublisher
+from dynamo_tpu.kv_router.recorder import KvRecorder
+from dynamo_tpu.kv_router.router import KvRouter
+from dynamo_tpu.kv_router.scheduler import DefaultWorkerSelector, KvScheduler
+
+BS = 4
+
+
+def stored_event(worker, token_ids, event_id=0):
+    hashes = compute_block_hashes_for_seq(token_ids, BS)
+    blocks = [StoredBlock(h, 0) for h in hashes]
+    parents = [None] + hashes[:-1]
+    return RouterEvent(
+        worker, KvCacheEvent(event_id, StoredBlocks(parent_hash=None, blocks=blocks))
+    )
+
+
+class TestRadixTree:
+    def test_match_after_store(self):
+        tree = RadixTree()
+        tree.apply_event(stored_event("w1", list(range(12))))
+        scores = tree.find_matches(compute_block_hashes_for_seq(list(range(12)), BS))
+        assert scores == {"w1": 3}
+
+    def test_partial_prefix_match(self):
+        tree = RadixTree()
+        tree.apply_event(stored_event("w1", list(range(12))))
+        query = list(range(8)) + [99, 98, 97, 96]
+        scores = tree.find_matches(compute_block_hashes_for_seq(query, BS))
+        assert scores == {"w1": 2}
+
+    def test_multiple_workers(self):
+        tree = RadixTree()
+        tree.apply_event(stored_event("w1", list(range(12))))
+        tree.apply_event(stored_event("w2", list(range(8))))
+        scores = tree.find_matches(compute_block_hashes_for_seq(list(range(12)), BS))
+        assert scores == {"w1": 3, "w2": 2}
+
+    def test_removed_blocks(self):
+        tree = RadixTree()
+        tree.apply_event(stored_event("w1", list(range(12))))
+        hashes = compute_block_hashes_for_seq(list(range(12)), BS)
+        tree.apply_event(
+            RouterEvent("w1", KvCacheEvent(1, RemovedBlocks([hashes[-1]])))
+        )
+        scores = tree.find_matches(hashes)
+        assert scores == {"w1": 2}
+
+    def test_remove_worker(self):
+        tree = RadixTree()
+        tree.apply_event(stored_event("w1", list(range(8))))
+        tree.apply_event(stored_event("w2", list(range(8))))
+        tree.remove_worker("w1")
+        scores = tree.find_matches(compute_block_hashes_for_seq(list(range(8)), BS))
+        assert scores == {"w2": 2}
+
+    def test_contiguity_required(self):
+        """A worker holding a later block but missing an earlier one scores
+        only the contiguous part."""
+        tree = RadixTree()
+        tree.apply_event(stored_event("w1", list(range(12))))
+        hashes = compute_block_hashes_for_seq(list(range(12)), BS)
+        # w2 only has the middle block (simulate via removed on 1st and 3rd)
+        tree.apply_event(stored_event("w2", list(range(12))))
+        tree.apply_event(RouterEvent("w2", KvCacheEvent(1, RemovedBlocks([hashes[0]]))))
+        scores = tree.find_matches(hashes)
+        assert scores.get("w2") is None  # chain broken at block 0
+        assert scores["w1"] == 3
+
+
+class TestScheduler:
+    def metrics(self, slots=0, usage=0.0):
+        return ForwardPassMetrics(
+            request_active_slots=slots,
+            request_total_slots=8,
+            kv_total_blocks=100,
+            gpu_cache_usage_perc=usage,
+        )
+
+    def test_overlap_wins(self):
+        sel = DefaultWorkerSelector(random.Random(0))
+        workers = {"a": self.metrics(), "b": self.metrics()}
+        d = sel.select_worker(workers, {"b": 3}, isl_blocks=4)
+        assert d.worker_id == "b"
+        assert d.overlap_blocks == 3
+
+    def test_load_breaks_even_overlap(self):
+        sel = DefaultWorkerSelector(random.Random(0))
+        workers = {"a": self.metrics(slots=7), "b": self.metrics(slots=0)}
+        d = sel.select_worker(workers, {}, isl_blocks=4)
+        assert d.worker_id == "b"
+
+    def test_usage_penalty(self):
+        sel = DefaultWorkerSelector(random.Random(0))
+        workers = {"a": self.metrics(usage=0.9), "b": self.metrics(usage=0.1)}
+        d = sel.select_worker(workers, {}, isl_blocks=4)
+        assert d.worker_id == "b"
+
+    def test_predicted_load_spreads_burst(self):
+        sched = KvScheduler()
+        sched.update_worker("a", self.metrics())
+        sched.update_worker("b", self.metrics())
+        chosen = {sched.schedule({}, 4).worker_id for _ in range(8)}
+        assert chosen == {"a", "b"}  # optimistic bump spreads identical requests
+
+    def test_no_workers(self):
+        sched = KvScheduler()
+        assert sched.schedule({}, 4) is None
+
+
+class TestRouterEndToEnd:
+    def test_routes_to_prefix_holder(self):
+        router = KvRouter(block_size=BS)
+        router.update_worker_metrics("w1", ForwardPassMetrics(request_total_slots=8, kv_total_blocks=100))
+        router.update_worker_metrics("w2", ForwardPassMetrics(request_total_slots=8, kv_total_blocks=100))
+        router.apply_event(stored_event("w2", list(range(16))))
+        d = router.schedule(list(range(16)) + [77])
+        assert d.worker_id == "w2"
+        assert d.overlap_blocks == 4
+
+    def test_dead_worker_not_selected(self):
+        router = KvRouter(block_size=BS)
+        router.update_worker_metrics("w1", ForwardPassMetrics())
+        router.update_worker_metrics("w2", ForwardPassMetrics())
+        router.apply_event(stored_event("w2", list(range(16))))
+        router.remove_worker("w2")
+        d = router.schedule(list(range(16)))
+        assert d.worker_id == "w1"
+
+
+class TestPublisherIntegration:
+    def test_allocator_to_indexer_roundtrip(self):
+        """Worker allocator events → publisher → indexer: prefix visible."""
+        events = []
+        pub = KvEventPublisher("w9", events.append)
+        alloc = BlockAllocator(num_blocks=8, block_size=BS, event_sink=pub)
+        a = alloc.allocate_sequence(list(range(10)))
+        alloc.note_tokens_computed(a, list(range(10)))
+
+        idx = KvIndexer(block_size=BS)
+        idx.apply_events(events)
+        scores = idx.find_matches_for_request(list(range(10)))
+        assert scores == {"w9": 2}
+
+        alloc.free_sequence(a)
+        # force eviction by filling the pool
+        b = alloc.allocate_sequence([100 + i for i in range(32)])
+        assert b is not None
+        idx.apply_events(events[1:])
+        scores = idx.find_matches_for_request(list(range(10)))
+        assert scores.get("w9") is None  # evicted blocks no longer advertised
+
+
+class TestRecorder:
+    def test_record_replay(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        rec = KvRecorder(path)
+        ev = stored_event("w1", list(range(8)))
+        rec.record(ev)
+        rec.record(RouterEvent("w1", KvCacheEvent(1, RemovedBlocks([123]))))
+        rec.close()
+
+        tree = RadixTree()
+        n = KvRecorder.replay_into(path, tree.apply_event)
+        assert n == 2
+        scores = tree.find_matches(compute_block_hashes_for_seq(list(range(8)), BS))
+        assert scores == {"w1": 2}
+
+    def test_rotation(self, tmp_path):
+        path = str(tmp_path / "r.jsonl")
+        rec = KvRecorder(path, max_lines_per_file=2)
+        for i in range(5):
+            rec.record(stored_event("w", [i, i + 1, i + 2, i + 3], event_id=i))
+        rec.close()
+        import glob
+
+        assert len(glob.glob(str(tmp_path / "r*.jsonl"))) == 3
