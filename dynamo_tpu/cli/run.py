@@ -64,8 +64,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-batch-size", type=int, default=8)
     p.add_argument("--kv-block-size", type=int, default=16)
     p.add_argument("--max-model-len", type=int, default=None)
-    p.add_argument("--router-mode", choices=["random", "round_robin", "kv"], default="random")
+    p.add_argument("--router-mode", default="random",
+                   help="random | round_robin | kv | direct:<instance_id>")
     p.add_argument("--statestore", default=None, help="statestore url for distributed mode")
+    p.add_argument("--bus", default=None, help="message bus url for distributed mode")
+    p.add_argument("--wait-workers-timeout", type=float, default=60.0)
     p.add_argument("--extra-engine-args", default=None, help="JSON file of engine kwargs")
     return p
 
@@ -86,6 +89,12 @@ class DispatchEngine:
         is_chat = hasattr(data, "messages") or (
             isinstance(data, dict) and "messages" in data
         )
+        if isinstance(data, dict):
+            # requests arriving over RPC are plain dicts: revalidate
+            from ..llm.protocols.openai import ChatCompletionRequest, CompletionRequest
+
+            model = ChatCompletionRequest if is_chat else CompletionRequest
+            request = request.transfer(model.model_validate(data))
         engine = self._chat if is_chat else self._completions
         return engine.generate(request)
 
@@ -119,13 +128,13 @@ def build_engine(out_spec: str, flags: argparse.Namespace):
 
     if out_spec == "echo_full":
         engine = EchoEngineFull()
-        return engine, engine, model_name
+        return engine, engine, model_name, None
 
     if out_spec == "echo_core":
         if card is None:
             raise SystemExit("out=echo_core requires --model-path (tokenizer needed)")
         chat_eng, comp_eng = _token_pipelines(card, EchoEngineCore)
-        return chat_eng, comp_eng, model_name
+        return chat_eng, comp_eng, model_name, None
 
     if out_spec == "jax":
         if card is None:
@@ -148,20 +157,25 @@ def build_engine(out_spec: str, flags: argparse.Namespace):
             **extra,
         )
         chat_eng, comp_eng = _token_pipelines(card, lambda: core)
-        return chat_eng, comp_eng, model_name
+        return chat_eng, comp_eng, model_name, core
 
     if out_spec.startswith("dyn://"):
-        try:
-            from ..runtime.distributed import DistributedRuntime, parse_endpoint_path
-        except ImportError as e:
-            raise SystemExit(f"out=dyn:// unavailable: {e}")
-
-        ns, comp, ep = parse_endpoint_path(out_spec)
-        drt = DistributedRuntime.from_settings(statestore_url=flags.statestore)
-        client = drt.namespace(ns).component(comp).endpoint(ep).client(flags.router_mode)
-        return client, client, model_name
+        raise SystemExit("internal: dyn:// engines are built in amain")  # async path
 
     raise SystemExit(f"unknown out= engine: {out_spec!r}")
+
+
+async def build_remote_client(out_spec: str, flags: argparse.Namespace):
+    """out=dyn://ns.comp.ep → EndpointClient routing across live workers."""
+    from ..runtime.distributed import DistributedRuntime, parse_endpoint_path
+
+    ns, comp, ep = parse_endpoint_path(out_spec)
+    drt = await DistributedRuntime.create(
+        statestore_url=flags.statestore, bus_url=flags.bus
+    )
+    client = await drt.namespace(ns).component(comp).endpoint(ep).client(flags.router_mode)
+    await client.wait_for_instances(1, timeout=flags.wait_workers_timeout)
+    return client, drt
 
 
 async def run_http(chat_engine, completions_engine, model_name: str, flags: argparse.Namespace) -> None:
@@ -272,22 +286,30 @@ async def run_batch(engine, model_name: str, batch_file: str) -> None:
     print(json.dumps(stats))
 
 
-async def run_endpoint(chat_engine, completions_engine, model_name: str, in_spec: str, flags: argparse.Namespace) -> None:
+async def run_endpoint(chat_engine, completions_engine, model_name: str, in_spec: str,
+                       flags: argparse.Namespace, core_engine=None) -> None:
     """Register as a distributed worker on dyn://ns.comp.ep (serves both
-    chat and completions requests via shape dispatch)."""
-    engine = DispatchEngine(chat_engine, completions_engine)
-    try:
-        from ..runtime.distributed import DistributedRuntime, parse_endpoint_path
-    except ImportError as e:
-        raise SystemExit(f"in=dyn:// unavailable: {e}")
+    chat and completions requests via shape dispatch). Engines with a KV
+    allocator also publish KV events + load metrics for KV-aware routing."""
+    from ..runtime.distributed import (
+        DistributedRuntime,
+        attach_kv_publishing,
+        parse_endpoint_path,
+    )
 
+    engine = DispatchEngine(chat_engine, completions_engine)
     ns, comp, ep = parse_endpoint_path(in_spec)
-    drt = DistributedRuntime.from_settings(statestore_url=flags.statestore)
+    drt = await DistributedRuntime.create(
+        statestore_url=flags.statestore, bus_url=flags.bus
+    )
     component = drt.namespace(ns).component(comp)
     await component.create_service()
     endpoint = component.endpoint(ep)
-    await endpoint.serve(engine, model_entry={"name": model_name})
-    logger.info("worker serving %s", in_spec)
+    info = await endpoint.serve(engine, model_entry={"name": model_name, "kind": "chat"})
+    if core_engine is not None and hasattr(core_engine, "metrics_snapshot"):
+        await attach_kv_publishing(endpoint, info.instance_id, core_engine)
+        logger.info("kv events + metrics publishing enabled (worker key %s)", info.instance_id)
+    logger.info("worker %s serving %s at %s", info.worker_id, in_spec, info.address)
     await drt.wait_closed()
 
 
@@ -295,7 +317,13 @@ async def amain(argv: list[str]) -> None:
     init_logging()
     in_spec, out_spec, rest = parse_io(argv)
     flags = build_parser().parse_args(rest)
-    chat_engine, completions_engine, model_name = build_engine(out_spec, flags)
+    core_engine = None
+    if out_spec.startswith("dyn://"):
+        client, _drt = await build_remote_client(out_spec, flags)
+        chat_engine = completions_engine = client
+        model_name = flags.model_name or out_spec
+    else:
+        chat_engine, completions_engine, model_name, core_engine = build_engine(out_spec, flags)
 
     if in_spec == "http":
         await run_http(chat_engine, completions_engine, model_name, flags)
@@ -304,7 +332,8 @@ async def amain(argv: list[str]) -> None:
     elif in_spec.startswith("batch:"):
         await run_batch(chat_engine, model_name, in_spec[len("batch:"):])
     elif in_spec.startswith("dyn://"):
-        await run_endpoint(chat_engine, completions_engine, model_name, in_spec, flags)
+        await run_endpoint(chat_engine, completions_engine, model_name, in_spec, flags,
+                           core_engine=core_engine)
     elif in_spec == "none":
         await asyncio.Event().wait()
     else:

@@ -75,6 +75,9 @@ class BlockAllocator:
         self.hit_tokens = 0
         self.probe_tokens = 0
 
+    def set_sink(self, sink: Optional[KvEventSink]) -> None:
+        self._sink = sink
+
     # -- queries -------------------------------------------------------------
 
     @property

@@ -460,6 +460,11 @@ class JaxServingEngine(AsyncEngine):
         with self._cond:
             self._pending.append(seq)
 
+    def set_event_sink(self, sink: KvEventSink) -> None:
+        """Attach/replace the KV event sink (e.g. the distributed publish
+        bridge) after construction."""
+        self.allocator.set_sink(sink)
+
     # -- metrics -------------------------------------------------------------
 
     def metrics_snapshot(self) -> Dict[str, Any]:

@@ -1,0 +1,329 @@
+"""Self-hosted event/queue plane: pub/sub subjects + durable work queues.
+
+Capability parity with the reference's NATS usage (SURVEY.md §1):
+- **pub/sub subjects** carry KV cache events (`kv_events`), hit-rate events
+  and other scoped notifications (traits/events.rs:31-96);
+- **work queues** back the disaggregated prefill queue (JetStream work-queue
+  stream, examples/llm/utils/nats_queue.py) — at-most-once pop with blocking
+  waiters.
+
+One asyncio TCP service speaking the framed codec; the request/response RPC
+plane does NOT go through here (workers are dialed directly — see rpc.py —
+which removes a broker hop the reference pays on every request).
+
+Run standalone: ``python -m dynamo_tpu.runtime.bus --port 37902``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import itertools
+import json
+import logging
+import uuid
+from collections import deque
+from typing import AsyncIterator, Deque, Dict, List, Optional, Tuple
+
+from dynamo_tpu.runtime.codec import TwoPartMessage, read_frame, write_frame
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PORT = 37902
+
+
+class MessageBusServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = DEFAULT_PORT):
+        self.host = host
+        self.port = port
+        # subject → {sub_id → writer}
+        self._subs: Dict[str, Dict[str, asyncio.StreamWriter]] = {}
+        self._queues: Dict[str, Deque[bytes]] = {}
+        # queue → waiters (sub_id, writer, req_id)
+        self._queue_waiters: Dict[str, Deque[Tuple[asyncio.StreamWriter, int]]] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("message bus listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        conn_subs: List[Tuple[str, str]] = []  # (subject, sub_id)
+        try:
+            while True:
+                try:
+                    frame = await read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return
+                req = json.loads(frame.header)
+                reply = await self._dispatch(req, frame.body, writer, conn_subs)
+                if reply is not None:
+                    reply["id"] = req.get("id")
+                    await write_frame(writer, TwoPartMessage(json.dumps(reply).encode(), b""))
+        finally:
+            for subject, sub_id in conn_subs:
+                subs = self._subs.get(subject)
+                if subs:
+                    subs.pop(sub_id, None)
+            for waiters in self._queue_waiters.values():
+                remaining = deque((w, rid) for w, rid in waiters if w is not writer)
+                waiters.clear()
+                waiters.extend(remaining)
+            writer.close()
+
+    async def _dispatch(self, req, body, writer, conn_subs) -> Optional[dict]:
+        op = req.get("op")
+        if op == "pub":
+            subject = req["subject"]
+            dead = []
+            for sub_id, w in list(self._subs.get(subject, {}).items()):
+                try:
+                    await write_frame(
+                        w,
+                        TwoPartMessage(
+                            json.dumps(
+                                {"push": "msg", "subject": subject, "sub_id": sub_id}
+                            ).encode(),
+                            body,
+                        ),
+                    )
+                except (ConnectionError, RuntimeError):
+                    dead.append(sub_id)
+            for sid in dead:
+                self._subs[subject].pop(sid, None)
+            return {"ok": True}
+        if op == "sub":
+            sub_id = req.get("sub_id") or uuid.uuid4().hex
+            self._subs.setdefault(req["subject"], {})[sub_id] = writer
+            conn_subs.append((req["subject"], sub_id))
+            return {"ok": True, "sub_id": sub_id}
+        if op == "unsub":
+            subs = self._subs.get(req["subject"], {})
+            subs.pop(req["sub_id"], None)
+            return {"ok": True}
+        if op == "qpush":
+            queue = req["queue"]
+            waiters = self._queue_waiters.get(queue)
+            while waiters:  # try every live waiter before enqueueing
+                w, req_id = waiters.popleft()
+                try:
+                    await write_frame(
+                        w,
+                        TwoPartMessage(
+                            json.dumps({"id": req_id, "ok": True, "found": True}).encode(),
+                            body,
+                        ),
+                    )
+                    return {"ok": True}
+                except (ConnectionError, RuntimeError):
+                    continue  # waiter died: try the next one
+            self._queues.setdefault(queue, deque()).append(body)
+            return {"ok": True}
+        if op == "qpop":
+            queue = req["queue"]
+            q = self._queues.get(queue)
+            if q:
+                return_body = q.popleft()
+                await write_frame(
+                    writer,
+                    TwoPartMessage(
+                        json.dumps({"id": req.get("id"), "ok": True, "found": True}).encode(),
+                        return_body,
+                    ),
+                )
+                return None  # reply already sent (with body)
+            if req.get("block"):
+                self._queue_waiters.setdefault(queue, deque()).append(
+                    (writer, req.get("id"))
+                )
+                return None  # reply deferred until a push arrives
+            return {"ok": True, "found": False}
+        if op == "qcancel":
+            # remove this connection's blocked pop (client-side cancellation)
+            waiters = self._queue_waiters.get(req["queue"])
+            if waiters:
+                remaining = deque(
+                    (w, rid) for w, rid in waiters
+                    if not (w is writer and rid == req.get("cancel_id"))
+                )
+                waiters.clear()
+                waiters.extend(remaining)
+            return {"ok": True}
+        if op == "qlen":
+            return {"ok": True, "len": len(self._queues.get(req["queue"], ()))}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+
+class Subscription:
+    """Async iterator over messages for one subject subscription."""
+
+    def __init__(self, client: "MessageBusClient", subject: str, sub_id: str):
+        self.client = client
+        self.subject = subject
+        self.sub_id = sub_id
+        self.queue: asyncio.Queue = asyncio.Queue()
+
+    def __aiter__(self) -> AsyncIterator[bytes]:
+        return self._iter()
+
+    async def _iter(self) -> AsyncIterator[bytes]:
+        while True:
+            item = await self.queue.get()
+            if item is None:
+                return
+            yield item
+
+    async def cancel(self) -> None:
+        self.client._subs.pop(self.sub_id, None)
+        try:
+            await self.client._call({"op": "unsub", "subject": self.subject, "sub_id": self.sub_id})
+        except ConnectionError:
+            pass
+        self.queue.put_nowait(None)
+
+
+class MessageBusClient:
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._ids = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._subs: Dict[str, Subscription] = {}
+        self._reader_task: Optional[asyncio.Task] = None
+        self._send_lock = asyncio.Lock()
+
+    @classmethod
+    async def connect(cls, url: str) -> "MessageBusClient":
+        host, _, port = url.rpartition(":")
+        c = cls(host or "127.0.0.1", int(port))
+        c._reader, c._writer = await asyncio.open_connection(c.host, c.port)
+        c._reader_task = asyncio.create_task(c._read_loop())
+        return c
+
+    async def close(self) -> None:
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._writer:
+            self._writer.close()
+        for s in self._subs.values():
+            s.queue.put_nowait(None)
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = await read_frame(self._reader)
+                h = json.loads(frame.header)
+                if h.get("push") == "msg":
+                    sub = self._subs.get(h["sub_id"])
+                    if sub is not None:
+                        sub.queue.put_nowait(frame.body)
+                    continue
+                fut = self._pending.pop(h.get("id"), None)
+                if fut is not None and not fut.done():
+                    fut.set_result((h, frame.body))
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("bus connection lost"))
+            for s in self._subs.values():
+                s.queue.put_nowait(None)
+
+    async def _call(self, req: dict, body: bytes = b"") -> Tuple[dict, bytes]:
+        req_id = next(self._ids)
+        req["id"] = req_id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        async with self._send_lock:
+            await write_frame(self._writer, TwoPartMessage(json.dumps(req).encode(), body))
+        reply, rbody = await fut
+        if not reply.get("ok"):
+            raise RuntimeError(f"bus error: {reply.get('error')}")
+        return reply, rbody
+
+    # -- public API ----------------------------------------------------------
+
+    async def publish(self, subject: str, payload: bytes) -> None:
+        await self._call({"op": "pub", "subject": subject}, payload)
+
+    async def subscribe(self, subject: str) -> Subscription:
+        sub_id = uuid.uuid4().hex
+        sub = Subscription(self, subject, sub_id)
+        self._subs[sub_id] = sub
+        await self._call({"op": "sub", "subject": subject, "sub_id": sub_id})
+        return sub
+
+    async def queue_push(self, queue: str, payload: bytes) -> None:
+        await self._call({"op": "qpush", "queue": queue}, payload)
+
+    async def queue_pop(self, queue: str, block: bool = False) -> Optional[bytes]:
+        """Pop one item; with block=True waits for a push. Cancellation-safe:
+        a cancelled blocking pop withdraws its server-side waiter, and an item
+        that raced the cancellation is re-pushed rather than lost."""
+        req_id = next(self._ids)
+        req = {"op": "qpop", "queue": queue, "block": block, "id": req_id}
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        async with self._send_lock:
+            await write_frame(self._writer, TwoPartMessage(json.dumps(req).encode(), b""))
+        try:
+            reply, body = await fut
+        except asyncio.CancelledError:
+            # leave a tombstone so a racing delivery is still captured, then
+            # withdraw the waiter. Server→client writes are FIFO, so once the
+            # qcancel reply arrives, any delivery for req_id has already been
+            # read — the tombstone tells us whether to requeue it.
+            tomb: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._pending[req_id] = tomb
+
+            async def _cleanup():
+                try:
+                    await self._call({"op": "qcancel", "queue": queue, "cancel_id": req_id})
+                    self._pending.pop(req_id, None)
+                    if tomb.done():
+                        r, b = tomb.result()
+                        if r.get("found"):
+                            await self.queue_push(queue, b)
+                except (ConnectionError, RuntimeError):
+                    pass
+
+            asyncio.ensure_future(_cleanup())
+            raise
+        if not reply.get("ok"):
+            raise RuntimeError(f"bus error: {reply.get('error')}")
+        return body if reply.get("found") else None
+
+    async def queue_len(self, queue: str) -> int:
+        reply, _ = await self._call({"op": "qlen", "queue": queue})
+        return int(reply.get("len", 0))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="dynamo_tpu message bus server")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=DEFAULT_PORT)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        server = MessageBusServer(args.host, args.port)
+        await server.start()
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

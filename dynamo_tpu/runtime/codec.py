@@ -1,0 +1,96 @@
+"""Two-part framed wire codec: length-prefixed header + body with checksum.
+
+Frame layout (capability parity with the reference's TwoPartCodec,
+lib/runtime/src/pipeline/network/codec/two_part.rs — re-specified, not ported):
+
+    [8B LE header_len][8B LE body_len][8B LE checksum][header][body]
+
+checksum = crc32(header || body), zero-extended to 8 bytes. (The reference
+uses xxh3; crc32 is chosen here because it is equally cheap from Python
+(zlib) and C++ (zlib/hardware), keeping the native codec trivially
+wire-compatible. Content-addressed KV hashing still uses xxh3 — different
+concern, different hash.)
+
+Max-size enforcement guards both sides against corrupt/hostile frames.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+PRELUDE = struct.Struct("<QQQ")
+MAX_HEADER = 16 * 1024 * 1024
+MAX_BODY = 1024 * 1024 * 1024
+
+
+class CodecError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class TwoPartMessage:
+    header: bytes
+    body: bytes
+
+
+def checksum(header: bytes, body: bytes) -> int:
+    c = zlib.crc32(header)
+    return zlib.crc32(body, c)
+
+
+def encode(msg: TwoPartMessage) -> bytes:
+    if len(msg.header) > MAX_HEADER:
+        raise CodecError(f"header too large: {len(msg.header)}")
+    if len(msg.body) > MAX_BODY:
+        raise CodecError(f"body too large: {len(msg.body)}")
+    return (
+        PRELUDE.pack(len(msg.header), len(msg.body), checksum(msg.header, msg.body))
+        + msg.header
+        + msg.body
+    )
+
+
+def decode(buf: bytes) -> Tuple[Optional[TwoPartMessage], bytes]:
+    """Try to decode one frame; returns (message | None, remaining bytes)."""
+    if len(buf) < PRELUDE.size:
+        return None, buf
+    hlen, blen, csum = PRELUDE.unpack_from(buf)
+    _validate_sizes(hlen, blen)
+    total = PRELUDE.size + hlen + blen
+    if len(buf) < total:
+        return None, buf
+    header = buf[PRELUDE.size : PRELUDE.size + hlen]
+    body = buf[PRELUDE.size + hlen : total]
+    if checksum(header, body) != csum:
+        raise CodecError("checksum mismatch")
+    return TwoPartMessage(bytes(header), bytes(body)), buf[total:]
+
+
+def _validate_sizes(hlen: int, blen: int) -> None:
+    if hlen > MAX_HEADER:
+        raise CodecError(f"header length {hlen} exceeds max {MAX_HEADER}")
+    if blen > MAX_BODY:
+        raise CodecError(f"body length {blen} exceeds max {MAX_BODY}")
+
+
+# -- asyncio stream helpers --------------------------------------------------
+
+async def read_frame(reader: asyncio.StreamReader) -> TwoPartMessage:
+    """Read one frame; raises IncompleteReadError on clean EOF."""
+    prelude = await reader.readexactly(PRELUDE.size)
+    hlen, blen, csum = PRELUDE.unpack(prelude)
+    _validate_sizes(hlen, blen)
+    header = await reader.readexactly(hlen) if hlen else b""
+    body = await reader.readexactly(blen) if blen else b""
+    if checksum(header, body) != csum:
+        raise CodecError("checksum mismatch")
+    return TwoPartMessage(header, body)
+
+
+async def write_frame(writer: asyncio.StreamWriter, msg: TwoPartMessage) -> None:
+    writer.write(encode(msg))
+    await writer.drain()

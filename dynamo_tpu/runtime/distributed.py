@@ -1,0 +1,499 @@
+"""Distributed runtime: Namespace → Component → Endpoint over the self-hosted
+control plane (statestore.py) + event plane (bus.py) + direct RPC (rpc.py).
+
+Capability parity with the reference's component model
+(lib/runtime/src/component.rs:99-345, component/client.rs:52-319):
+
+- workers register endpoint *instances* in the statestore under a lease;
+  lease expiry removes them and every watching client drops them live
+- clients watch the instance prefix and route Random / RoundRobin / Direct /
+  KV-aware across live instances
+- namespaced pub/sub events (`{ns}.{subject}`) carry KV cache events and
+  worker metrics
+
+Key layout in the statestore:
+  {ns}/components/{comp}/endpoints/{ep}/instances/{instance_id} → InstanceInfo
+  {ns}/models/{kind}/{name}                                     → ModelEntry
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import random
+import uuid
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional
+
+from dynamo_tpu.runtime.annotated import Annotated
+from dynamo_tpu.runtime.bus import MessageBusClient
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.rpc import RpcClient, RpcServer
+from dynamo_tpu.runtime.statestore import Lease, StateStoreClient, WatchEvent
+
+logger = logging.getLogger(__name__)
+
+KV_EVENTS_SUBJECT = "kv_events"
+KV_METRICS_SUBJECT = "kv_metrics"
+
+
+def parse_endpoint_path(path: str) -> tuple:
+    """dyn://ns.comp.ep → (ns, comp, ep). Reference: protocols.rs:33-302."""
+    p = path
+    if p.startswith("dyn://"):
+        p = p[len("dyn://"):]
+    parts = p.split(".")
+    if len(parts) != 3 or not all(parts):
+        raise ValueError(f"invalid endpoint path {path!r} (want dyn://ns.component.endpoint)")
+    return parts[0], parts[1], parts[2]
+
+
+@dataclass
+class InstanceInfo:
+    instance_id: str
+    address: str  # host:port of the worker's rpc server
+    worker_id: str
+
+    def to_json(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "InstanceInfo":
+        d = json.loads(raw)
+        return cls(**{k: d[k] for k in ("instance_id", "address", "worker_id")})
+
+
+class DistributedRuntime:
+    """Per-process handle on the distributed planes.
+
+    Reference: DistributedRuntime (lib/runtime/src/distributed.rs:32-155).
+    """
+
+    def __init__(self, store: StateStoreClient, bus: Optional[MessageBusClient],
+                 advertise_host: str = "127.0.0.1"):
+        self.store = store
+        self.bus = bus
+        self.worker_id = uuid.uuid4().hex[:12]
+        self.advertise_host = advertise_host
+        self._store_url: str = ""
+        self._rpc_server: Optional[RpcServer] = None
+        self._primary_lease: Optional[Lease] = None
+        self._closed = asyncio.Event()
+        self._background: list = []
+
+    @classmethod
+    async def create(
+        cls,
+        statestore_url: Optional[str] = None,
+        bus_url: Optional[str] = None,
+        advertise_host: Optional[str] = None,
+    ) -> "DistributedRuntime":
+        store_url = statestore_url or os.environ.get("DYN_TPU_STATESTORE", "127.0.0.1:37901")
+        b_url = bus_url or os.environ.get("DYN_TPU_BUS", "127.0.0.1:37902")
+        store = await StateStoreClient.connect(store_url)
+        bus: Optional[MessageBusClient] = None
+        try:
+            bus = await MessageBusClient.connect(b_url)
+        except OSError:
+            logger.warning("message bus unavailable at %s (events disabled)", b_url)
+        rt = cls(store, bus, advertise_host or os.environ.get("DYN_TPU_ADVERTISE_HOST", "127.0.0.1"))
+        rt._store_url = store_url
+        return rt
+
+    async def reconnect_store(self) -> None:
+        try:
+            await self.store.close()
+        except Exception:
+            pass
+        self.store = await StateStoreClient.connect(self._store_url)
+        self._primary_lease = None
+
+    # sync wrapper used by CLI code paths that build the runtime lazily
+    @classmethod
+    def from_settings(cls, statestore_url: Optional[str] = None, **kw) -> "DistributedRuntime":
+        raise RuntimeError("use `await DistributedRuntime.create(...)` in async context")
+
+    async def primary_lease(self) -> Lease:
+        if self._primary_lease is None:
+            self._primary_lease = await self.store.grant_lease()
+        return self._primary_lease
+
+    async def rpc_server(self) -> RpcServer:
+        if self._rpc_server is None:
+            self._rpc_server = RpcServer(host="0.0.0.0", port=0)
+            await self._rpc_server.start()
+        return self._rpc_server
+
+    def namespace(self, name: str) -> "Namespace":
+        return Namespace(self, name)
+
+    async def wait_closed(self) -> None:
+        await self._closed.wait()
+
+    async def shutdown(self) -> None:
+        for t in self._background:
+            t.cancel()
+        if self._primary_lease is not None:
+            await self._primary_lease.revoke()
+        if self._rpc_server is not None:
+            await self._rpc_server.stop()
+        if self.bus is not None:
+            await self.bus.close()
+        await self.store.close()
+        self._closed.set()
+
+
+class Namespace:
+    def __init__(self, runtime: DistributedRuntime, name: str):
+        self.runtime = runtime
+        self.name = name
+
+    def component(self, name: str) -> "Component":
+        return Component(self, name)
+
+    # -- scoped events (reference traits/events.rs:31-96) ---------------------
+
+    def subject(self, subject: str) -> str:
+        return f"{self.name}.{subject}"
+
+    async def publish(self, subject: str, payload: Any) -> None:
+        if self.runtime.bus is None:
+            return
+        raw = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+        await self.runtime.bus.publish(self.subject(subject), raw)
+
+    async def subscribe(self, subject: str):
+        if self.runtime.bus is None:
+            raise RuntimeError("message bus not connected")
+        return await self.runtime.bus.subscribe(self.subject(subject))
+
+
+class Component:
+    def __init__(self, namespace: Namespace, name: str):
+        self.namespace = namespace
+        self.name = name
+
+    @property
+    def base_key(self) -> str:
+        return f"{self.namespace.name}/components/{self.name}"
+
+    async def create_service(self) -> None:
+        await self.namespace.runtime.store.create(
+            f"{self.base_key}/service", json.dumps({"name": self.name}).encode()
+        )
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self, name)
+
+
+class Endpoint:
+    def __init__(self, component: Component, name: str):
+        self.component = component
+        self.name = name
+
+    @property
+    def instances_prefix(self) -> str:
+        return f"{self.component.base_key}/endpoints/{self.name}/instances/"
+
+    @property
+    def rpc_name(self) -> str:
+        ns = self.component.namespace.name
+        return f"{ns}.{self.component.name}.{self.name}"
+
+    @property
+    def path(self) -> str:
+        return f"dyn://{self.rpc_name}"
+
+    async def serve(
+        self,
+        engine: AsyncEngine,
+        model_entry: Optional[dict] = None,
+        lease: Optional[Lease] = None,
+    ) -> InstanceInfo:
+        """Register this process as an instance of the endpoint.
+
+        A monitor task watches for lease loss (statestore restart / missed
+        heartbeats) and re-registers with a fresh lease so the worker rejoins
+        discovery instead of silently serving zero traffic.
+        Reference: EndpointConfigBuilder::start (component/endpoint.rs:58-142).
+        """
+        rt = self.component.namespace.runtime
+        server = await rt.rpc_server()
+        server.register(self.rpc_name, engine)
+        lease = lease or await rt.primary_lease()
+        info = InstanceInfo(
+            instance_id=lease.lease_id,
+            address=f"{rt.advertise_host}:{server.port}",
+            worker_id=rt.worker_id,
+        )
+        keys = {self.instances_prefix + info.instance_id: info.to_json()}
+        if model_entry is not None:
+            kind = model_entry.get("kind", "chat")
+            name = model_entry.get("name", "model")
+            entry = dict(model_entry, endpoint=self.path)
+            keys[f"{self.component.namespace.name}/models/{kind}/{name}"] = json.dumps(
+                entry
+            ).encode()
+        for k, v in keys.items():
+            await rt.store.put(k, v, lease=lease)
+        rt._background.append(
+            asyncio.create_task(self._reregister_on_lease_loss(rt, lease, info, keys))
+        )
+        return info
+
+    async def _reregister_on_lease_loss(
+        self, rt: DistributedRuntime, lease: Lease, info: InstanceInfo, keys: dict
+    ) -> None:
+        backoff = 0.5
+        while True:
+            await lease.lost.wait()
+            logger.warning(
+                "lease %s lost for %s — re-registering", lease.lease_id, self.path
+            )
+            while True:
+                try:
+                    try:
+                        await rt.store.get("__ping__")
+                    except (ConnectionError, RuntimeError):
+                        await rt.reconnect_store()
+                    lease = await rt.store.grant_lease()
+                    rt._primary_lease = lease
+                    # instance id follows the lease: re-key the instance entry
+                    old_instance_key = next(k for k in keys if "/instances/" in k)
+                    keys.pop(old_instance_key)
+                    info.instance_id = lease.lease_id
+                    keys[self.instances_prefix + info.instance_id] = info.to_json()
+                    for k, v in keys.items():
+                        await rt.store.put(k, v, lease=lease)
+                    logger.info("re-registered %s under lease %s", self.path, lease.lease_id)
+                    backoff = 0.5
+                    break
+                except (ConnectionError, RuntimeError, OSError):
+                    logger.warning("re-registration failed; retrying in %.1fs", backoff)
+                    await asyncio.sleep(backoff)
+                    backoff = min(backoff * 2, 10.0)
+
+    async def client(self, mode: str = "random", **kw) -> "EndpointClient":
+        c = EndpointClient(self, mode, **kw)
+        await c.start()
+        return c
+
+
+class EndpointClient(AsyncEngine):
+    """Routes requests across live endpoint instances.
+
+    Modes: random | round_robin | direct:<instance_id> | kv
+    (reference RouterMode, component/client.rs:216-319). KV mode routes
+    token-level requests by prefix overlap via the kv_router stack fed from
+    the namespace event plane; non-token requests fall back to round-robin.
+    """
+
+    def __init__(self, endpoint: Endpoint, mode: str = "random", kv_block_size: int = 16):
+        self.endpoint = endpoint
+        self.mode = mode
+        self.kv_block_size = kv_block_size
+        self._instances: Dict[str, InstanceInfo] = {}
+        self._conns: Dict[str, RpcClient] = {}
+        self._rr = 0
+        self._watcher = None
+        self._watch_task: Optional[asyncio.Task] = None
+        self._kv_task: Optional[asyncio.Task] = None
+        self._router = None
+        self._ready = asyncio.Event()
+
+    VALID_MODES = ("random", "round_robin", "kv")
+
+    async def start(self) -> None:
+        if self.mode not in self.VALID_MODES and not self.mode.startswith("direct:"):
+            raise ValueError(
+                f"unknown router mode {self.mode!r}; want one of "
+                f"{self.VALID_MODES} or direct:<instance_id>"
+            )
+        rt = self.endpoint.component.namespace.runtime
+        self._watcher = await rt.store.watch_prefix(self.endpoint.instances_prefix)
+        self._watch_task = asyncio.create_task(self._watch_loop())
+        if self.mode == "kv":
+            from dynamo_tpu.kv_router.router import KvRouter
+
+            self._router = KvRouter(block_size=self.kv_block_size)
+            if rt.bus is not None:
+                self._kv_task = asyncio.create_task(self._kv_feed())
+
+    async def _watch_loop(self) -> None:
+        async for ev in self._watcher:
+            iid = ev.key.rsplit("/", 1)[-1]
+            if ev.type == "put":
+                try:
+                    self._instances[iid] = InstanceInfo.from_json(ev.value)
+                except (ValueError, KeyError):
+                    continue
+                self._ready.set()
+            else:
+                self._instances.pop(iid, None)
+                conn = self._conns.pop(iid, None)
+                if conn is not None:
+                    await conn.close()
+                if self._router is not None:
+                    info_wid = iid  # worker keyed by instance id in router
+                    self._router.remove_worker(info_wid)
+            if not self._instances:
+                self._ready.clear()
+
+    async def _kv_feed(self) -> None:
+        """Feed KV events + metrics from the namespace event plane into the router."""
+        from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, RouterEvent
+
+        ns = self.endpoint.component.namespace
+        ev_sub = await ns.subscribe(KV_EVENTS_SUBJECT)
+        met_sub = await ns.subscribe(KV_METRICS_SUBJECT)
+
+        async def events():
+            async for raw in ev_sub:
+                try:
+                    self._router.apply_event(RouterEvent.from_dict(json.loads(raw)))
+                except (ValueError, KeyError):
+                    logger.warning("bad kv event", exc_info=True)
+
+        async def metrics():
+            async for raw in met_sub:
+                try:
+                    d = json.loads(raw)
+                    self._router.update_worker_metrics(
+                        d["worker_id"], ForwardPassMetrics.from_dict(d["metrics"])
+                    )
+                except (ValueError, KeyError):
+                    logger.warning("bad kv metrics", exc_info=True)
+
+        await asyncio.gather(events(), metrics())
+
+    async def wait_for_instances(self, n: int = 1, timeout: float = 30.0) -> None:
+        """Reference: Client::wait_for_endpoints (client.rs:205-215)."""
+        async with asyncio.timeout(timeout):
+            while len(self._instances) < n:
+                self._ready.clear()
+                await self._ready.wait()
+
+    def instance_ids(self) -> List[str]:
+        return sorted(self._instances)
+
+    def _pick(self, request: Any) -> str:
+        ids = sorted(self._instances)
+        if not ids:
+            raise RuntimeError(f"no live instances for {self.endpoint.path}")
+        if self.mode.startswith("direct:"):
+            want = self.mode.split(":", 1)[1]
+            if want not in self._instances:
+                raise RuntimeError(f"instance {want} not live")
+            return want
+        if self.mode == "random":
+            return random.choice(ids)
+        if self.mode == "kv" and self._router is not None:
+            token_ids = None
+            if isinstance(request, dict):
+                token_ids = request.get("token_ids")
+            if token_ids:
+                # router workers are keyed by instance id (via metrics/events)
+                decision = self._router.schedule(token_ids)
+                if decision is not None and decision.worker_id in self._instances:
+                    return decision.worker_id
+        # round_robin fallback
+        self._rr = (self._rr + 1) % len(ids)
+        return ids[self._rr]
+
+    async def _conn(self, iid: str) -> RpcClient:
+        conn = self._conns.get(iid)
+        if conn is None or conn.closed:
+            conn = await RpcClient.connect(self._instances[iid].address)
+            self._conns[iid] = conn
+        return conn
+
+    async def generate(self, request: Context) -> AsyncIterator[Annotated]:
+        payload = request.data
+        if hasattr(payload, "to_dict"):
+            payload = payload.to_dict()
+        elif hasattr(payload, "model_dump"):
+            payload = payload.model_dump(exclude_none=True)
+        iid = self._pick(payload)
+        conn = await self._conn(iid)
+        async for item in conn.generate(self.endpoint.rpc_name, payload, context=request):
+            yield item
+
+    async def close(self) -> None:
+        if self._watch_task:
+            self._watch_task.cancel()
+        if self._kv_task:
+            self._kv_task.cancel()
+        if self._watcher:
+            await self._watcher.cancel()
+        for c in self._conns.values():
+            await c.close()
+
+
+class KvPublishBridge:
+    """Thread-safe bridge: engine-thread KV events → namespace event plane.
+
+    Implements the allocator's KvEventSink protocol. The engine's step loop
+    runs on its own thread, so events are handed to the asyncio side via
+    call_soon_threadsafe into a queue drained by a publisher task.
+    """
+
+    def __init__(self, namespace: Namespace, worker_id: str):
+        from dynamo_tpu.kv_router.publisher import KvEventPublisher
+
+        self._ns = namespace
+        self._loop = asyncio.get_running_loop()
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._inner = KvEventPublisher(worker_id, self._enqueue)
+        self._task = asyncio.create_task(self._drain())
+
+    # KvEventSink protocol (called from the engine thread)
+    def blocks_stored(self, parent_hash, blocks) -> None:
+        self._inner.blocks_stored(parent_hash, blocks)
+
+    def blocks_removed(self, block_hashes) -> None:
+        self._inner.blocks_removed(block_hashes)
+
+    def _enqueue(self, event) -> None:
+        self._loop.call_soon_threadsafe(self._queue.put_nowait, event.to_dict())
+
+    async def _drain(self) -> None:
+        while True:
+            payload = await self._queue.get()
+            try:
+                await self._ns.publish(KV_EVENTS_SUBJECT, payload)
+            except (ConnectionError, RuntimeError):
+                logger.warning("kv event publish failed", exc_info=True)
+
+    def close(self) -> None:
+        self._task.cancel()
+
+
+async def attach_kv_publishing(
+    endpoint: Endpoint, instance_id: str, engine, interval: float = 1.0
+) -> KvPublishBridge:
+    """Wire a serving engine's KV events + load metrics onto the event plane.
+
+    Workers are keyed by their *instance id* so the router's choices map
+    directly onto live instances. Reference analogue: KvEventPublisher +
+    KvMetricsPublisher on the worker (SURVEY.md §3.5).
+    """
+    ns = endpoint.component.namespace
+    bridge = KvPublishBridge(ns, instance_id)
+    if hasattr(engine, "set_event_sink"):
+        engine.set_event_sink(bridge)
+
+    async def metrics_loop():
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                snap = engine.metrics_snapshot()
+                await ns.publish(
+                    KV_METRICS_SUBJECT, {"worker_id": instance_id, "metrics": snap}
+                )
+            except (ConnectionError, RuntimeError):
+                logger.warning("kv metrics publish failed", exc_info=True)
+
+    ns.runtime._background.append(asyncio.create_task(metrics_loop()))
+    return bridge

@@ -1,0 +1,237 @@
+"""Direct-dial streaming RPC between clients and workers.
+
+Design delta vs the reference (intentional): the reference pushes requests
+through NATS and opens a TCP connect-back for responses (two hops + a broker;
+egress/push.rs:37-180, tcp/server.rs). Here discovery (statestore) hands the
+client the worker's address and the client dials it directly — request and
+response stream ride ONE multiplexed TCP connection with the same framed
+codec. Same capability (streaming, cancellation, graceful drain), one less
+network hop on every token.
+
+Wire protocol (header JSON + body):
+  client→worker: {id, op:"generate", endpoint} body=request JSON
+                 {id, op:"stop"|"kill"}        (mid-stream cancellation)
+  worker→client: {id, op:"item"}  body=one Annotated dict JSON
+                 {id, op:"done"}
+                 {id, op:"error", message}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import logging
+from typing import Any, AsyncIterator, Dict, Optional, Tuple
+
+from dynamo_tpu.runtime.annotated import Annotated
+from dynamo_tpu.runtime.codec import TwoPartMessage, read_frame, write_frame
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+logger = logging.getLogger(__name__)
+
+
+class RpcServer:
+    """Serves registered engines over TCP; tracks in-flight requests and
+    drains them on stop (reference PushEndpoint semantics)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self.host = host
+        self.port = port
+        self._engines: Dict[str, AsyncEngine] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._inflight: set = set()
+        self._draining = False
+
+    def register(self, endpoint: str, engine: AsyncEngine) -> None:
+        self._engines[endpoint] = engine
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("rpc server listening on %s:%d", self.host, self.port)
+
+    async def stop(self, drain_timeout: float = 10.0) -> None:
+        self._draining = True
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        if self._inflight:
+            done, pending = await asyncio.wait(self._inflight, timeout=drain_timeout)
+            for t in pending:
+                t.cancel()
+
+    @property
+    def inflight_count(self) -> int:
+        return len(self._inflight)
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        contexts: Dict[int, Context] = {}
+        write_lock = asyncio.Lock()
+        conn_tasks: set = set()
+        try:
+            while True:
+                try:
+                    frame = await read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return
+                h = json.loads(frame.header)
+                op = h.get("op")
+                if op == "generate":
+                    if self._draining:
+                        async with write_lock:
+                            await write_frame(writer, TwoPartMessage(
+                                json.dumps({"id": h["id"], "op": "error",
+                                            "message": "worker draining"}).encode(), b""))
+                        continue
+                    task = asyncio.create_task(
+                        self._serve_request(h, frame.body, writer, write_lock, contexts)
+                    )
+                    self._inflight.add(task)
+                    conn_tasks.add(task)
+                    task.add_done_callback(self._inflight.discard)
+                    task.add_done_callback(conn_tasks.discard)
+                elif op in ("stop", "kill"):
+                    ctx = contexts.get(h["id"])
+                    if ctx is not None:
+                        if op == "kill":
+                            ctx.context.kill()
+                        else:
+                            ctx.context.stop_generating()
+        finally:
+            # client went away: kill everything it had in flight on this conn
+            for ctx in contexts.values():
+                ctx.context.kill()
+            for t in list(conn_tasks):
+                t.cancel()
+            writer.close()
+
+    async def _serve_request(self, h, body, writer, write_lock, contexts) -> None:
+        req_id = h["id"]
+        engine = self._engines.get(h.get("endpoint", ""))
+
+        async def send(header: dict, payload: bytes = b"") -> None:
+            async with write_lock:
+                await write_frame(writer, TwoPartMessage(json.dumps(header).encode(), payload))
+
+        if engine is None:
+            await send({"id": req_id, "op": "error",
+                        "message": f"no such endpoint {h.get('endpoint')!r}"})
+            return
+        try:
+            payload = json.loads(body) if body else None
+            ctx = Context(payload, request_id=h.get("request_id"))
+            contexts[req_id] = ctx
+            stream = engine.generate(ctx)
+            if hasattr(stream, "__await__"):
+                stream = await stream
+            async for item in stream:
+                d = item.to_dict() if isinstance(item, Annotated) else item
+                await send({"id": req_id, "op": "item"}, json.dumps(d).encode())
+            await send({"id": req_id, "op": "done"})
+        except (ConnectionError, asyncio.CancelledError):
+            raise
+        except Exception as e:
+            logger.exception("rpc handler error (req %s)", req_id)
+            try:
+                await send({"id": req_id, "op": "error", "message": str(e)})
+            except ConnectionError:
+                pass
+        finally:
+            contexts.pop(req_id, None)
+
+
+class RpcClient:
+    """Multiplexed client connection to one worker."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._ids = itertools.count(1)
+        self._streams: Dict[int, asyncio.Queue] = {}
+        self._reader_task: Optional[asyncio.Task] = None
+        self._send_lock = asyncio.Lock()
+        self.closed = False
+
+    @classmethod
+    async def connect(cls, address: str) -> "RpcClient":
+        host, _, port = address.rpartition(":")
+        c = cls(host or "127.0.0.1", int(port))
+        c._reader, c._writer = await asyncio.open_connection(c.host, c.port)
+        c._reader_task = asyncio.create_task(c._read_loop())
+        return c
+
+    async def close(self) -> None:
+        self.closed = True
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._writer:
+            self._writer.close()
+        for q in self._streams.values():
+            q.put_nowait(("error", "connection closed"))
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = await read_frame(self._reader)
+                h = json.loads(frame.header)
+                q = self._streams.get(h.get("id"))
+                if q is None:
+                    continue
+                op = h.get("op")
+                if op == "item":
+                    q.put_nowait(("item", frame.body))
+                elif op == "done":
+                    q.put_nowait(("done", None))
+                elif op == "error":
+                    q.put_nowait(("error", h.get("message", "remote error")))
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            self.closed = True
+            for q in self._streams.values():
+                q.put_nowait(("error", "connection lost"))
+
+    async def _send(self, header: dict, body: bytes = b"") -> None:
+        async with self._send_lock:
+            await write_frame(self._writer, TwoPartMessage(json.dumps(header).encode(), body))
+
+    async def generate(
+        self, endpoint: str, request: Any, context: Optional[Context] = None
+    ) -> AsyncIterator[Annotated]:
+        """Call a remote endpoint; yields Annotated items. Propagates local
+        context stop/kill to the worker."""
+        req_id = next(self._ids)
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[req_id] = q
+        payload = request if isinstance(request, (dict, list)) else getattr(request, "to_dict")()
+        header = {"id": req_id, "op": "generate", "endpoint": endpoint}
+        if context is not None:
+            header["request_id"] = context.id
+        await self._send(header, json.dumps(payload).encode())
+
+        monitor: Optional[asyncio.Task] = None
+        if context is not None:
+            async def watch_cancel():
+                await context.context.stopped()
+                try:
+                    await self._send({"id": req_id, "op": "stop"})
+                except ConnectionError:
+                    pass
+
+            monitor = asyncio.create_task(watch_cancel())
+        try:
+            while True:
+                kind, data = await q.get()
+                if kind == "item":
+                    yield Annotated.from_dict(json.loads(data))
+                elif kind == "done":
+                    return
+                else:
+                    yield Annotated.from_error(str(data))
+                    return
+        finally:
+            if monitor:
+                monitor.cancel()
+            self._streams.pop(req_id, None)

@@ -1,0 +1,476 @@
+"""Self-hosted discovery/config state store with leases and prefix watches.
+
+The control plane of the distributed runtime: capability parity with the
+reference's etcd usage (lib/runtime/src/transports/etcd.rs:40-500 — leases
+with keep-alive, atomic create-if-absent, prefix get/watch with Put/Delete
+events), implemented as a lightweight asyncio TCP service speaking the framed
+codec (runtime/codec.py) so deployments need no external etcd. Semantics:
+
+- every key may be attached to a **lease**; lease expiry (missed keep-alives)
+  or revoke deletes its keys and notifies watchers → dead workers vanish from
+  the live set within a TTL, exactly like the reference's liveness model
+  (SURVEY.md §5 failure detection).
+- **watch(prefix)** streams Put/Delete events (optionally preceded by a
+  snapshot of existing keys), the basis for client-side live endpoint sets
+  and dynamic config.
+
+Run standalone: ``python -m dynamo_tpu.runtime.statestore --port 37901``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import base64
+import itertools
+import json
+import logging
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Dict, List, Optional, Tuple
+
+from dynamo_tpu.runtime.codec import TwoPartMessage, read_frame, write_frame
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PORT = 37901
+DEFAULT_LEASE_TTL = 10.0
+
+
+@dataclass
+class WatchEvent:
+    type: str  # "put" | "delete"
+    key: str
+    value: bytes = b""
+
+
+# =========================================================================
+# server
+# =========================================================================
+
+
+@dataclass
+class _Lease:
+    lease_id: str
+    ttl: float
+    deadline: float
+    keys: set = field(default_factory=set)
+
+
+class _Watch:
+    """A registered prefix watch with its own bounded send queue + sender task,
+    so one stalled watcher can never block the server's mutation paths."""
+
+    MAX_QUEUE = 4096
+
+    def __init__(self, watch_id: str, prefix: str, writer: asyncio.StreamWriter):
+        self.watch_id = watch_id
+        self.prefix = prefix
+        self.writer = writer
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=self.MAX_QUEUE)
+        self.task = asyncio.create_task(self._send_loop())
+        self.dead = False
+
+    def offer(self, frame: TwoPartMessage) -> None:
+        try:
+            self.queue.put_nowait(frame)
+        except asyncio.QueueFull:
+            # slow consumer: drop the watch (it would miss events anyway)
+            self.dead = True
+            self.task.cancel()
+
+    async def _send_loop(self) -> None:
+        try:
+            while True:
+                frame = await self.queue.get()
+                await write_frame(self.writer, frame)
+        except (ConnectionError, RuntimeError, asyncio.CancelledError):
+            self.dead = True
+
+    def close(self) -> None:
+        self.task.cancel()
+
+
+class StateStoreServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = DEFAULT_PORT):
+        self.host = host
+        self.port = port
+        self._kv: Dict[str, Tuple[bytes, Optional[str]]] = {}  # key → (value, lease)
+        self._leases: Dict[str, _Lease] = {}
+        self._watches: Dict[str, _Watch] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._expiry_task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        self._expiry_task = asyncio.create_task(self._expire_loop())
+        logger.info("statestore listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._expiry_task:
+            self._expiry_task.cancel()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def _expire_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.25)
+            now = time.monotonic()
+            for lease in [l for l in self._leases.values() if l.deadline < now]:
+                logger.info("lease %s expired (%d keys)", lease.lease_id, len(lease.keys))
+                await self._drop_lease(lease)
+
+    async def _drop_lease(self, lease: _Lease) -> None:
+        self._leases.pop(lease.lease_id, None)
+        for key in list(lease.keys):
+            await self._delete_key(key)
+
+    async def _delete_key(self, key: str) -> bool:
+        entry = self._kv.pop(key, None)
+        if entry is None:
+            return False
+        _, lease_id = entry
+        if lease_id and lease_id in self._leases:
+            self._leases[lease_id].keys.discard(key)
+        await self._notify(WatchEvent("delete", key))
+        return True
+
+    async def _put_key(self, key: str, value: bytes, lease_id: Optional[str]) -> None:
+        old = self._kv.get(key)
+        if old is not None and old[1] and old[1] in self._leases:
+            self._leases[old[1]].keys.discard(key)
+        self._kv[key] = (value, lease_id)
+        if lease_id and lease_id in self._leases:
+            self._leases[lease_id].keys.add(key)
+        await self._notify(WatchEvent("put", key, value))
+
+    async def _notify(self, event: WatchEvent) -> None:
+        dead = []
+        for w in list(self._watches.values()):
+            if w.dead:
+                dead.append(w.watch_id)
+                continue
+            if not event.key.startswith(w.prefix):
+                continue
+            w.offer(
+                TwoPartMessage(
+                    json.dumps(
+                        {"push": "watch", "watch_id": w.watch_id,
+                         "event": event.type, "key": event.key}
+                    ).encode(),
+                    event.value,
+                )
+            )
+        for wid in dead:
+            w = self._watches.pop(wid, None)
+            if w:
+                w.close()
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        conn_watches: List[str] = []
+        try:
+            while True:
+                try:
+                    frame = await read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return
+                req = json.loads(frame.header)
+                reply_header, reply_body = await self._dispatch(
+                    req, frame.body, writer, conn_watches
+                )
+                reply_header["id"] = req.get("id")
+                await write_frame(
+                    writer, TwoPartMessage(json.dumps(reply_header).encode(), reply_body)
+                )
+        finally:
+            for wid in conn_watches:
+                w = self._watches.pop(wid, None)
+                if w:
+                    w.close()
+            writer.close()
+
+    async def _dispatch(self, req, body, writer, conn_watches) -> Tuple[dict, bytes]:
+        op = req.get("op")
+        if op == "put":
+            lease_id = req.get("lease")
+            if lease_id and lease_id not in self._leases:
+                return {"ok": False, "error": f"unknown lease {lease_id}"}, b""
+            await self._put_key(req["key"], body, lease_id)
+            return {"ok": True}, b""
+        if op == "create":
+            if req["key"] in self._kv:
+                return {"ok": True, "created": False}, b""
+            lease_id = req.get("lease")
+            if lease_id and lease_id not in self._leases:
+                return {"ok": False, "error": f"unknown lease {lease_id}"}, b""
+            await self._put_key(req["key"], body, lease_id)
+            return {"ok": True, "created": True}, b""
+        if op == "get":
+            entry = self._kv.get(req["key"])
+            if entry is None:
+                return {"ok": True, "found": False}, b""
+            return {"ok": True, "found": True}, entry[0]
+        if op == "get_prefix":
+            items = [
+                {"key": k, "value": base64.b64encode(v[0]).decode()}
+                for k, v in sorted(self._kv.items())
+                if k.startswith(req["prefix"])
+            ]
+            return {"ok": True}, json.dumps(items).encode()
+        if op == "delete":
+            deleted = await self._delete_key(req["key"])
+            return {"ok": True, "deleted": deleted}, b""
+        if op == "delete_prefix":
+            keys = [k for k in self._kv if k.startswith(req["prefix"])]
+            for k in keys:
+                await self._delete_key(k)
+            return {"ok": True, "count": len(keys)}, b""
+        if op == "watch":
+            watch_id = req.get("watch_id") or uuid.uuid4().hex
+            w = _Watch(watch_id, req["prefix"], writer)
+            self._watches[watch_id] = w
+            conn_watches.append(watch_id)
+            if req.get("include_existing"):
+                for k, (v, _) in sorted(self._kv.items()):
+                    if k.startswith(req["prefix"]):
+                        w.offer(
+                            TwoPartMessage(
+                                json.dumps(
+                                    {"push": "watch", "watch_id": watch_id,
+                                     "event": "put", "key": k}
+                                ).encode(),
+                                v,
+                            )
+                        )
+            return {"ok": True, "watch_id": watch_id}, b""
+        if op == "unwatch":
+            w = self._watches.pop(req["watch_id"], None)
+            if w:
+                w.close()
+            return {"ok": True}, b""
+        if op == "lease_grant":
+            ttl = float(req.get("ttl", DEFAULT_LEASE_TTL))
+            lease_id = uuid.uuid4().hex[:16]
+            self._leases[lease_id] = _Lease(lease_id, ttl, time.monotonic() + ttl)
+            return {"ok": True, "lease_id": lease_id, "ttl": ttl}, b""
+        if op == "keepalive":
+            lease = self._leases.get(req["lease_id"])
+            if lease is None:
+                return {"ok": False, "error": "unknown lease"}, b""
+            lease.deadline = time.monotonic() + lease.ttl
+            return {"ok": True}, b""
+        if op == "revoke":
+            lease = self._leases.get(req["lease_id"])
+            if lease is not None:
+                await self._drop_lease(lease)
+            return {"ok": True}, b""
+        return {"ok": False, "error": f"unknown op {op!r}"}, b""
+
+
+# =========================================================================
+# client
+# =========================================================================
+
+
+class Lease:
+    """A granted lease with a background keep-alive heartbeat.
+
+    Reference parity: Lease + keep-alive task (transports/etcd/lease.rs:19-117).
+    """
+
+    def __init__(self, client: "StateStoreClient", lease_id: str, ttl: float):
+        self.client = client
+        self.lease_id = lease_id
+        self.ttl = ttl
+        self._task: Optional[asyncio.Task] = None
+        self.lost = asyncio.Event()
+
+    def start_keepalive(self) -> None:
+        self._task = asyncio.create_task(self._beat())
+
+    async def _beat(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.ttl / 3)
+                try:
+                    reply, _ = await self.client._call({"op": "keepalive", "lease_id": self.lease_id})
+                    if not reply.get("ok"):
+                        self.lost.set()
+                        return
+                except ConnectionError:
+                    self.lost.set()
+                    return
+        except asyncio.CancelledError:
+            pass
+
+    async def revoke(self) -> None:
+        if self._task:
+            self._task.cancel()
+        try:
+            await self.client._call({"op": "revoke", "lease_id": self.lease_id})
+        except ConnectionError:
+            pass
+
+
+class Watcher:
+    """Async iterator of WatchEvents for a prefix."""
+
+    def __init__(self, client: "StateStoreClient", watch_id: str):
+        self.client = client
+        self.watch_id = watch_id
+        self.queue: asyncio.Queue = asyncio.Queue()
+
+    def __aiter__(self) -> AsyncIterator[WatchEvent]:
+        return self._iter()
+
+    async def _iter(self) -> AsyncIterator[WatchEvent]:
+        while True:
+            ev = await self.queue.get()
+            if ev is None:
+                return
+            yield ev
+
+    async def cancel(self) -> None:
+        self.client._watchers.pop(self.watch_id, None)
+        try:
+            await self.client._call({"op": "unwatch", "watch_id": self.watch_id})
+        except ConnectionError:
+            pass
+        self.queue.put_nowait(None)
+
+
+class StateStoreClient:
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._ids = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._watchers: Dict[str, Watcher] = {}
+        self._reader_task: Optional[asyncio.Task] = None
+        self._send_lock = asyncio.Lock()
+
+    @classmethod
+    async def connect(cls, url: str) -> "StateStoreClient":
+        host, _, port = url.rpartition(":")
+        c = cls(host or "127.0.0.1", int(port))
+        c._reader, c._writer = await asyncio.open_connection(c.host, c.port)
+        c._reader_task = asyncio.create_task(c._read_loop())
+        return c
+
+    async def close(self) -> None:
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._writer:
+            self._writer.close()
+        for w in self._watchers.values():
+            w.queue.put_nowait(None)
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = await read_frame(self._reader)
+                h = json.loads(frame.header)
+                if h.get("push") == "watch":
+                    w = self._watchers.get(h["watch_id"])
+                    if w is not None:
+                        w.queue.put_nowait(WatchEvent(h["event"], h["key"], frame.body))
+                    continue
+                fut = self._pending.pop(h.get("id"), None)
+                if fut is not None and not fut.done():
+                    fut.set_result((h, frame.body))
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("statestore connection lost"))
+            for w in self._watchers.values():
+                w.queue.put_nowait(None)
+
+    async def _call(self, req: dict, body: bytes = b"") -> Tuple[dict, bytes]:
+        req_id = next(self._ids)
+        req["id"] = req_id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        async with self._send_lock:
+            await write_frame(self._writer, TwoPartMessage(json.dumps(req).encode(), body))
+        reply, rbody = await fut
+        if not reply.get("ok"):
+            raise RuntimeError(f"statestore error: {reply.get('error')}")
+        return reply, rbody
+
+    # -- public API ----------------------------------------------------------
+
+    async def put(self, key: str, value: bytes, lease: Optional[Lease] = None) -> None:
+        await self._call(
+            {"op": "put", "key": key, "lease": lease.lease_id if lease else None}, value
+        )
+
+    async def create(self, key: str, value: bytes, lease: Optional[Lease] = None) -> bool:
+        """Atomic create-if-absent (reference kv_create). True if created."""
+        reply, _ = await self._call(
+            {"op": "create", "key": key, "lease": lease.lease_id if lease else None},
+            value,
+        )
+        return bool(reply.get("created"))
+
+    async def get(self, key: str) -> Optional[bytes]:
+        reply, body = await self._call({"op": "get", "key": key})
+        return body if reply.get("found") else None
+
+    async def get_prefix(self, prefix: str) -> Dict[str, bytes]:
+        _, body = await self._call({"op": "get_prefix", "prefix": prefix})
+        return {
+            item["key"]: base64.b64decode(item["value"]) for item in json.loads(body)
+        }
+
+    async def delete(self, key: str) -> bool:
+        reply, _ = await self._call({"op": "delete", "key": key})
+        return bool(reply.get("deleted"))
+
+    async def delete_prefix(self, prefix: str) -> int:
+        reply, _ = await self._call({"op": "delete_prefix", "prefix": prefix})
+        return int(reply.get("count", 0))
+
+    async def grant_lease(self, ttl: float = DEFAULT_LEASE_TTL) -> Lease:
+        reply, _ = await self._call({"op": "lease_grant", "ttl": ttl})
+        lease = Lease(self, reply["lease_id"], reply["ttl"])
+        lease.start_keepalive()
+        return lease
+
+    async def watch_prefix(self, prefix: str, include_existing: bool = True) -> Watcher:
+        watch_id = uuid.uuid4().hex
+        w = Watcher(self, watch_id)
+        self._watchers[watch_id] = w
+        await self._call(
+            {"op": "watch", "prefix": prefix, "watch_id": watch_id,
+             "include_existing": include_existing}
+        )
+        return w
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="dynamo_tpu statestore server")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=DEFAULT_PORT)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        server = StateStoreServer(args.host, args.port)
+        await server.start()
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,465 @@
+"""Distributed runtime stack: codec, statestore, bus, rpc, component model.
+
+All tests run fully in-process on ephemeral localhost ports — the equivalent of
+the reference's mock-transport + subprocess-fixture strategy (SURVEY.md §4),
+except our planes are self-hosted so the real servers ARE the test fixtures.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.llm.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.runtime import codec
+from dynamo_tpu.runtime.annotated import Annotated
+from dynamo_tpu.runtime.bus import MessageBusClient, MessageBusServer
+from dynamo_tpu.runtime.distributed import (
+    DistributedRuntime,
+    parse_endpoint_path,
+)
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.rpc import RpcClient, RpcServer
+from dynamo_tpu.runtime.statestore import StateStoreClient, StateStoreServer
+
+
+# -- codec -------------------------------------------------------------------
+
+
+class TestCodec:
+    def test_roundtrip(self):
+        msg = codec.TwoPartMessage(b'{"a":1}', b"payload bytes")
+        decoded, rest = codec.decode(codec.encode(msg))
+        assert decoded == msg and rest == b""
+
+    def test_partial_and_concatenated(self):
+        m1 = codec.TwoPartMessage(b"h1", b"b1")
+        m2 = codec.TwoPartMessage(b"h2", b"")
+        buf = codec.encode(m1) + codec.encode(m2)
+        d1, rest = codec.decode(buf)
+        d2, rest = codec.decode(rest)
+        assert (d1, d2) == (m1, m2) and rest == b""
+        none, rest = codec.decode(codec.encode(m1)[:10])
+        assert none is None
+
+    def test_checksum_mismatch(self):
+        buf = bytearray(codec.encode(codec.TwoPartMessage(b"h", b"body")))
+        buf[-1] ^= 0xFF
+        with pytest.raises(codec.CodecError):
+            codec.decode(bytes(buf))
+
+    def test_size_limits(self):
+        with pytest.raises(codec.CodecError):
+            codec.encode(codec.TwoPartMessage(b"x" * (codec.MAX_HEADER + 1), b""))
+
+
+# -- statestore ---------------------------------------------------------------
+
+
+class TestStateStore:
+    def test_put_get_prefix_delete(self, run):
+        async def go():
+            server = StateStoreServer(port=0)
+            await server.start()
+            c = await StateStoreClient.connect(server.url)
+            await c.put("a/x", b"1")
+            await c.put("a/y", b"2")
+            await c.put("b/z", b"3")
+            assert await c.get("a/x") == b"1"
+            assert await c.get("missing") is None
+            assert await c.get_prefix("a/") == {"a/x": b"1", "a/y": b"2"}
+            assert await c.delete("a/x") is True
+            assert await c.delete("a/x") is False
+            assert await c.delete_prefix("a/") == 1
+            assert (await c.create("c/k", b"v")) is True
+            assert (await c.create("c/k", b"v2")) is False
+            assert await c.get("c/k") == b"v"
+            await c.close()
+            await server.stop()
+
+        run(go())
+
+    def test_watch_put_delete(self, run):
+        async def go():
+            server = StateStoreServer(port=0)
+            await server.start()
+            c = await StateStoreClient.connect(server.url)
+            await c.put("w/pre", b"existing")
+            watcher = await c.watch_prefix("w/", include_existing=True)
+            events = []
+
+            async def consume():
+                async for ev in watcher:
+                    events.append((ev.type, ev.key, ev.value))
+                    if len(events) >= 3:
+                        return
+
+            task = asyncio.create_task(consume())
+            await asyncio.sleep(0.05)
+            await c.put("w/new", b"v")
+            await c.delete("w/new")
+            await asyncio.wait_for(task, 5)
+            assert events[0] == ("put", "w/pre", b"existing")
+            assert events[1] == ("put", "w/new", b"v")
+            assert events[2][:2] == ("delete", "w/new")
+            await c.close()
+            await server.stop()
+
+        run(go())
+
+    def test_lease_expiry_deletes_keys(self, run):
+        async def go():
+            server = StateStoreServer(port=0)
+            await server.start()
+            c = await StateStoreClient.connect(server.url)
+            lease = await c.grant_lease(ttl=0.5)
+            await c.put("l/k", b"v", lease=lease)
+            assert await c.get("l/k") == b"v"
+            # simulate worker death: stop heartbeats
+            lease._task.cancel()
+            await asyncio.sleep(1.2)
+            assert await c.get("l/k") is None
+            await c.close()
+            await server.stop()
+
+        run(go())
+
+    def test_lease_revoke_immediate(self, run):
+        async def go():
+            server = StateStoreServer(port=0)
+            await server.start()
+            c = await StateStoreClient.connect(server.url)
+            lease = await c.grant_lease(ttl=30)
+            await c.put("r/k", b"v", lease=lease)
+            await lease.revoke()
+            assert await c.get("r/k") is None
+            await c.close()
+            await server.stop()
+
+        run(go())
+
+
+# -- bus ----------------------------------------------------------------------
+
+
+class TestMessageBus:
+    def test_pub_sub(self, run):
+        async def go():
+            server = MessageBusServer(port=0)
+            await server.start()
+            a = await MessageBusClient.connect(server.url)
+            b = await MessageBusClient.connect(server.url)
+            sub = await a.subscribe("events.test")
+            got = []
+
+            async def consume():
+                async for m in sub:
+                    got.append(m)
+                    if len(got) == 2:
+                        return
+
+            t = asyncio.create_task(consume())
+            await asyncio.sleep(0.05)
+            await b.publish("events.test", b"one")
+            await b.publish("events.other", b"nope")
+            await b.publish("events.test", b"two")
+            await asyncio.wait_for(t, 5)
+            assert got == [b"one", b"two"]
+            await a.close()
+            await b.close()
+            await server.stop()
+
+        run(go())
+
+    def test_queue_fifo_and_len(self, run):
+        async def go():
+            server = MessageBusServer(port=0)
+            await server.start()
+            c = await MessageBusClient.connect(server.url)
+            await c.queue_push("q1", b"a")
+            await c.queue_push("q1", b"b")
+            assert await c.queue_len("q1") == 2
+            assert await c.queue_pop("q1") == b"a"
+            assert await c.queue_pop("q1") == b"b"
+            assert await c.queue_pop("q1") is None
+            await c.close()
+            await server.stop()
+
+        run(go())
+
+    def test_blocking_pop_wakes_on_push(self, run):
+        async def go():
+            server = MessageBusServer(port=0)
+            await server.start()
+            consumer = await MessageBusClient.connect(server.url)
+            producer = await MessageBusClient.connect(server.url)
+            pop = asyncio.create_task(consumer.queue_pop("jobs", block=True))
+            await asyncio.sleep(0.05)
+            assert not pop.done()
+            await producer.queue_push("jobs", b"work")
+            assert await asyncio.wait_for(pop, 5) == b"work"
+            await consumer.close()
+            await producer.close()
+            await server.stop()
+
+        run(go())
+
+
+# -- rpc ----------------------------------------------------------------------
+
+
+class CountEngine(AsyncEngine):
+    """Streams n items then finishes; cancellable."""
+
+    async def generate(self, request: Context):
+        n = request.data.get("n", 3)
+        for i in range(n):
+            if request.context.is_stopped:
+                yield Annotated.from_data({"cancelled": True})
+                return
+            await asyncio.sleep(0)
+            yield Annotated.from_data({"i": i})
+
+
+class TestRpc:
+    def test_stream_roundtrip(self, run):
+        async def go():
+            server = RpcServer(host="127.0.0.1", port=0)
+            server.register("ns.c.e", CountEngine())
+            await server.start()
+            client = await RpcClient.connect(f"127.0.0.1:{server.port}")
+            items = [i async for i in client.generate("ns.c.e", {"n": 4})]
+            assert [i.data["i"] for i in items] == [0, 1, 2, 3]
+            # two concurrent streams multiplex on one connection
+            r1, r2 = await asyncio.gather(
+                _collect(client.generate("ns.c.e", {"n": 2})),
+                _collect(client.generate("ns.c.e", {"n": 5})),
+            )
+            assert len(r1) == 2 and len(r2) == 5
+            await client.close()
+            await server.stop()
+
+        async def _collect(agen):
+            return [i async for i in agen]
+
+        run(go())
+
+    def test_unknown_endpoint_errors(self, run):
+        async def go():
+            server = RpcServer(host="127.0.0.1", port=0)
+            await server.start()
+            client = await RpcClient.connect(f"127.0.0.1:{server.port}")
+            items = [i async for i in client.generate("nope", {})]
+            assert len(items) == 1 and items[0].is_error
+            await client.close()
+            await server.stop()
+
+        run(go())
+
+    def test_handler_exception_becomes_error_item(self, run):
+        class Boom(AsyncEngine):
+            async def generate(self, request):
+                yield Annotated.from_data({"ok": 1})
+                raise RuntimeError("kaboom")
+
+        async def go():
+            server = RpcServer(host="127.0.0.1", port=0)
+            server.register("b", Boom())
+            await server.start()
+            client = await RpcClient.connect(f"127.0.0.1:{server.port}")
+            items = [i async for i in client.generate("b", {})]
+            assert items[0].data == {"ok": 1}
+            assert items[-1].is_error and "kaboom" in items[-1].error_message()
+            await client.close()
+            await server.stop()
+
+        run(go())
+
+
+# -- distributed component model ----------------------------------------------
+
+
+def test_parse_endpoint_path():
+    assert parse_endpoint_path("dyn://ns.comp.ep") == ("ns", "comp", "ep")
+    assert parse_endpoint_path("a.b.c") == ("a", "b", "c")
+    with pytest.raises(ValueError):
+        parse_endpoint_path("dyn://only.two")
+
+
+class EchoTokens(AsyncEngine):
+    def __init__(self, tag: str):
+        self.tag = tag
+
+    async def generate(self, request: Context):
+        req = request.data
+        for t in req.get("token_ids", []):
+            yield Annotated.from_data({"token_ids": [t], "worker": self.tag})
+
+
+class TestComponentModel:
+    def test_register_route_and_failover(self, run):
+        async def go():
+            ss = StateStoreServer(port=0)
+            bus = MessageBusServer(port=0)
+            await ss.start()
+            await bus.start()
+
+            w1 = await DistributedRuntime.create(ss.url, bus.url)
+            w2 = await DistributedRuntime.create(ss.url, bus.url)
+            fe = await DistributedRuntime.create(ss.url, bus.url)
+
+            ep1 = w1.namespace("t").component("worker").endpoint("generate")
+            ep2 = w2.namespace("t").component("worker").endpoint("generate")
+            await ep1.component.create_service()
+            i1 = await ep1.serve(EchoTokens("w1"), model_entry={"name": "m", "kind": "chat"})
+            i2 = await ep2.serve(EchoTokens("w2"))
+
+            client = await fe.namespace("t").component("worker").endpoint("generate").client("round_robin")
+            await client.wait_for_instances(2, timeout=5)
+            assert len(client.instance_ids()) == 2
+
+            # round robin alternates workers
+            seen = set()
+            for _ in range(4):
+                items = [
+                    i async for i in client.generate(Context({"token_ids": [1, 2]}))
+                ]
+                assert [i.data["token_ids"] for i in items] == [[1], [2]]
+                seen.add(items[0].data["worker"])
+            assert seen == {"w1", "w2"}
+
+            # direct routing pins one instance
+            direct = await ep1.component.endpoint("generate").client(f"direct:{i1.instance_id}")
+            # reuse fe's runtime for the client: endpoint built from w1 runtime is fine
+            await direct.wait_for_instances(1, timeout=5)
+            items = [i async for i in direct.generate(Context({"token_ids": [9]}))]
+            assert items[0].data["worker"] == "w1"
+
+            # model entry registered for discovery
+            models = await fe.store.get_prefix("t/models/chat/")
+            assert len(models) == 1
+            entry = json.loads(list(models.values())[0])
+            assert entry["endpoint"] == "dyn://t.worker.generate"
+
+            # worker death: revoke w2's lease → client drops it
+            await w2._primary_lease.revoke()
+            await asyncio.sleep(0.3)
+            assert client.instance_ids() == [i1.instance_id]
+            items = [i async for i in client.generate(Context({"token_ids": [5]}))]
+            assert items[0].data["worker"] == "w1"
+
+            for rt in (w1, w2, fe):
+                await rt.shutdown()
+            await bus.stop()
+            await ss.stop()
+
+        run(go())
+
+    def test_invalid_router_mode_rejected(self, run):
+        async def go():
+            ss = StateStoreServer(port=0)
+            await ss.start()
+            rt = await DistributedRuntime.create(ss.url, "127.0.0.1:1")  # no bus
+            ep = rt.namespace("t").component("c").endpoint("e")
+            with pytest.raises(ValueError):
+                await ep.client("ranodm")
+            await rt.shutdown()
+            await ss.stop()
+
+        run(go())
+
+    def test_kv_mode_routes_to_prefix_holder(self, run):
+        """Worker-side allocator events flow over the bus into the client's
+        router; a prompt with a cached prefix is routed to its holder."""
+        from dynamo_tpu.engine_jax.allocator import BlockAllocator
+        from dynamo_tpu.runtime.distributed import attach_kv_publishing
+
+        class FakeKvEngine:
+            def __init__(self):
+                self.allocator = BlockAllocator(64, 4)
+
+            def set_event_sink(self, sink):
+                self.allocator.set_sink(sink)
+
+            def metrics_snapshot(self):
+                return {
+                    "request_active_slots": 0, "request_total_slots": 8,
+                    "kv_active_blocks": self.allocator.active_blocks,
+                    "kv_total_blocks": 64, "num_requests_waiting": 0,
+                    "gpu_cache_usage_perc": self.allocator.usage(),
+                    "gpu_prefix_cache_hit_rate": 0.0,
+                }
+
+        async def go():
+            ss = StateStoreServer(port=0)
+            bus = MessageBusServer(port=0)
+            await ss.start()
+            await bus.start()
+            w1 = await DistributedRuntime.create(ss.url, bus.url)
+            w2 = await DistributedRuntime.create(ss.url, bus.url)
+            fe = await DistributedRuntime.create(ss.url, bus.url)
+
+            engines = {}
+            infos = {}
+            for tag, rt in (("w1", w1), ("w2", w2)):
+                ep = rt.namespace("kvt").component("worker").endpoint("gen")
+                eng = FakeKvEngine()
+                engines[tag] = eng
+                infos[tag] = await ep.serve(EchoTokens(tag))
+                await attach_kv_publishing(ep, infos[tag].instance_id, eng, interval=0.1)
+
+            client = await fe.namespace("kvt").component("worker").endpoint("gen").client(
+                "kv", kv_block_size=4
+            )
+            await client.wait_for_instances(2, timeout=5)
+
+            # w2 computes a prefix → events reach the client's router
+            prompt = list(range(16))
+            alloc = engines["w2"].allocator.allocate_sequence(prompt)
+            engines["w2"].allocator.note_tokens_computed(alloc, prompt)
+            await asyncio.sleep(0.5)  # let events + metrics propagate
+
+            items = [
+                i async for i in client.generate(
+                    Context({"token_ids": prompt + [99, 98]})
+                )
+            ]
+            assert items[0].data["worker"] == "w2"
+
+            for rt in (w1, w2, fe):
+                await rt.shutdown()
+            await bus.stop()
+            await ss.stop()
+
+        run(go())
+
+    def test_namespace_events(self, run):
+        async def go():
+            ss = StateStoreServer(port=0)
+            bus = MessageBusServer(port=0)
+            await ss.start()
+            await bus.start()
+            a = await DistributedRuntime.create(ss.url, bus.url)
+            b = await DistributedRuntime.create(ss.url, bus.url)
+            sub = await a.namespace("n1").subscribe("kv_events")
+
+            got = []
+
+            async def consume():
+                async for m in sub:
+                    got.append(json.loads(m))
+                    return
+
+            t = asyncio.create_task(consume())
+            await asyncio.sleep(0.05)
+            await b.namespace("n1").publish("kv_events", {"hello": 1})
+            await asyncio.wait_for(t, 5)
+            assert got == [{"hello": 1}]
+            await a.shutdown()
+            await b.shutdown()
+            await bus.stop()
+            await ss.stop()
+
+        run(go())
+
+        # namespacing isolates subjects
