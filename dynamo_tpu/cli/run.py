@@ -5,6 +5,7 @@ Input frontends:
   in=text            interactive REPL
   in=batch:FILE      offline JSONL benchmark with TTFT/ITL stats
   in=dyn://ns.comp.ep  register as a distributed worker endpoint
+  in=prefill:NS      disagg prefill worker consuming namespace NS's queue
 Output engines:
   out=echo_full      OpenAI-level echo (no model files needed)
   out=echo_core      token-level echo through the preprocessor pipeline
@@ -20,9 +21,19 @@ import argparse
 import asyncio
 import json
 import logging
+import os
 import sys
 import time
 from typing import Optional
+
+# DYN_TPU_PLATFORM=cpu lets auxiliary processes (frontends, prefill workers on
+# a host without a free chip) run on CPU even when the environment pins a TPU
+# plugin. Must be applied before any model/engine import touches jax.
+_platform = os.environ.get("DYN_TPU_PLATFORM")
+if _platform:
+    import jax
+
+    jax.config.update("jax_platforms", _platform)
 
 from ..llm.engines import EchoEngineCore, EchoEngineFull
 from ..llm.http.service import HttpService, ModelManager
@@ -70,6 +81,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--bus", default=None, help="message bus url for distributed mode")
     p.add_argument("--wait-workers-timeout", type=float, default=60.0)
     p.add_argument("--extra-engine-args", default=None, help="JSON file of engine kwargs")
+    p.add_argument("--disagg", choices=["none", "decode"], default="none",
+                   help="decode: enqueue long prefills to remote prefill workers")
+    p.add_argument("--max-local-prefill-length", type=int, default=1000)
+    p.add_argument("--max-prefill-queue-size", type=int, default=2)
     return p
 
 
@@ -309,14 +324,52 @@ async def run_endpoint(chat_engine, completions_engine, model_name: str, in_spec
     if core_engine is not None and hasattr(core_engine, "metrics_snapshot"):
         await attach_kv_publishing(endpoint, info.instance_id, core_engine)
         logger.info("kv events + metrics publishing enabled (worker key %s)", info.instance_id)
+    if flags.disagg == "decode" and core_engine is not None:
+        from ..disagg.protocols import DisaggConfig
+        from ..disagg.serving import enable_disagg_decode
+
+        await enable_disagg_decode(
+            endpoint, core_engine, info.instance_id,
+            config=DisaggConfig(
+                max_local_prefill_length=flags.max_local_prefill_length,
+                max_prefill_queue_size=flags.max_prefill_queue_size,
+            ),
+        )
     logger.info("worker %s serving %s at %s", info.worker_id, in_spec, info.address)
     await drt.wait_closed()
+
+
+async def run_prefill_worker_main(out_spec: str, in_spec: str, flags: argparse.Namespace) -> None:
+    """in=prefill:<namespace>: consume the prefill work queue (disagg)."""
+    from ..disagg.prefill_worker import PrefillEngine, run_prefill_worker
+    from ..engine_jax.weights import config_from_card, load_params
+    from ..runtime.distributed import DistributedRuntime
+
+    namespace = in_spec.split(":", 1)[1] if ":" in in_spec else "dynamo"
+    if not flags.model_path:
+        raise SystemExit("prefill worker requires --model-path")
+    card = ModelDeploymentCard.from_local_path(flags.model_path, flags.model_name)
+    model_config = config_from_card(card)
+    params = load_params(card, model_config)
+    engine = PrefillEngine(
+        model_config, params,
+        max_model_len=flags.max_model_len or min(card.context_length, 4096),
+        block_size=flags.kv_block_size,
+    )
+    drt = await DistributedRuntime.create(
+        statestore_url=flags.statestore, bus_url=flags.bus
+    )
+    await run_prefill_worker(drt, namespace, engine)
 
 
 async def amain(argv: list[str]) -> None:
     init_logging()
     in_spec, out_spec, rest = parse_io(argv)
     flags = build_parser().parse_args(rest)
+    if in_spec.startswith("prefill"):
+        await run_prefill_worker_main(out_spec, in_spec, flags)
+        return
+
     core_engine = None
     if out_spec.startswith("dyn://"):
         client, _drt = await build_remote_client(out_spec, flags)

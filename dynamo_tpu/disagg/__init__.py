@@ -1,0 +1,33 @@
+"""Disaggregated prefill/decode serving.
+
+The flagship architecture of the reference (SURVEY.md §3.4), rebuilt
+TPU-native: decode workers conditionally enqueue long prefills to a shared
+work queue; prefill workers compute the prompt KV and ship the pages to the
+decode worker's HBM. On TPU the bulk KV plane is host-staged over TCP/DCN
+(device_get → framed transfer → donated device update); within a single
+process/slice, jax resharding rides ICI automatically. TP-mismatched layouts
+need no custom kernel: pages are logical [L, n, bs, KVH, D] arrays and
+GSPMD re-lays them out on device_put (the reference needed kv_rearrange.py
+CUDA/Triton kernels for this, patch §2.10).
+
+Components:
+  protocols.py      RemotePrefillRequest + disagg config
+  router.py         conditional disagg policy (thresholds, live from statestore)
+  transfer.py       KV page transfer server/client (framed TCP)
+  prefill_worker.py prefill-only engine popping the work queue
+  serving.py        decode-worker glue: policy + transfer server + queue wiring
+"""
+
+from dynamo_tpu.disagg.protocols import DisaggConfig, RemotePrefillRequest
+from dynamo_tpu.disagg.router import DisaggPolicy
+from dynamo_tpu.disagg.transfer import KvTransferClient, KvTransferServer
+from dynamo_tpu.disagg.prefill_worker import PrefillEngine
+
+__all__ = [
+    "DisaggConfig",
+    "RemotePrefillRequest",
+    "DisaggPolicy",
+    "KvTransferClient",
+    "KvTransferServer",
+    "PrefillEngine",
+]

@@ -1,0 +1,175 @@
+"""Prefill-only engine + the prefill worker loop.
+
+A prefill worker pops RemotePrefillRequests from the shared work queue,
+computes the prompt KV (full prompt — it has no access to the decode
+worker's cached prefix KV), samples the first output token with the
+request's sampling params, and ships the *uncached-suffix* pages to the
+decode worker's transfer server.
+
+Reference parity: PrefillWorker (examples/llm/components/prefill_worker.py:
+34-181) — re-designed around the scratch-page prefill engine instead of a
+patched vLLM.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dynamo_tpu.disagg.protocols import (
+    PREFILL_QUEUE,
+    TRANSFER_KEY_PREFIX,
+    RemotePrefillRequest,
+)
+from dynamo_tpu.disagg.transfer import KvTransferClient
+
+logger = logging.getLogger(__name__)
+
+
+class PrefillEngine:
+    """Sequential prefill-only engine with a single-sequence scratch page pool."""
+
+    def __init__(self, model_config, params, max_model_len: int = 2048,
+                 block_size: int = 16, min_bucket: int = 16):
+        import jax
+
+        from dynamo_tpu.models.llama import make_kv_cache
+
+        self.model_config = model_config
+        self.params = params
+        self.block_size = block_size
+        self.max_model_len = max_model_len
+        self.max_blocks = math.ceil(max_model_len / block_size)
+        self.min_bucket = min_bucket
+        self._cache = make_kv_cache(model_config, self.max_blocks, block_size)
+        self._tables = np.arange(self.max_blocks, dtype=np.int32)[None, :]
+        self._fns: Dict[int, object] = {}
+        self._key = jax.random.PRNGKey(0)
+        self._counter = 0
+
+    def _bucket(self, n: int) -> int:
+        b = self.min_bucket
+        while b < n:
+            b *= 2
+        return min(b, self.max_model_len)
+
+    def _fn(self, bucket: int):
+        fn = self._fns.get(bucket)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        from dynamo_tpu.engine_jax.sampling import sample_tokens
+        from dynamo_tpu.models.llama import forward
+
+        cfg = self.model_config
+
+        def prefill(params, cache, tokens, positions, table, sample_at, key, temp, topk, topp):
+            logits, cache = forward(params, cfg, tokens, positions, cache, table)
+            tok = sample_tokens(
+                logits[:, sample_at], key[None], temp[None], topk[None], topp[None]
+            )
+            return tok[0], cache
+
+        fn = jax.jit(prefill, donate_argnums=(1,))
+        self._fns[bucket] = fn
+        return fn
+
+    def prefill(
+        self, token_ids: List[int], cached_tokens: int, sampling: dict
+    ) -> Tuple[int, np.ndarray, np.ndarray]:
+        """Compute the prompt KV; return (first_token, k_pages, v_pages) where
+        the pages cover blocks from cached_tokens//block_size onward."""
+        import jax
+        import jax.numpy as jnp
+
+        n = len(token_ids)
+        if n > self.max_model_len:
+            raise ValueError(f"prompt {n} exceeds prefill max_model_len {self.max_model_len}")
+        bucket = self._bucket(n)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = token_ids
+        positions = np.full((1, bucket), -1, np.int32)
+        positions[0, :n] = np.arange(n)
+
+        self._counter += 1
+        key = jax.random.fold_in(self._key, self._counter)
+        if sampling.get("seed"):
+            key = jax.random.fold_in(key, int(sampling["seed"]))
+
+        fn = self._fn(bucket)
+        tok, self._cache = fn(
+            self.params, self._cache, tokens, positions,
+            self._tables[:, : self.max_blocks], n - 1, key,
+            jnp.float32(sampling.get("temperature") or 0.0),
+            jnp.int32(sampling.get("top_k") or 0),
+            jnp.float32(sampling.get("top_p") or 1.0),
+        )
+        first_token = int(tok)
+
+        first_block = cached_tokens // self.block_size
+        n_blocks = math.ceil(n / self.block_size)
+        idx = jnp.arange(first_block, n_blocks, dtype=jnp.int32)
+        k = np.asarray(jax.device_get(self._cache["k"][:, idx]))
+        v = np.asarray(jax.device_get(self._cache["v"][:, idx]))
+        return first_token, k, v
+
+
+async def run_prefill_worker(runtime, namespace: str, engine: PrefillEngine) -> None:
+    """Pop → prefill → ship, forever. Multiple prefill workers share the queue."""
+    if runtime.bus is None:
+        raise RuntimeError("prefill worker needs the message bus")
+    client = KvTransferClient()
+    addr_cache: Dict[str, str] = {}
+    queue = f"{namespace}.{PREFILL_QUEUE}"
+    logger.info("prefill worker consuming %s", queue)
+    while True:
+        raw = await runtime.bus.queue_pop(queue, block=True)
+        if raw is None:
+            continue
+        req = RemotePrefillRequest.from_dict(json.loads(raw))
+        addr = addr_cache.get(req.engine_id)
+        if addr is None:
+            key = f"{namespace}/{TRANSFER_KEY_PREFIX}{req.engine_id}"
+            raw_addr = None
+            for delay in (0, 0.2, 0.5, 1.0):  # brief re-registration races
+                if delay:
+                    await asyncio.sleep(delay)
+                raw_addr = await runtime.store.get(key)
+                if raw_addr is not None:
+                    break
+            if raw_addr is None:
+                # can't reach the decode worker to report failure either; its
+                # engine-side remote_prefill_timeout falls the request back to
+                # local prefill
+                logger.error("no transfer address for engine %s; dropping %s "
+                             "(decode worker will fall back after timeout)",
+                             req.engine_id, req.request_id)
+                continue
+            addr = raw_addr.decode()
+            addr_cache[req.engine_id] = addr
+        try:
+            tok, k, v = await asyncio.to_thread(
+                engine.prefill, req.token_ids, req.cached_tokens, req.sampling
+            )
+            if k.shape[1] != len(req.block_ids):
+                raise ValueError(
+                    f"page count mismatch: computed {k.shape[1]}, decode expects "
+                    f"{len(req.block_ids)} (block_size skew?)"
+                )
+            await client.send_blocks(addr, req.request_id, tok, req.block_ids, k, v)
+            logger.info("prefilled %s (%d tokens → %d pages)",
+                        req.request_id, len(req.token_ids), k.shape[1])
+        except Exception as e:
+            logger.exception("prefill failed for %s", req.request_id)
+            addr_cache.pop(req.engine_id, None)
+            try:
+                await client.send_failure(addr, req.request_id, str(e))
+            except (ConnectionError, OSError):
+                logger.warning("could not report prefill failure for %s", req.request_id)

@@ -1,0 +1,69 @@
+"""Disagg wire types.
+
+Reference parity: RemotePrefillRequest (vllm patch remote_prefill.py,
+SURVEY.md §2.10) and DisaggRouterConf (lib/llm/src/disagg_router.rs:24-262).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class RemotePrefillRequest:
+    """Decode worker → prefill queue: compute this prompt's KV into my pages."""
+
+    request_id: str
+    engine_id: str  # decode worker's transfer identity
+    token_ids: List[int]
+    block_ids: List[int]  # decode-side physical pages for the UNCACHED suffix
+    cached_tokens: int  # prefix already present decode-side (skip computing)
+    sampling: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "request_id": self.request_id,
+            "engine_id": self.engine_id,
+            "token_ids": self.token_ids,
+            "block_ids": self.block_ids,
+            "cached_tokens": self.cached_tokens,
+            "sampling": self.sampling,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RemotePrefillRequest":
+        return cls(
+            request_id=d["request_id"],
+            engine_id=d["engine_id"],
+            token_ids=list(d["token_ids"]),
+            block_ids=list(d["block_ids"]),
+            cached_tokens=int(d.get("cached_tokens", 0)),
+            sampling=dict(d.get("sampling", {})),
+        )
+
+
+@dataclass
+class DisaggConfig:
+    """Conditional-disagg thresholds (reference defaults: disagg_router.rs:28-33)."""
+
+    max_local_prefill_length: int = 1000
+    max_prefill_queue_size: int = 2
+
+    def to_dict(self) -> dict:
+        return {
+            "max_local_prefill_length": self.max_local_prefill_length,
+            "max_prefill_queue_size": self.max_prefill_queue_size,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DisaggConfig":
+        return cls(
+            max_local_prefill_length=int(d.get("max_local_prefill_length", 1000)),
+            max_prefill_queue_size=int(d.get("max_prefill_queue_size", 2)),
+        )
+
+
+PREFILL_QUEUE = "prefill_queue"  # bus queue name, namespaced by caller
+TRANSFER_KEY_PREFIX = "disagg/kv_transfer/"  # statestore: engine_id → address
+CONFIG_KEY = "disagg_router/config"

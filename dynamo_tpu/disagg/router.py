@@ -1,0 +1,76 @@
+"""Conditional disaggregation policy.
+
+Decide per request whether prefill runs locally or on a remote prefill
+worker: remote iff the *uncached* prefill length exceeds
+``max_local_prefill_length`` AND the prefill queue is not backed up
+(reference: PyDisaggregatedRouter, examples/llm/components/disagg_router.py:66,
+and the etcd-watched DisaggRouterConf, disagg_router.rs:36-150).
+
+The policy object is handed to the engine (set_remote_prefill_policy);
+`should_remote` runs on the engine thread against cached state, `submit` hops
+to the asyncio side thread-safely.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Callable, Optional
+
+from dynamo_tpu.disagg.protocols import CONFIG_KEY, DisaggConfig, RemotePrefillRequest
+
+logger = logging.getLogger(__name__)
+
+
+class DisaggPolicy:
+    def __init__(
+        self,
+        engine_id: str,
+        config: DisaggConfig,
+        enqueue: Callable[[RemotePrefillRequest], None],
+        queue_len: Callable[[], int],
+    ):
+        """enqueue: thread-safe submit of a RemotePrefillRequest.
+        queue_len: cheap read of the (cached) prefill queue depth."""
+        self.engine_id = engine_id
+        self.config = config
+        self._enqueue = enqueue
+        self._queue_len = queue_len
+
+    # engine-thread side -------------------------------------------------------
+
+    def should_remote(self, uncached_prefill_len: int) -> bool:
+        if uncached_prefill_len <= self.config.max_local_prefill_length:
+            return False
+        if self._queue_len() >= self.config.max_prefill_queue_size:
+            return False  # queue backed up: prefill locally (backpressure)
+        return True
+
+    def submit(self, request_id, token_ids, block_ids, cached_tokens, sampling) -> None:
+        req = RemotePrefillRequest(
+            request_id=request_id,
+            engine_id=self.engine_id,
+            token_ids=list(token_ids),
+            block_ids=list(block_ids),
+            cached_tokens=cached_tokens,
+            sampling=dict(sampling),
+        )
+        self._enqueue(req)
+
+
+async def watch_disagg_config(store, namespace: str, policy: DisaggPolicy) -> None:
+    """Live-update thresholds from the statestore (flip disagg on/off without
+    restarts — reference disagg_router.rs:36-150)."""
+    key = f"{namespace}/{CONFIG_KEY}"
+    raw = await store.get(key)
+    if raw:
+        policy.config = DisaggConfig.from_dict(json.loads(raw))
+    watcher = await store.watch_prefix(key, include_existing=False)
+    async for ev in watcher:
+        if ev.type == "put":
+            try:
+                policy.config = DisaggConfig.from_dict(json.loads(ev.value))
+                logger.info("disagg config updated: %s", policy.config)
+            except (ValueError, KeyError):
+                logger.warning("bad disagg config", exc_info=True)

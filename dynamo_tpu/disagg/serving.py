@@ -1,0 +1,92 @@
+"""Decode-worker disagg glue: transfer server + policy + queue wiring.
+
+`enable_disagg_decode(endpoint, engine, instance_id)`:
+- starts the KV transfer server and registers its address in the statestore
+  under the worker's lease (the NIXL-metadata-rendezvous analogue)
+- polls the prefill queue depth (backpressure signal for conditional disagg,
+  reference disagg_router.py)
+- installs a DisaggPolicy on the engine and live-watches threshold config
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+from dynamo_tpu.disagg.protocols import (
+    PREFILL_QUEUE,
+    TRANSFER_KEY_PREFIX,
+    DisaggConfig,
+)
+from dynamo_tpu.disagg.router import DisaggPolicy, watch_disagg_config
+from dynamo_tpu.disagg.transfer import KvTransferServer
+
+logger = logging.getLogger(__name__)
+
+
+async def enable_disagg_decode(
+    endpoint, engine, instance_id: str, config: DisaggConfig | None = None,
+    queue_poll_interval: float = 0.25,
+) -> KvTransferServer:
+    ns = endpoint.component.namespace
+    rt = ns.runtime
+    if rt.bus is None:
+        raise RuntimeError("disagg decode needs the message bus")
+    loop = asyncio.get_running_loop()
+
+    server = KvTransferServer(engine, host="0.0.0.0", port=0)
+    await server.start()
+    # rendezvous key: use the STABLE worker id (not the lease-scoped instance
+    # id) so in-flight prefills still resolve across a lease loss; registered
+    # via the endpoint so re-registration restores it
+    engine_id = rt.worker_id
+    transfer_key = f"{ns.name}/{TRANSFER_KEY_PREFIX}{engine_id}"
+    address = f"{rt.advertise_host}:{server.port}".encode()
+    if hasattr(endpoint, "_leased_keys"):
+        await endpoint.add_leased_key(transfer_key, address)
+    else:
+        await rt.store.put(transfer_key, address, lease=await rt.primary_lease())
+
+    queue = f"{ns.name}.{PREFILL_QUEUE}"
+    depth = [0]
+
+    async def poll_depth():
+        while True:
+            try:
+                depth[0] = await rt.bus.queue_len(queue)
+            except (ConnectionError, RuntimeError):
+                pass
+            await asyncio.sleep(queue_poll_interval)
+
+    async def push(req, payload: bytes) -> None:
+        try:
+            await rt.bus.queue_push(queue, payload)
+        except (ConnectionError, RuntimeError, OSError) as e:
+            # the request is already parked in _awaiting: fail it over to the
+            # engine's local-prefill fallback instead of hanging
+            logger.warning("prefill enqueue failed for %s: %s", req.request_id, e)
+            engine.fail_remote_prefill(req.request_id, f"enqueue failed: {e}")
+
+    def enqueue(req) -> None:  # called from the engine thread
+        payload = json.dumps(req.to_dict()).encode()
+        depth[0] += 1  # optimistic bump until the next poll
+        loop.call_soon_threadsafe(
+            lambda: rt._background.append(loop.create_task(push(req, payload)))
+        )
+
+    policy = DisaggPolicy(
+        engine_id=engine_id,
+        config=config or DisaggConfig(),
+        enqueue=enqueue,
+        queue_len=lambda: depth[0],
+    )
+    engine.set_remote_prefill_policy(policy)
+
+    rt._background.append(asyncio.create_task(poll_depth()))
+    rt._background.append(asyncio.create_task(watch_disagg_config(rt.store, ns.name, policy)))
+    logger.info(
+        "disagg decode enabled: transfer %s:%d, queue %s, thresholds %s",
+        rt.advertise_host, server.port, queue, policy.config.to_dict(),
+    )
+    return server

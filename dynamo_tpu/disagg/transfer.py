@@ -1,0 +1,142 @@
+"""KV page transfer plane: prefill worker → decode worker HBM.
+
+Host-staged bulk transfer over the framed TCP codec (the TPU-native
+replacement for the reference's NIXL RDMA path, SURVEY.md §2.10): the
+prefill side pulls computed pages to host, ships one frame
+(header JSON + raw bf16/f32 bytes), and the decode side writes them into its
+page pool with a donated on-device update (engine.inject_blocks). Rendezvous
+is by engine_id → address in the statestore, exactly like NixlMetadataStore
+(examples/llm/utils/nixl.py:58-109).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Dict, Optional
+
+import numpy as np
+
+from dynamo_tpu.runtime.codec import TwoPartMessage, read_frame, write_frame
+
+logger = logging.getLogger(__name__)
+
+
+def _pack(arr: np.ndarray) -> bytes:
+    # bfloat16 isn't a standard numpy dtype everywhere: ship as raw bytes +
+    # dtype string (ml_dtypes provides bfloat16 in this stack)
+    return arr.tobytes()
+
+
+def _unpack(raw: bytes, dtype: str, shape) -> np.ndarray:
+    import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
+
+    return np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape)
+
+
+class KvTransferServer:
+    """Decode-worker side: receives KV pages and completes waiting requests."""
+
+    def __init__(self, engine, host: str = "0.0.0.0", port: int = 0):
+        self.engine = engine
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("kv transfer server on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    frame = await read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return
+                h = json.loads(frame.header)
+                if h.get("op") == "kv_blocks":
+                    k_len = h["k_bytes"]
+                    k = _unpack(frame.body[:k_len], h["dtype"], h["shape"])
+                    v = _unpack(frame.body[k_len:], h["dtype"], h["shape"])
+                    self.engine.complete_remote_prefill(
+                        h["request_id"], h["first_token"], h["block_ids"], k, v
+                    )
+                elif h.get("op") == "prefill_failed":
+                    self.engine.fail_remote_prefill(h["request_id"], h.get("message", ""))
+                await write_frame(
+                    writer,
+                    TwoPartMessage(json.dumps({"id": h.get("id"), "ok": True}).encode(), b""),
+                )
+        finally:
+            writer.close()
+
+
+class KvTransferClient:
+    """Prefill-worker side: pooled connections to decode workers' servers."""
+
+    def __init__(self):
+        self._conns: Dict[str, tuple] = {}
+        self._locks: Dict[str, asyncio.Lock] = {}
+
+    async def _conn(self, address: str):
+        c = self._conns.get(address)
+        if c is None or c[1].is_closing():
+            host, _, port = address.rpartition(":")
+            reader, writer = await asyncio.open_connection(host or "127.0.0.1", int(port))
+            c = (reader, writer)
+            self._conns[address] = c
+            self._locks[address] = asyncio.Lock()
+        return c
+
+    async def send_blocks(
+        self,
+        address: str,
+        request_id: str,
+        first_token: int,
+        block_ids,
+        k: np.ndarray,
+        v: np.ndarray,
+    ) -> None:
+        reader, writer = await self._conn(address)
+        k_raw, v_raw = _pack(k), _pack(v)
+        header = {
+            "op": "kv_blocks",
+            "request_id": request_id,
+            "first_token": int(first_token),
+            "block_ids": list(map(int, block_ids)),
+            "dtype": k.dtype.name,
+            "shape": list(k.shape),
+            "k_bytes": len(k_raw),
+        }
+        async with self._locks[address]:
+            await write_frame(
+                writer, TwoPartMessage(json.dumps(header).encode(), k_raw + v_raw)
+            )
+            await read_frame(reader)  # ack
+
+    async def send_failure(self, address: str, request_id: str, message: str) -> None:
+        reader, writer = await self._conn(address)
+        async with self._locks[address]:
+            await write_frame(
+                writer,
+                TwoPartMessage(
+                    json.dumps(
+                        {"op": "prefill_failed", "request_id": request_id, "message": message}
+                    ).encode(),
+                    b"",
+                ),
+            )
+            await read_frame(reader)
+
+    async def close(self) -> None:
+        for _, w in self._conns.values():
+            w.close()

@@ -62,6 +62,9 @@ class EngineConfig:
     # condition are discarded host-side; worst case wastes decode_steps-1
     # token computations per finished request.
     decode_steps: int = 1
+    # safety net for disaggregated prefill: a sequence whose remote prefill
+    # hasn't landed within this window falls back to local prefill
+    remote_prefill_timeout: float = 60.0
 
     def resolve_num_blocks(self) -> int:
         if self.num_kv_blocks is not None:
@@ -89,7 +92,8 @@ class _Seq:
     __slots__ = (
         "ctx", "request", "prompt", "alloc", "slot", "out_queue", "loop",
         "generated", "max_tokens", "eos_ids", "ignore_eos", "temperature",
-        "top_k", "top_p", "seed", "enqueue_t", "first_token_t",
+        "top_k", "top_p", "seed", "enqueue_t", "first_token_t", "remote",
+        "remote_deadline",
     )
 
     def __init__(self, ctx: Context, request: PreprocessedRequest, loop) -> None:
@@ -112,6 +116,8 @@ class _Seq:
         self.seed = so.seed if so.seed is not None else 0
         self.enqueue_t = time.perf_counter()
         self.first_token_t: Optional[float] = None
+        self.remote = False  # prefill dispatched to a remote prefill worker
+        self.remote_deadline: Optional[float] = None
 
     @property
     def total_len(self) -> int:
@@ -174,6 +180,12 @@ class JaxServingEngine(AsyncEngine):
         self._cond = threading.Condition()
         self._shutdown = False
         self._thread: Optional[threading.Thread] = None
+
+        # disaggregated prefill: policy decides + submits; sequences wait in
+        # _awaiting until the prefill worker's KV lands (complete_remote_prefill)
+        self._remote_policy: Optional[Any] = None
+        self._awaiting: Dict[str, _Seq] = {}
+        self._posted: Deque[Any] = deque()  # host fns to run on the engine thread
 
         # stats
         self.total_requests = 0
@@ -279,20 +291,42 @@ class JaxServingEngine(AsyncEngine):
                     while (
                         not self._shutdown
                         and not self._pending
+                        and not self._posted
                         and not any(self._slots)
                     ):
+                        if self._awaiting:
+                            # wake periodically to sweep remote-prefill timeouts
+                            self._cond.wait(timeout=1.0)
+                            break
                         self._cond.wait()
                     if self._shutdown:
                         return
+                self._run_posted()
+                self._sweep_remote_timeouts()
                 self._admit()
                 self._decode_step()
         except Exception:
             logger.exception("engine step loop crashed")
             # fail every in-flight request rather than hanging clients
-            for seq in list(self._slots) + list(self._pending):
+            for seq in list(self._slots) + list(self._pending) + list(self._awaiting.values()):
                 if seq is not None:
                     seq.emit(Annotated.from_error("engine internal error"))
                     seq.emit(_FINISHED)
+
+    def post(self, fn) -> None:
+        """Schedule a host function to run on the engine thread (thread-safe).
+        The only way external code may touch the cache or allocator."""
+        with self._cond:
+            self._posted.append(fn)
+            self._cond.notify()
+
+    def _run_posted(self) -> None:
+        while True:
+            with self._cond:
+                if not self._posted:
+                    return
+                fn = self._posted.popleft()
+            fn()
 
     # -- scheduling ----------------------------------------------------------
 
@@ -307,13 +341,30 @@ class JaxServingEngine(AsyncEngine):
                     return
                 seq = self._pending.popleft()
             if seq.ctx.context.is_stopped:
+                if seq.alloc is not None:
+                    self.allocator.free_sequence(seq.alloc)
+                    seq.alloc = None
                 seq.emit(Annotated.from_data(LLMEngineOutput.final(FinishReason.CANCELLED).to_dict()))
                 seq.emit(_FINISHED)
                 continue
+            if seq.alloc is not None and seq.generated:
+                # remotely-prefilled sequence re-entering for a decode slot:
+                # KV + first token already landed, just start decoding
+                seq.slot = free[0]
+                self._slots[seq.slot] = seq
+                continue
+            if seq.alloc is not None:
+                # remote prefill failed/timed out: run the prefill locally on
+                # the allocation we already hold
+                seq.slot = free[0]
+                self._slots[seq.slot] = seq
+                self._run_prefill(seq)
+                continue
             alloc = self.allocator.allocate_sequence(seq.prompt)
             if alloc is None:
-                if not any(self._slots):
-                    # nothing running will ever free blocks: impossible request
+                if not any(self._slots) and not self._awaiting:
+                    # nothing running (or awaiting remote prefill) will ever
+                    # free blocks: impossible request
                     seq.emit(Annotated.from_error(
                         f"prompt needs {self.allocator.blocks_needed(len(seq.prompt))} "
                         f"KV blocks; pool has {self.num_blocks}"
@@ -324,10 +375,36 @@ class JaxServingEngine(AsyncEngine):
                     self._pending.appendleft(seq)  # retry when blocks free up
                 return
             seq.alloc = alloc
-            seq.slot = free[0]
-            self._slots[seq.slot] = seq
             self.total_requests += 1
             self.total_prompt_tokens += len(seq.prompt)
+
+            # conditional disaggregation: long-enough prefills (minus whatever
+            # the prefix cache already covers) go to a remote prefill worker
+            policy = self._remote_policy
+            uncached = len(seq.prompt) - alloc.cached_tokens
+            if (
+                policy is not None
+                and not seq.remote
+                and policy.should_remote(uncached)
+            ):
+                seq.remote = True
+                seq.remote_deadline = time.perf_counter() + self.config.remote_prefill_timeout
+                self._awaiting[seq.ctx.id] = seq
+                first_suffix_block = alloc.cached_tokens // self.config.kv_block_size
+                policy.submit(
+                    request_id=seq.ctx.id,
+                    token_ids=seq.prompt,
+                    block_ids=list(alloc.block_ids[first_suffix_block:]),
+                    cached_tokens=alloc.cached_tokens,
+                    sampling={
+                        "temperature": seq.temperature, "top_k": seq.top_k,
+                        "top_p": seq.top_p, "seed": seq.seed,
+                    },
+                )
+                continue  # holds no slot while prefill runs remotely
+
+            seq.slot = free[0]
+            self._slots[seq.slot] = seq
             self._run_prefill(seq)
 
     def _run_prefill(self, seq: _Seq) -> None:
@@ -460,6 +537,114 @@ class JaxServingEngine(AsyncEngine):
         with self._cond:
             self._pending.append(seq)
 
+    # -- disaggregated prefill ------------------------------------------------
+
+    def set_remote_prefill_policy(self, policy) -> None:
+        """policy must provide should_remote(uncached_len)->bool and
+        submit(request_id, token_ids, block_ids, cached_tokens, sampling)
+        (called from the engine thread; submit must be thread-safe)."""
+        self._remote_policy = policy
+
+    def extract_blocks(self, block_ids: List[int]):
+        """Copy KV pages out of HBM → host numpy ([L, n, bs, KVH, D] ×2).
+        MUST run on the engine thread (e.g. via post())."""
+        idx = jnp.asarray(block_ids, jnp.int32)
+        k = np.asarray(jax.device_get(self.cache["k"][:, idx]))
+        v = np.asarray(jax.device_get(self.cache["v"][:, idx]))
+        return k, v
+
+    def _inject_fn(self):
+        if not hasattr(self, "_inject_jit"):
+            def inject(cache_arr, idx, vals):
+                # padded idx entries are out of range → dropped by the scatter
+                return cache_arr.at[:, idx].set(vals, mode="drop")
+
+            self._inject_jit = jax.jit(inject, donate_argnums=(0,))
+        return self._inject_jit
+
+    def inject_blocks(self, block_ids: List[int], k_np, v_np) -> None:
+        """Write transferred KV pages into HBM at the given physical pages.
+        MUST run on the engine thread. Donated update (no cache-sized copy);
+        the page count is padded to a power of two so at most log2(max_blocks)
+        shapes ever compile — an unpadded count would recompile the donated
+        scatter (and stall decode) for every distinct transfer size."""
+        n = len(block_ids)
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        idx = np.full((bucket,), self.num_blocks, np.int32)  # out-of-range pad
+        idx[:n] = block_ids
+        dt = self.cache["k"].dtype
+
+        def pad(vals):
+            out = np.zeros((vals.shape[0], bucket) + vals.shape[2:], vals.dtype)
+            out[:, :n] = vals
+            return out
+
+        fn = self._inject_fn()
+        idx_dev = jnp.asarray(idx)
+        self.cache["k"] = fn(self.cache["k"], idx_dev, jnp.asarray(pad(k_np), dt))
+        self.cache["v"] = fn(self.cache["v"], idx_dev, jnp.asarray(pad(v_np), dt))
+
+    def complete_remote_prefill(
+        self, request_id: str, first_token: int, block_ids: List[int], k_np, v_np
+    ) -> None:
+        """Called (any thread) when a prefill worker's KV lands for a waiting
+        sequence: injects pages, registers the prompt KV, emits the first
+        token, and queues the sequence for a decode slot."""
+
+        def apply():
+            seq = self._awaiting.pop(request_id, None)
+            if seq is None:
+                logger.warning("remote prefill for unknown request %s", request_id)
+                return
+            # inject only the pages the prefill worker computed (suffix after
+            # any prefix-cache hit)
+            if block_ids:
+                self.inject_blocks(block_ids, k_np, v_np)
+            self.allocator.note_tokens_computed(seq.alloc, seq.prompt[seq.alloc.cached_tokens:])
+            seq.first_token_t = time.perf_counter()
+            self._emit_token(seq, int(first_token))
+            if seq.alloc is not None:  # not finished by the first token
+                with self._cond:
+                    self._pending.append(seq)
+                    self._cond.notify()
+
+        self.post(apply)
+
+    def fail_remote_prefill(self, request_id: str, message: str) -> None:
+        """Remote prefill failed: fall back to computing the prefill locally
+        (the allocation is still held; seq.remote stays True so _admit won't
+        re-dispatch it)."""
+
+        def apply():
+            seq = self._awaiting.pop(request_id, None)
+            if seq is None:
+                return
+            logger.warning(
+                "remote prefill failed for %s (%s): falling back to local",
+                request_id, message,
+            )
+            with self._cond:
+                self._pending.append(seq)
+                self._cond.notify()
+
+        self.post(apply)
+
+    def _sweep_remote_timeouts(self) -> None:
+        if not self._awaiting:
+            return
+        now = time.perf_counter()
+        for rid, seq in list(self._awaiting.items()):
+            if seq.remote_deadline is not None and now > seq.remote_deadline:
+                del self._awaiting[rid]
+                logger.warning(
+                    "remote prefill for %s timed out after %.0fs: prefilling locally",
+                    rid, self.config.remote_prefill_timeout,
+                )
+                with self._cond:
+                    self._pending.append(seq)
+
     def set_event_sink(self, sink: KvEventSink) -> None:
         """Attach/replace the KV event sink (e.g. the distributed publish
         bridge) after construction."""
@@ -476,7 +661,7 @@ class JaxServingEngine(AsyncEngine):
             "request_total_slots": self.config.max_slots,
             "kv_active_blocks": self.allocator.active_blocks,
             "kv_total_blocks": self.num_blocks,
-            "num_requests_waiting": len(self._pending),
+            "num_requests_waiting": len(self._pending) + len(self._awaiting),
             "gpu_cache_usage_perc": self.allocator.usage(),
             "gpu_prefix_cache_hit_rate": self.allocator.hit_tokens / probe,
         }

@@ -44,15 +44,15 @@ class MessageBusServer:
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> None:
-        self._server = await asyncio.start_server(self._handle, self.host, self.port)
-        if self.port == 0:
-            self.port = self._server.sockets[0].getsockname()[1]
+        from dynamo_tpu.runtime.netutil import TrackedServer
+
+        self._server = TrackedServer(self._handle, self.host, self.port)
+        self.port = await self._server.start()
         logger.info("message bus listening on %s:%d", self.host, self.port)
 
     async def stop(self) -> None:
         if self._server:
-            self._server.close()
-            await self._server.wait_closed()
+            await self._server.stop()
 
     @property
     def url(self) -> str:

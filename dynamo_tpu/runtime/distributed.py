@@ -238,10 +238,19 @@ class Endpoint:
             ).encode()
         for k, v in keys.items():
             await rt.store.put(k, v, lease=lease)
+        self._leased_keys = keys  # add_leased_key extends this set
+        self._serve_lease = lease
         rt._background.append(
             asyncio.create_task(self._reregister_on_lease_loss(rt, lease, info, keys))
         )
         return info
+
+    async def add_leased_key(self, key: str, value: bytes) -> None:
+        """Register an extra key under the serve lease; it participates in
+        re-registration after lease loss (e.g. the disagg transfer address)."""
+        rt = self.component.namespace.runtime
+        self._leased_keys[key] = value
+        await rt.store.put(key, value, lease=self._serve_lease)
 
     async def _reregister_on_lease_loss(
         self, rt: DistributedRuntime, lease: Lease, info: InstanceInfo, keys: dict
@@ -260,6 +269,7 @@ class Endpoint:
                         await rt.reconnect_store()
                     lease = await rt.store.grant_lease()
                     rt._primary_lease = lease
+                    self._serve_lease = lease
                     # instance id follows the lease: re-key the instance entry
                     old_instance_key = next(k for k in keys if "/instances/" in k)
                     keys.pop(old_instance_key)
@@ -302,6 +312,7 @@ class EndpointClient(AsyncEngine):
         self._kv_task: Optional[asyncio.Task] = None
         self._router = None
         self._ready = asyncio.Event()
+        self._closed = False
 
     VALID_MODES = ("random", "round_robin", "kv")
 
@@ -322,24 +333,50 @@ class EndpointClient(AsyncEngine):
                 self._kv_task = asyncio.create_task(self._kv_feed())
 
     async def _watch_loop(self) -> None:
-        async for ev in self._watcher:
-            iid = ev.key.rsplit("/", 1)[-1]
-            if ev.type == "put":
+        """Consume watch events; if the statestore connection drops, reconnect
+        and re-watch with a fresh snapshot (the worker side re-registers on
+        lease loss — this is the client half of that recovery)."""
+        backoff = 0.5
+        while not self._closed:
+            async for ev in self._watcher:
+                iid = ev.key.rsplit("/", 1)[-1]
+                if ev.type == "put":
+                    try:
+                        self._instances[iid] = InstanceInfo.from_json(ev.value)
+                    except (ValueError, KeyError):
+                        continue
+                    self._ready.set()
+                else:
+                    self._instances.pop(iid, None)
+                    conn = self._conns.pop(iid, None)
+                    if conn is not None:
+                        await conn.close()
+                    if self._router is not None:
+                        self._router.remove_worker(iid)
+                if not self._instances:
+                    self._ready.clear()
+            if self._closed:
+                return
+            # watcher ended: the statestore connection died. Reconnect + rewatch.
+            rt = self.endpoint.component.namespace.runtime
+            logger.warning("instance watch for %s lost; reconnecting", self.endpoint.path)
+            while not self._closed:
                 try:
-                    self._instances[iid] = InstanceInfo.from_json(ev.value)
-                except (ValueError, KeyError):
-                    continue
-                self._ready.set()
-            else:
-                self._instances.pop(iid, None)
-                conn = self._conns.pop(iid, None)
-                if conn is not None:
-                    await conn.close()
-                if self._router is not None:
-                    info_wid = iid  # worker keyed by instance id in router
-                    self._router.remove_worker(info_wid)
-            if not self._instances:
-                self._ready.clear()
+                    try:
+                        await rt.store.get("__ping__")
+                    except (ConnectionError, RuntimeError):
+                        await rt.reconnect_store()
+                    self._watcher = await rt.store.watch_prefix(
+                        self.endpoint.instances_prefix, include_existing=True
+                    )
+                    # fresh snapshot replaces stale state as puts stream in
+                    self._instances.clear()
+                    self._ready.clear()
+                    backoff = 0.5
+                    break
+                except (ConnectionError, RuntimeError, OSError):
+                    await asyncio.sleep(backoff)
+                    backoff = min(backoff * 2, 10.0)
 
     async def _kv_feed(self) -> None:
         """Feed KV events + metrics from the namespace event plane into the router."""
@@ -421,6 +458,7 @@ class EndpointClient(AsyncEngine):
             yield item
 
     async def close(self) -> None:
+        self._closed = True
         if self._watch_task:
             self._watch_task.cancel()
         if self._kv_task:

@@ -1,0 +1,50 @@
+"""Shared asyncio server plumbing.
+
+`TrackedServer` wraps asyncio.start_server with connection tracking so stop()
+can force-close lingering client connections — Python 3.12's
+Server.wait_closed() otherwise blocks until every client hangs up on its own.
+Used by the statestore, message bus, rpc and kv-transfer servers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Optional
+
+Handler = Callable[[asyncio.StreamReader, asyncio.StreamWriter], Awaitable[None]]
+
+
+class TrackedServer:
+    def __init__(self, handler: Handler, host: str, port: int):
+        self.handler = handler
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()
+
+    async def start(self) -> int:
+        """Start listening; returns the bound port."""
+
+        async def handle(reader, writer):
+            self._conns.add(writer)
+            try:
+                await self.handler(reader, writer)
+            finally:
+                self._conns.discard(writer)
+
+        self._server = await asyncio.start_server(handle, self.host, self.port)
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    def close_listener(self) -> None:
+        """Stop accepting new connections (existing ones keep running)."""
+        if self._server:
+            self._server.close()
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            for w in list(self._conns):
+                w.close()
+            await self._server.wait_closed()

@@ -47,20 +47,22 @@ class RpcServer:
         self._engines[endpoint] = engine
 
     async def start(self) -> None:
-        self._server = await asyncio.start_server(self._handle, self.host, self.port)
-        if self.port == 0:
-            self.port = self._server.sockets[0].getsockname()[1]
+        from dynamo_tpu.runtime.netutil import TrackedServer
+
+        self._server = TrackedServer(self._handle, self.host, self.port)
+        self.port = await self._server.start()
         logger.info("rpc server listening on %s:%d", self.host, self.port)
 
     async def stop(self, drain_timeout: float = 10.0) -> None:
         self._draining = True
         if self._server:
-            self._server.close()
-            await self._server.wait_closed()
+            self._server.close_listener()
         if self._inflight:
             done, pending = await asyncio.wait(self._inflight, timeout=drain_timeout)
             for t in pending:
                 t.cancel()
+        if self._server:
+            await self._server.stop()
 
     @property
     def inflight_count(self) -> int:
@@ -205,7 +207,12 @@ class RpcClient:
         req_id = next(self._ids)
         q: asyncio.Queue = asyncio.Queue()
         self._streams[req_id] = q
-        payload = request if isinstance(request, (dict, list)) else getattr(request, "to_dict")()
+        if hasattr(request, "to_dict"):
+            payload = request.to_dict()
+        elif hasattr(request, "model_dump"):
+            payload = request.model_dump(exclude_none=True)
+        else:
+            payload = request  # any JSON-serializable value
         header = {"id": req_id, "op": "generate", "endpoint": endpoint}
         if context is not None:
             header["request_id"] = context.id

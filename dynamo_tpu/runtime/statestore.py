@@ -99,13 +99,14 @@ class StateStoreServer:
         self._kv: Dict[str, Tuple[bytes, Optional[str]]] = {}  # key → (value, lease)
         self._leases: Dict[str, _Lease] = {}
         self._watches: Dict[str, _Watch] = {}
-        self._server: Optional[asyncio.AbstractServer] = None
+        self._server = None  # TrackedServer
         self._expiry_task: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
-        self._server = await asyncio.start_server(self._handle, self.host, self.port)
-        if self.port == 0:
-            self.port = self._server.sockets[0].getsockname()[1]
+        from dynamo_tpu.runtime.netutil import TrackedServer
+
+        self._server = TrackedServer(self._handle, self.host, self.port)
+        self.port = await self._server.start()
         self._expiry_task = asyncio.create_task(self._expire_loop())
         logger.info("statestore listening on %s:%d", self.host, self.port)
 
@@ -113,8 +114,7 @@ class StateStoreServer:
         if self._expiry_task:
             self._expiry_task.cancel()
         if self._server:
-            self._server.close()
-            await self._server.wait_closed()
+            await self._server.stop()
 
     @property
     def url(self) -> str:

@@ -1,0 +1,27 @@
+"""Python SDK: compose inference graphs from decorated service classes.
+
+Capability parity with the reference's `deploy/dynamo/sdk` (@service,
+@dynamo_endpoint, depends(), `dynamo serve`, dynamo_context — SURVEY.md §2.8)
+minus the BentoML packaging layer: services are plain Python classes; `serve`
+spawns one process per service over the self-hosted distributed runtime.
+"""
+
+from dynamo_tpu.sdk.service import (
+    DynamoService,
+    depends,
+    dynamo_context,
+    dynamo_endpoint,
+    async_on_start,
+    service,
+)
+from dynamo_tpu.sdk.config import ServiceConfig
+
+__all__ = [
+    "DynamoService",
+    "depends",
+    "dynamo_context",
+    "dynamo_endpoint",
+    "async_on_start",
+    "service",
+    "ServiceConfig",
+]

@@ -1,0 +1,133 @@
+"""`dynamo serve` equivalent: launch a whole graph under one supervisor.
+
+    python -m dynamo_tpu.sdk.cli serve graphs.agg:Frontend -f config.yaml
+
+Starts (unless --no-infra) an in-tree statestore + message bus, then one
+subprocess per service in the graph's dependency closure (× its configured
+worker count), restarts crashed services with backoff, and tears everything
+down on Ctrl-C. Reference parity: `dynamo serve` + circus arbiter + allocator
+(cli/{serve,serving,allocator}.py, SURVEY.md §2.8) — supervised subprocesses
+instead of circus, TPU visibility via per-service env.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import signal
+import sys
+from typing import Dict, List, Optional
+
+from dynamo_tpu.sdk.config import ServiceConfig
+from dynamo_tpu.sdk.serve_service import resolve_graph
+
+logger = logging.getLogger("dynamo.serve")
+
+
+class Supervisor:
+    def __init__(self, restart_backoff: float = 1.0, max_backoff: float = 30.0):
+        self.procs: Dict[str, asyncio.subprocess.Process] = {}
+        self.restart_backoff = restart_backoff
+        self.max_backoff = max_backoff
+        self._tasks: List[asyncio.Task] = []
+        self._shutdown = False
+
+    async def run_service(self, tag: str, argv: List[str], env: dict) -> None:
+        backoff = self.restart_backoff
+        while not self._shutdown:
+            logger.info("[%s] starting: %s", tag, " ".join(argv))
+            proc = await asyncio.create_subprocess_exec(*argv, env=env)
+            self.procs[tag] = proc
+            rc = await proc.wait()
+            if self._shutdown:
+                return
+            logger.warning("[%s] exited rc=%s; restarting in %.1fs", tag, rc, backoff)
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, self.max_backoff)
+
+    def add(self, tag: str, argv: List[str], env: dict) -> None:
+        self._tasks.append(asyncio.create_task(self.run_service(tag, argv, env)))
+
+    async def shutdown(self) -> None:
+        self._shutdown = True
+        for p in self.procs.values():
+            if p.returncode is None:
+                p.terminate()
+        await asyncio.sleep(1.0)
+        for p in self.procs.values():
+            if p.returncode is None:
+                p.kill()
+        for t in self._tasks:
+            t.cancel()
+
+
+async def serve_cmd(args) -> None:
+    graph = resolve_graph(args.graph)
+    cfg = ServiceConfig.load(args.config_file) if args.config_file else ServiceConfig.load()
+    ServiceConfig.set_instance(cfg)
+
+    statestore = args.statestore
+    bus = args.bus
+    infra_tasks = []
+    if not args.no_infra:
+        from dynamo_tpu.runtime.bus import MessageBusServer
+        from dynamo_tpu.runtime.statestore import StateStoreServer
+
+        ss_server = StateStoreServer(host="127.0.0.1", port=args.statestore_port)
+        bus_server = MessageBusServer(host="127.0.0.1", port=args.bus_port)
+        await ss_server.start()
+        await bus_server.start()
+        statestore = ss_server.url
+        bus = bus_server.url
+        logger.info("infra: statestore %s, bus %s", statestore, bus)
+
+    sup = Supervisor()
+    base_env = dict(os.environ)
+    base_env["DYNAMO_SERVICE_CONFIG"] = cfg.serialized()
+
+    services = [s for s in graph.dependency_closure() if s.config.enabled]
+    logger.info("graph %s: services %s", args.graph, [s.name for s in services])
+    for svc in services:
+        workers = cfg.service_workers(svc.name)
+        svc_cfg = cfg.for_service(svc.name)
+        env_overrides = (svc_cfg.get("ServiceArgs", {}) or {}).get("env", {})
+        for w in range(workers):
+            env = dict(base_env)
+            env.update({k: str(v) for k, v in env_overrides.items()})
+            argv = [
+                sys.executable, "-m", "dynamo_tpu.sdk.serve_service",
+                args.graph, "--service-name", svc.name,
+                "--statestore", statestore, "--bus", bus,
+            ]
+            sup.add(f"{svc.name}/{w}", argv, env)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    logger.info("shutting down graph")
+    await sup.shutdown()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(prog="dynamo")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sp = sub.add_parser("serve", help="launch a service graph")
+    sp.add_argument("graph", help="module:GraphService")
+    sp.add_argument("-f", "--config-file", default=None)
+    sp.add_argument("--statestore", default=None)
+    sp.add_argument("--bus", default=None)
+    sp.add_argument("--statestore-port", type=int, default=0)
+    sp.add_argument("--bus-port", type=int, default=0)
+    sp.add_argument("--no-infra", action="store_true",
+                    help="don't start statestore/bus (use --statestore/--bus)")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    asyncio.run(serve_cmd(args))
+
+
+if __name__ == "__main__":
+    main()

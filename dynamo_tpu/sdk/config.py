@@ -1,0 +1,94 @@
+"""Layered service configuration.
+
+YAML file (`-f`) + env `DYNAMO_SERVICE_CONFIG` (JSON/YAML string) merge into a
+singleton; per-service sections configure constructor kwargs and worker
+counts, and a `Common:` block supplies shared values that services opt into
+with `common-configs: [key, ...]`. Reference parity: ServiceConfig
+(deploy/dynamo/sdk/lib/config.py:23-105).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+class ServiceConfig:
+    _instance: Optional["ServiceConfig"] = None
+
+    def __init__(self, data: Optional[Dict[str, Any]] = None):
+        self.data: Dict[str, Any] = data or {}
+
+    @classmethod
+    def get_instance(cls) -> "ServiceConfig":
+        if cls._instance is None:
+            cls._instance = cls.load()
+        return cls._instance
+
+    @classmethod
+    def set_instance(cls, cfg: "ServiceConfig") -> None:
+        cls._instance = cfg
+
+    @classmethod
+    def load(cls, path: Optional[str] = None) -> "ServiceConfig":
+        data: Dict[str, Any] = {}
+        if path:
+            data = _read_config_file(path)
+        env = os.environ.get("DYNAMO_SERVICE_CONFIG")
+        if env:
+            data = _deep_merge(data, _parse_config_str(env))
+        return cls(data)
+
+    def for_service(self, name: str) -> Dict[str, Any]:
+        cfg = dict(self.data.get(name, {}))
+        common = self.data.get("Common", {})
+        for key in cfg.pop("common-configs", []):
+            if key in common and key not in cfg:
+                cfg[key] = common[key]
+        return cfg
+
+    def service_args(self, name: str) -> Dict[str, Any]:
+        """Constructor kwargs for a service (minus orchestration keys)."""
+        cfg = self.for_service(name)
+        cfg.pop("ServiceArgs", None)
+        return cfg
+
+    def service_workers(self, name: str) -> int:
+        sa = self.for_service(name).get("ServiceArgs", {})
+        return int(sa.get("workers", 1))
+
+    def serialized(self) -> str:
+        return json.dumps(self.data)
+
+
+def _read_config_file(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        raw = f.read()
+    return _parse_config_str(raw)
+
+
+def _parse_config_str(raw: str) -> Dict[str, Any]:
+    raw = raw.strip()
+    if not raw:
+        return {}
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:
+        pass
+    try:
+        import yaml
+
+        return yaml.safe_load(raw) or {}
+    except ImportError:
+        raise RuntimeError("config is not JSON and pyyaml is unavailable")
+
+
+def _deep_merge(base: Dict, override: Dict) -> Dict:
+    out = dict(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out

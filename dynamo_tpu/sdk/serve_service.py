@@ -1,0 +1,140 @@
+"""Per-process service entrypoint: run ONE service of a graph.
+
+`python -m dynamo_tpu.sdk.serve_service graphs.agg:Frontend --service-name Middle`
+instantiates the named service from the graph module, wires its endpoints
+onto the distributed runtime, resolves depends() to remote handles, runs
+@async_on_start hooks, and serves until killed.
+
+Reference parity: cli/serve_dynamo.py:38-200.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import importlib
+import logging
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime.annotated import Annotated
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.sdk.config import ServiceConfig
+from dynamo_tpu.sdk.service import DynamoService, RemoteHandle, dynamo_context
+
+logger = logging.getLogger(__name__)
+
+
+class MethodEngine(AsyncEngine):
+    """Adapts a bound async-generator endpoint method to the engine interface."""
+
+    def __init__(self, bound_method):
+        self._fn = bound_method
+
+    async def generate(self, request: Context) -> AsyncIterator[Annotated]:
+        async for item in self._fn(request.data):
+            if isinstance(item, Annotated):
+                yield item
+            else:
+                yield Annotated.from_data(item, id=request.id)
+
+
+def resolve_graph(spec: str) -> DynamoService:
+    """'pkg.module:ServiceName' → the DynamoService object."""
+    module_name, _, attr = spec.partition(":")
+    module = importlib.import_module(module_name)
+    svc = getattr(module, attr)
+    if not isinstance(svc, DynamoService):
+        raise TypeError(f"{spec} is not a @service-decorated class")
+    return svc
+
+
+async def serve_one(
+    graph: DynamoService,
+    service_name: str,
+    statestore_url: str | None = None,
+    bus_url: str | None = None,
+    ready_event: asyncio.Event | None = None,
+) -> None:
+    services = {s.name: s for s in graph.dependency_closure()}
+    svc = services[service_name]
+
+    drt = await DistributedRuntime.create(statestore_url, bus_url)
+    cfg = ServiceConfig.get_instance()
+    kwargs = cfg.service_args(svc.name)
+    instance = svc(**kwargs) if _accepts_kwargs(svc.cls, kwargs) else svc()
+
+    component = drt.namespace(svc.namespace).component(svc.name)
+    await component.create_service()
+
+    dynamo_context.update(
+        runtime=drt, component=component, service=svc, endpoints=[], instance=instance
+    )
+
+    # resolve depends() to remote handles BEFORE serving (so startup hooks can
+    # call dependencies)
+    for attr, dep in svc.dependencies.items():
+        target = dep.on
+        clients = {}
+        for ep in target.endpoints:
+            endpoint = (
+                drt.namespace(target.namespace).component(target.name).endpoint(ep.name)
+            )
+            clients[ep.name] = await endpoint.client("round_robin")
+        handle = RemoteHandle(clients)
+        dep.resolve(handle)
+        setattr(instance, attr, handle)
+
+    for ep in svc.endpoints:
+        endpoint = component.endpoint(ep.name)
+        engine = MethodEngine(getattr(instance, ep.method_name))
+        info = await endpoint.serve(engine)
+        dynamo_context["endpoints"].append(endpoint)
+        logger.info("serving %s at %s", endpoint.path, info.address)
+
+    for hook in svc.startup_hooks:
+        await getattr(instance, hook)()
+
+    if ready_event is not None:
+        ready_event.set()
+    try:
+        await drt.wait_closed()
+    finally:
+        # on cancellation (supervisor stop / test teardown) release network
+        # resources so servers can close cleanly
+        await drt.shutdown()
+
+
+def _accepts_kwargs(cls: type, kwargs: dict) -> bool:
+    if not kwargs:
+        return False
+    import inspect
+
+    sig = inspect.signature(cls.__init__)
+    return len(sig.parameters) > 1 or any(
+        p.kind == inspect.Parameter.VAR_KEYWORD for p in sig.parameters.values()
+    )
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("graph", help="module:GraphService")
+    p.add_argument("--service-name", required=True)
+    p.add_argument("--statestore", default=None)
+    p.add_argument("--bus", default=None)
+    p.add_argument("-f", "--config-file", default=None)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    if args.config_file:
+        ServiceConfig.set_instance(ServiceConfig.load(args.config_file))
+
+    graph = resolve_graph(args.graph)
+    try:
+        asyncio.run(serve_one(graph, args.service_name, args.statestore, args.bus))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

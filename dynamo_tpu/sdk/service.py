@@ -1,0 +1,190 @@
+"""Service decorators and the dependency graph.
+
+    @service(namespace="demo")
+    class Middle:
+        @dynamo_endpoint()
+        async def generate(self, request):
+            yield transform(request)
+
+    @service(namespace="demo")
+    class Frontend:
+        middle = depends(Middle)
+
+        @async_on_start
+        async def init(self): ...
+
+        @dynamo_endpoint()
+        async def generate(self, request):
+            async for item in self.middle.generate(request):
+                yield item
+
+Reference parity: @service/DynamoService/.link/depends/@dynamo_endpoint/
+dynamo_context (deploy/dynamo/sdk/lib/{service,dependency,decorators}.py)
+with the BentoML layer replaced by plain classes over the native runtime.
+"""
+
+from __future__ import annotations
+
+import inspect
+import logging
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Type
+
+logger = logging.getLogger(__name__)
+
+# populated by serve_service at startup (reference: dynamo_context,
+# cli/serve_dynamo.py:100-200)
+dynamo_context: Dict[str, Any] = {}
+
+
+@dataclass
+class DynamoConfig:
+    name: str
+    namespace: str = "dynamo"
+    enabled: bool = True
+
+
+@dataclass
+class EndpointSpec:
+    name: str
+    method_name: str
+
+
+class Dependency:
+    """Declared with depends(OtherService) at class scope; resolved at serve
+    time to a remote client handle exposing the dependency's endpoints as
+    async-generator methods."""
+
+    def __init__(self, on: "DynamoService"):
+        self.on = on
+        self._handle: Optional[Any] = None
+
+    def resolve(self, handle: Any) -> None:
+        self._handle = handle
+
+    def __getattr__(self, name: str):
+        if self._handle is None:
+            raise RuntimeError(
+                f"dependency on {self.on.name} not resolved (not serving?)"
+            )
+        return getattr(self._handle, name)
+
+
+class RemoteHandle:
+    """Client-side view of a service: one method per endpoint, returning an
+    async iterator of response payloads."""
+
+    def __init__(self, clients: Dict[str, Any]):
+        self._clients = clients
+
+    def __getattr__(self, endpoint: str):
+        client = self._clients.get(endpoint)
+        if client is None:
+            raise AttributeError(f"no endpoint {endpoint!r} on this service")
+
+        async def call(request) -> AsyncIterator[Any]:
+            from dynamo_tpu.runtime.annotated import Annotated
+            from dynamo_tpu.runtime.engine import Context
+
+            ctx = request if hasattr(request, "context") else Context(request)
+            async for item in client.generate(ctx):
+                if isinstance(item, Annotated):
+                    if item.is_error:
+                        raise RuntimeError(item.error_message())
+                    if item.data is None:
+                        continue
+                    yield item.data
+                else:
+                    yield item
+
+        return call
+
+
+class DynamoService:
+    """Wraps a user class into a deployable service definition."""
+
+    def __init__(self, cls: type, config: DynamoConfig):
+        self.cls = cls
+        self.config = config
+        self.endpoints: List[EndpointSpec] = [
+            EndpointSpec(m._dynamo_endpoint_name, name)
+            for name, m in inspect.getmembers(cls, inspect.isfunction)
+            if hasattr(m, "_dynamo_endpoint_name")
+        ]
+        self.startup_hooks: List[str] = [
+            name
+            for name, m in inspect.getmembers(cls, inspect.isfunction)
+            if getattr(m, "_dynamo_on_start", False)
+        ]
+        self.dependencies: Dict[str, Dependency] = {
+            name: dep for name, dep in vars(cls).items() if isinstance(dep, Dependency)
+        }
+        self._links: List["DynamoService"] = []
+
+    @property
+    def name(self) -> str:
+        return self.config.name
+
+    @property
+    def namespace(self) -> str:
+        return self.config.namespace
+
+    def link(self, other: "DynamoService") -> "DynamoService":
+        """Add an explicit graph edge (reference .link / RuntimeLinkedServices)."""
+        self._links.append(other)
+        return self
+
+    def dependency_closure(self) -> List["DynamoService"]:
+        """All services reachable via depends() and .link(), dependencies first."""
+        seen: Dict[str, DynamoService] = {}
+
+        def visit(svc: DynamoService):
+            for dep in list(svc.dependencies.values()):
+                visit(dep.on)
+            for linked in svc._links:
+                visit(linked)
+            if svc.name not in seen:
+                seen[svc.name] = svc
+
+        visit(self)
+        return list(seen.values())
+
+    def __call__(self, *args, **kwargs):
+        return self.cls(*args, **kwargs)
+
+
+def service(
+    name: Optional[str] = None,
+    namespace: str = "dynamo",
+    enabled: bool = True,
+    **_ignored,
+) -> Callable[[type], DynamoService]:
+    """Class decorator declaring a deployable service."""
+
+    def wrap(cls: type) -> DynamoService:
+        cfg = DynamoConfig(name=name or cls.__name__, namespace=namespace, enabled=enabled)
+        return DynamoService(cls, cfg)
+
+    return wrap
+
+
+def dynamo_endpoint(name: Optional[str] = None) -> Callable:
+    """Marks an async-generator method as a served endpoint."""
+
+    def wrap(fn):
+        fn._dynamo_endpoint_name = name or fn.__name__
+        return fn
+
+    return wrap
+
+
+def async_on_start(fn):
+    """Marks an async method to run once after the runtime is wired up."""
+    fn._dynamo_on_start = True
+    return fn
+
+
+def depends(svc: DynamoService) -> Dependency:
+    if not isinstance(svc, DynamoService):
+        raise TypeError("depends() takes a @service-decorated class")
+    return Dependency(svc)

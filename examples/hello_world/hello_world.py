@@ -1,0 +1,41 @@
+"""Minimal SDK pipeline: Frontend → Middle → Backend text transform.
+
+Run:  python -m dynamo_tpu.sdk.cli serve examples.hello_world.hello_world:Frontend
+Then call the Frontend's `generate` endpoint (dyn://hello.Frontend.generate)
+or import and drive it in-process (see tests/test_sdk.py).
+
+Reference parity: examples/hello_world/hello_world.py:40-100.
+"""
+
+from dynamo_tpu.sdk import depends, dynamo_endpoint, service
+
+
+@service(namespace="hello")
+class Backend:
+    @dynamo_endpoint()
+    async def generate(self, req_text: str):
+        text = f"{req_text}-back"
+        for token in text.split("-"):
+            yield f"Backend: {token}"
+
+
+@service(namespace="hello")
+class Middle:
+    backend = depends(Backend)
+
+    @dynamo_endpoint()
+    async def generate(self, req_text: str):
+        text = f"{req_text}-mid"
+        async for response in self.backend.generate(text):
+            yield f"Middle: {response}"
+
+
+@service(namespace="hello")
+class Frontend:
+    middle = depends(Middle)
+
+    @dynamo_endpoint()
+    async def generate(self, req_text: str):
+        text = f"{req_text}-front"
+        async for response in self.middle.generate(text):
+            yield f"Frontend: {response}"

@@ -1,0 +1,238 @@
+"""Disaggregated prefill/decode: full in-process round trip on the CPU backend.
+
+The decisive assertion: a request served disaggregated (prefill on a separate
+engine, KV pages shipped over the transfer plane) produces EXACTLY the same
+greedy tokens as the same request served locally.
+"""
+
+import asyncio
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dynamo_tpu.disagg.prefill_worker import PrefillEngine, run_prefill_worker
+from dynamo_tpu.disagg.protocols import DisaggConfig, RemotePrefillRequest
+from dynamo_tpu.disagg.router import DisaggPolicy
+from dynamo_tpu.disagg.serving import enable_disagg_decode
+from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+from dynamo_tpu.runtime.bus import MessageBusServer
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.statestore import StateStoreServer
+
+CFG = dataclasses.replace(LLAMA_PRESETS["tiny"], dtype=jnp.float32)
+BLOCK = 8
+ENGINE_CFG = EngineConfig(
+    max_slots=2, kv_block_size=BLOCK, max_model_len=128, min_prefill_bucket=16
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+async def collect(engine, prompt, max_tokens=6, **sampling):
+    req = PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling_options=SamplingOptions(**sampling),
+    )
+    toks = []
+    async for item in engine.generate(Context(req)):
+        if item.is_error:
+            raise AssertionError(item.error_message())
+        toks.extend((item.data or {}).get("token_ids", []))
+    return toks
+
+
+def test_prefill_engine_pages_match_serving_engine(params):
+    """Pages computed by the prefill-only engine equal the decode engine's own."""
+    import numpy as np
+
+    decode = JaxServingEngine(CFG, params, ENGINE_CFG, cache_dtype=jnp.float32)
+    pre = PrefillEngine(CFG, params, max_model_len=128, block_size=BLOCK)
+    prompt = list(range(1, 21))  # 20 tokens → 3 blocks
+
+    tok, k, v = pre.prefill(prompt, cached_tokens=0, sampling={})
+    assert k.shape[1] == 3
+
+    # run the same prompt locally on the decode engine and compare its pages
+    async def local():
+        return await collect(decode, prompt, max_tokens=1)
+
+    toks = asyncio.run(local())
+    assert toks[0] == tok  # same greedy first token
+    decode.close()
+
+
+def test_disagg_round_trip_matches_local(params, run):
+    """decode engine + bus queue + prefill worker + transfer server,
+    token-for-token parity with local serving."""
+
+    async def go():
+        ss = StateStoreServer(port=0)
+        bus = MessageBusServer(port=0)
+        await ss.start()
+        await bus.start()
+        rt = await DistributedRuntime.create(ss.url, bus.url)
+
+        # local-only engine for the golden output
+        local_engine = JaxServingEngine(CFG, params, ENGINE_CFG, cache_dtype=jnp.float32)
+        prompt = list(range(3, 43))  # 40 tokens, > threshold below
+        golden = await collect(local_engine, prompt, max_tokens=5)
+        local_engine.close()
+
+        # decode engine with disagg enabled (everything remote: threshold 8)
+        decode = JaxServingEngine(CFG, params, ENGINE_CFG, cache_dtype=jnp.float32)
+        ep = rt.namespace("dz").component("decode").endpoint("gen")
+        await enable_disagg_decode(
+            ep, decode, "dec-1",
+            config=DisaggConfig(max_local_prefill_length=8, max_prefill_queue_size=10),
+        )
+
+        # prefill worker on its own engine instance
+        pre_engine = PrefillEngine(CFG, params, max_model_len=128, block_size=BLOCK)
+        worker_task = asyncio.create_task(run_prefill_worker(rt, "dz", pre_engine))
+
+        try:
+            toks = await asyncio.wait_for(collect(decode, prompt, max_tokens=5), 60)
+            assert toks == golden, f"disagg {toks} != local {golden}"
+            # the request really went remote
+            m = decode.metrics_snapshot()
+            assert decode.total_requests == 1
+        finally:
+            worker_task.cancel()
+            decode.close()
+            await rt.shutdown()
+            await bus.stop()
+            await ss.stop()
+
+    run(go())
+
+
+def test_disagg_second_request_uses_prefix_cache(params, run):
+    """A repeat prompt hits the decode-side prefix cache; the uncached
+    remainder is below threshold so it prefills locally — and the output
+    still matches."""
+
+    async def go():
+        ss = StateStoreServer(port=0)
+        bus = MessageBusServer(port=0)
+        await ss.start()
+        await bus.start()
+        rt = await DistributedRuntime.create(ss.url, bus.url)
+
+        decode = JaxServingEngine(CFG, params, ENGINE_CFG, cache_dtype=jnp.float32)
+        ep = rt.namespace("dz3").component("decode").endpoint("gen")
+        await enable_disagg_decode(
+            ep, decode, "dec-1",
+            config=DisaggConfig(max_local_prefill_length=16, max_prefill_queue_size=10),
+        )
+        pre_engine = PrefillEngine(CFG, params, max_model_len=128, block_size=BLOCK)
+        worker_task = asyncio.create_task(run_prefill_worker(rt, "dz3", pre_engine))
+        try:
+            prompt = list(range(7, 47))  # 40 tokens: remote
+            t1 = await asyncio.wait_for(collect(decode, prompt, max_tokens=4), 60)
+            hit_before = decode.allocator.hit_tokens
+            t2 = await asyncio.wait_for(collect(decode, prompt, max_tokens=4), 60)
+            assert t1 == t2
+            assert decode.allocator.hit_tokens > hit_before  # prefix cache used
+        finally:
+            worker_task.cancel()
+            decode.close()
+            await rt.shutdown()
+            await bus.stop()
+            await ss.stop()
+
+    run(go())
+
+
+def test_short_prompts_stay_local(params, run):
+    """Prompts under the threshold never touch the queue."""
+
+    async def go():
+        ss = StateStoreServer(port=0)
+        bus = MessageBusServer(port=0)
+        await ss.start()
+        await bus.start()
+        rt = await DistributedRuntime.create(ss.url, bus.url)
+        decode = JaxServingEngine(CFG, params, ENGINE_CFG, cache_dtype=jnp.float32)
+        ep = rt.namespace("dz2").component("decode").endpoint("gen")
+        await enable_disagg_decode(
+            ep, decode, "dec-1",
+            config=DisaggConfig(max_local_prefill_length=1000),
+        )
+        toks = await asyncio.wait_for(collect(decode, [5, 6, 7, 8], max_tokens=3), 60)
+        assert len(toks) == 3
+        assert await rt.bus.queue_len("dz2.prefill_queue") == 0
+        decode.close()
+        await rt.shutdown()
+        await bus.stop()
+        await ss.stop()
+
+    run(go())
+
+
+def test_remote_prefill_failure_falls_back_local(params, run):
+    """A failed/unreachable remote prefill must not hang the client: the
+    engine falls back to local prefill and still produces the right tokens."""
+
+    async def go():
+        engine = JaxServingEngine(CFG, params, ENGINE_CFG, cache_dtype=jnp.float32)
+        submitted = []
+
+        class BrokenPolicy:
+            def should_remote(self, n):
+                return n > 8
+
+            def submit(self, request_id, **kw):
+                submitted.append(request_id)
+                # simulate the transfer plane reporting failure
+                engine.fail_remote_prefill(request_id, "simulated outage")
+
+        engine.set_remote_prefill_policy(BrokenPolicy())
+        prompt = list(range(11, 51))
+        golden_engine = JaxServingEngine(CFG, params, ENGINE_CFG, cache_dtype=jnp.float32)
+        golden = await collect(golden_engine, prompt, max_tokens=4)
+        golden_engine.close()
+        try:
+            toks = await asyncio.wait_for(collect(engine, prompt, max_tokens=4), 60)
+            assert submitted, "request should have been dispatched remotely"
+            assert toks == golden
+        finally:
+            engine.close()
+
+    run(go())
+
+
+def test_queue_backpressure_falls_back_local():
+    policy = DisaggPolicy(
+        "e1", DisaggConfig(max_local_prefill_length=10, max_prefill_queue_size=2),
+        enqueue=lambda r: None, queue_len=lambda: 5,
+    )
+    assert not policy.should_remote(100)  # queue full → local
+    policy2 = DisaggPolicy(
+        "e1", DisaggConfig(max_local_prefill_length=10, max_prefill_queue_size=2),
+        enqueue=lambda r: None, queue_len=lambda: 0,
+    )
+    assert policy2.should_remote(100)
+    assert not policy2.should_remote(5)  # short → local
+
+
+def test_remote_prefill_request_roundtrip():
+    req = RemotePrefillRequest(
+        request_id="r1", engine_id="e1", token_ids=[1, 2, 3],
+        block_ids=[4, 5], cached_tokens=8, sampling={"temperature": 0.5},
+    )
+    again = RemotePrefillRequest.from_dict(json.loads(json.dumps(req.to_dict())))
+    assert again == req

@@ -1,0 +1,136 @@
+"""SDK: service decorators, config layering, dependency resolution, and a
+full in-process three-service graph (the hello_world example)."""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.runtime.bus import MessageBusServer
+from dynamo_tpu.runtime.statestore import StateStoreServer
+from dynamo_tpu.sdk import ServiceConfig, depends, dynamo_endpoint, service
+from dynamo_tpu.sdk.serve_service import resolve_graph, serve_one
+from dynamo_tpu.sdk.service import DynamoService
+
+
+class TestDecorators:
+    def test_service_wraps_class(self):
+        @service(namespace="t")
+        class Svc:
+            @dynamo_endpoint()
+            async def gen(self, x):
+                yield x
+
+        assert isinstance(Svc, DynamoService)
+        assert Svc.name == "Svc"
+        assert [e.name for e in Svc.endpoints] == ["gen"]
+
+    def test_dependency_closure_order(self):
+        @service(namespace="t")
+        class A:
+            @dynamo_endpoint()
+            async def gen(self, x):
+                yield x
+
+        @service(namespace="t")
+        class B:
+            a = depends(A)
+
+            @dynamo_endpoint()
+            async def gen(self, x):
+                yield x
+
+        @service(namespace="t")
+        class C:
+            b = depends(B)
+
+            @dynamo_endpoint()
+            async def gen(self, x):
+                yield x
+
+        names = [s.name for s in C.dependency_closure()]
+        assert names == ["A", "B", "C"]  # dependencies first
+
+    def test_depends_type_error(self):
+        with pytest.raises(TypeError):
+            depends(object())
+
+
+class TestServiceConfig:
+    def test_yaml_and_common_merge(self, tmp_path):
+        cfg_file = tmp_path / "c.yaml"
+        cfg_file.write_text(
+            "Common:\n  model: llama\n  block-size: 16\n"
+            "Worker:\n  common-configs: [model]\n  extra: 1\n"
+            "  ServiceArgs:\n    workers: 2\n"
+        )
+        cfg = ServiceConfig.load(str(cfg_file))
+        svc = cfg.for_service("Worker")
+        assert svc["model"] == "llama"
+        assert "block-size" not in svc
+        assert cfg.service_workers("Worker") == 2
+        assert cfg.service_args("Worker") == {"model": "llama", "extra": 1}
+
+    def test_env_override(self, tmp_path, monkeypatch):
+        cfg_file = tmp_path / "c.yaml"
+        cfg_file.write_text("W:\n  a: 1\n")
+        monkeypatch.setenv("DYNAMO_SERVICE_CONFIG", json.dumps({"W": {"a": 2, "b": 3}}))
+        cfg = ServiceConfig.load(str(cfg_file))
+        assert cfg.for_service("W") == {"a": 2, "b": 3}
+
+
+class TestHelloWorldGraph:
+    def test_graph_resolves(self):
+        graph = resolve_graph("examples.hello_world.hello_world:Frontend")
+        assert [s.name for s in graph.dependency_closure()] == [
+            "Backend", "Middle", "Frontend",
+        ]
+
+    def test_three_service_pipeline(self, run):
+        """All three services in one process, chained over the real runtime."""
+
+        async def go():
+            ss = StateStoreServer(port=0)
+            bus = MessageBusServer(port=0)
+            await ss.start()
+            await bus.start()
+            graph = resolve_graph("examples.hello_world.hello_world:Frontend")
+
+            tasks = []
+            for svc in graph.dependency_closure():
+                ready = asyncio.Event()
+                tasks.append(
+                    asyncio.create_task(
+                        serve_one(graph, svc.name, ss.url, bus.url, ready_event=ready)
+                    )
+                )
+                await asyncio.wait_for(ready.wait(), 15)
+
+            # call the Frontend endpoint like a client would
+            from dynamo_tpu.runtime.distributed import DistributedRuntime
+            from dynamo_tpu.runtime.engine import Context
+
+            fe_rt = await DistributedRuntime.create(ss.url, bus.url)
+            client = await (
+                fe_rt.namespace("hello").component("Frontend").endpoint("generate")
+                .client("round_robin")
+            )
+            await client.wait_for_instances(1, timeout=10)
+            out = [
+                i.data async for i in client.generate(Context("hi"))
+                if i.data is not None
+            ]
+            assert out == [
+                "Frontend: Middle: Backend: hi",
+                "Frontend: Middle: Backend: front",
+                "Frontend: Middle: Backend: mid",
+                "Frontend: Middle: Backend: back",
+            ]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            await fe_rt.shutdown()
+            await bus.stop()
+            await ss.stop()
+
+        run(go())
