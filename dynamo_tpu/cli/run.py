@@ -188,7 +188,19 @@ async def build_remote_client(out_spec: str, flags: argparse.Namespace):
     drt = await DistributedRuntime.create(
         statestore_url=flags.statestore, bus_url=flags.bus
     )
-    client = await drt.namespace(ns).component(comp).endpoint(ep).client(flags.router_mode)
+    # KV-aware routing needs token ids at the frontend; raw OpenAI dicts don't
+    # carry them, so (given a tokenizer) render+tokenize just for routing —
+    # the reference tokenizes frontend-side before its KV router (SURVEY §3.4)
+    route_token_fn = None
+    if flags.router_mode == "kv" and flags.model_path:
+        card = ModelDeploymentCard.from_local_path(flags.model_path, flags.model_name)
+        pre = OpenAIPreprocessor(card)
+        route_token_fn = pre.route_token_ids
+    client = await drt.namespace(ns).component(comp).endpoint(ep).client(
+        flags.router_mode,
+        kv_block_size=flags.kv_block_size,
+        route_token_fn=route_token_fn,
+    )
     await client.wait_for_instances(1, timeout=flags.wait_workers_timeout)
     return client, drt
 
@@ -322,8 +334,8 @@ async def run_endpoint(chat_engine, completions_engine, model_name: str, in_spec
     endpoint = component.endpoint(ep)
     info = await endpoint.serve(engine, model_entry={"name": model_name, "kind": "chat"})
     if core_engine is not None and hasattr(core_engine, "metrics_snapshot"):
-        await attach_kv_publishing(endpoint, info.instance_id, core_engine)
-        logger.info("kv events + metrics publishing enabled (worker key %s)", info.instance_id)
+        await attach_kv_publishing(endpoint, core_engine)
+        logger.info("kv events + metrics publishing enabled (worker key %s)", drt.worker_id)
     if flags.disagg == "decode" and core_engine is not None:
         from ..disagg.protocols import DisaggConfig
         from ..disagg.serving import enable_disagg_decode
@@ -333,6 +345,13 @@ async def run_endpoint(chat_engine, completions_engine, model_name: str, in_spec
             config=DisaggConfig(
                 max_local_prefill_length=flags.max_local_prefill_length,
                 max_prefill_queue_size=flags.max_prefill_queue_size,
+            ),
+            # identity = card checksum, NOT the served alias (--model-name):
+            # prefill and decode workers loading the same weights must agree
+            model=(
+                ModelDeploymentCard.from_local_path(flags.model_path).mdcsum or ""
+                if flags.model_path
+                else ""
             ),
         )
     logger.info("worker %s serving %s at %s", info.worker_id, in_spec, info.address)
@@ -355,6 +374,7 @@ async def run_prefill_worker_main(out_spec: str, in_spec: str, flags: argparse.N
         model_config, params,
         max_model_len=flags.max_model_len or min(card.context_length, 4096),
         block_size=flags.kv_block_size,
+        model=card.mdcsum or "",
     )
     drt = await DistributedRuntime.create(
         statestore_url=flags.statestore, bus_url=flags.bus

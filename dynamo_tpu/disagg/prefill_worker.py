@@ -35,7 +35,7 @@ class PrefillEngine:
     """Sequential prefill-only engine with a single-sequence scratch page pool."""
 
     def __init__(self, model_config, params, max_model_len: int = 2048,
-                 block_size: int = 16, min_bucket: int = 16):
+                 block_size: int = 16, min_bucket: int = 16, model: str = ""):
         import jax
 
         from dynamo_tpu.models.llama import make_kv_cache
@@ -43,6 +43,7 @@ class PrefillEngine:
         self.model_config = model_config
         self.params = params
         self.block_size = block_size
+        self.model = model
         self.max_model_len = max_model_len
         self.max_blocks = math.ceil(max_model_len / block_size)
         self.min_bucket = min_bucket
@@ -155,6 +156,16 @@ async def run_prefill_worker(runtime, namespace: str, engine: PrefillEngine) -> 
             addr = raw_addr.decode()
             addr_cache[req.engine_id] = addr
         try:
+            if req.block_size and req.block_size != engine.block_size:
+                raise ValueError(
+                    f"block_size mismatch: decode worker uses {req.block_size}, "
+                    f"this prefill worker uses {engine.block_size}"
+                )
+            if req.model and engine.model and req.model != engine.model:
+                raise ValueError(
+                    f"model mismatch: decode worker serves {req.model!r}, "
+                    f"this prefill worker loaded {engine.model!r}"
+                )
             tok, k, v = await asyncio.to_thread(
                 engine.prefill, req.token_ids, req.cached_tokens, req.sampling
             )

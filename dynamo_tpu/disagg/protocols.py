@@ -20,6 +20,12 @@ class RemotePrefillRequest:
     block_ids: List[int]  # decode-side physical pages for the UNCACHED suffix
     cached_tokens: int  # prefix already present decode-side (skip computing)
     sampling: dict = field(default_factory=dict)
+    # page-geometry / identity guards: a prefill worker configured with a
+    # different block size could produce a matching page COUNT for some prompt
+    # lengths while every page is misshaped — validate up front, not deep in a
+    # jax scatter (round-1 advisor finding)
+    block_size: int = 0  # 0 = unknown (older producers)
+    model: str = ""  # served model identity; "" = unknown
 
     def to_dict(self) -> dict:
         return {
@@ -29,6 +35,8 @@ class RemotePrefillRequest:
             "block_ids": self.block_ids,
             "cached_tokens": self.cached_tokens,
             "sampling": self.sampling,
+            "block_size": self.block_size,
+            "model": self.model,
         }
 
     @classmethod
@@ -40,6 +48,8 @@ class RemotePrefillRequest:
             block_ids=list(d["block_ids"]),
             cached_tokens=int(d.get("cached_tokens", 0)),
             sampling=dict(d.get("sampling", {})),
+            block_size=int(d.get("block_size", 0)),
+            model=str(d.get("model", "")),
         )
 
 

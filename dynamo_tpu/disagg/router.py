@@ -30,6 +30,8 @@ class DisaggPolicy:
         config: DisaggConfig,
         enqueue: Callable[[RemotePrefillRequest], None],
         queue_len: Callable[[], int],
+        block_size: int = 0,
+        model: str = "",
     ):
         """enqueue: thread-safe submit of a RemotePrefillRequest.
         queue_len: cheap read of the (cached) prefill queue depth."""
@@ -37,6 +39,8 @@ class DisaggPolicy:
         self.config = config
         self._enqueue = enqueue
         self._queue_len = queue_len
+        self.block_size = block_size
+        self.model = model
 
     # engine-thread side -------------------------------------------------------
 
@@ -55,6 +59,8 @@ class DisaggPolicy:
             block_ids=list(block_ids),
             cached_tokens=cached_tokens,
             sampling=dict(sampling),
+            block_size=self.block_size,
+            model=self.model,
         )
         self._enqueue(req)
 

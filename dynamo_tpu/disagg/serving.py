@@ -27,7 +27,7 @@ logger = logging.getLogger(__name__)
 
 async def enable_disagg_decode(
     endpoint, engine, instance_id: str, config: DisaggConfig | None = None,
-    queue_poll_interval: float = 0.25,
+    queue_poll_interval: float = 0.25, model: str = "",
 ) -> KvTransferServer:
     ns = endpoint.component.namespace
     rt = ns.runtime
@@ -80,6 +80,8 @@ async def enable_disagg_decode(
         config=config or DisaggConfig(),
         enqueue=enqueue,
         queue_len=lambda: depth[0],
+        block_size=getattr(getattr(engine, "allocator", None), "block_size", 0),
+        model=model,
     )
     engine.set_remote_prefill_policy(policy)
 

@@ -91,9 +91,9 @@ class _Seq:
 
     __slots__ = (
         "ctx", "request", "prompt", "alloc", "slot", "out_queue", "loop",
-        "generated", "max_tokens", "eos_ids", "ignore_eos", "temperature",
-        "top_k", "top_p", "seed", "enqueue_t", "first_token_t", "remote",
-        "remote_deadline",
+        "generated", "emitted", "max_tokens", "eos_ids", "ignore_eos",
+        "temperature", "top_k", "top_p", "seed", "enqueue_t", "first_token_t",
+        "remote", "remote_deadline",
     )
 
     def __init__(self, ctx: Context, request: PreprocessedRequest, loop) -> None:
@@ -105,6 +105,9 @@ class _Seq:
         self.out_queue: asyncio.Queue = asyncio.Queue()
         self.loop = loop
         self.generated: List[int] = []
+        # tokens streamed to the caller — survives preemption (generated is
+        # absorbed into prompt on preempt, so it can't back max_tokens)
+        self.emitted = 0
         sc = request.stop_conditions
         self.max_tokens = sc.max_tokens if sc.max_tokens is not None else 2**30
         self.eos_ids: Set[int] = set(request.eos_token_ids or [])
@@ -191,6 +194,7 @@ class JaxServingEngine(AsyncEngine):
         self.total_requests = 0
         self.total_generated_tokens = 0
         self.total_prompt_tokens = 0
+        self.preemptions = 0
 
         self._decode_fn = self._build_decode_fn()
         self._prefill_fns: Dict[int, Any] = {}  # bucket → compiled fn
@@ -262,11 +266,19 @@ class JaxServingEngine(AsyncEngine):
             self._pending.append(seq)
             self._cond.notify()
 
-        while True:
-            item = await seq.out_queue.get()
-            if item is _FINISHED:
-                return
-            yield item
+        try:
+            while True:
+                item = await seq.out_queue.get()
+                if item is _FINISHED:
+                    return
+                yield item
+        finally:
+            # Consumer closed the stream (stop string hit downstream, client
+            # disconnect, GeneratorExit): make sure the engine stops burning
+            # the slot. No-op after a normal finish.
+            request.context.stop_generating()
+            with self._cond:
+                self._cond.notify()
 
     # -- engine thread -------------------------------------------------------
 
@@ -375,8 +387,9 @@ class JaxServingEngine(AsyncEngine):
                     self._pending.appendleft(seq)  # retry when blocks free up
                 return
             seq.alloc = alloc
-            self.total_requests += 1
-            self.total_prompt_tokens += len(seq.prompt)
+            if seq.emitted == 0:  # don't re-count preempted re-admissions
+                self.total_requests += 1
+                self.total_prompt_tokens += len(seq.prompt)
 
             # conditional disaggregation: long-enough prefills (minus whatever
             # the prefix cache already covers) go to a remote prefill worker
@@ -498,11 +511,12 @@ class JaxServingEngine(AsyncEngine):
 
     def _emit_token(self, seq: _Seq, tok: int) -> None:
         seq.generated.append(tok)
+        seq.emitted += 1
         self.total_generated_tokens += 1
         finish: Optional[FinishReason] = None
         if tok in seq.eos_ids and not seq.ignore_eos:
             finish = FinishReason.EOS
-        elif len(seq.generated) >= seq.max_tokens:
+        elif seq.emitted >= seq.max_tokens:
             finish = FinishReason.LENGTH
         elif seq.total_len >= self.config.max_model_len:
             finish = FinishReason.LENGTH
@@ -524,15 +538,21 @@ class JaxServingEngine(AsyncEngine):
         seq.emit(_FINISHED)
 
     def _preempt(self, seq: _Seq) -> None:
-        """Out of KV blocks mid-decode: recompute-preempt (free pages, requeue
-        with prompt := prompt + generated so far, prefix cache softens the hit)."""
+        """Out of KV blocks mid-decode: recompute-preempt — free pages, requeue
+        with prompt := prompt + generated, prefix cache softens the recompute.
+
+        ``generated`` is cleared so positions/total_len stay consistent after
+        re-admission (it had been double-counted before, writing KV at wrong
+        slots with wrong RoPE); ``seq.emitted`` keeps the caller-visible token
+        count for max_tokens."""
         logger.warning("preempting request %s (out of KV blocks)", seq.ctx.id)
+        self.preemptions += 1
         if seq.slot is not None:
             self._slots[seq.slot] = None
             seq.slot = None
         self.allocator.free_sequence(seq.alloc)
         seq.prompt = seq.prompt + seq.generated
-        # keep generated list (continues streaming after re-admission)
+        seq.generated = []
         seq.alloc = None
         with self._cond:
             self._pending.append(seq)
@@ -601,6 +621,16 @@ class JaxServingEngine(AsyncEngine):
             # inject only the pages the prefill worker computed (suffix after
             # any prefix-cache hit)
             if block_ids:
+                bs = self.config.kv_block_size
+                if k_np.shape[2] != bs:
+                    logger.error(
+                        "remote prefill for %s has block_size %d, engine uses %d"
+                        " — falling back to local prefill",
+                        request_id, k_np.shape[2], bs,
+                    )
+                    self._awaiting[request_id] = seq
+                    self.fail_remote_prefill(request_id, "block_size mismatch")
+                    return
                 self.inject_blocks(block_ids, k_np, v_np)
             self.allocator.note_tokens_computed(seq.alloc, seq.prompt[seq.alloc.cached_tokens:])
             seq.first_token_t = time.perf_counter()
@@ -653,7 +683,14 @@ class JaxServingEngine(AsyncEngine):
     # -- metrics -------------------------------------------------------------
 
     def metrics_snapshot(self) -> Dict[str, Any]:
-        """ForwardPassMetrics-equivalent (reference kv_router/protocols.rs:42-54)."""
+        """ForwardPassMetrics-equivalent (reference kv_router/protocols.rs:42-54).
+
+        Taken under the engine condition lock so slot/allocator counters are
+        mutually consistent (they feed the KV scheduler's cost function)."""
+        with self._cond:
+            return self._metrics_locked()
+
+    def _metrics_locked(self) -> Dict[str, Any]:
         active = sum(1 for s in self._slots if s is not None)
         probe = max(self.allocator.probe_tokens, 1)
         return {

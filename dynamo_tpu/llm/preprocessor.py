@@ -117,6 +117,24 @@ class OpenAIPreprocessor:
         token_ids = self.tokenizer.encode(prompt)
         return self._build(request, prompt, token_ids, request.stop_list())
 
+    def route_token_ids(self, request: dict) -> Optional[list[int]]:
+        """Tokenize a raw OpenAI request dict *for KV routing only* (no stop/
+        sampling lowering): chat messages are chat-template-rendered first so
+        the routing prefix matches what the worker will compute. Reference:
+        the Processor tokenizes frontend-side before the KV router
+        (examples/llm/components/processor.py:100-160)."""
+        msgs = request.get("messages")
+        if msgs and self.formatter is not None:
+            return self.tokenizer.encode(self.formatter.render(msgs))
+        prompt = request.get("prompt")
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            return [int(t) for t in prompt]
+        if isinstance(prompt, list):
+            prompt = "".join(prompt)
+        if isinstance(prompt, str):
+            return self.tokenizer.encode(prompt)
+        return None
+
     def preprocess_completion(self, request: CompletionRequest) -> PreprocessedRequest:
         prompt = request.prompt
         if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
@@ -320,6 +338,12 @@ class DetokenizeOperator(Operator):
                 id=ann_id,
             )
             if finish is not None:
+                if out.finish_reason is None:
+                    # We finished the stream (stop string / max_tokens) before
+                    # the engine did: release its slot now rather than letting
+                    # it decode to its own limit (ref backend.rs stop-jail
+                    # semantics — the engine must observe the stop).
+                    request.context.stop_generating()
                 return
 
 

@@ -32,15 +32,76 @@ logger = logging.getLogger(__name__)
 DEFAULT_PORT = 37902
 
 
+class _Conn:
+    """One client connection's outbound side: a bounded queue drained by a
+    dedicated writer task. Every server→client frame goes through here, which
+    (a) serializes writes (no frame interleaving between concurrent
+    dispatches) and (b) decouples publishers from slow subscribers — a
+    stalled subscriber fills its own outbox and starts dropping instead of
+    blocking whoever published (round-1 weakness W6; same bounded-queue
+    design as statestore.py watches)."""
+
+    __slots__ = ("writer", "outbox", "task", "alive", "dropped")
+
+    def __init__(self, writer: asyncio.StreamWriter, maxsize: int = 512):
+        self.writer = writer
+        self.outbox: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+        self.alive = True
+        self.dropped = 0
+        self.task = asyncio.create_task(self._drain())
+
+    async def _drain(self) -> None:
+        try:
+            while True:
+                msg = await self.outbox.get()
+                await write_frame(self.writer, msg)
+        except (ConnectionError, RuntimeError, OSError, asyncio.CancelledError):
+            self.alive = False
+            # discard queued frames so send_reliable callers blocked on a
+            # full outbox wake up (get_nowait wakes putters) and see alive=False
+            while not self.outbox.empty():
+                self.outbox.get_nowait()
+
+    def send(self, msg: TwoPartMessage) -> bool:
+        """Best-effort enqueue; False = connection dead or outbox full.
+        For droppable pushes (pub/sub events) ONLY — replies and queue-item
+        deliveries must use send_reliable, a dropped reply hangs the caller."""
+        if not self.alive:
+            return False
+        try:
+            self.outbox.put_nowait(msg)
+            return True
+        except asyncio.QueueFull:
+            self.dropped += 1
+            if self.dropped in (1, 100, 10000):
+                logger.warning(
+                    "bus connection outbox full (%d drops): slow consumer",
+                    self.dropped,
+                )
+            return False
+
+    async def send_reliable(self, msg: TwoPartMessage) -> bool:
+        """Guaranteed-order enqueue with backpressure (awaits outbox space);
+        False only if the connection is dead."""
+        if not self.alive:
+            return False
+        await self.outbox.put(msg)
+        return self.alive
+
+    def close(self) -> None:
+        self.alive = False
+        self.task.cancel()
+
+
 class MessageBusServer:
     def __init__(self, host: str = "127.0.0.1", port: int = DEFAULT_PORT):
         self.host = host
         self.port = port
-        # subject → {sub_id → writer}
-        self._subs: Dict[str, Dict[str, asyncio.StreamWriter]] = {}
+        # subject → {sub_id → conn}
+        self._subs: Dict[str, Dict[str, _Conn]] = {}
         self._queues: Dict[str, Deque[bytes]] = {}
-        # queue → waiters (sub_id, writer, req_id)
-        self._queue_waiters: Dict[str, Deque[Tuple[asyncio.StreamWriter, int]]] = {}
+        # queue → waiters (conn, req_id)
+        self._queue_waiters: Dict[str, Deque[Tuple[_Conn, int]]] = {}
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> None:
@@ -59,6 +120,7 @@ class MessageBusServer:
         return f"{self.host}:{self.port}"
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        conn = _Conn(writer)
         conn_subs: List[Tuple[str, str]] = []  # (subject, sub_id)
         try:
             while True:
@@ -67,45 +129,47 @@ class MessageBusServer:
                 except (asyncio.IncompleteReadError, ConnectionError):
                     return
                 req = json.loads(frame.header)
-                reply = await self._dispatch(req, frame.body, writer, conn_subs)
+                reply = await self._dispatch(req, frame.body, conn, conn_subs)
                 if reply is not None:
                     reply["id"] = req.get("id")
-                    await write_frame(writer, TwoPartMessage(json.dumps(reply).encode(), b""))
+                    await conn.send_reliable(
+                        TwoPartMessage(json.dumps(reply).encode(), b"")
+                    )
         finally:
             for subject, sub_id in conn_subs:
                 subs = self._subs.get(subject)
                 if subs:
                     subs.pop(sub_id, None)
             for waiters in self._queue_waiters.values():
-                remaining = deque((w, rid) for w, rid in waiters if w is not writer)
+                remaining = deque((c, rid) for c, rid in waiters if c is not conn)
                 waiters.clear()
                 waiters.extend(remaining)
+            conn.close()
             writer.close()
 
-    async def _dispatch(self, req, body, writer, conn_subs) -> Optional[dict]:
+    async def _dispatch(self, req, body, conn: _Conn, conn_subs) -> Optional[dict]:
         op = req.get("op")
         if op == "pub":
             subject = req["subject"]
             dead = []
-            for sub_id, w in list(self._subs.get(subject, {}).items()):
-                try:
-                    await write_frame(
-                        w,
-                        TwoPartMessage(
-                            json.dumps(
-                                {"push": "msg", "subject": subject, "sub_id": sub_id}
-                            ).encode(),
-                            body,
-                        ),
+            for sub_id, c in list(self._subs.get(subject, {}).items()):
+                delivered = c.send(
+                    TwoPartMessage(
+                        json.dumps(
+                            {"push": "msg", "subject": subject, "sub_id": sub_id}
+                        ).encode(),
+                        body,
                     )
-                except (ConnectionError, RuntimeError):
+                )
+                if not delivered and not c.alive:
                     dead.append(sub_id)
+                # alive-but-full: event dropped for that subscriber only
             for sid in dead:
                 self._subs[subject].pop(sid, None)
             return {"ok": True}
         if op == "sub":
             sub_id = req.get("sub_id") or uuid.uuid4().hex
-            self._subs.setdefault(req["subject"], {})[sub_id] = writer
+            self._subs.setdefault(req["subject"], {})[sub_id] = conn
             conn_subs.append((req["subject"], sub_id))
             return {"ok": True, "sub_id": sub_id}
         if op == "unsub":
@@ -116,18 +180,16 @@ class MessageBusServer:
             queue = req["queue"]
             waiters = self._queue_waiters.get(queue)
             while waiters:  # try every live waiter before enqueueing
-                w, req_id = waiters.popleft()
-                try:
-                    await write_frame(
-                        w,
-                        TwoPartMessage(
-                            json.dumps({"id": req_id, "ok": True, "found": True}).encode(),
-                            body,
-                        ),
+                c, req_id = waiters.popleft()
+                delivered = await c.send_reliable(
+                    TwoPartMessage(
+                        json.dumps({"id": req_id, "ok": True, "found": True}).encode(),
+                        body,
                     )
+                )
+                if delivered:
                     return {"ok": True}
-                except (ConnectionError, RuntimeError):
-                    continue  # waiter died: try the next one
+                # waiter connection died: try the next one
             self._queues.setdefault(queue, deque()).append(body)
             return {"ok": True}
         if op == "qpop":
@@ -135,17 +197,18 @@ class MessageBusServer:
             q = self._queues.get(queue)
             if q:
                 return_body = q.popleft()
-                await write_frame(
-                    writer,
+                sent = await conn.send_reliable(
                     TwoPartMessage(
                         json.dumps({"id": req.get("id"), "ok": True, "found": True}).encode(),
                         return_body,
-                    ),
+                    )
                 )
+                if not sent:  # popper died: don't lose the item
+                    q.appendleft(return_body)
                 return None  # reply already sent (with body)
             if req.get("block"):
                 self._queue_waiters.setdefault(queue, deque()).append(
-                    (writer, req.get("id"))
+                    (conn, req.get("id"))
                 )
                 return None  # reply deferred until a push arrives
             return {"ok": True, "found": False}
@@ -154,8 +217,8 @@ class MessageBusServer:
             waiters = self._queue_waiters.get(req["queue"])
             if waiters:
                 remaining = deque(
-                    (w, rid) for w, rid in waiters
-                    if not (w is writer and rid == req.get("cancel_id"))
+                    (c, rid) for c, rid in waiters
+                    if not (c is conn and rid == req.get("cancel_id"))
                 )
                 waiters.clear()
                 waiters.extend(remaining)

@@ -300,11 +300,23 @@ class EndpointClient(AsyncEngine):
     the namespace event plane; non-token requests fall back to round-robin.
     """
 
-    def __init__(self, endpoint: Endpoint, mode: str = "random", kv_block_size: int = 16):
+    def __init__(
+        self,
+        endpoint: Endpoint,
+        mode: str = "random",
+        kv_block_size: int = 16,
+        route_token_fn: Optional[Callable[[dict], Optional[List[int]]]] = None,
+    ):
         self.endpoint = endpoint
         self.mode = mode
         self.kv_block_size = kv_block_size
+        # kv mode: derives token_ids from requests that don't carry them
+        # (e.g. raw OpenAI dicts at a frontend) so prefix routing still works
+        self.route_token_fn = route_token_fn
         self._instances: Dict[str, InstanceInfo] = {}
+        # stable worker_id → live instance_id: KV events/metrics are keyed by
+        # worker_id (which survives lease loss), instances come and go
+        self._by_worker: Dict[str, str] = {}
         self._conns: Dict[str, RpcClient] = {}
         self._rr = 0
         self._watcher = None
@@ -313,6 +325,7 @@ class EndpointClient(AsyncEngine):
         self._router = None
         self._ready = asyncio.Event()
         self._closed = False
+        self._warned_no_tokens = False
 
     VALID_MODES = ("random", "round_robin", "kv")
 
@@ -342,17 +355,24 @@ class EndpointClient(AsyncEngine):
                 iid = ev.key.rsplit("/", 1)[-1]
                 if ev.type == "put":
                     try:
-                        self._instances[iid] = InstanceInfo.from_json(ev.value)
+                        info = InstanceInfo.from_json(ev.value)
                     except (ValueError, KeyError):
                         continue
+                    self._instances[iid] = info
+                    self._by_worker[info.worker_id] = iid
                     self._ready.set()
                 else:
-                    self._instances.pop(iid, None)
+                    gone = self._instances.pop(iid, None)
                     conn = self._conns.pop(iid, None)
                     if conn is not None:
                         await conn.close()
-                    if self._router is not None:
-                        self._router.remove_worker(iid)
+                    if gone is not None and self._by_worker.get(gone.worker_id) == iid:
+                        del self._by_worker[gone.worker_id]
+                        # only purge the router when the worker has no live
+                        # instance left (a re-registration overwrites the
+                        # mapping before the old instance key is deleted)
+                        if self._router is not None:
+                            self._router.remove_worker(gone.worker_id)
                 if not self._instances:
                     self._ready.clear()
             if self._closed:
@@ -369,8 +389,15 @@ class EndpointClient(AsyncEngine):
                     self._watcher = await rt.store.watch_prefix(
                         self.endpoint.instances_prefix, include_existing=True
                     )
-                    # fresh snapshot replaces stale state as puts stream in
+                    # fresh snapshot replaces stale state as puts stream in.
+                    # Workers that died during the outage never get a delete
+                    # event, so purge the router/worker maps too — live
+                    # workers repopulate from the snapshot + future events.
                     self._instances.clear()
+                    if self._router is not None:
+                        for wid in self._by_worker:
+                            self._router.remove_worker(wid)
+                    self._by_worker.clear()
                     self._ready.clear()
                     backoff = 0.5
                     break
@@ -430,11 +457,26 @@ class EndpointClient(AsyncEngine):
             token_ids = None
             if isinstance(request, dict):
                 token_ids = request.get("token_ids")
+                if not token_ids and self.route_token_fn is not None:
+                    try:
+                        token_ids = self.route_token_fn(request)
+                    except Exception:
+                        logger.warning("route_token_fn failed", exc_info=True)
             if token_ids:
-                # router workers are keyed by instance id (via metrics/events)
+                # router workers are keyed by the stable worker_id; map the
+                # decision back onto that worker's live instance
                 decision = self._router.schedule(token_ids)
-                if decision is not None and decision.worker_id in self._instances:
-                    return decision.worker_id
+                if decision is not None:
+                    iid = self._by_worker.get(decision.worker_id)
+                    if iid in self._instances:
+                        return iid
+            elif not self._warned_no_tokens:
+                self._warned_no_tokens = True
+                logger.warning(
+                    "kv router mode got a request without token_ids and no "
+                    "route_token_fn — falling back to round-robin (pass "
+                    "--model-path to the frontend to enable prefix routing)"
+                )
         # round_robin fallback
         self._rr = (self._rr + 1) % len(ids)
         return ids[self._rr]
@@ -509,16 +551,18 @@ class KvPublishBridge:
 
 
 async def attach_kv_publishing(
-    endpoint: Endpoint, instance_id: str, engine, interval: float = 1.0
+    endpoint: Endpoint, engine, interval: float = 1.0
 ) -> KvPublishBridge:
     """Wire a serving engine's KV events + load metrics onto the event plane.
 
-    Workers are keyed by their *instance id* so the router's choices map
-    directly onto live instances. Reference analogue: KvEventPublisher +
-    KvMetricsPublisher on the worker (SURVEY.md §3.5).
+    Events/metrics are keyed by the runtime's *stable worker_id* — NOT the
+    instance id, which changes when a lost lease forces re-registration;
+    clients map worker_id → live instance via InstanceInfo. Reference
+    analogue: KvEventPublisher + KvMetricsPublisher (SURVEY.md §3.5).
     """
     ns = endpoint.component.namespace
-    bridge = KvPublishBridge(ns, instance_id)
+    worker_id = ns.runtime.worker_id
+    bridge = KvPublishBridge(ns, worker_id)
     if hasattr(engine, "set_event_sink"):
         engine.set_event_sink(bridge)
 
@@ -528,7 +572,7 @@ async def attach_kv_publishing(
             try:
                 snap = engine.metrics_snapshot()
                 await ns.publish(
-                    KV_METRICS_SUBJECT, {"worker_id": instance_id, "metrics": snap}
+                    KV_METRICS_SUBJECT, {"worker_id": worker_id, "metrics": snap}
                 )
             except (ConnectionError, RuntimeError):
                 logger.warning("kv metrics publish failed", exc_info=True)

@@ -12,17 +12,15 @@ import os
 # on the virtual CPU mesh (fast, 8 devices). jax.config.update after import is
 # the only override that sticks. Escape hatch for hardware runs
 # (`pytest -m tpu`): DYN_TPU_TESTS_REAL=1 leaves the platform alone.
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 if os.environ.get("DYN_TPU_TESTS_REAL") != "1":
-    _flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in _flags:
-        os.environ["XLA_FLAGS"] = (
-            _flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    # importing __graft_entry__ is pre-jax safe (it only pulls in os/sys)
+    from __graft_entry__ import _ensure_devices  # noqa: E402
 
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
+    _ensure_devices(8)
 
 import asyncio  # noqa: E402
 

@@ -406,7 +406,7 @@ class TestComponentModel:
                 eng = FakeKvEngine()
                 engines[tag] = eng
                 infos[tag] = await ep.serve(EchoTokens(tag))
-                await attach_kv_publishing(ep, infos[tag].instance_id, eng, interval=0.1)
+                await attach_kv_publishing(ep, eng, interval=0.1)
 
             client = await fe.namespace("kvt").component("worker").endpoint("gen").client(
                 "kv", kv_block_size=4

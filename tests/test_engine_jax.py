@@ -196,3 +196,56 @@ def test_metrics_snapshot(engine, run):
     assert m["kv_total_blocks"] == engine.num_blocks
     assert m["request_active_slots"] == 0
     assert 0.0 <= m["gpu_cache_usage_perc"] <= 1.0
+
+
+def test_preemption_parity(params, run):
+    """Out-of-blocks preemption must recompute-resume with exact greedy parity
+    (round-1 advisor: positions were offset by the pre-preemption generation
+    length, corrupting KV placement and RoPE)."""
+    cfg = EngineConfig(
+        max_slots=2, kv_block_size=8, max_model_len=48, num_kv_blocks=6,
+        min_prefill_bucket=16,
+    )
+    eng = JaxServingEngine(CFG, params, cfg)
+    try:
+        prompts = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8, 2, 8, 1, 8]]
+
+        async def go():
+            return await asyncio.gather(
+                *[collect_tokens(eng, p, max_tokens=18) for p in prompts]
+            )
+
+        results = run(go())
+        assert eng.preemptions > 0, "test must actually exercise preemption"
+        for p, (toks, finish) in zip(prompts, results):
+            assert finish == "length"
+            assert toks == reference_greedy(params, p, 18), f"prompt {p}"
+    finally:
+        eng.close()
+
+
+def test_consumer_break_frees_slot(engine, run):
+    """Closing the response stream early (stop-string downstream, client
+    disconnect) must release the engine slot within a step, not decode to
+    max_tokens (round-1 weakness W4)."""
+
+    async def go():
+        req = PreprocessedRequest(
+            token_ids=[5, 6, 7],
+            stop_conditions=StopConditions(max_tokens=100000, ignore_eos=True),
+        )
+        gen = engine.generate(Context(req))
+        n = 0
+        async for item in gen:
+            n += len(item.data.get("token_ids", []))
+            if n >= 2:
+                break
+        await gen.aclose()
+        for _ in range(100):
+            if engine.metrics_snapshot()["request_active_slots"] == 0:
+                return True
+            await asyncio.sleep(0.05)
+        return False
+
+    assert run(go()), "slot not released after consumer closed the stream"
+    assert engine.total_generated_tokens < 1000

@@ -3,6 +3,8 @@
 Mirrors lib/llm/tests/preprocessor.rs (template goldens) and backend.rs behavior.
 """
 
+import asyncio
+
 import pytest
 
 from dynamo_tpu.llm.engines import EchoEngineCore
@@ -202,3 +204,49 @@ class TestFullPipeline:
         events = [a.event for a in items if a.event]
         assert "formatted_prompt" in events
         assert "token_ids" in events
+
+
+class TestStopPropagation:
+    def test_detok_stop_string_stops_engine(self, card, run):
+        """When the stop-jail fires, DetokenizeOperator must signal
+        stop_generating so the engine frees its slot (round-1 W4); an engine
+        that ignores it would stream forever here."""
+        from dynamo_tpu.llm.preprocessor import DetokenizeOperator
+        from dynamo_tpu.llm.protocols.common import (
+            PreprocessedRequest,
+            StopConditions,
+        )
+        from dynamo_tpu.runtime.engine import AsyncEngine
+
+        pre = OpenAIPreprocessor(card)
+        tok = pre.tokenizer
+        stop_ids = tok.encode("hello STOP")
+        filler = tok.encode(" more")
+
+        class EndlessEngine(AsyncEngine):
+            def __init__(self):
+                self.steps = 0
+
+            async def generate(self, request):
+                i = 0
+                while not request.context.is_stopped:
+                    self.steps += 1
+                    tid = stop_ids[i] if i < len(stop_ids) else filler[0]
+                    i += 1
+                    yield Annotated.from_data({"token_ids": [tid]})
+                    await asyncio.sleep(0)
+
+        inner = EndlessEngine()
+        engine = Pipeline().link(DetokenizeOperator(card, tok)).link_engine(inner)
+        req = PreprocessedRequest(
+            token_ids=tok.encode("x"),
+            stop_conditions=StopConditions(stop=["STOP"], max_tokens=100000),
+        )
+        ctx = Context(req)
+        items = run(collect(engine.generate(ctx)))
+        assert ctx.context.is_stopped
+        assert inner.steps <= len(stop_ids) + 4
+        texts = "".join(i.data.text or "" for i in items if i.data is not None)
+        assert "STOP" not in texts
+        finals = [i.data.finish_reason for i in items if i.data is not None and i.data.finish_reason]
+        assert finals and finals[-1].value == "stop"
