@@ -163,6 +163,9 @@ def build_engine(out_spec: str, flags: argparse.Namespace):
         if flags.extra_engine_args:
             with open(flags.extra_engine_args) as f:
                 extra = json.load(f)
+        from ..engine_jax.compile_cache import enable_compile_cache
+
+        enable_compile_cache()
         core = build_jax_serving_engine(
             card,
             max_batch_size=flags.max_batch_size,
@@ -171,6 +174,7 @@ def build_engine(out_spec: str, flags: argparse.Namespace):
             tensor_parallel_size=flags.tensor_parallel_size,
             **extra,
         )
+        core.warmup()  # compile the step functions off the request path
         chat_eng, comp_eng = _token_pipelines(card, lambda: core)
         return chat_eng, comp_eng, model_name, core
 

@@ -5,8 +5,12 @@ Architecture (TPU-first, cf. SURVEY.md §7 stage 4):
 - **Fixed batch slots**: `max_slots` decode lanes; a request occupies one slot
   from first token to finish. All decode steps run ONE jitted function with
   static shapes — no recompilation, ever.
-- **Bucketed prefill**: prompt suffixes are padded to power-of-two buckets, so
-  at most log2(max_len) prefill variants compile.
+- **Chunked, batched prefill**: every step with a prefilling lane runs ONE
+  compiled `[slots, prefill_chunk]` function in which prefilling lanes consume
+  up to `prefill_chunk` prompt tokens while decode lanes advance one token —
+  prefill never runs batch-1 and never blocks decode for more than a chunk.
+  Prompts longer than a chunk just take several steps (long-context prefill is
+  chunked by construction; no shape depends on prompt length).
 - **Paged KV**: allocator (allocator.py) maps sequences onto a page pool in
   HBM with content-addressed prefix reuse; the model writes-then-attends
   through block tables (models/llama.py), making prefix hits free.
@@ -55,7 +59,10 @@ class EngineConfig:
     kv_block_size: int = 16
     max_model_len: int = 2048
     num_kv_blocks: Optional[int] = None  # default: 1.5× what max_slots need
-    min_prefill_bucket: int = 16
+    # tokens of prompt consumed per prefilling lane per step — the unit of
+    # prefill/decode interleaving (a decode lane is delayed at most one
+    # chunk's compute by any admission wave)
+    prefill_chunk: int = 128
     # decode steps per device dispatch: each dispatch scans this many
     # forward+sample steps in one jitted call, amortizing host↔device latency
     # (critical when dispatch rides a network tunnel). Tokens past a stop
@@ -76,15 +83,6 @@ class EngineConfig:
     def max_blocks_per_seq(self) -> int:
         return math.ceil(self.max_model_len / self.kv_block_size)
 
-    def prefill_buckets(self) -> List[int]:
-        buckets = []
-        b = self.min_prefill_bucket
-        while b < self.max_model_len:
-            buckets.append(b)
-            b *= 2
-        buckets.append(self.max_model_len)
-        return buckets
-
 
 class _Seq:
     """One in-flight request's host-side state."""
@@ -93,7 +91,7 @@ class _Seq:
         "ctx", "request", "prompt", "alloc", "slot", "out_queue", "loop",
         "generated", "emitted", "max_tokens", "eos_ids", "ignore_eos",
         "temperature", "top_k", "top_p", "seed", "enqueue_t", "first_token_t",
-        "remote", "remote_deadline",
+        "remote", "remote_deadline", "prefill_pos",
     )
 
     def __init__(self, ctx: Context, request: PreprocessedRequest, loop) -> None:
@@ -121,6 +119,8 @@ class _Seq:
         self.first_token_t: Optional[float] = None
         self.remote = False  # prefill dispatched to a remote prefill worker
         self.remote_deadline: Optional[float] = None
+        # next prompt position to compute while prefilling; None = decoding
+        self.prefill_pos: Optional[int] = None
 
     @property
     def total_len(self) -> int:
@@ -131,6 +131,26 @@ class _Seq:
 
 
 _FINISHED = object()  # sentinel closing a request's output queue
+
+
+class _Inflight:
+    """A dispatched-but-unprocessed decode chunk (pipelined decode).
+
+    Holds device handles for the chunk's sampled tokens and the final carry
+    (last token + position per lane), plus the lane→sequence snapshot at
+    dispatch time. The engine dispatches chunk N+1 off these handles before
+    fetching chunk N's results, hiding the host↔device round trip behind
+    compute — on a tunneled chip that round trip is ~90 ms, comparable to the
+    whole chunk's compute.
+    """
+
+    __slots__ = ("out", "tokens", "positions", "lanes")
+
+    def __init__(self, out, tokens, positions, lanes):
+        self.out = out  # [S, k_steps] device
+        self.tokens = tokens  # [S] device, final carry
+        self.positions = positions  # [S] device, final carry
+        self.lanes = lanes  # List[Optional[_Seq]] snapshot
 
 
 class JaxServingEngine(AsyncEngine):
@@ -159,8 +179,12 @@ class JaxServingEngine(AsyncEngine):
             dtype=cache_dtype or model_config.dtype,
         )
         if mesh is not None:
+            from dynamo_tpu.ops.attention import force_jnp_attention
             from dynamo_tpu.parallel.mesh import kv_cache_sharding
 
+            # Mosaic kernels can't be auto-partitioned over a sharded cache;
+            # let XLA partition the jnp attention instead
+            force_jnp_attention(True)
             sh = kv_cache_sharding(mesh)
             cache = {k: jax.device_put(v, sh) for k, v in cache.items()}
         self.cache = cache
@@ -184,6 +208,12 @@ class JaxServingEngine(AsyncEngine):
         self._shutdown = False
         self._thread: Optional[threading.Thread] = None
 
+        # pipelined decode: at most one dispatched-but-unprocessed chunk, plus
+        # allocations whose blocks may still receive speculative writes from
+        # the in-flight chunk (freed only once it has been fetched)
+        self._inflight: Optional[_Inflight] = None
+        self._zombie_allocs: List[SequenceAllocation] = []
+
         # disaggregated prefill: policy decides + submits; sequences wait in
         # _awaiting until the prefill worker's KV lands (complete_remote_prefill)
         self._remote_policy: Optional[Any] = None
@@ -197,7 +227,7 @@ class JaxServingEngine(AsyncEngine):
         self.preemptions = 0
 
         self._decode_fn = self._build_decode_fn()
-        self._prefill_fns: Dict[int, Any] = {}  # bucket → compiled fn
+        self._chunk_fn = self._build_chunk_fn()
 
     # -- jitted step functions ----------------------------------------------
 
@@ -209,7 +239,11 @@ class JaxServingEngine(AsyncEngine):
         def decode(params, cache, tokens, positions, tables, step_key, seeds, temp, topk, topp):
             # tokens/positions: [S]; tables: [S, MB]. Scans k_steps forward+
             # sample iterations, feeding each sampled token back in — one
-            # dispatch yields [S, k_steps] tokens.
+            # dispatch yields [S, k_steps] tokens. The final carry (tokens,
+            # positions) is returned so the NEXT dispatch can chain off the
+            # device-resident state without a host round trip (pipelined
+            # decode); a lane whose position would pass max_pos goes to -1 so
+            # speculative steps never scatter into a block past its table.
             def body(carry, k):
                 toks, pos, cache = carry
                 logits, cache = forward(
@@ -218,32 +252,63 @@ class JaxServingEngine(AsyncEngine):
                 kk = jax.random.fold_in(step_key, k)
                 keys = jax.vmap(lambda s: jax.random.fold_in(kk, s))(seeds)
                 nxt = sample_tokens(logits[:, 0], keys, temp, topk, topp)
-                new_pos = jnp.where(pos >= 0, jnp.minimum(pos + 1, max_pos), -1)
+                new_pos = jnp.where((pos >= 0) & (pos < max_pos), pos + 1, -1)
                 return (nxt, new_pos, cache), nxt
 
-            (_, _, cache), out = jax.lax.scan(
+            (toks, pos, cache), out = jax.lax.scan(
                 body, (tokens, positions, cache), jnp.arange(k_steps)
             )
-            return out.T, cache  # [S, k_steps]
+            return out.T, toks, pos, cache  # [S, k_steps], [S], [S]
 
         return jax.jit(decode, donate_argnums=(1,))
 
-    def _prefill_fn(self, bucket: int):
-        fn = self._prefill_fns.get(bucket)
-        if fn is not None:
-            return fn
+    def _build_chunk_fn(self):
         cfg = self.model_config
+        S = self.config.max_slots
 
-        def prefill(params, cache, tokens, positions, table, sample_at, key, temp, topk, topp):
-            # tokens/positions: [1, bucket]; table: [1, MB]
-            logits, cache = forward(params, cfg, tokens, positions, cache, table)
-            last = logits[:, sample_at]  # [1, V]
-            next_token = sample_tokens(last, key[None], temp[None], topk[None], topp[None])
-            return next_token[0], cache
+        def chunk(params, cache, tokens, positions, tables, sample_at, step_key, seeds, temp, topk, topp):
+            # tokens/positions: [S, C] (−1 positions = padding); sample_at: [S]
+            # index of the token whose logits to sample, −1 → output unused.
+            # One shape serves any mix of prefilling and decoding lanes.
+            logits, cache = forward(params, cfg, tokens, positions, cache, tables)
+            sel = logits[jnp.arange(S), jnp.clip(sample_at, 0)]  # [S, V]
+            keys = jax.vmap(lambda s: jax.random.fold_in(step_key, s))(seeds)
+            nxt = sample_tokens(sel, keys, temp, topk, topp)
+            return nxt, cache
 
-        fn = jax.jit(prefill, donate_argnums=(1,))
-        self._prefill_fns[bucket] = fn
-        return fn
+        return jax.jit(chunk, donate_argnums=(1,))
+
+    def warmup(self) -> None:
+        """Compile the chunk and decode step functions before serving traffic.
+
+        A cold compile is tens of seconds on a real chip — taken mid-request it
+        stalls every in-flight sequence (the round-1 bench measured a 13.5 s
+        head-of-line compile inside the timed run). All-padding inputs make
+        both dispatches no-ops on the cache (scatters drop every index)."""
+        cfg = self.config
+        S, C, MB = cfg.max_slots, cfg.prefill_chunk, cfg.max_blocks_per_seq
+        key = jax.random.PRNGKey(0)
+        neg = np.full((S, C), -1, np.int32)
+        zeros_sc = np.zeros((S, C), np.int32)
+        tables = np.zeros((S, MB), np.int32)
+        svec_i = np.zeros((S,), np.int32)
+        svec_f = np.zeros((S,), np.float32)
+        ones_f = np.ones((S,), np.float32)
+
+        out, self.cache = self._chunk_fn(
+            self.params, self.cache, jnp.asarray(zeros_sc), jnp.asarray(neg),
+            jnp.asarray(tables), jnp.asarray(np.full((S,), -1, np.int32)), key,
+            jnp.asarray(svec_i), jnp.asarray(svec_f), jnp.asarray(svec_i),
+            jnp.asarray(ones_f),
+        )
+        jax.device_get(out)
+        out, _, _, self.cache = self._decode_fn(
+            self.params, self.cache, jnp.asarray(svec_i),
+            jnp.asarray(np.full((S,), -1, np.int32)), jnp.asarray(tables), key,
+            jnp.asarray(svec_i), jnp.asarray(svec_f), jnp.asarray(svec_i),
+            jnp.asarray(ones_f),
+        )
+        jax.device_get(out)
 
     # -- AsyncEngine interface ----------------------------------------------
 
@@ -305,6 +370,7 @@ class JaxServingEngine(AsyncEngine):
                         and not self._pending
                         and not self._posted
                         and not any(self._slots)
+                        and self._inflight is None
                     ):
                         if self._awaiting:
                             # wake periodically to sweep remote-prefill timeouts
@@ -316,7 +382,7 @@ class JaxServingEngine(AsyncEngine):
                 self._run_posted()
                 self._sweep_remote_timeouts()
                 self._admit()
-                self._decode_step()
+                self._dispatch_step()
         except Exception:
             logger.exception("engine step loop crashed")
             # fail every in-flight request rather than hanging clients
@@ -370,9 +436,13 @@ class JaxServingEngine(AsyncEngine):
                 # the allocation we already hold
                 seq.slot = free[0]
                 self._slots[seq.slot] = seq
-                self._run_prefill(seq)
+                seq.prefill_pos = min(seq.alloc.cached_tokens, len(seq.prompt) - 1)
                 continue
             alloc = self.allocator.allocate_sequence(seq.prompt)
+            if alloc is None and (self._inflight is not None or self._zombie_allocs):
+                # blocks may be parked behind the in-flight speculative chunk
+                self._drain_inflight()
+                alloc = self.allocator.allocate_sequence(seq.prompt)
             if alloc is None:
                 if not any(self._slots) and not self._awaiting:
                     # nothing running (or awaiting remote prefill) will ever
@@ -418,98 +488,207 @@ class JaxServingEngine(AsyncEngine):
 
             seq.slot = free[0]
             self._slots[seq.slot] = seq
-            self._run_prefill(seq)
+            # the last prompt token is never cached (allocator guarantees it),
+            # so every admitted sequence computes at least one position
+            seq.prefill_pos = seq.alloc.cached_tokens
 
-    def _run_prefill(self, seq: _Seq) -> None:
+    def _dispatch_step(self) -> None:
+        active = [s for s in self._slots if s is not None]
+        if not active:
+            self._drain_inflight()
+            return
+        if any(s.prefill_pos is not None for s in active):
+            # chunk prefill needs each decode lane's true last token host-side
+            self._drain_inflight()
+            self._chunk_step()
+        else:
+            self._decode_step()
+
+    def _chunk_step(self) -> None:
+        """One [slots, prefill_chunk] dispatch: prefilling lanes consume up to
+        a chunk of prompt; decode lanes advance one token. A whole admission
+        wave prefills in ceil(longest_suffix / chunk) dispatches instead of
+        one serial batch-1 dispatch per request (the round-1 18 s TTFT)."""
         cfg = self.config
-        alloc = seq.alloc
-        suffix = seq.prompt[alloc.cached_tokens :]
-        n = len(suffix)
-        bucket = next(b for b in cfg.prefill_buckets() if b >= n)
+        S, C = cfg.max_slots, cfg.prefill_chunk
+        for seq in [s for s in self._slots if s is not None]:
+            if seq.ctx.context.is_stopped:
+                self._finish(seq, FinishReason.CANCELLED)
+            elif seq.prefill_pos is None:
+                # decode lane writes KV at position total_len-1
+                if not self.allocator.grow(seq.alloc, min(seq.total_len, cfg.max_model_len)):
+                    self._preempt(seq)
+        if not any(self._slots):
+            return
 
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :n] = suffix
-        positions = np.full((1, bucket), -1, np.int32)
-        positions[0, :n] = np.arange(alloc.cached_tokens, alloc.cached_tokens + n)
-        table = np.zeros((1, cfg.max_blocks_per_seq), np.int32)
-        table[0, : len(alloc.block_ids)] = alloc.block_ids
+        tokens = np.zeros((S, C), np.int32)
+        positions = np.full((S, C), -1, np.int32)
+        sample_at = np.full((S,), -1, np.int32)
+        consumed: List[Optional[List[int]]] = [None] * S
+        for i in range(S):
+            seq = self._slots[i]
+            self._tables[i, :] = 0
+            self._temp[i] = 0.0
+            self._topk[i] = 0
+            self._topp[i] = 1.0
+            self._seeds[i] = 0
+            if seq is None:
+                continue
+            self._tables[i, : len(seq.alloc.block_ids)] = seq.alloc.block_ids
+            self._temp[i] = seq.temperature
+            self._topk[i] = seq.top_k
+            self._topp[i] = seq.top_p
+            self._seeds[i] = seq.seed & 0x7FFFFFFF
+            if seq.prefill_pos is not None:
+                n = min(C, len(seq.prompt) - seq.prefill_pos)
+                chunk_toks = seq.prompt[seq.prefill_pos : seq.prefill_pos + n]
+                tokens[i, :n] = chunk_toks
+                positions[i, :n] = np.arange(seq.prefill_pos, seq.prefill_pos + n)
+                if seq.prefill_pos + n == len(seq.prompt):
+                    sample_at[i] = n - 1
+                consumed[i] = chunk_toks
+            else:
+                fed = seq.generated[-1] if seq.generated else seq.prompt[-1]
+                tokens[i, 0] = fed
+                positions[i, 0] = seq.total_len - 1
+                sample_at[i] = 0
+                consumed[i] = [fed]
 
         self._step_counter += 1
         step_key = jax.random.fold_in(self._base_key, self._step_counter)
-        key = jax.random.fold_in(step_key, seq.seed)
-
-        fn = self._prefill_fn(bucket)
-        next_token, self.cache = fn(
-            self.params, self.cache, tokens, positions, table,
-            n - 1,
-            key,
-            jnp.float32(seq.temperature), jnp.int32(seq.top_k), jnp.float32(seq.top_p),
+        sampled, self.cache = self._chunk_fn(
+            self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(self._tables), jnp.asarray(sample_at), step_key,
+            jnp.asarray(self._seeds), jnp.asarray(self._temp),
+            jnp.asarray(self._topk), jnp.asarray(self._topp),
         )
-        tok = int(next_token)
-        self.allocator.note_tokens_computed(alloc, suffix)
-        seq.first_token_t = time.perf_counter()
-        self._emit_token(seq, tok)
+        sampled_np = np.asarray(jax.device_get(sampled))  # [S]
+
+        for i in range(S):
+            seq = self._slots[i]
+            if seq is None or consumed[i] is None:
+                continue
+            self.allocator.note_tokens_computed(seq.alloc, consumed[i])
+            if seq.prefill_pos is not None:
+                seq.prefill_pos += len(consumed[i])
+                if seq.prefill_pos >= len(seq.prompt):
+                    seq.prefill_pos = None
+                    seq.first_token_t = time.perf_counter()
+                    self._emit_token(seq, int(sampled_np[i]))
+            else:
+                self._emit_token(seq, int(sampled_np[i]))
 
     def _decode_step(self) -> None:
-        active = [s for s in self._slots if s is not None]
-        if not active:
-            return
-        k_steps = self.config.decode_steps
-        # cancellation + capacity checks before the step
-        for seq in active:
-            if seq.ctx.context.is_stopped:
-                self._finish(seq, FinishReason.CANCELLED)
-                continue
-            # the chunk writes KV at positions total_len-1 .. total_len-2+k
-            need = min(seq.total_len - 1 + k_steps, self.config.max_model_len)
-            if not self.allocator.grow(seq.alloc, need):
-                self._preempt(seq)
+        """Pipelined decode: dispatch chunk N+1 off the previous dispatch's
+        device-resident carry, THEN fetch + process chunk N. The host↔device
+        round trip (which on a tunneled chip rivals the chunk's compute time)
+        overlaps the next chunk's execution. Blocks owned by sequences that
+        finish mid-pipeline receive up to one chunk of speculative garbage
+        writes, so their allocations are parked in ``_zombie_allocs`` and
+        freed only once the in-flight chunk has been fetched."""
+        cfg = self.config
+        S, k = cfg.max_slots, cfg.decode_steps
+
+        stopped = [s for s in self._slots if s is not None and s.ctx.context.is_stopped]
+        if stopped:
+            self._drain_inflight()
+            for seq in stopped:
+                if seq.slot is not None:
+                    self._finish(seq, FinishReason.CANCELLED)
+
+        # capacity: this chunk writes positions total_len-1 .. total_len-2+k,
+        # and the next (speculative) chunk another k past that
+        while True:
+            ok = True
+            for seq in [s for s in self._slots if s is not None]:
+                need = min(seq.total_len - 1 + 2 * k, cfg.max_model_len)
+                if not self.allocator.grow(seq.alloc, need):
+                    if self._inflight is not None or self._zombie_allocs:
+                        self._drain_inflight()  # releases zombie blocks
+                    else:
+                        self._preempt(seq)
+                    ok = False
+                    break
+            if ok:
+                break
         active = [s for s in self._slots if s is not None]
         if not active:
             return
 
-        cfg = self.config
-        for i in range(cfg.max_slots):
+        lanes = list(self._slots)
+        if self._inflight is not None and any(
+            a is not b for a, b in zip(self._inflight.lanes, lanes)
+        ):
+            # lane set changed since the in-flight dispatch: its carry no
+            # longer matches; fall back to host-built inputs
+            self._drain_inflight()
+            lanes = list(self._slots)
+            if not any(lanes):
+                return
+
+        for i in range(S):
             seq = self._slots[i]
+            self._tables[i, :] = 0
             if seq is None:
                 self._positions[i] = -1
                 self._last_tokens[i] = 0
+                self._temp[i] = 0.0
+                self._topk[i] = 0
+                self._topp[i] = 1.0
+                self._seeds[i] = 0
                 continue
             self._positions[i] = seq.total_len - 1
             self._last_tokens[i] = seq.generated[-1] if seq.generated else seq.prompt[-1]
-            self._tables[i, :] = 0
             self._tables[i, : len(seq.alloc.block_ids)] = seq.alloc.block_ids
             self._temp[i] = seq.temperature
             self._topk[i] = seq.top_k
             self._topp[i] = seq.top_p
             self._seeds[i] = seq.seed & 0x7FFFFFFF
 
+        if self._inflight is None:
+            toks_in = jnp.asarray(self._last_tokens)
+            pos_in = jnp.asarray(self._positions)
+        else:
+            toks_in, pos_in = self._inflight.tokens, self._inflight.positions
+
         self._step_counter += 1
         step_key = jax.random.fold_in(self._base_key, self._step_counter)
-        next_tokens, self.cache = self._decode_fn(
-            self.params, self.cache,
-            jnp.asarray(self._last_tokens), jnp.asarray(self._positions),
+        out, toks2, pos2, self.cache = self._decode_fn(
+            self.params, self.cache, toks_in, pos_in,
             jnp.asarray(self._tables), step_key, jnp.asarray(self._seeds),
             jnp.asarray(self._temp), jnp.asarray(self._topk), jnp.asarray(self._topp),
         )
-        next_np = np.asarray(jax.device_get(next_tokens))  # [S, k_steps]
+        prev, self._inflight = self._inflight, _Inflight(out, toks2, pos2, lanes)
+        if prev is not None:
+            self._process_chunk(prev, defer_free=True)
 
-        for i in range(cfg.max_slots):
-            seq = self._slots[i]
-            if seq is None:
-                continue
+    def _process_chunk(self, chunk: _Inflight, defer_free: bool) -> None:
+        out = np.asarray(jax.device_get(chunk.out))  # [S, k_steps]
+        for i, seq in enumerate(chunk.lanes):
+            if seq is None or seq.slot != i:
+                continue  # empty lane, or finished in an earlier chunk
             # fed tokens this chunk: last accepted token, then each output fed
             # back. KV is registered only for fed tokens on the accepted path.
             fed = seq.generated[-1] if seq.generated else seq.prompt[-1]
-            for k in range(k_steps):
+            for j in range(out.shape[1]):
                 self.allocator.note_tokens_computed(seq.alloc, [fed])
-                tok = int(next_np[i, k])
-                self._emit_token(seq, tok)
-                if self._slots[i] is not seq:  # finished/preempted mid-chunk
+                tok = int(out[i, j])
+                self._emit_token(seq, tok, defer_free=defer_free)
+                if seq.slot != i:  # finished mid-chunk
                     break
                 fed = tok
 
-    def _emit_token(self, seq: _Seq, tok: int) -> None:
+    def _drain_inflight(self) -> None:
+        """Fetch + process any in-flight chunk, then release zombie blocks
+        (no further speculative writes can touch them)."""
+        if self._inflight is not None:
+            chunk, self._inflight = self._inflight, None
+            self._process_chunk(chunk, defer_free=False)
+        for alloc in self._zombie_allocs:
+            self.allocator.free_sequence(alloc)
+        self._zombie_allocs.clear()
+
+    def _emit_token(self, seq: _Seq, tok: int, defer_free: bool = False) -> None:
         seq.generated.append(tok)
         seq.emitted += 1
         self.total_generated_tokens += 1
@@ -525,14 +704,19 @@ class JaxServingEngine(AsyncEngine):
             LLMEngineOutput(token_ids=[tok]).to_dict(), id=seq.ctx.id
         ))
         if finish is not None:
-            self._finish(seq, finish)
+            self._finish(seq, finish, defer_free=defer_free)
 
-    def _finish(self, seq: _Seq, reason: FinishReason) -> None:
+    def _finish(self, seq: _Seq, reason: FinishReason, defer_free: bool = False) -> None:
         if seq.slot is not None:
             self._slots[seq.slot] = None
             seq.slot = None
         if seq.alloc is not None:
-            self.allocator.free_sequence(seq.alloc)
+            if defer_free:
+                # the in-flight speculative chunk may still write into these
+                # blocks; park them until it has been fetched
+                self._zombie_allocs.append(seq.alloc)
+            else:
+                self.allocator.free_sequence(seq.alloc)
             seq.alloc = None
         seq.emit(Annotated.from_data(LLMEngineOutput.final(reason).to_dict(), id=seq.ctx.id))
         seq.emit(_FINISHED)
@@ -554,6 +738,7 @@ class JaxServingEngine(AsyncEngine):
         seq.prompt = seq.prompt + seq.generated
         seq.generated = []
         seq.alloc = None
+        seq.prefill_pos = None  # re-set from the fresh allocation on re-admit
         with self._cond:
             self._pending.append(seq)
 

@@ -13,10 +13,45 @@ max-blocks-per-seq, batch is padded to fixed slot count, masks do the rest.
 
 from __future__ import annotations
 
+import os
+from functools import lru_cache
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+
+# Mosaic kernels have no GSPMD partitioning rule: when the KV cache is
+# sharded over a mesh the engine forces the jnp path (XLA partitions it)
+# until the shard_map-wrapped kernel variant lands.
+_FORCE_JNP = False
+
+
+def force_jnp_attention(value: bool) -> None:
+    global _FORCE_JNP
+    _FORCE_JNP = value
+    _use_pallas_decode.cache_clear()
+
+
+@lru_cache(maxsize=1)
+def _use_pallas_decode() -> bool:
+    """Pallas decode kernel on TPU backends; jnp fallback elsewhere.
+
+    DYN_TPU_ATTENTION=pallas|jnp overrides the autodetection (pallas also
+    works on CPU via the interpreter — slow, test-only).
+    """
+    if _FORCE_JNP:
+        return False
+    mode = os.environ.get("DYN_TPU_ATTENTION", "auto")
+    if mode == "pallas":
+        return True
+    if mode == "jnp":
+        return False
+    try:
+        dev = jax.devices()[0]
+        return dev.platform == "tpu" or dev.device_kind.startswith("TPU")
+    except Exception:
+        return False
 
 
 def write_kv_to_pages(
@@ -91,16 +126,24 @@ def paged_attention(
     if scale is None:
         scale = d ** -0.5
 
+    if t == 1 and soft_cap is None and _use_pallas_decode():
+        from dynamo_tpu.ops.pallas.paged_attention import paged_attention_decode
+
+        lengths = jnp.maximum(q_positions[:, 0] + 1, 0)  # padding (pos<0) → 0
+        out = paged_attention_decode(
+            q[:, 0], k_cache, v_cache, block_tables, lengths, scale=scale,
+            interpret=jax.devices()[0].platform == "cpu",
+        )
+        return out[:, None]
+
     k = gather_pages(k_cache, block_tables)  # [B, S, KVH, D]
     v = gather_pages(v_cache, block_tables)
     s = k.shape[1]
 
-    if h != kvh:  # GQA: repeat kv heads to query heads
-        rep = h // kvh
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-
-    scores = jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32)
+    # GQA without materializing repeated K/V: group query heads per kv head
+    g = h // kvh
+    qg = q.reshape(b, t, kvh, g, d)
+    scores = jnp.einsum("btngd,bsnd->bngts", qg, k, preferred_element_type=jnp.float32)
     scores = scores * scale
     if soft_cap is not None:
         scores = jnp.tanh(scores / soft_cap) * soft_cap
@@ -108,11 +151,11 @@ def paged_attention(
     kv_pos = jnp.arange(s)[None, None, :]  # logical context positions
     causal = kv_pos <= q_positions[:, :, None]  # [B, T, S]
     valid_q = (q_positions >= 0)[:, :, None]
-    mask = (causal & valid_q)[:, None, :, :]  # [B, 1, T, S]
+    mask = (causal & valid_q)[:, None, None, :, :]  # [B, 1, 1, T, S]
 
     scores = jnp.where(mask, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     # rows with no valid keys (padding queries) produce NaN → zero them
     probs = jnp.where(mask.any(axis=-1, keepdims=True), probs, 0.0)
-    out = jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v)
-    return out.astype(q.dtype)
+    out = jnp.einsum("bngts,bsnd->btngd", probs.astype(v.dtype), v)
+    return out.reshape(b, t, h, d).astype(q.dtype)

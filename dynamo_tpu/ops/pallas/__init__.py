@@ -1,0 +1,10 @@
+"""Pallas TPU kernels — the framework's native-kernel tier.
+
+The reference implements its KV hot ops as CUDA (`block_copy.cu`, SURVEY.md
+§2.3); on TPU the same tier is Pallas: kernels get block-table-driven DMA
+from HBM instead of gather-materialized context copies.
+"""
+
+from dynamo_tpu.ops.pallas.paged_attention import paged_attention_decode
+
+__all__ = ["paged_attention_decode"]

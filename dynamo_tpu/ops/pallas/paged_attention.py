@@ -1,0 +1,139 @@
+"""Pallas TPU paged-attention decode kernel.
+
+Computes flash-style attention of one query token per lane against that
+lane's paged KV context, streaming pages from HBM into VMEM with the block
+table driving the DMA schedule — the physical page id is read from a
+scalar-prefetched block table inside each BlockSpec ``index_map``, so the
+kernel never materializes a gathered context (the round-1 jnp fallback
+gathered + GQA-repeated the full padded context every step).
+
+TPU counterpart of the reference's CUDA KV kernel tier
+(``lib/llm/src/kernels/block_copy.cu:41-758`` moves paged KV; its engines'
+paged attention lives in vLLM). Contract matches ``ops/attention.py``'s
+``paged_attention`` for T==1; parity is tested in interpret mode on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _decode_kernel(
+    # scalar prefetch
+    tables_ref,  # [S, MB] int32 physical page per (lane, logical block)
+    lengths_ref,  # [S] int32 context length (0 = padding lane)
+    # blocks
+    q_ref,  # [1, H, D]
+    k_ref,  # [1, bs, KVH, D] — the page selected by index_map
+    v_ref,  # [1, bs, KVH, D]
+    o_ref,  # [1, H, D]
+    # scratch
+    m_ref,  # [H, 1] f32 running max
+    l_ref,  # [H, 1] f32 running denominator
+    acc_ref,  # [H, D] f32 running numerator
+    *,
+    scale: float,
+    kvh: int,
+):
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    bs = k_ref.shape[1]
+    h, d = q_ref.shape[1], q_ref.shape[2]
+    g = h // kvh
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = lengths_ref[s]
+    base = j * bs
+
+    @pl.when(base < length)
+    def _():
+        q = q_ref[0].reshape(kvh, g, d).astype(jnp.float32)  # [KVH, G, D]
+        k = k_ref[0].transpose(1, 0, 2).astype(jnp.float32)  # [KVH, bs, D]
+        v = v_ref[0].transpose(1, 0, 2).astype(jnp.float32)  # [KVH, bs, D]
+
+        scores = jax.lax.dot_general(  # [KVH, G, bs]
+            q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        ) * scale
+        pos = base + jax.lax.broadcasted_iota(jnp.int32, (kvh, g, bs), 2)
+        scores = jnp.where(pos < length, scores, -jnp.inf)
+
+        flat = scores.reshape(h, bs)
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, flat.max(axis=1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(flat - m_new[:, None])  # [H, bs]
+
+        l_ref[:, 0] = l_ref[:, 0] * alpha + p.sum(axis=1)
+        m_ref[:, 0] = m_new
+        pv = jax.lax.dot_general(  # [KVH, G, D]
+            p.reshape(kvh, g, bs), v,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + pv.reshape(h, d)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        l = l_ref[:, 0]
+        denom = jnp.where(l > 0.0, l, 1.0)  # padding lanes produce zeros
+        o_ref[0] = (acc_ref[:] / denom[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_attention_decode(
+    q: jax.Array,  # [S, H, D] one query token per lane
+    k_cache: jax.Array,  # [N, bs, KVH, D]
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [S, MB] int32
+    lengths: jax.Array,  # [S] int32 context length; 0 = padding lane
+    *,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash decode over paged KV. Returns [S, H, D] in q's dtype."""
+    s, h, d = q.shape
+    _, bs, kvh, _ = k_cache.shape
+    mb = block_tables.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+
+    # pages past a lane's live context re-select the previous page index so
+    # the pipeline skips the redundant HBM→VMEM copy (compute is masked off)
+    def page_index(si, ji, tables, lengths):
+        last = jnp.maximum(pl.cdiv(lengths[si], bs) - 1, 0)
+        return (tables[si, jnp.minimum(ji, last)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, mb),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda si, ji, *_: (si, 0, 0)),
+            pl.BlockSpec((1, bs, kvh, d), page_index),
+            pl.BlockSpec((1, bs, kvh, d), page_index),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda si, ji, *_: (si, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+
+    kernel = functools.partial(_decode_kernel, scale=scale, kvh=kvh)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((s, h, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32), q, k_cache, v_cache)

@@ -391,13 +391,22 @@ class EndpointClient(AsyncEngine):
                     )
                     # fresh snapshot replaces stale state as puts stream in.
                     # Workers that died during the outage never get a delete
-                    # event, so purge the router/worker maps too — live
-                    # workers repopulate from the snapshot + future events.
+                    # event, so purge the router/worker maps AND their RPC
+                    # connections (the delete-event path closes these; without
+                    # it they'd leak across outages) — live workers repopulate
+                    # from the snapshot + future events and re-dial lazily.
                     self._instances.clear()
                     if self._router is not None:
                         for wid in self._by_worker:
                             self._router.remove_worker(wid)
                     self._by_worker.clear()
+                    stale_conns = list(self._conns.values())
+                    self._conns.clear()
+                    for conn in stale_conns:
+                        try:
+                            await conn.close()
+                        except Exception:
+                            pass
                     self._ready.clear()
                     backoff = 0.5
                     break

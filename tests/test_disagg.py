@@ -32,7 +32,7 @@ from dynamo_tpu.runtime.statestore import StateStoreServer
 CFG = dataclasses.replace(LLAMA_PRESETS["tiny"], dtype=jnp.float32)
 BLOCK = 8
 ENGINE_CFG = EngineConfig(
-    max_slots=2, kv_block_size=BLOCK, max_model_len=128, min_prefill_bucket=16
+    max_slots=2, kv_block_size=BLOCK, max_model_len=128
 )
 
 
