@@ -1,0 +1,101 @@
+"""llmctl — control CLI for the model registry.
+
+    python -m dynamo_tpu.cli.llmctl [--statestore URL] http add chat-models <name> <dyn://ns.comp.ep>
+    python -m dynamo_tpu.cli.llmctl http add completion-models <name> <dyn://ns.comp.ep>
+    python -m dynamo_tpu.cli.llmctl [--namespace ns] http list
+    python -m dynamo_tpu.cli.llmctl http remove chat-models <name>
+
+Writes/deletes ``{ns}/models/{kind}/{name}`` entries WITHOUT a lease (they
+outlive this process, like the reference's `for_cli` etcd config) so an
+operator can point a discovery frontend at a worker by hand.
+
+Re-designed from `launch/llmctl/src/main.rs:29-452` (same verbs, same key
+layout, statestore instead of etcd).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+_KIND_BY_LIST = {"chat-models": "chat", "completion-models": "completions"}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="llmctl")
+    p.add_argument("--statestore", default=None, help="statestore url")
+    p.add_argument("--namespace", default=None,
+                   help="registry namespace (default: from endpoint path, or 'dynamo')")
+    sub = p.add_subparsers(dest="plane", required=True)
+    http = sub.add_parser("http", help="manage the HTTP frontend model registry")
+    verbs = http.add_subparsers(dest="verb", required=True)
+
+    add = verbs.add_parser("add")
+    add.add_argument("list_name", choices=sorted(_KIND_BY_LIST))
+    add.add_argument("name")
+    add.add_argument("endpoint", help="dyn://ns.comp.ep the model is served at")
+
+    ls = verbs.add_parser("list")
+    ls.add_argument("list_name", nargs="?", choices=sorted(_KIND_BY_LIST))
+
+    rm = verbs.add_parser("remove")
+    rm.add_argument("list_name", choices=sorted(_KIND_BY_LIST))
+    rm.add_argument("name")
+    return p
+
+
+async def amain(argv: list) -> int:
+    args = build_parser().parse_args(argv)
+
+    import os
+
+    from dynamo_tpu.runtime.distributed import parse_endpoint_path
+    from dynamo_tpu.runtime.statestore import StateStoreClient
+
+    url = args.statestore or os.environ.get("DYN_TPU_STATESTORE", "127.0.0.1:37901")
+    store = await StateStoreClient.connect(url)
+    try:
+        if args.verb == "add":
+            kind = _KIND_BY_LIST[args.list_name]
+            ns, comp, ep = parse_endpoint_path(args.endpoint)
+            namespace = args.namespace or ns
+            entry = {
+                "name": args.name, "kind": kind,
+                "endpoint": f"dyn://{ns}.{comp}.{ep}",
+            }
+            await store.put(
+                f"{namespace}/models/{kind}/{args.name}", json.dumps(entry).encode()
+            )
+            print(f"added {kind} model {args.name!r} -> {entry['endpoint']}")
+        elif args.verb == "list":
+            namespace = args.namespace or "dynamo"
+            want = _KIND_BY_LIST.get(args.list_name) if args.list_name else None
+            entries = await store.get_prefix(f"{namespace}/models/")
+            for key in sorted(entries):
+                tail = key[len(f"{namespace}/models/"):]
+                kind = tail.split("/", 1)[0]
+                if want is not None and kind != want:
+                    continue
+                e = json.loads(entries[key])
+                print(f"{kind:12s} {e.get('name', '?'):24s} {e.get('endpoint', '?')}")
+            if not entries:
+                print(f"(no models registered under {namespace}/models/)")
+        elif args.verb == "remove":
+            kind = _KIND_BY_LIST[args.list_name]
+            namespace = args.namespace or "dynamo"
+            ok = await store.delete(f"{namespace}/models/{kind}/{args.name}")
+            print(f"removed {args.name!r}" if ok else f"{args.name!r} not found")
+            return 0 if ok else 1
+    finally:
+        await store.close()
+    return 0
+
+
+def main() -> None:
+    sys.exit(asyncio.run(amain(sys.argv[1:])))
+
+
+if __name__ == "__main__":
+    main()

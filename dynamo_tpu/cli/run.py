@@ -75,8 +75,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-batch-size", type=int, default=8)
     p.add_argument("--kv-block-size", type=int, default=16)
     p.add_argument("--max-model-len", type=int, default=None)
+    p.add_argument("--host-cache-blocks", type=int, default=0,
+                   help="host-RAM KV tier size in blocks (0 = disabled)")
     p.add_argument("--router-mode", default="random",
                    help="random | round_robin | kv | direct:<instance_id>")
+    p.add_argument("--namespace", default="dynamo",
+                   help="registry namespace for out=discover model watching")
     p.add_argument("--statestore", default=None, help="statestore url for distributed mode")
     p.add_argument("--bus", default=None, help="message bus url for distributed mode")
     p.add_argument("--wait-workers-timeout", type=float, default=60.0)
@@ -172,6 +176,7 @@ def build_engine(out_spec: str, flags: argparse.Namespace):
             kv_block_size=flags.kv_block_size,
             max_model_len=flags.max_model_len,
             tensor_parallel_size=flags.tensor_parallel_size,
+            host_cache_blocks=flags.host_cache_blocks,
             **extra,
         )
         core.warmup()  # compile the step functions off the request path
@@ -218,6 +223,35 @@ async def run_http(chat_engine, completions_engine, model_name: str, flags: argp
     service = HttpService(manager, host=flags.host, port=flags.port)
     logger.info("serving model %r on port %d", model_name, flags.port)
     await service.run()
+
+
+async def run_http_discover(flags: argparse.Namespace) -> None:
+    """in=http out=discover: frontend whose model set tracks the registry.
+
+    Workers that register models (Endpoint.serve model_entry / llmctl) appear
+    and disappear live — no frontend restart. Reference: the standalone
+    `http` component binary (components/http/src/main.rs:50-104).
+    """
+    from ..llm.http.discovery import ModelWatcher
+    from ..runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.create(
+        statestore_url=flags.statestore, bus_url=flags.bus
+    )
+    manager = ModelManager()
+    watcher = ModelWatcher(
+        drt, flags.namespace, manager,
+        router_mode=flags.router_mode, kv_block_size=flags.kv_block_size,
+    )
+    watcher.start()
+    service = HttpService(manager, host=flags.host, port=flags.port)
+    logger.info(
+        "discovery frontend on port %d (watching %s)", flags.port, watcher.prefix
+    )
+    try:
+        await service.run()
+    finally:
+        await watcher.close()
 
 
 async def run_text(engine, model_name: str) -> None:
@@ -336,7 +370,9 @@ async def run_endpoint(chat_engine, completions_engine, model_name: str, in_spec
     component = drt.namespace(ns).component(comp)
     await component.create_service()
     endpoint = component.endpoint(ep)
-    info = await endpoint.serve(engine, model_entry={"name": model_name, "kind": "chat"})
+    info = await endpoint.serve(
+        engine, model_entry={"name": model_name, "kinds": ["chat", "completions"]}
+    )
     if core_engine is not None and hasattr(core_engine, "metrics_snapshot"):
         await attach_kv_publishing(endpoint, core_engine)
         logger.info("kv events + metrics publishing enabled (worker key %s)", drt.worker_id)
@@ -395,6 +431,11 @@ async def amain(argv: list[str]) -> None:
         return
 
     core_engine = None
+    if out_spec == "discover":
+        if in_spec != "http":
+            raise SystemExit("out=discover requires in=http")
+        await run_http_discover(flags)
+        return
     if out_spec.startswith("dyn://"):
         client, _drt = await build_remote_client(out_spec, flags)
         chat_engine = completions_engine = client

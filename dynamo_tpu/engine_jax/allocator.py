@@ -20,8 +20,8 @@ not ported: single-threaded host logic driven by the engine loop.
 from __future__ import annotations
 
 from collections import OrderedDict
-from dataclasses import dataclass
-from typing import Dict, List, Optional, Protocol, Sequence, Tuple
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Protocol, Sequence, Tuple
 
 from dynamo_tpu.kv.tokens import TokenBlockSequence, compute_block_hashes_for_seq
 
@@ -43,8 +43,51 @@ class SequenceAllocation:
 
     block_ids: List[int]  # physical page ids, logical order
     token_blocks: TokenBlockSequence  # hashing state (tracks sealed blocks)
-    cached_tokens: int  # prompt tokens served from prefix cache
+    cached_tokens: int  # prompt tokens served from prefix cache (any tier)
     sealed_blocks: int = 0  # how many full blocks have been hashed+registered
+    # host-tier prefix hits: (logical block index, sequence hash, k, v) with
+    # the content captured at probe time (a later offload into the LRU pool
+    # can't invalidate them). The engine must inject each into
+    # block_ids[index] before any compute touches the sequence.
+    host_hits: List[Tuple[int, int, Any, Any]] = field(default_factory=list)
+
+
+class HostKvPool:
+    """Host-RAM tier of the KV cache: evicted device blocks spill here.
+
+    Content-addressed by the same chained sequence hash as the device tier,
+    LRU-bounded. TPU analogue of the reference's pinned-host block pool
+    (`lib/llm/src/kv/manager.rs:79-124`, `kv/storage.rs` CudaPinnedMemory):
+    host arrays re-enter HBM via the engine's donated-scatter inject path.
+    """
+
+    def __init__(self, max_blocks: int):
+        self.max_blocks = max_blocks
+        self._data: "OrderedDict[int, Tuple[Any, Any]]" = OrderedDict()
+        self.hits = 0
+        self.offloaded = 0
+
+    def __contains__(self, h: int) -> bool:
+        return h in self._data
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def put(self, h: int, k, v) -> None:
+        if h in self._data:
+            self._data.move_to_end(h)
+            return
+        while len(self._data) >= self.max_blocks:
+            self._data.popitem(last=False)
+        self._data[h] = (k, v)
+        self.offloaded += 1
+
+    def get(self, h: int) -> Optional[Tuple[Any, Any]]:
+        item = self._data.get(h)
+        if item is not None:
+            self._data.move_to_end(h)
+            self.hits += 1
+        return item
 
 
 class BlockAllocator:
@@ -59,11 +102,18 @@ class BlockAllocator:
         block_size: int,
         event_sink: Optional[KvEventSink] = None,
         salt: Optional[bytes] = None,
+        host_pool: Optional[HostKvPool] = None,
+        offload: Optional[Callable[[List[Tuple[int, int]]], None]] = None,
     ):
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.salt = salt
         self._sink = event_sink
+        # host tier: `offload([(hash, block_id), ...])` is called while the
+        # evicted blocks' device contents are still valid; the engine copies
+        # them into `host_pool` (device_get) before they can be overwritten
+        self.host_pool = host_pool
+        self._offload = offload
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
         self._refcount: Dict[int, int] = {}
         # sequence_hash → block id, for every block whose contents are valid
@@ -119,6 +169,18 @@ class BlockAllocator:
                 break
             reused.append(bid)
 
+        # host tier continues the chain where the device tier missed; content
+        # is captured now so later evictions from the pool can't invalidate it
+        host_hits: List[Tuple[int, int, Any, Any]] = []
+        if self.host_pool is not None:
+            j = len(reused)
+            while j < max_cacheable:
+                item = self.host_pool.get(seq_hashes[j])
+                if item is None:
+                    break
+                host_hits.append((j, seq_hashes[j], item[0], item[1]))
+                j += 1
+
         # acquire matches FIRST so LRU eviction below can't reclaim them
         for bid in reused:
             self._acquire(bid)
@@ -130,8 +192,26 @@ class BlockAllocator:
             return None
 
         block_ids = list(reused) + [self._take_free() for _ in range(n_fresh)]
-        cached_tokens = len(reused) * self.block_size
+        cached_tokens = (len(reused) + len(host_hits)) * self.block_size
         self.hit_tokens += cached_tokens
+
+        # host-hit blocks become valid device content once the engine injects
+        # them; register their hashes so the next request hits the device tier
+        stored: List[Tuple[int, List[int]]] = []
+        for idx, h, _, _ in host_hits:
+            bid = block_ids[idx]
+            prior = self._hash_of.get(bid)
+            if prior is not None and prior != h:
+                self._unregister(bid)
+            if h not in self._by_hash:
+                self._by_hash[h] = bid
+                self._hash_of[bid] = h
+                stored.append(
+                    (h, list(token_ids[idx * self.block_size : (idx + 1) * self.block_size]))
+                )
+        if stored and self._sink is not None:
+            parent = seq_hashes[host_hits[0][0] - 1] if host_hits[0][0] > 0 else None
+            self._sink.blocks_stored(parent, stored)
 
         # hashing state covers only tokens whose KV exists (the cached prefix);
         # note_tokens_computed extends it as prefill/decode computes the rest
@@ -141,7 +221,8 @@ class BlockAllocator:
                 token_ids[:cached_tokens], self.block_size, salt=self.salt
             ),
             cached_tokens=cached_tokens,
-            sealed_blocks=len(reused),
+            sealed_blocks=len(reused) + len(host_hits),
+            host_hits=host_hits,
         )
 
     def grow(self, alloc: SequenceAllocation, n_tokens: int) -> bool:
@@ -209,8 +290,12 @@ class BlockAllocator:
         return bid
 
     def _reserve_capacity(self, n: int) -> bool:
-        """Make sure the free list has n entries, evicting LRU cached blocks."""
+        """Make sure the free list has n entries, evicting LRU cached blocks.
+
+        Evicted blocks spill to the host tier (offload callback copies their
+        still-valid device contents) before their pages are reusable."""
         evicted: List[int] = []
+        spill: List[Tuple[int, int]] = []
         while len(self._free) < n:
             if not self._cached:
                 return False
@@ -218,7 +303,12 @@ class BlockAllocator:
             h = self._hash_of.pop(bid)
             del self._by_hash[h]
             evicted.append(h)
+            if self._offload is not None and self.host_pool is not None:
+                if h not in self.host_pool:
+                    spill.append((h, bid))
             self._free.append(bid)
+        if spill:
+            self._offload(spill)
         if evicted and self._sink is not None:
             self._sink.blocks_removed(evicted)
         return True

@@ -39,7 +39,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dynamo_tpu.engine_jax.allocator import BlockAllocator, KvEventSink, SequenceAllocation
+from dynamo_tpu.engine_jax.allocator import (
+    BlockAllocator,
+    HostKvPool,
+    KvEventSink,
+    SequenceAllocation,
+)
 from dynamo_tpu.engine_jax.sampling import sample_tokens
 from dynamo_tpu.llm.protocols.common import (
     FinishReason,
@@ -72,6 +77,10 @@ class EngineConfig:
     # safety net for disaggregated prefill: a sequence whose remote prefill
     # hasn't landed within this window falls back to local prefill
     remote_prefill_timeout: float = 60.0
+    # host-RAM KV tier: evicted device blocks spill here and re-enter HBM on
+    # a prefix hit (0 = disabled). Sized in blocks; reference credits the
+    # equivalent pinned-host tier with +40% TTFT on multi-turn (BASELINE.md).
+    host_cache_blocks: int = 0
 
     def resolve_num_blocks(self) -> int:
         if self.num_kv_blocks is not None:
@@ -170,21 +179,28 @@ class JaxServingEngine(AsyncEngine):
         self.params = params
         self.mesh = mesh
         self.num_blocks = engine_config.resolve_num_blocks()
+        self.host_pool = (
+            HostKvPool(engine_config.host_cache_blocks)
+            if engine_config.host_cache_blocks > 0
+            else None
+        )
         self.allocator = BlockAllocator(
-            self.num_blocks, engine_config.kv_block_size, event_sink=event_sink
+            self.num_blocks, engine_config.kv_block_size, event_sink=event_sink,
+            host_pool=self.host_pool,
+            offload=self._offload_blocks if self.host_pool is not None else None,
         )
 
         cache = make_kv_cache(
             model_config, self.num_blocks, engine_config.kv_block_size,
             dtype=cache_dtype or model_config.dtype,
         )
+        # Mosaic kernels can't be auto-partitioned over a sharded cache; this
+        # engine's jitted steps force the jnp attention there (per-engine, so
+        # an unsharded engine in the same process keeps the Pallas kernel)
+        self._use_pallas: Optional[bool] = False if mesh is not None else None
         if mesh is not None:
-            from dynamo_tpu.ops.attention import force_jnp_attention
             from dynamo_tpu.parallel.mesh import kv_cache_sharding
 
-            # Mosaic kernels can't be auto-partitioned over a sharded cache;
-            # let XLA partition the jnp attention instead
-            force_jnp_attention(True)
             sh = kv_cache_sharding(mesh)
             cache = {k: jax.device_put(v, sh) for k, v in cache.items()}
         self.cache = cache
@@ -247,7 +263,8 @@ class JaxServingEngine(AsyncEngine):
             def body(carry, k):
                 toks, pos, cache = carry
                 logits, cache = forward(
-                    params, cfg, toks[:, None], pos[:, None], cache, tables
+                    params, cfg, toks[:, None], pos[:, None], cache, tables,
+                    use_pallas=self._use_pallas,
                 )
                 kk = jax.random.fold_in(step_key, k)
                 keys = jax.vmap(lambda s: jax.random.fold_in(kk, s))(seeds)
@@ -270,7 +287,10 @@ class JaxServingEngine(AsyncEngine):
             # tokens/positions: [S, C] (−1 positions = padding); sample_at: [S]
             # index of the token whose logits to sample, −1 → output unused.
             # One shape serves any mix of prefilling and decoding lanes.
-            logits, cache = forward(params, cfg, tokens, positions, cache, tables)
+            logits, cache = forward(
+                params, cfg, tokens, positions, cache, tables,
+                use_pallas=self._use_pallas,
+            )
             sel = logits[jnp.arange(S), jnp.clip(sample_at, 0)]  # [S, V]
             keys = jax.vmap(lambda s: jax.random.fold_in(step_key, s))(seeds)
             nxt = sample_tokens(sel, keys, temp, topk, topp)
@@ -457,6 +477,11 @@ class JaxServingEngine(AsyncEngine):
                     self._pending.appendleft(seq)  # retry when blocks free up
                 return
             seq.alloc = alloc
+            if alloc.host_hits:
+                # must land before ANY path uses the allocation: both local
+                # prefill and remote-prefill submission treat cached_tokens
+                # (which counts host hits) as valid device KV
+                self._inject_host_hits(alloc)
             if seq.emitted == 0:  # don't re-count preempted re-admissions
                 self.total_requests += 1
                 self.total_prompt_tokens += len(seq.prompt)
@@ -791,6 +816,32 @@ class JaxServingEngine(AsyncEngine):
         self.cache["k"] = fn(self.cache["k"], idx_dev, jnp.asarray(pad(k_np), dt))
         self.cache["v"] = fn(self.cache["v"], idx_dev, jnp.asarray(pad(v_np), dt))
 
+    # -- host KV tier ---------------------------------------------------------
+
+    def _offload_blocks(self, pairs: List[Tuple[int, int]]) -> None:
+        """Spill evicted device blocks to the host pool (engine thread only;
+        called by the allocator while the device contents are still valid —
+        nothing can overwrite the pages before this device_get completes
+        because all dispatches happen on this thread, after it returns)."""
+        idx = jnp.asarray([bid for _, bid in pairs], jnp.int32)
+        k = np.asarray(jax.device_get(self.cache["k"][:, idx]))
+        v = np.asarray(jax.device_get(self.cache["v"][:, idx]))
+        for i, (h, _) in enumerate(pairs):
+            # copies, not views: a view would pin the whole batch array in
+            # host RAM for as long as any one entry stays in the pool
+            self.host_pool.put(
+                h, np.ascontiguousarray(k[:, i]), np.ascontiguousarray(v[:, i])
+            )
+
+    def _inject_host_hits(self, alloc: SequenceAllocation) -> None:
+        """Load host-tier prefix hits back into the sequence's device pages
+        (engine thread only). Runs before any compute touches the sequence."""
+        block_ids = [alloc.block_ids[idx] for idx, _, _, _ in alloc.host_hits]
+        k = np.stack([k for _, _, k, _ in alloc.host_hits], axis=1)
+        v = np.stack([v for _, _, _, v in alloc.host_hits], axis=1)
+        alloc.host_hits = []
+        self.inject_blocks(block_ids, k, v)
+
     def complete_remote_prefill(
         self, request_id: str, first_token: int, block_ids: List[int], k_np, v_np
     ) -> None:
@@ -878,7 +929,7 @@ class JaxServingEngine(AsyncEngine):
     def _metrics_locked(self) -> Dict[str, Any]:
         active = sum(1 for s in self._slots if s is not None)
         probe = max(self.allocator.probe_tokens, 1)
-        return {
+        m = {
             "request_active_slots": active,
             "request_total_slots": self.config.max_slots,
             "kv_active_blocks": self.allocator.active_blocks,
@@ -887,6 +938,10 @@ class JaxServingEngine(AsyncEngine):
             "gpu_cache_usage_perc": self.allocator.usage(),
             "gpu_prefix_cache_hit_rate": self.allocator.hit_tokens / probe,
         }
+        if self.host_pool is not None:
+            m["host_cache_blocks"] = len(self.host_pool)
+            m["host_cache_hits"] = self.host_pool.hits
+        return m
 
 
 def build_jax_serving_engine(
@@ -899,6 +954,7 @@ def build_jax_serving_engine(
     seed: int = 0,
     event_sink: Optional[KvEventSink] = None,
     decode_steps: int = 4,
+    host_cache_blocks: int = 0,
 ) -> JaxServingEngine:
     """CLI/SDK entry: model + engine from a ModelDeploymentCard."""
     from dynamo_tpu.engine_jax.weights import config_from_card, load_params
@@ -919,6 +975,7 @@ def build_jax_serving_engine(
         max_model_len=max_model_len or min(card.context_length, 4096),
         num_kv_blocks=num_kv_blocks,
         decode_steps=decode_steps,
+        host_cache_blocks=host_cache_blocks,
     )
     return JaxServingEngine(
         model_config, params, engine_config, mesh=mesh, event_sink=event_sink

@@ -1,0 +1,165 @@
+"""Frontend model discovery: keep the ModelManager in sync with the registry.
+
+Workers register ``{ns}/models/{kind}/{name}`` entries (lease-attached) when
+they serve an endpoint; ``llmctl`` writes the same entries by hand. The
+frontend watches the prefix and adds/removes models live — a worker started
+AFTER the frontend appears without a restart, and a dead worker's lease
+expiry removes its model.
+
+Re-designed from the reference's etcd watcher
+(`lib/llm/src/http/service/discovery.rs:38-171`, consumed by
+`components/http/src/main.rs:50-104`): same key layout and lifecycle, but
+the client pipeline is this framework's direct-dial EndpointClient instead
+of a NATS push router.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Dict, Optional
+
+from dynamo_tpu.llm.http.service import ModelManager
+
+logger = logging.getLogger(__name__)
+
+
+class ModelWatcher:
+    """Watches ``{namespace}/models/`` and maintains manager + clients."""
+
+    def __init__(
+        self,
+        drt,
+        namespace: str,
+        manager: ModelManager,
+        router_mode: str = "round_robin",
+        kv_block_size: int = 16,
+    ):
+        self.drt = drt
+        self.namespace = namespace
+        self.manager = manager
+        self.router_mode = router_mode
+        self.kv_block_size = kv_block_size
+        self._clients: Dict[str, object] = {}  # registry key → EndpointClient
+        self._task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    @property
+    def prefix(self) -> str:
+        return f"{self.namespace}/models/"
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._run())
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        for key in list(self._clients):
+            await self._remove(key)
+
+    async def _run(self) -> None:
+        backoff = 0.5
+        while not self._closed:
+            try:
+                watcher = await self.drt.store.watch_prefix(
+                    self.prefix, include_existing=True
+                )
+                backoff = 0.5
+                async for ev in watcher:
+                    if ev.type == "put":
+                        await self._add(ev.key, ev.value)
+                    elif ev.type == "delete":
+                        await self._remove(ev.key)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("model watch error; reconnecting")
+            if self._closed:
+                return
+            # watch ended: statestore connection lost. Models stay registered
+            # (workers may still be fine) until the fresh snapshot replaces
+            # the state; entries absent from it are then removed.
+            try:
+                try:
+                    await self.drt.store.get("__ping__")
+                except (ConnectionError, RuntimeError):
+                    await self.drt.reconnect_store()
+                snapshot = await self.drt.store.get_prefix(self.prefix)
+                for key in list(self._clients):
+                    if key not in snapshot:
+                        await self._remove(key)
+            except Exception:
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 10.0)
+
+    def _parse_key(self, key: str) -> Optional[tuple]:
+        # {ns}/models/{kind}/{name}
+        tail = key[len(self.prefix):]
+        if "/" not in tail:
+            return None
+        kind, name = tail.split("/", 1)
+        return kind, name
+
+    async def _add(self, key: str, value: bytes) -> None:
+        parsed = self._parse_key(key)
+        if parsed is None:
+            return
+        kind, name = parsed
+        try:
+            entry = json.loads(value)
+            endpoint_path = entry["endpoint"]
+        except (ValueError, KeyError):
+            logger.warning("malformed model entry at %s", key)
+            return
+        if key in self._clients:
+            await self._remove(key)
+
+        from dynamo_tpu.runtime.distributed import parse_endpoint_path
+
+        # a single bad entry must not crash the watch loop (the reconnect
+        # path re-delivers existing keys, so a raise here would tear down
+        # and re-dial every healthy model's client forever)
+        try:
+            ns, comp, ep = parse_endpoint_path(endpoint_path)
+            client = await (
+                self.drt.namespace(ns).component(comp).endpoint(ep).client(
+                    self.router_mode, kv_block_size=self.kv_block_size
+                )
+            )
+        except (ValueError, KeyError):
+            logger.warning("unusable model entry at %s: %r", key, endpoint_path)
+            return
+        self._clients[key] = client
+        if kind == "chat":
+            self.manager.add_chat_model(name, client)
+        elif kind == "completions":
+            self.manager.add_completions_model(name, client)
+        else:
+            logger.warning("unknown model kind %r at %s", kind, key)
+            await client.close()
+            del self._clients[key]
+            return
+        logger.info("model %r (%s) added via %s", name, kind, endpoint_path)
+
+    async def _remove(self, key: str) -> None:
+        parsed = self._parse_key(key)
+        client = self._clients.pop(key, None)
+        if client is not None:
+            try:
+                await client.close()
+            except Exception:
+                pass
+        if parsed is None:
+            return
+        kind, name = parsed
+        if kind == "chat":
+            self.manager.remove_chat_model(name)
+        elif kind == "completions":
+            self.manager.remove_completions_model(name)
+        logger.info("model %r (%s) removed", name, kind)

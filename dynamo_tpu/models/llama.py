@@ -177,6 +177,7 @@ def forward(
     block_tables: jax.Array,  # [B, max_blocks]
     *,
     soft_cap: Optional[float] = None,
+    use_pallas: Optional[bool] = None,  # None = auto; False forced for sharded caches
 ) -> Tuple[jax.Array, KVCache]:
     """One forward step (prefill if T>1, decode if T==1).
 
@@ -203,7 +204,8 @@ def forward(
 
         k_page, v_page = write_kv_to_pages(k_page, v_page, k, v, positions, block_tables)
         attn = paged_attention(
-            q, k_page, v_page, block_tables, positions, soft_cap=soft_cap
+            q, k_page, v_page, block_tables, positions, soft_cap=soft_cap,
+            use_pallas=use_pallas,
         )
         attn = attn.reshape(b, t, c.q_dim) @ lp["wo"]
         hidden = hidden + attn

@@ -21,27 +21,16 @@ import jax
 import jax.numpy as jnp
 
 
-# Mosaic kernels have no GSPMD partitioning rule: when the KV cache is
-# sharded over a mesh the engine forces the jnp path (XLA partitions it)
-# until the shard_map-wrapped kernel variant lands.
-_FORCE_JNP = False
-
-
-def force_jnp_attention(value: bool) -> None:
-    global _FORCE_JNP
-    _FORCE_JNP = value
-    _use_pallas_decode.cache_clear()
-
-
 @lru_cache(maxsize=1)
 def _use_pallas_decode() -> bool:
     """Pallas decode kernel on TPU backends; jnp fallback elsewhere.
 
     DYN_TPU_ATTENTION=pallas|jnp overrides the autodetection (pallas also
-    works on CPU via the interpreter — slow, test-only).
+    works on CPU via the interpreter — slow, test-only). Callers that shard
+    the KV cache over a mesh pass ``use_pallas=False`` per call instead —
+    Mosaic kernels have no GSPMD partitioning rule, so XLA must partition
+    the jnp path there.
     """
-    if _FORCE_JNP:
-        return False
     mode = os.environ.get("DYN_TPU_ATTENTION", "auto")
     if mode == "pallas":
         return True
@@ -109,6 +98,7 @@ def paged_attention(
     *,
     scale: Optional[float] = None,
     soft_cap: Optional[float] = None,
+    use_pallas: Optional[bool] = None,
 ) -> jax.Array:
     """Causal attention of ``q`` against the paged context (reference impl).
 
@@ -126,7 +116,9 @@ def paged_attention(
     if scale is None:
         scale = d ** -0.5
 
-    if t == 1 and soft_cap is None and _use_pallas_decode():
+    if use_pallas is None:
+        use_pallas = _use_pallas_decode()
+    if t == 1 and soft_cap is None and use_pallas:
         from dynamo_tpu.ops.pallas.paged_attention import paged_attention_decode
 
         lengths = jnp.maximum(q_positions[:, 0] + 1, 0)  # padding (pos<0) → 0

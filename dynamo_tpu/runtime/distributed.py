@@ -230,12 +230,14 @@ class Endpoint:
         )
         keys = {self.instances_prefix + info.instance_id: info.to_json()}
         if model_entry is not None:
-            kind = model_entry.get("kind", "chat")
+            kinds = model_entry.get("kinds") or [model_entry.get("kind", "chat")]
             name = model_entry.get("name", "model")
-            entry = dict(model_entry, endpoint=self.path)
-            keys[f"{self.component.namespace.name}/models/{kind}/{name}"] = json.dumps(
-                entry
-            ).encode()
+            for kind in kinds:
+                entry = dict(model_entry, kind=kind, endpoint=self.path)
+                entry.pop("kinds", None)
+                keys[
+                    f"{self.component.namespace.name}/models/{kind}/{name}"
+                ] = json.dumps(entry).encode()
         for k, v in keys.items():
             await rt.store.put(k, v, lease=lease)
         self._leased_keys = keys  # add_leased_key extends this set

@@ -1,0 +1,228 @@
+"""Multi-process end-to-end test: real subprocesses, real sockets.
+
+Spawns the statestore, the message bus, echo workers (``in=dyn://``), and a
+discovery HTTP frontend (``in=http out=discover``) as separate OS processes,
+then drives the OpenAI API over HTTP. Catches serialization/lifecycle bugs
+that in-process tests can't (reference runs real etcd+nats subprocess
+fixtures, lib/bindings/python/tests/test_kv_bindings.py:39-60).
+
+Covers: streaming, non-streaming, live model discovery, cancellation
+(client disconnect mid-stream), and worker-death failover.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_port(port: int, timeout: float = 20.0) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=0.5):
+                return True
+        except OSError:
+            time.sleep(0.1)
+    return False
+
+
+def _spawn(args, env=None):
+    e = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    e.update(env or {})
+    return subprocess.Popen(
+        [sys.executable, *args],
+        env=e,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        cwd=REPO,
+    )
+
+
+def _http_json(url, payload=None, timeout=10.0):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data, headers={"content-type": "application/json"}
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _sse_lines(url, payload, timeout=15.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"content-type": "application/json"},
+    )
+    resp = urllib.request.urlopen(req, timeout=timeout)
+    out = []
+    for raw in resp:
+        line = raw.decode().strip()
+        if line.startswith("data: "):
+            out.append(line[len("data: "):])
+    resp.close()
+    return out
+
+
+@pytest.fixture(scope="class")
+def cluster(tmp_path_factory):
+    """statestore + bus + 2 echo workers + discovery frontend, all processes."""
+    from tests.fixtures import build_model_dir
+
+    model_dir = build_model_dir(str(tmp_path_factory.mktemp("model")))
+    ss_port, bus_port, http_port = _free_port(), _free_port(), _free_port()
+    ss_url = f"127.0.0.1:{ss_port}"
+    bus_url = f"127.0.0.1:{bus_port}"
+
+    procs = {}
+    procs["statestore"] = _spawn(
+        ["-m", "dynamo_tpu.runtime.statestore", "--host", "127.0.0.1", "--port", str(ss_port)]
+    )
+    procs["bus"] = _spawn(
+        ["-m", "dynamo_tpu.runtime.bus", "--host", "127.0.0.1", "--port", str(bus_port)]
+    )
+    assert _wait_port(ss_port) and _wait_port(bus_port), "infra didn't come up"
+
+    worker_args = [
+        "-m", "dynamo_tpu.cli.run", "in=dyn://dynamo.backend.generate",
+        "out=echo_core", "--model-path", model_dir, "--model-name", "parrot",
+        "--statestore", ss_url, "--bus", bus_url,
+    ]
+    procs["worker1"] = _spawn(worker_args, env={"DYN_TPU_TOKEN_ECHO_DELAY_MS": "1"})
+    procs["frontend"] = _spawn(
+        ["-m", "dynamo_tpu.cli.run", "in=http", "out=discover",
+         "--statestore", ss_url, "--bus", bus_url, "--port", str(http_port)]
+    )
+    assert _wait_port(http_port), "frontend didn't come up"
+
+    cluster = {
+        "procs": procs, "http": f"http://127.0.0.1:{http_port}",
+        "ss_url": ss_url, "bus_url": bus_url, "model_dir": model_dir,
+        "worker_args": worker_args,
+    }
+    yield cluster
+    for p in procs.values():
+        if p.poll() is None:
+            p.terminate()
+    for p in procs.values():
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+class TestMultiProcessE2E:
+    def _wait_model(self, base, name="parrot", timeout=30.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                models = _http_json(f"{base}/v1/models")
+                if any(m["id"] == name for m in models.get("data", [])):
+                    return True
+            except OSError:
+                pass
+            time.sleep(0.25)
+        return False
+
+    def test_model_discovered_and_streams(self, cluster):
+        base = cluster["http"]
+        assert self._wait_model(base), "worker model never appeared on frontend"
+
+        lines = _sse_lines(
+            f"{base}/v1/chat/completions",
+            {"model": "parrot", "stream": True,
+             "messages": [{"role": "user", "content": "hello world"}],
+             "max_tokens": 32},
+        )
+        assert lines and lines[-1] == "[DONE]"
+        text = "".join(
+            (c.get("delta") or {}).get("content", "")
+            for l in lines[:-1]
+            for c in json.loads(l).get("choices", [])
+        )
+        assert "hello" in text  # echo engine parrots the prompt back
+
+    def test_nonstreaming_fold(self, cluster):
+        base = cluster["http"]
+        assert self._wait_model(base)
+        resp = _http_json(
+            f"{base}/v1/chat/completions",
+            {"model": "parrot",
+             "messages": [{"role": "user", "content": "roundtrip"}],
+             "max_tokens": 16},
+        )
+        content = resp["choices"][0]["message"]["content"]
+        assert "roundtrip" in content
+
+    def test_client_disconnect_cancels(self, cluster):
+        """Closing the HTTP connection mid-stream must not wedge the worker:
+        a follow-up request on the same worker still completes."""
+        base = cluster["http"]
+        assert self._wait_model(base)
+        req = urllib.request.Request(
+            f"{base}/v1/chat/completions",
+            data=json.dumps({
+                "model": "parrot", "stream": True,
+                "messages": [{"role": "user", "content": "a " * 200}],
+                "max_tokens": 400,
+            }).encode(),
+            headers={"content-type": "application/json"},
+        )
+        resp = urllib.request.urlopen(req, timeout=10)
+        resp.read(64)  # first bytes only
+        resp.close()  # disconnect mid-stream
+
+        resp2 = _http_json(
+            f"{base}/v1/chat/completions",
+            {"model": "parrot",
+             "messages": [{"role": "user", "content": "still alive"}],
+             "max_tokens": 8},
+        )
+        assert resp2["choices"][0]["message"]["content"]
+
+    def test_worker_death_failover(self, cluster):
+        """Second worker joins; killing the first must leave service up
+        (requests route to the survivor after lease expiry)."""
+        base = cluster["http"]
+        assert self._wait_model(base)
+        procs = cluster["procs"]
+        procs["worker2"] = _spawn(
+            cluster["worker_args"], env={"DYN_TPU_TOKEN_ECHO_DELAY_MS": "1"}
+        )
+        time.sleep(2.0)  # let it register
+
+        procs["worker1"].send_signal(signal.SIGKILL)
+        procs["worker1"].wait(timeout=10)
+
+        # once lease expiry purges the dead instance, the survivor must serve
+        # EVERY request — require 3 consecutive successes inside the window
+        deadline = time.time() + 30.0
+        streak = 0
+        while time.time() < deadline and streak < 3:
+            try:
+                resp = _http_json(
+                    f"{base}/v1/chat/completions",
+                    {"model": "parrot",
+                     "messages": [{"role": "user", "content": "failover"}],
+                     "max_tokens": 8},
+                )
+                streak = streak + 1 if resp.get("choices") else 0
+            except Exception:
+                streak = 0
+                time.sleep(0.5)
+        assert streak >= 3, "survivor did not take over after worker death"

@@ -224,6 +224,38 @@ def test_warmup_compiles_before_serving(params, run):
         eng.close()
 
 
+def test_host_kv_tier_offload_and_rehit(params, run):
+    """Device eviction spills blocks to the host pool; re-sending the prompt
+    hits the host tier (device tier was overwritten) and produces exactly the
+    same tokens (corrupted re-injected KV would diverge from the reference)."""
+    cfg = EngineConfig(
+        max_slots=2, kv_block_size=8, max_model_len=64, num_kv_blocks=8,
+        prefill_chunk=16, host_cache_blocks=32,
+    )
+    eng = JaxServingEngine(CFG, params, cfg)
+    try:
+        prompt_a = [(3 * i + 1) % 100 for i in range(32)]  # 4 full blocks
+        prompt_b = [(5 * i + 2) % 100 for i in range(32)]  # evicts A's blocks
+
+        ref_a = reference_greedy(params, prompt_a, 4)
+        t1, _ = run(collect_tokens(eng, prompt_a, max_tokens=4))
+        assert t1 == ref_a
+
+        # B (plus its decode growth) forces A's cached blocks out of the
+        # 10-block device pool → offload to host
+        run(collect_tokens(eng, prompt_b, max_tokens=4))
+        assert eng.host_pool.offloaded > 0, "eviction must spill to host tier"
+
+        hits_before = eng.host_pool.hits
+        t2, _ = run(collect_tokens(eng, prompt_a, max_tokens=4))
+        assert eng.host_pool.hits > hits_before, "re-sent prompt must hit host tier"
+        assert t2 == ref_a
+        m = eng.metrics_snapshot()
+        assert m["host_cache_hits"] == eng.host_pool.hits
+    finally:
+        eng.close()
+
+
 def test_metrics_snapshot(engine, run):
     run(collect_tokens(engine, [1, 2, 3, 4], max_tokens=2))
     m = engine.metrics_snapshot()

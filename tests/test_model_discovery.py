@@ -1,0 +1,140 @@
+"""Frontend model discovery + llmctl registry control.
+
+The flagship scenario (reference discovery.rs behavior): frontend starts
+FIRST, worker starts second, the model appears on the running frontend
+without a restart; when the worker's lease dies the model disappears.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.llm.http.discovery import ModelWatcher
+from dynamo_tpu.llm.http.service import ModelManager
+from dynamo_tpu.runtime.annotated import Annotated
+from dynamo_tpu.runtime.bus import MessageBusServer
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.statestore import StateStoreClient, StateStoreServer
+
+
+class Parrot(AsyncEngine):
+    async def generate(self, request: Context):
+        yield Annotated.from_data({"echo": request.data.get("text", "")})
+
+
+async def _wait_for(cond, timeout=5.0):
+    for _ in range(int(timeout / 0.05)):
+        if cond():
+            return True
+        await asyncio.sleep(0.05)
+    return cond()
+
+
+class TestModelDiscovery:
+    def test_worker_model_appears_and_disappears_live(self, run):
+        async def go():
+            ss = StateStoreServer(port=0)
+            bus = MessageBusServer(port=0)
+            await ss.start()
+            await bus.start()
+
+            # frontend first: empty manager, watcher running
+            fe = await DistributedRuntime.create(ss.url, bus.url)
+            manager = ModelManager()
+            watcher = ModelWatcher(fe, "dynamo", manager)
+            watcher.start()
+            await asyncio.sleep(0.1)
+            assert manager.model_names() == []
+
+            # worker second
+            wk = await DistributedRuntime.create(ss.url, bus.url)
+            ep = wk.namespace("dynamo").component("backend").endpoint("generate")
+            await ep.component.create_service()
+            await ep.serve(
+                Parrot(), model_entry={"name": "tiny-llm", "kinds": ["chat", "completions"]}
+            )
+
+            ok = await _wait_for(lambda: "tiny-llm" in manager.model_names())
+            assert ok, "model did not appear on the running frontend"
+
+            # request flows end-to-end through the discovered client
+            engine = manager.chat_engine("tiny-llm")
+            items = [i async for i in engine.generate(Context({"text": "hi"}))]
+            assert any((i.data or {}).get("echo") == "hi" for i in items)
+
+            # worker death → lease expiry → model removed
+            await wk.shutdown()
+            ok = await _wait_for(
+                lambda: "tiny-llm" not in manager.model_names(), timeout=30.0
+            )
+            assert ok, "dead worker's model was not removed"
+
+            await watcher.close()
+            await fe.shutdown()
+            await ss.stop()
+            await bus.stop()
+
+        run(go())
+
+    def test_llmctl_add_list_remove(self, run):
+        async def go():
+            ss = StateStoreServer(port=0)
+            await ss.start()
+            from dynamo_tpu.cli.llmctl import amain
+
+            rc = await amain(
+                ["--statestore", ss.url, "http", "add", "chat-models",
+                 "manual", "dyn://dynamo.backend.generate"]
+            )
+            assert rc == 0
+            store = await StateStoreClient.connect(ss.url)
+            raw = await store.get("dynamo/models/chat/manual")
+            assert raw is not None
+            entry = json.loads(raw)
+            assert entry["endpoint"] == "dyn://dynamo.backend.generate"
+
+            rc = await amain(["--statestore", ss.url, "http", "list"])
+            assert rc == 0
+            rc = await amain(
+                ["--statestore", ss.url, "http", "remove", "chat-models", "manual"]
+            )
+            assert rc == 0
+            assert await store.get("dynamo/models/chat/manual") is None
+            rc = await amain(
+                ["--statestore", ss.url, "http", "remove", "chat-models", "manual"]
+            )
+            assert rc == 1  # already gone
+
+            await store.close()
+            await ss.stop()
+
+        run(go())
+
+    def test_llmctl_entry_feeds_watcher(self, run):
+        """An llmctl-registered (lease-less) entry reaches a watching frontend."""
+
+        async def go():
+            ss = StateStoreServer(port=0)
+            bus = MessageBusServer(port=0)
+            await ss.start()
+            await bus.start()
+            from dynamo_tpu.cli.llmctl import amain
+
+            await amain(
+                ["--statestore", ss.url, "http", "add", "chat-models",
+                 "byhand", "dyn://dynamo.backend.generate"]
+            )
+            fe = await DistributedRuntime.create(ss.url, bus.url)
+            manager = ModelManager()
+            watcher = ModelWatcher(fe, "dynamo", manager)
+            watcher.start()
+            ok = await _wait_for(lambda: "byhand" in manager.model_names())
+            assert ok
+            await watcher.close()
+            await fe.shutdown()
+            await ss.stop()
+            await bus.stop()
+
+        run(go())
