@@ -168,6 +168,48 @@ def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 # -- forward -----------------------------------------------------------------
 
+def decoder_layer(
+    lp: Params,  # one layer's params (leading layer axis removed)
+    config: LlamaConfig,
+    hidden: jax.Array,  # [B, T, E]
+    positions: jax.Array,  # [B, T]; < 0 = padding
+    k_page: jax.Array,  # this layer's page pool [N, bs, KVH, D]
+    v_page: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks]
+    *,
+    soft_cap: Optional[float] = None,
+    use_pallas: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decoder layer: returns (hidden, k_page, v_page).
+
+    Shared by the single-program scan in :func:`forward` and the
+    pipeline-parallel stage loop (parallel/pipeline.py)."""
+    from dynamo_tpu.ops.attention import paged_attention, write_kv_to_pages
+
+    c = config
+    b, t = positions.shape
+
+    x = rms_norm(hidden, lp["attn_norm"], c.rms_norm_eps)
+    q = (x @ lp["wq"]).reshape(b, t, c.num_heads, c.head_dim)
+    k = (x @ lp["wk"]).reshape(b, t, c.num_kv_heads, c.head_dim)
+    v = (x @ lp["wv"]).reshape(b, t, c.num_kv_heads, c.head_dim)
+    q = apply_rope(q, positions, c.rope_theta)
+    k = apply_rope(k, positions, c.rope_theta)
+
+    k_page, v_page = write_kv_to_pages(k_page, v_page, k, v, positions, block_tables)
+    attn = paged_attention(
+        q, k_page, v_page, block_tables, positions, soft_cap=soft_cap,
+        use_pallas=use_pallas,
+    )
+    attn = attn.reshape(b, t, c.q_dim) @ lp["wo"]
+    hidden = hidden + attn
+
+    x = rms_norm(hidden, lp["mlp_norm"], c.rms_norm_eps)
+    gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+    mlp = (gate * (x @ lp["w_up"])) @ lp["w_down"]
+    return hidden + mlp, k_page, v_page
+
+
 def forward(
     params: Params,
     config: LlamaConfig,
@@ -185,35 +227,15 @@ def forward(
     (logits [B, T, vocab] float32, updated cache). Single code path for
     prefill/decode/prefix-hit keeps everything static-shaped under jit.
     """
-    from dynamo_tpu.ops.attention import paged_attention, write_kv_to_pages
-
     c = config
-    b, t = tokens.shape
     h = params["embed"][jnp.clip(tokens, 0)]  # [B, T, E]
 
     def layer_body(carry, xs):
-        hidden = carry
         lp, k_page, v_page = xs  # layer params + this layer's page pool
-
-        x = rms_norm(hidden, lp["attn_norm"], c.rms_norm_eps)
-        q = (x @ lp["wq"]).reshape(b, t, c.num_heads, c.head_dim)
-        k = (x @ lp["wk"]).reshape(b, t, c.num_kv_heads, c.head_dim)
-        v = (x @ lp["wv"]).reshape(b, t, c.num_kv_heads, c.head_dim)
-        q = apply_rope(q, positions, c.rope_theta)
-        k = apply_rope(k, positions, c.rope_theta)
-
-        k_page, v_page = write_kv_to_pages(k_page, v_page, k, v, positions, block_tables)
-        attn = paged_attention(
-            q, k_page, v_page, block_tables, positions, soft_cap=soft_cap,
-            use_pallas=use_pallas,
+        hidden, k_page, v_page = decoder_layer(
+            lp, c, carry, positions, k_page, v_page, block_tables,
+            soft_cap=soft_cap, use_pallas=use_pallas,
         )
-        attn = attn.reshape(b, t, c.q_dim) @ lp["wo"]
-        hidden = hidden + attn
-
-        x = rms_norm(hidden, lp["mlp_norm"], c.rms_norm_eps)
-        gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-        mlp = (gate * (x @ lp["w_up"])) @ lp["w_down"]
-        hidden = hidden + mlp
         return hidden, (k_page, v_page)
 
     h, (new_k, new_v) = jax.lax.scan(

@@ -4,12 +4,12 @@ Axes (superset of the reference's capability; reference delegates TP/PP to
 engines, SURVEY.md §2.12 — here they are native):
 
 - ``dp``: data parallel — batch-slot axis of the continuous batcher
+- ``pp``: pipeline parallel — layer-stage axis (parallel/pipeline.py runs
+  GPipe-style microbatching over it with shard_map + ppermute)
 - ``tp``: tensor parallel — attention heads / MLP intermediate
 - ``sp``: sequence/context parallel — ring-attention axis for long context
-  (a TPU-native extension; the reference has none)
-
-Pipeline parallelism is expressed as a stage dimension over params plus
-`shard_map` ppermute microbatching (see parallel/pipeline.py).
+  (parallel/ring_attention.py; a TPU-native extension — the reference has
+  none, SURVEY.md §2.12)
 
 The design follows the standard JAX recipe: pick a mesh, annotate shardings
 with PartitionSpec, let XLA insert the collectives over ICI.
@@ -25,6 +25,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS_DP = "dp"
+AXIS_PP = "pp"
 AXIS_TP = "tp"
 AXIS_SP = "sp"
 
@@ -34,20 +35,21 @@ class MeshConfig:
     """Logical mesh shape. Total size must equal the number of devices used."""
 
     dp: int = 1
+    pp: int = 1
     tp: int = 1
     sp: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.tp * self.sp
+        return self.dp * self.pp * self.tp * self.sp
 
     @property
     def axis_names(self) -> Tuple[str, ...]:
-        return (AXIS_DP, AXIS_SP, AXIS_TP)
+        return (AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP)
 
     @property
     def shape(self) -> Tuple[int, ...]:
-        return (self.dp, self.sp, self.tp)
+        return (self.dp, self.pp, self.sp, self.tp)
 
 
 def make_mesh(config: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -70,6 +72,7 @@ def make_mesh(config: MeshConfig, devices: Optional[Sequence[jax.Device]] = None
 _LOGICAL_RULES = {
     "batch": AXIS_DP,
     "seq": AXIS_SP,
+    "layers": AXIS_PP,  # stacked layer axis → pipeline stages
     "heads": AXIS_TP,  # attention query heads
     "kv_heads": AXIS_TP,  # attention kv heads (GQA)
     "mlp": AXIS_TP,  # MLP intermediate dim

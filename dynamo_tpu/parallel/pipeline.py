@@ -1,0 +1,138 @@
+"""Pipeline parallelism: GPipe-style microbatching over the ``pp`` mesh axis.
+
+Each pipeline rank holds a contiguous slice of the stacked layer params and
+of the paged KV pool's layer axis. The batch is split into microbatches;
+activations flow rank→rank over ICI via ``lax.ppermute`` inside a
+``shard_map``, with the classic M + S − 1 tick schedule (M microbatches,
+S stages). Embedding and the LM head run replicated outside the pipelined
+region.
+
+The reference delegates PP to its engines and disables it for disagg
+(SURVEY.md §2.12, `examples/llm/components/worker.py:82-84`); here it is a
+first-class mesh axis like the rest of the parallelism stack. Invalid ticks
+(pipeline fill/drain) mask their positions to −1 so they can never scatter
+garbage into the KV pool.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dynamo_tpu.models.llama import KVCache, LlamaConfig, Params, decoder_layer, rms_norm
+from dynamo_tpu.parallel.mesh import AXIS_PP
+
+
+def pipeline_forward(
+    params: Params,
+    config: LlamaConfig,
+    tokens: jax.Array,  # [B, T] int32
+    positions: jax.Array,  # [B, T]; < 0 = padding
+    kv_cache: KVCache,  # {"k","v"}: [L, N, bs, KVH, D]
+    block_tables: jax.Array,  # [B, max_blocks]
+    mesh: Mesh,
+    *,
+    num_microbatches: Optional[int] = None,
+    soft_cap: Optional[float] = None,
+    use_pallas: Optional[bool] = None,
+) -> Tuple[jax.Array, KVCache]:
+    """Pipelined equivalent of models/llama.forward (same contract).
+
+    Requires ``config.num_layers % pp == 0`` and ``B % num_microbatches == 0``.
+    Under jit, place params["layers"] leaves and the cache with
+    ``NamedSharding(mesh, P("pp"))`` so each rank materializes only its stage.
+    """
+    S = mesh.shape[AXIS_PP]
+    L = config.num_layers
+    if L % S != 0:
+        raise ValueError(f"num_layers {L} not divisible by pp {S}")
+    b, t = tokens.shape
+    M = num_microbatches or S
+    if b % M != 0:
+        raise ValueError(f"batch {b} not divisible by microbatches {M}")
+    mb = b // M
+
+    h = params["embed"][jnp.clip(tokens, 0)]  # [B, T, E] replicated
+    # microbatch-major stacking: [M, mb, ...]
+    h_mb = h.reshape(M, mb, t, -1)
+    pos_mb = positions.reshape(M, mb, t)
+    tab_mb = block_tables.reshape(M, mb, -1)
+
+    layer_specs = jax.tree.map(lambda _: P(AXIS_PP), params["layers"])
+    in_specs = (layer_specs, P(AXIS_PP), P(AXIS_PP), P(), P(), P())
+    out_specs = (P(), P(AXIS_PP), P(AXIS_PP))
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False,
+    )
+    def pipelined(layers, k_pages, v_pages, h_mb, pos_mb, tab_mb):
+        # local shapes: layers [L/S, ...]; k_pages/v_pages [L/S, N, bs, KVH, D]
+        rank = jax.lax.axis_index(AXIS_PP)
+        n_ticks = M + S - 1
+
+        def run_stage(act, pos, tab, k_pages, v_pages):
+            def body(carry, xs):
+                hidden = carry
+                lp, kp, vp = xs
+                hidden, kp, vp = decoder_layer(
+                    lp, config, hidden, pos, kp, vp, tab,
+                    soft_cap=soft_cap, use_pallas=use_pallas,
+                )
+                return hidden, (kp, vp)
+
+            act, (k_pages, v_pages) = jax.lax.scan(
+                body, act, (layers, k_pages, v_pages)
+            )
+            return act, k_pages, v_pages
+
+        def tick(carry, tick_idx):
+            state, k_pages, v_pages, outputs = carry
+            # microbatch index this rank works on at this tick
+            m = tick_idx - rank
+            valid = (m >= 0) & (m < M)
+            m_idx = jnp.clip(m, 0, M - 1)
+            # stage 0 ingests a fresh microbatch; later stages use what the
+            # previous rank sent last tick
+            act = jnp.where(rank == 0, h_mb[m_idx], state)
+            pos = pos_mb[m_idx]
+            tab = tab_mb[m_idx]
+            # fill/drain ticks must not scatter into the KV pool
+            pos = jnp.where(valid, pos, -1)
+            act, k_pages, v_pages = run_stage(act, pos, tab, k_pages, v_pages)
+            # last rank records its finished microbatch
+            take = (rank == S - 1) & valid
+            outputs = jnp.where(
+                take, outputs.at[m_idx].set(act), outputs
+            )
+            # shift activations one rank forward (ring; wraparound ignored
+            # because stage 0 always overwrites with a fresh microbatch)
+            state = jax.lax.ppermute(
+                act, AXIS_PP, [(i, (i + 1) % S) for i in range(S)]
+            )
+            return (state, k_pages, v_pages, outputs), None
+
+        state0 = jnp.zeros_like(h_mb[0])
+        outputs0 = jnp.zeros_like(h_mb)
+        (_, k_pages, v_pages, outputs), _ = jax.lax.scan(
+            tick, (state0, k_pages, v_pages, outputs0), jnp.arange(M + S - 1)
+        )
+        # outputs live on the last rank only; broadcast to all
+        outputs = jax.lax.psum(
+            jnp.where(rank == S - 1, outputs, jnp.zeros_like(outputs)), AXIS_PP
+        )
+        return outputs, k_pages, v_pages
+
+    out_mb, new_k, new_v = pipelined(
+        params["layers"], kv_cache["k"], kv_cache["v"], h_mb, pos_mb, tab_mb
+    )
+    h = out_mb.reshape(b, t, -1)
+    h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    logits = (h @ head).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}

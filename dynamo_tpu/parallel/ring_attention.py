@@ -1,0 +1,128 @@
+"""Ring attention: causal self-attention over the ``sp`` mesh axis.
+
+Long-context prefill splits the sequence across sp ranks; each rank holds a
+contiguous Q/K/V shard. K/V shards rotate around the ring via
+``lax.ppermute`` while every rank accumulates flash-style online-softmax
+partials of its local queries against each visiting K/V shard — full
+attention without any rank ever materializing the whole sequence, and with
+the K/V transfer overlapping compute on ICI.
+
+The reference has NO sequence/context parallelism (SURVEY.md §2.12 calls it
+absent and asks the TPU build to design it natively); this module is that
+extension. Causality is enforced with global positions, so it composes with
+the paged-KV layout (ragged shards mask with position −1).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dynamo_tpu.parallel.mesh import AXIS_SP
+
+
+def _block_attend(q, k, v, q_pos, kv_pos, scale):
+    """Partial (unnormalized-softmax) attention of q against one K/V block.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, KVH, D]. Returns (numerator [B,Tq,H,D]
+    f32, running max [B,H,Tq] f32, denom [B,H,Tq] f32) for online-softmax
+    merging across blocks.
+    """
+    b, tq, h, d = q.shape
+    kvh = k.shape[2]
+    g = h // kvh
+    qg = q.reshape(b, tq, kvh, g, d)
+    scores = jnp.einsum(
+        "btngd,bsnd->bngts", qg, k, preferred_element_type=jnp.float32
+    ) * scale  # [B, KVH, G, Tq, Tk]
+    causal = kv_pos[:, None, None, None, :] <= q_pos[:, None, None, :, None]
+    valid = (q_pos >= 0)[:, None, None, :, None] & (kv_pos >= 0)[:, None, None, None, :]
+    scores = jnp.where(causal & valid, scores, -jnp.inf)
+
+    m = scores.max(axis=-1)  # [B, KVH, G, Tq]
+    # all-masked rows: keep m finite so exp() can't produce NaN
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(scores - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    denom = p.sum(axis=-1)  # [B, KVH, G, Tq]
+    num = jnp.einsum("bngts,bsnd->btngd", p, v.astype(jnp.float32))
+    return (
+        num.reshape(b, tq, h, d),
+        m_safe.reshape(b, kvh * g, tq),
+        denom.reshape(b, kvh * g, tq),
+        jnp.isfinite(m).reshape(b, kvh * g, tq),
+    )
+
+
+def ring_attention(
+    q: jax.Array,  # [B, T_local, H, D] — this rank's query shard
+    k: jax.Array,  # [B, T_local, KVH, D]
+    v: jax.Array,
+    q_positions: jax.Array,  # [B, T_local] global positions; < 0 = padding
+    kv_positions: jax.Array,  # [B, T_local]
+    mesh: Mesh,
+    *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact causal attention with Q/K/V sharded over sp. Returns q's dtype."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = d ** -0.5
+    sp = mesh.shape[AXIS_SP]
+
+    spec = P(None, AXIS_SP)
+    qspec = P(None, AXIS_SP, None, None)
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(qspec, qspec, qspec, spec, spec),
+        out_specs=qspec, check_vma=False,
+    )
+    def ring(q, k, v, q_pos, kv_pos):
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+        def step(carry, step_idx):
+            k_cur, v_cur, pos_cur, num, m, den, seen = carry
+            bnum, bm, bden, bvalid = _block_attend(q, k_cur, v_cur, q_pos, pos_cur, scale)
+            # online-softmax merge of (num, m, den) with the new block
+            m_new = jnp.where(bvalid, jnp.maximum(m, bm), m)
+            a_old = jnp.exp(m - m_new)
+            a_new = jnp.where(bvalid, jnp.exp(bm - m_new), 0.0)
+            num = num * a_old.transpose(0, 2, 1)[..., None] + bnum * a_new.transpose(0, 2, 1)[..., None]
+            den = den * a_old + bden * a_new
+            seen = seen | bvalid
+            # last step's rotation would only be thrown away — skip the
+            # ring hop (the largest ICI transfer in the loop)
+            def rotate(args):
+                k_cur, v_cur, pos_cur = args
+                return (
+                    jax.lax.ppermute(k_cur, AXIS_SP, perm),
+                    jax.lax.ppermute(v_cur, AXIS_SP, perm),
+                    jax.lax.ppermute(pos_cur, AXIS_SP, perm),
+                )
+
+            k_nxt, v_nxt, p_nxt = jax.lax.cond(
+                step_idx < sp - 1, rotate, lambda a: a, (k_cur, v_cur, pos_cur)
+            )
+            return (k_nxt, v_nxt, p_nxt, num, m_new, den, seen), None
+
+        b, tq, h, _ = q.shape
+        num0 = jnp.zeros((b, tq, h, d), jnp.float32)
+        # exp(-inf - m_new) = nan when m_new is also -inf: start the running
+        # max at a huge negative finite value instead
+        m0 = jnp.full((b, h, tq), -1e30, jnp.float32)
+        den0 = jnp.zeros((b, h, tq), jnp.float32)
+        seen0 = jnp.zeros((b, h, tq), bool)
+        (_, _, _, num, m, den, seen), _ = jax.lax.scan(
+            step, (k, v, kv_pos, num0, m0, den0, seen0), jnp.arange(sp)
+        )
+        den = jnp.where(seen, den, 1.0)  # padding queries → zeros
+        out = num / den.transpose(0, 2, 1)[..., None]
+        return out.astype(q.dtype)
+
+    return ring(q, k, v, q_positions, kv_positions)
