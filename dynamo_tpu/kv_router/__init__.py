@@ -16,7 +16,13 @@ from dynamo_tpu.kv_router.protocols import (
     StoredBlock,
     StoredBlocks,
 )
-from dynamo_tpu.kv_router.indexer import KvIndexer, OverlapScores, RadixTree
+from dynamo_tpu.kv_router.indexer import (
+    KvIndexer,
+    NativeKvIndexer,
+    OverlapScores,
+    RadixTree,
+    make_indexer,
+)
 from dynamo_tpu.kv_router.scheduler import DefaultWorkerSelector, KvScheduler, WorkerSelector
 from dynamo_tpu.kv_router.router import KvRouter
 from dynamo_tpu.kv_router.recorder import KvRecorder
@@ -30,6 +36,8 @@ __all__ = [
     "StoredBlock",
     "StoredBlocks",
     "KvIndexer",
+    "NativeKvIndexer",
+    "make_indexer",
     "OverlapScores",
     "RadixTree",
     "DefaultWorkerSelector",

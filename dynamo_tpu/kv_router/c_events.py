@@ -1,0 +1,130 @@
+"""ctypes wrapper over the C ABI KV-event publisher (native/kv_events.cc).
+
+This is how a non-Python engine integrates with KV-aware routing: it links
+the tiny C library, calls ``dyn_kv_event_publish_stored/removed`` as blocks
+are cached/evicted, and the host process drains the queue and forwards the
+RouterEvent JSON to the event plane. The wrapper also implements the
+allocator's KvEventSink protocol so the same code path is exercised by the
+in-tree engine and tests.
+
+Reference counterpart: `lib/bindings/c/src/lib.rs:51-342`
+(dynamo_llm_init + dynamo_kv_event_publish_*), consumed by the patched
+vLLM's KVCacheEventManager via ctypes (SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import threading
+from typing import Callable, Iterator, List, Optional, Tuple
+
+from dynamo_tpu.kv.tokens import compute_local_block_hash
+from dynamo_tpu.kv_router.protocols import RouterEvent
+
+
+class CKvEventPublisher:
+    """KvEventSink over the native queue; drain() yields RouterEvents."""
+
+    def __init__(self, worker_id: str, lib=None):
+        if lib is None:
+            from dynamo_tpu import native
+
+            lib = native.load("kv_events")
+            if lib is None:
+                raise RuntimeError("native kv_events library unavailable")
+        self._lib = lib
+        self._configure(lib)
+        self._pub = lib.dyn_kv_publisher_create(worker_id.encode())
+        self._event_id = 0
+        self._lock = threading.Lock()
+        self._buf = ctypes.create_string_buffer(1 << 16)
+
+    @staticmethod
+    def _configure(lib) -> None:
+        if getattr(lib, "_dyn_kv_configured", False):
+            return
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.dyn_kv_publisher_create.argtypes = [ctypes.c_char_p]
+        lib.dyn_kv_publisher_create.restype = ctypes.c_void_p
+        lib.dyn_kv_publisher_destroy.argtypes = [ctypes.c_void_p]
+        lib.dyn_kv_publisher_dropped.argtypes = [ctypes.c_void_p]
+        lib.dyn_kv_publisher_dropped.restype = ctypes.c_uint64
+        lib.dyn_kv_event_publish_stored.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_uint64,
+            u64p, u64p, ctypes.c_size_t,
+        ]
+        lib.dyn_kv_event_publish_stored.restype = ctypes.c_int
+        lib.dyn_kv_event_publish_removed.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, u64p, ctypes.c_size_t,
+        ]
+        lib.dyn_kv_event_publish_removed.restype = ctypes.c_int
+        lib.dyn_kv_drain_one.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        lib.dyn_kv_drain_one.restype = ctypes.c_long
+        lib._dyn_kv_configured = True
+
+    def close(self) -> None:
+        if self._pub:
+            self._lib.dyn_kv_publisher_destroy(self._pub)
+            self._pub = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _handle(self):
+        if not self._pub:
+            raise RuntimeError("CKvEventPublisher used after close()")
+        return self._pub
+
+    @property
+    def dropped(self) -> int:
+        return int(self._lib.dyn_kv_publisher_dropped(self._handle()))
+
+    # -- KvEventSink protocol -------------------------------------------------
+
+    def blocks_stored(
+        self, parent_hash: Optional[int], blocks: List[Tuple[int, List[int]]]
+    ) -> None:
+        n = len(blocks)
+        bh = (ctypes.c_uint64 * n)()
+        th = (ctypes.c_uint64 * n)()
+        for i, (h, tokens) in enumerate(blocks):
+            bh[i] = h & 0xFFFFFFFFFFFFFFFF
+            th[i] = compute_local_block_hash(tokens) & 0xFFFFFFFFFFFFFFFF
+        with self._lock:
+            eid = self._event_id
+            self._event_id += 1
+            self._lib.dyn_kv_event_publish_stored(
+                self._handle(), eid, int(parent_hash is not None),
+                (parent_hash or 0) & 0xFFFFFFFFFFFFFFFF, bh, th, n,
+            )
+
+    def blocks_removed(self, block_hashes: List[int]) -> None:
+        n = len(block_hashes)
+        arr = (ctypes.c_uint64 * n)()
+        for i, h in enumerate(block_hashes):
+            arr[i] = h & 0xFFFFFFFFFFFFFFFF
+        with self._lock:
+            eid = self._event_id
+            self._event_id += 1
+            self._lib.dyn_kv_event_publish_removed(self._handle(), eid, arr, n)
+
+    # -- host-side drain ------------------------------------------------------
+
+    def drain(self) -> Iterator[RouterEvent]:
+        """Pop all queued events (host side, any thread)."""
+        while True:
+            with self._lock:
+                n = self._lib.dyn_kv_drain_one(self._handle(), self._buf, len(self._buf))
+                if n < 0:  # grow and retry
+                    self._buf = ctypes.create_string_buffer(-n)
+                    continue
+                if n == 0:
+                    return
+                raw = self._buf.raw[:n]
+            yield RouterEvent.from_dict(json.loads(raw))

@@ -5,13 +5,16 @@ that block. `find_matches` walks a request's hash chain from the root and
 scores each worker by the length of its *contiguous* cached prefix.
 
 Capability parity with the reference's RadixTree/KvIndexer
-(kv_router/indexer.rs:239-677) — re-designed: plain single-threaded Python
-guarded by a lock (the reference pins a tree to a dedicated runtime thread;
-the native C++ tree in native/ is the perf path, this is the portable one).
+(kv_router/indexer.rs:239-677). Two implementations with one interface:
+the C++ tree (native/radix_tree.cc, ctypes, the perf path — mirroring the
+reference's native/Python split) selected by ``make_indexer()`` when the
+toolchain is available, and this portable lock-guarded Python tree.
+Differential-tested against each other in tests/test_native.py.
 """
 
 from __future__ import annotations
 
+import ctypes
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence
 
@@ -171,3 +174,138 @@ class KvIndexer:
     @property
     def event_count(self) -> int:
         return self._tree.event_count
+
+
+class NativeKvIndexer:
+    """KvIndexer backed by the C++ radix tree (native/radix_tree.cc).
+
+    Same interface and semantics as :class:`KvIndexer`; worker-id strings
+    are interned to uint64 handles for the C ABI.
+    """
+
+    MAX_WORKERS_OUT = 4096
+
+    def __init__(self, lib, block_size: int, salt: Optional[bytes] = None):
+        self.block_size = block_size
+        self.salt = salt
+        self._lib = lib
+        self._configure(lib)
+        self._tree = lib.dyn_radix_create()
+        self._lock = threading.Lock()
+        self._worker_to_id: Dict[str, int] = {}
+        self._id_to_worker: Dict[int, str] = {}
+        self._out_workers = (ctypes.c_uint64 * self.MAX_WORKERS_OUT)()
+        self._out_scores = (ctypes.c_uint32 * self.MAX_WORKERS_OUT)()
+
+    @staticmethod
+    def _configure(lib) -> None:
+        if getattr(lib, "_dyn_radix_configured", False):
+            return
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.dyn_radix_create.restype = ctypes.c_void_p
+        lib.dyn_radix_destroy.argtypes = [ctypes.c_void_p]
+        lib.dyn_radix_event_count.argtypes = [ctypes.c_void_p]
+        lib.dyn_radix_event_count.restype = ctypes.c_uint64
+        lib.dyn_radix_apply_stored.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64, u64p,
+            ctypes.c_size_t, ctypes.c_uint64,
+        ]
+        lib.dyn_radix_apply_removed.argtypes = [
+            ctypes.c_void_p, u64p, ctypes.c_size_t, ctypes.c_uint64,
+        ]
+        lib.dyn_radix_remove_worker.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.dyn_radix_find_matches.argtypes = [
+            ctypes.c_void_p, u64p, ctypes.c_size_t, u64p,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+        ]
+        lib.dyn_radix_find_matches.restype = ctypes.c_size_t
+        lib._dyn_radix_configured = True
+
+    def __del__(self):
+        tree = getattr(self, "_tree", None)
+        if tree:
+            self._lib.dyn_radix_destroy(tree)
+            self._tree = None
+
+    def _intern(self, worker: str) -> int:
+        wid = self._worker_to_id.get(worker)
+        if wid is None:
+            wid = len(self._worker_to_id) + 1
+            self._worker_to_id[worker] = wid
+            self._id_to_worker[wid] = worker
+        return wid
+
+    @staticmethod
+    def _hash_array(hashes: Sequence[int]):
+        n = len(hashes)
+        arr = (ctypes.c_uint64 * n)()
+        for i, h in enumerate(hashes):
+            arr[i] = h & 0xFFFFFFFFFFFFFFFF
+        return arr, n
+
+    def apply_event(self, event: RouterEvent) -> None:
+        with self._lock:
+            self._apply_locked(event)
+
+    def apply_events(self, events: Iterable[RouterEvent]) -> None:
+        with self._lock:
+            for e in events:
+                self._apply_locked(e)
+
+    def _apply_locked(self, event: RouterEvent) -> None:
+        data = event.event.data
+        wid = self._intern(event.worker_id)
+        if isinstance(data, StoredBlocks):
+            arr, n = self._hash_array([b.block_hash for b in data.blocks])
+            parent = data.parent_hash
+            self._lib.dyn_radix_apply_stored(
+                self._tree, int(parent is not None),
+                (parent or 0) & 0xFFFFFFFFFFFFFFFF, arr, n, wid,
+            )
+        elif isinstance(data, RemovedBlocks):
+            arr, n = self._hash_array(data.block_hashes)
+            self._lib.dyn_radix_apply_removed(self._tree, arr, n, wid)
+
+    def remove_worker(self, worker: str) -> None:
+        with self._lock:
+            wid = self._worker_to_id.get(worker)
+            if wid is not None:
+                self._lib.dyn_radix_remove_worker(self._tree, wid)
+
+    def find_matches(self, sequence_hashes: Sequence[int]) -> OverlapScores:
+        with self._lock:
+            arr, n = self._hash_array(sequence_hashes)
+            while True:
+                cap = len(self._out_workers)
+                k = self._lib.dyn_radix_find_matches(
+                    self._tree, arr, n, self._out_workers, self._out_scores, cap
+                )
+                if k < cap:
+                    break
+                # possibly truncated (>= cap workers share the prefix): grow
+                # the output buffers and re-probe so no worker is dropped
+                self._out_workers = (ctypes.c_uint64 * (cap * 2))()
+                self._out_scores = (ctypes.c_uint32 * (cap * 2))()
+            return {
+                self._id_to_worker[self._out_workers[i]]: int(self._out_scores[i])
+                for i in range(k)
+            }
+
+    def find_matches_for_request(self, token_ids: Sequence[int]) -> OverlapScores:
+        hashes = compute_block_hashes_for_seq(token_ids, self.block_size, self.salt)
+        return self.find_matches(hashes)
+
+    @property
+    def event_count(self) -> int:
+        return int(self._lib.dyn_radix_event_count(self._tree))
+
+
+def make_indexer(block_size: int, salt: Optional[bytes] = None):
+    """The framework's indexer factory: C++ tree when buildable, else the
+    portable Python tree (interfaces are identical)."""
+    from dynamo_tpu import native
+
+    lib = native.load("radix_tree")
+    if lib is not None:
+        return NativeKvIndexer(lib, block_size, salt)
+    return KvIndexer(block_size, salt)

@@ -12,7 +12,7 @@ from __future__ import annotations
 import logging
 from typing import Optional, Sequence
 
-from dynamo_tpu.kv_router.indexer import KvIndexer
+from dynamo_tpu.kv_router.indexer import KvIndexer, make_indexer
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, RouterEvent
 from dynamo_tpu.kv_router.scheduler import (
     KvScheduler,
@@ -31,7 +31,8 @@ class KvRouter:
         salt: Optional[bytes] = None,
     ):
         self.block_size = block_size
-        self.indexer = KvIndexer(block_size, salt=salt)
+        # C++ tree when the toolchain is available, Python tree otherwise
+        self.indexer = make_indexer(block_size, salt=salt)
         self.scheduler = KvScheduler(selector)
 
     # -- event/metrics ingestion (wired to transports by the runtime layer) --

@@ -1,0 +1,97 @@
+// Two-part framed wire codec, C ABI — so native engines/components speak
+// the framework's wire format without Python. Frame layout matches
+// runtime/codec.py exactly (differential-tested):
+//
+//   [8B LE header_len][8B LE body_len][8B LE crc32(header||body)][header][body]
+//
+// Counterpart of the reference's TwoPartCodec
+// (lib/runtime/src/pipeline/network/codec/two_part.rs, 750 LoC), which its
+// Rust runtime uses for every RPC frame.
+
+#include <cstdint>
+#include <cstddef>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t kMaxHeader = 16ull * 1024 * 1024;
+constexpr uint64_t kMaxBody = 1024ull * 1024 * 1024;
+constexpr size_t kPrelude = 24;
+
+// CRC-32 (ISO-HDLC, same as zlib.crc32): poly 0xEDB88320 reflected,
+// init/xorout 0xFFFFFFFF.
+struct CrcTable {
+  uint32_t t[256];
+  CrcTable() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+const CrcTable kCrc;
+
+uint32_t crc32_update(uint32_t crc, const uint8_t* p, size_t n) {
+  crc = ~crc;
+  for (size_t i = 0; i < n; i++) crc = kCrc.t[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+void put_le64(uint8_t* p, uint64_t v) {
+  for (int i = 0; i < 8; i++) p[i] = static_cast<uint8_t>(v >> (8 * i));
+}
+
+uint64_t get_le64(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; i++) v |= static_cast<uint64_t>(p[i]) << (8 * i);
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t dyn_codec_crc32(const uint8_t* header, size_t hlen,
+                         const uint8_t* body, size_t blen) {
+  uint32_t c = crc32_update(0, header, hlen);
+  return crc32_update(c, body, blen);
+}
+
+// Encode one frame into out. Returns the total frame size, or a negative
+// value: -1 = size limits exceeded, -(needed) if cap is too small.
+long dyn_codec_encode(const uint8_t* header, size_t hlen, const uint8_t* body,
+                      size_t blen, uint8_t* out, size_t cap) {
+  if (hlen > kMaxHeader || blen > kMaxBody) return -1;
+  size_t total = kPrelude + hlen + blen;
+  if (cap < total) return -static_cast<long>(total);
+  put_le64(out, hlen);
+  put_le64(out + 8, blen);
+  put_le64(out + 16, dyn_codec_crc32(header, hlen, body, blen));
+  std::memcpy(out + kPrelude, header, hlen);
+  std::memcpy(out + kPrelude + hlen, body, blen);
+  return static_cast<long>(total);
+}
+
+// Parse + validate a frame in buf. On success returns the total frame size
+// and writes header/body offsets+lengths. Returns 0 if more bytes are
+// needed, -1 on size-limit violation, -2 on checksum mismatch.
+long dyn_codec_decode(const uint8_t* buf, size_t len, size_t* header_off,
+                      size_t* header_len, size_t* body_off, size_t* body_len) {
+  if (len < kPrelude) return 0;
+  uint64_t hlen = get_le64(buf);
+  uint64_t blen = get_le64(buf + 8);
+  uint64_t csum = get_le64(buf + 16);
+  if (hlen > kMaxHeader || blen > kMaxBody) return -1;
+  uint64_t total = kPrelude + hlen + blen;
+  if (len < total) return 0;
+  if (dyn_codec_crc32(buf + kPrelude, hlen, buf + kPrelude + hlen, blen) != csum)
+    return -2;
+  *header_off = kPrelude;
+  *header_len = hlen;
+  *body_off = kPrelude + hlen;
+  *body_len = blen;
+  return static_cast<long>(total);
+}
+
+}  // extern "C"

@@ -1,0 +1,158 @@
+// C ABI KV-event publisher: engines written in any language publish KV
+// block stored/removed events without touching Python. Events queue inside
+// the library as RouterEvent JSON lines (the framework's wire format,
+// kv_router/protocols.py); the host process drains them and forwards to
+// the event plane.
+//
+// Counterpart of the reference's C bindings, which patched engines consume
+// via ctypes (lib/bindings/c/src/lib.rs:51-342:
+// dynamo_llm_init / dynamo_kv_event_publish_stored / _removed). Same shape:
+// opaque handle + stored/removed publish calls + shutdown; the transport
+// differs (drain-to-host vs embedded runtime) because the event plane here
+// is the framework's own bus.
+
+#include <cstdint>
+#include <cstddef>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct Publisher {
+  std::string worker_id;
+  std::mutex mu;
+  std::deque<std::string> queue;
+  uint64_t dropped = 0;
+  size_t max_queue = 65536;
+};
+
+void append_u64_json(std::string& out, uint64_t v) { out += std::to_string(v); }
+
+// JSON string escaping for the worker id (quotes, backslashes, control
+// chars) — ids are caller-provided and must never corrupt the event stream.
+std::string json_escape(const char* s) {
+  std::string out;
+  for (const char* p = s; *p; p++) {
+    unsigned char c = static_cast<unsigned char>(*p);
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += static_cast<char>(c);
+        }
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* dyn_kv_publisher_create(const char* worker_id) {
+  Publisher* p = new Publisher();
+  p->worker_id = json_escape(worker_id ? worker_id : "");
+  return p;
+}
+
+void dyn_kv_publisher_destroy(void* pp) { delete static_cast<Publisher*>(pp); }
+
+uint64_t dyn_kv_publisher_dropped(void* pp) {
+  Publisher* p = static_cast<Publisher*>(pp);
+  std::lock_guard<std::mutex> g(p->mu);
+  return p->dropped;
+}
+
+// blocks: block_hashes[i] is the chained sequence hash, tokens_hashes[i]
+// the content-only hash. has_parent/parent_hash describe the chain link.
+// Returns 0 on success, -1 if the queue is full (event dropped + counted).
+int dyn_kv_event_publish_stored(void* pp, uint64_t event_id, int has_parent,
+                                uint64_t parent_hash,
+                                const uint64_t* block_hashes,
+                                const uint64_t* tokens_hashes,
+                                size_t num_blocks) {
+  Publisher* p = static_cast<Publisher*>(pp);
+  std::string j;
+  j.reserve(96 + 48 * num_blocks);
+  j += "{\"worker_id\":\"";
+  j += p->worker_id;
+  j += "\",\"event\":{\"event_id\":";
+  append_u64_json(j, event_id);
+  j += ",\"data\":{\"type\":\"stored\",\"parent_hash\":";
+  if (has_parent) {
+    append_u64_json(j, parent_hash);
+  } else {
+    j += "null";
+  }
+  j += ",\"blocks\":[";
+  for (size_t i = 0; i < num_blocks; i++) {
+    if (i) j += ",";
+    j += "{\"block_hash\":";
+    append_u64_json(j, block_hashes[i]);
+    j += ",\"tokens_hash\":";
+    append_u64_json(j, tokens_hashes ? tokens_hashes[i] : 0);
+    j += "}";
+  }
+  j += "]}}}";
+  std::lock_guard<std::mutex> g(p->mu);
+  if (p->queue.size() >= p->max_queue) {
+    p->dropped++;
+    return -1;
+  }
+  p->queue.push_back(std::move(j));
+  return 0;
+}
+
+int dyn_kv_event_publish_removed(void* pp, uint64_t event_id,
+                                 const uint64_t* block_hashes,
+                                 size_t num_blocks) {
+  Publisher* p = static_cast<Publisher*>(pp);
+  std::string j;
+  j.reserve(96 + 24 * num_blocks);
+  j += "{\"worker_id\":\"";
+  j += p->worker_id;
+  j += "\",\"event\":{\"event_id\":";
+  append_u64_json(j, event_id);
+  j += ",\"data\":{\"type\":\"removed\",\"block_hashes\":[";
+  for (size_t i = 0; i < num_blocks; i++) {
+    if (i) j += ",";
+    append_u64_json(j, block_hashes[i]);
+  }
+  j += "]}}}";
+  std::lock_guard<std::mutex> g(p->mu);
+  if (p->queue.size() >= p->max_queue) {
+    p->dropped++;
+    return -1;
+  }
+  p->queue.push_back(std::move(j));
+  return 0;
+}
+
+// Pop one queued event into buf (NUL-terminated). Returns the JSON length,
+// 0 when the queue is empty, or -(needed size) when cap is too small (the
+// event stays queued; call again with a bigger buffer).
+long dyn_kv_drain_one(void* pp, char* buf, size_t cap) {
+  Publisher* p = static_cast<Publisher*>(pp);
+  std::lock_guard<std::mutex> g(p->mu);
+  if (p->queue.empty()) return 0;
+  const std::string& s = p->queue.front();
+  if (s.size() + 1 > cap) return -static_cast<long>(s.size() + 1);
+  std::memcpy(buf, s.data(), s.size());
+  buf[s.size()] = '\0';
+  long n = static_cast<long>(s.size());
+  p->queue.pop_front();
+  return n;
+}
+
+}  // extern "C"

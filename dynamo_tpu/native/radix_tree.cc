@@ -1,0 +1,177 @@
+// Radix (prefix) tree over chained KV block hashes — the KV router's hot
+// data structure. C ABI consumed via ctypes (kv_router/indexer.py
+// NativeKvIndexer). Semantics mirror the portable Python RadixTree exactly
+// (differential-tested); the reference's equivalent is the Rust tree in
+// lib/llm/src/kv_router/indexer.rs:239-677.
+//
+// Worker identity is a caller-interned uint64 handle (the Python wrapper
+// maps worker-id strings <-> handles). Single-writer: the caller holds a
+// lock around mutations, as the Python wrapper does.
+
+#include <cstdint>
+#include <cstddef>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Node {
+  uint64_t block_hash = 0;
+  Node* parent = nullptr;
+  std::unordered_map<uint64_t, Node*> children;
+  std::unordered_set<uint64_t> workers;
+};
+
+struct Tree {
+  Node root;
+  std::unordered_map<uint64_t, Node*> by_hash;
+  uint64_t event_count = 0;
+
+  ~Tree() { free_children(&root); }
+
+  // iterative: a single long-context hash chain is one node per KV block
+  // (hundreds of thousands deep) — recursion would blow the stack
+  static void free_children(Node* n) {
+    std::vector<Node*> stack;
+    for (auto& kv : n->children) stack.push_back(kv.second);
+    n->children.clear();
+    while (!stack.empty()) {
+      Node* cur = stack.back();
+      stack.pop_back();
+      for (auto& kv : cur->children) stack.push_back(kv.second);
+      delete cur;
+    }
+  }
+
+  void maybe_prune(Node* node) {
+    while (node != &root && node->workers.empty() && node->children.empty() &&
+           node->parent != nullptr) {
+      Node* parent = node->parent;
+      parent->children.erase(node->block_hash);
+      by_hash.erase(node->block_hash);
+      delete node;
+      node = parent;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dyn_radix_create() { return new Tree(); }
+
+void dyn_radix_destroy(void* t) { delete static_cast<Tree*>(t); }
+
+uint64_t dyn_radix_event_count(void* t) {
+  return static_cast<Tree*>(t)->event_count;
+}
+
+void dyn_radix_apply_stored(void* tp, int has_parent, uint64_t parent_hash,
+                            const uint64_t* hashes, size_t n,
+                            uint64_t worker) {
+  Tree* t = static_cast<Tree*>(tp);
+  t->event_count++;
+  Node* node = &t->root;
+  if (has_parent) {
+    auto it = t->by_hash.find(parent_hash);
+    // unknown parent (out-of-order events / restart): root the fragment so
+    // its hashes still match — same recovery as the Python tree
+    if (it != t->by_hash.end()) node = it->second;
+  }
+  for (size_t i = 0; i < n; i++) {
+    uint64_t h = hashes[i];
+    auto it = node->children.find(h);
+    Node* child;
+    if (it == node->children.end()) {
+      child = new Node();
+      child->block_hash = h;
+      child->parent = node;
+      node->children.emplace(h, child);
+      t->by_hash[h] = child;
+    } else {
+      child = it->second;
+    }
+    child->workers.insert(worker);
+    node = child;
+  }
+}
+
+void dyn_radix_apply_removed(void* tp, const uint64_t* hashes, size_t n,
+                             uint64_t worker) {
+  Tree* t = static_cast<Tree*>(tp);
+  t->event_count++;
+  for (size_t i = 0; i < n; i++) {
+    auto it = t->by_hash.find(hashes[i]);
+    if (it == t->by_hash.end()) continue;
+    Node* node = it->second;
+    node->workers.erase(worker);
+    t->maybe_prune(node);
+  }
+}
+
+void dyn_radix_remove_worker(void* tp, uint64_t worker) {
+  Tree* t = static_cast<Tree*>(tp);
+  std::vector<Node*> stack;
+  std::vector<uint64_t> doomed;  // hashes, re-resolved before pruning so a
+                                 // prior prune can never leave a dangling ptr
+  for (auto& kv : t->root.children) stack.push_back(kv.second);
+  while (!stack.empty()) {
+    Node* n = stack.back();
+    stack.pop_back();
+    n->workers.erase(worker);
+    for (auto& kv : n->children) stack.push_back(kv.second);
+    if (n->workers.empty() && n->children.empty())
+      doomed.push_back(n->block_hash);
+  }
+  for (uint64_t h : doomed) {
+    auto it = t->by_hash.find(h);
+    if (it == t->by_hash.end()) continue;
+    Node* n = it->second;
+    if (n->workers.empty() && n->children.empty()) t->maybe_prune(n);
+  }
+}
+
+// Walk the request's hash chain; score = contiguous matched blocks per
+// worker (intersection semantics, identical to the Python tree). Writes up
+// to max_out (worker, score) pairs; returns the pair count.
+size_t dyn_radix_find_matches(void* tp, const uint64_t* hashes, size_t n,
+                              uint64_t* out_workers, uint32_t* out_scores,
+                              size_t max_out) {
+  Tree* t = static_cast<Tree*>(tp);
+  Node* node = &t->root;
+  std::unordered_map<uint64_t, uint32_t> scores;
+  std::unordered_set<uint64_t> current;
+  bool first = true;
+  for (size_t i = 0; i < n; i++) {
+    auto it = node->children.find(hashes[i]);
+    if (it == node->children.end()) break;
+    Node* child = it->second;
+    if (first) {
+      current = child->workers;
+      first = false;
+    } else {
+      for (auto w = current.begin(); w != current.end();) {
+        if (child->workers.count(*w) == 0) {
+          w = current.erase(w);
+        } else {
+          ++w;
+        }
+      }
+    }
+    if (current.empty()) break;
+    for (uint64_t w : current) scores[w] += 1;
+    node = child;
+  }
+  size_t k = 0;
+  for (auto& kv : scores) {
+    if (k >= max_out) break;
+    out_workers[k] = kv.first;
+    out_scores[k] = kv.second;
+    k++;
+  }
+  return k;
+}
+
+}  // extern "C"

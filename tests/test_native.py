@@ -1,0 +1,208 @@
+"""Native C++ tier: differential tests against the Python implementations.
+
+Each native component must behave identically to its portable Python twin:
+- radix_tree.so vs KvIndexer on randomized event streams
+- codec_core.so vs runtime/codec.py frame-for-frame
+- kv_events.so round-trip: C-published events parse into RouterEvents that
+  drive the (native) indexer
+"""
+
+import ctypes
+import random
+
+import pytest
+
+from dynamo_tpu import native
+from dynamo_tpu.kv_router.indexer import KvIndexer, NativeKvIndexer, make_indexer
+from dynamo_tpu.kv_router.protocols import (
+    KvCacheEvent,
+    RemovedBlocks,
+    RouterEvent,
+    StoredBlock,
+    StoredBlocks,
+)
+
+
+def _need(name):
+    lib = native.load(name)
+    if lib is None:
+        pytest.skip(f"native {name} unavailable (no toolchain)")
+    return lib
+
+
+def _stored(worker, parent, hashes, eid=0):
+    return RouterEvent(
+        worker_id=worker,
+        event=KvCacheEvent(
+            event_id=eid,
+            data=StoredBlocks(
+                parent_hash=parent,
+                blocks=[StoredBlock(h, h ^ 0xABC) for h in hashes],
+            ),
+        ),
+    )
+
+
+def _removed(worker, hashes, eid=0):
+    return RouterEvent(
+        worker_id=worker,
+        event=KvCacheEvent(event_id=eid, data=RemovedBlocks(list(hashes))),
+    )
+
+
+class TestNativeRadixTree:
+    def test_factory_prefers_native(self):
+        lib = native.load("radix_tree")
+        ix = make_indexer(16)
+        if lib is None:
+            assert isinstance(ix, KvIndexer)
+        else:
+            assert isinstance(ix, NativeKvIndexer)
+
+    def test_basic_parity(self):
+        lib = _need("radix_tree")
+        py, cc = KvIndexer(16), NativeKvIndexer(lib, 16)
+        for ix in (py, cc):
+            ix.apply_event(_stored("w1", None, [10, 11, 12]))
+            ix.apply_event(_stored("w2", None, [10, 11]))
+            ix.apply_event(_stored("w2", 11, [99]))
+        for probe in ([10, 11, 12], [10, 11, 99], [10], [55], []):
+            assert py.find_matches(probe) == cc.find_matches(probe), probe
+
+    def test_differential_random_streams(self):
+        lib = _need("radix_tree")
+        rng = random.Random(7)
+        py, cc = KvIndexer(16), NativeKvIndexer(lib, 16)
+        workers = [f"w{i}" for i in range(5)]
+        chains = {}  # chain id → list of hashes
+        for step in range(600):
+            op = rng.random()
+            if op < 0.5:
+                # extend or start a chain for a random worker
+                cid = rng.randrange(8)
+                chain = chains.setdefault(cid, [rng.randrange(1 << 48)])
+                parent = chain[-1] if len(chain) > 1 or rng.random() < 0.5 else None
+                new = [rng.randrange(1 << 48) for _ in range(rng.randrange(1, 4))]
+                if parent is None:
+                    chain[:] = chain[:1]
+                    ev = _stored(rng.choice(workers), None, chain[:1] + new, step)
+                else:
+                    ev = _stored(rng.choice(workers), parent, new, step)
+                chain.extend(new)
+                py.apply_event(ev)
+                cc.apply_event(ev)
+            elif op < 0.8 and chains:
+                cid = rng.choice(list(chains))
+                victim = rng.sample(chains[cid], min(len(chains[cid]), 2))
+                ev = _removed(rng.choice(workers), victim, step)
+                py.apply_event(ev)
+                cc.apply_event(ev)
+            else:
+                w = rng.choice(workers)
+                py.remove_worker(w)
+                cc.remove_worker(w)
+            if step % 20 == 0 and chains:
+                probe = chains[rng.choice(list(chains))]
+                assert py.find_matches(probe) == cc.find_matches(probe), f"step {step}"
+        assert py.event_count == cc.event_count
+
+    def test_contiguity_intersection_semantics(self):
+        """Score counts only the contiguous prefix every surviving worker
+        shares — mirror of the Python tree's intersection walk."""
+        lib = _need("radix_tree")
+        cc = NativeKvIndexer(lib, 16)
+        cc.apply_event(_stored("a", None, [1, 2, 3, 4]))
+        cc.apply_event(_stored("b", None, [1, 2]))
+        scores = cc.find_matches([1, 2, 3, 4])
+        assert scores == {"a": 4, "b": 2}
+        # b rejoins deeper but with a gap at 3: contiguity broken
+        cc.apply_event(_stored("b", 3, [4]))
+        scores = cc.find_matches([1, 2, 3, 4])
+        assert scores == {"a": 4, "b": 2}
+
+
+class TestNativeCodec:
+    def test_encode_matches_python(self):
+        lib = _need("codec_core")
+        from dynamo_tpu.runtime import codec
+
+        lib.dyn_codec_encode.restype = ctypes.c_long
+        lib.dyn_codec_crc32.restype = ctypes.c_uint32
+        for header, body in [
+            (b"", b""),
+            (b"h", b""),
+            (b"", b"b"),
+            (b"header-bytes", b"x" * 1000),
+        ]:
+            py = codec.encode(codec.TwoPartMessage(header, body))
+            out = ctypes.create_string_buffer(len(py))
+            n = lib.dyn_codec_encode(header, len(header), body, len(body),
+                                     out, len(out))
+            assert n == len(py)
+            assert out.raw[:n] == py
+
+    def test_decode_roundtrip_and_checksum(self):
+        lib = _need("codec_core")
+        from dynamo_tpu.runtime import codec
+
+        lib.dyn_codec_decode.restype = ctypes.c_long
+        frame = bytearray(codec.encode(codec.TwoPartMessage(b"hdr", b"body!")))
+        ho, hl, bo, bl = (ctypes.c_size_t(), ctypes.c_size_t(),
+                          ctypes.c_size_t(), ctypes.c_size_t())
+        buf = bytes(frame)
+        n = lib.dyn_codec_decode(buf, len(buf), ctypes.byref(ho),
+                                 ctypes.byref(hl), ctypes.byref(bo),
+                                 ctypes.byref(bl))
+        assert n == len(buf)
+        assert buf[ho.value:ho.value + hl.value] == b"hdr"
+        assert buf[bo.value:bo.value + bl.value] == b"body!"
+        # truncated → needs more bytes
+        assert lib.dyn_codec_decode(buf, len(buf) - 1, ctypes.byref(ho),
+                                    ctypes.byref(hl), ctypes.byref(bo),
+                                    ctypes.byref(bl)) == 0
+        # corrupted body → checksum error
+        frame[-1] ^= 0xFF
+        assert lib.dyn_codec_decode(bytes(frame), len(frame), ctypes.byref(ho),
+                                    ctypes.byref(hl), ctypes.byref(bo),
+                                    ctypes.byref(bl)) == -2
+
+
+class TestCKvEvents:
+    def test_roundtrip_into_indexer(self):
+        _need("kv_events")
+        from dynamo_tpu.kv_router.c_events import CKvEventPublisher
+
+        pub = CKvEventPublisher("worker-7")
+        pub.blocks_stored(None, [(101, [1, 2, 3]), (102, [4, 5, 6])])
+        pub.blocks_stored(102, [(103, [7, 8, 9])])
+        pub.blocks_removed([102])
+        events = list(pub.drain())
+        assert len(events) == 3
+        assert all(e.worker_id == "worker-7" for e in events)
+        assert list(pub.drain()) == []  # drained
+
+        ix = make_indexer(16)
+        for e in events:
+            ix.apply_event(e)
+        assert ix.find_matches([101]) == {"worker-7": 1}
+        # 102 was removed: chain breaks there
+        assert ix.find_matches([101, 102, 103]) == {"worker-7": 1}
+        assert pub.dropped == 0
+        pub.close()
+
+    def test_parity_with_python_publisher(self):
+        """C-published events must be byte-compatible with the Python
+        KvEventPublisher's RouterEvent dicts (same indexer behavior)."""
+        _need("kv_events")
+        from dynamo_tpu.kv_router.c_events import CKvEventPublisher
+        from dynamo_tpu.kv_router.publisher import KvEventPublisher
+
+        py_events = []
+        py_pub = KvEventPublisher("w", py_events.append)
+        cc_pub = CKvEventPublisher("w")
+        for pub in (py_pub, cc_pub):
+            pub.blocks_stored(None, [(11, [1, 2]), (12, [3, 4])])
+            pub.blocks_removed([11])
+        cc_events = list(cc_pub.drain())
+        assert [e.to_dict() for e in cc_events] == [e.to_dict() for e in py_events]
+        cc_pub.close()
