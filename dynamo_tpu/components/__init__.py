@@ -1,0 +1,6 @@
+"""Deployable service components (reference: the Rust `components/` binaries
+— http frontend, standalone router, metrics aggregator; SURVEY.md §2.6).
+
+The http frontend lives in cli/run.py (`in=http out=discover`); this package
+holds the cluster metrics aggregator and its mock worker test fixture.
+"""

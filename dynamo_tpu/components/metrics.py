@@ -1,0 +1,169 @@
+"""Namespace metrics aggregator: worker load metrics → Prometheus.
+
+Subscribes to the namespace's ``kv_metrics`` event-plane subject (the same
+stream the KV router consumes), keeps the latest ForwardPassMetrics per
+worker, and serves them as Prometheus gauges on ``/metrics`` — the third
+observability tier (frontend Prometheus and worker push being the first
+two; SURVEY.md §5).
+
+Workers that stop publishing for ``expiry`` seconds are dropped from the
+export (lease death already removes them from routing; this keeps the
+dashboard honest without a registry dependency).
+
+Re-designed from the reference's metrics component
+(`components/metrics/src/lib.rs:321-594`, `main.rs:279`): the reference
+scrapes NATS $SRV stats on a timer; here workers already push metrics on
+the event plane, so the aggregator subscribes instead of polling.
+
+Run:  python -m dynamo_tpu.components.metrics --namespace dynamo --port 9091
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import time
+from typing import Dict, Tuple
+
+from aiohttp import web
+
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+
+logger = logging.getLogger(__name__)
+
+GAUGES = [
+    ("request_active_slots", "Decode slots currently occupied"),
+    ("request_total_slots", "Total decode slots"),
+    ("kv_active_blocks", "KV pool blocks in use"),
+    ("kv_total_blocks", "Total KV pool blocks"),
+    ("num_requests_waiting", "Requests queued or awaiting remote prefill"),
+    ("gpu_cache_usage_perc", "KV pool usage fraction"),
+    ("gpu_prefix_cache_hit_rate", "Prefix cache hit rate"),
+]
+
+
+class MetricsAggregator:
+    """Latest per-worker ForwardPassMetrics with expiry, rendered as
+    Prometheus text exposition."""
+
+    def __init__(self, namespace: str, prefix: str = "dynamo_worker", expiry: float = 30.0):
+        self.namespace = namespace
+        self.prefix = prefix
+        self.expiry = expiry
+        self._workers: Dict[str, Tuple[float, ForwardPassMetrics]] = {}
+
+    def update(self, worker_id: str, metrics: ForwardPassMetrics) -> None:
+        self._workers[worker_id] = (time.monotonic(), metrics)
+
+    def live_workers(self) -> Dict[str, ForwardPassMetrics]:
+        cutoff = time.monotonic() - self.expiry
+        self._workers = {
+            w: (t, m) for w, (t, m) in self._workers.items() if t >= cutoff
+        }
+        return {w: m for w, (t, m) in self._workers.items()}
+
+    def render(self) -> str:
+        live = self.live_workers()
+        lines = []
+        for name, help_text in GAUGES:
+            full = f"{self.prefix}_{name}"
+            lines.append(f"# HELP {full} {help_text}")
+            lines.append(f"# TYPE {full} gauge")
+            for worker_id, m in sorted(live.items()):
+                value = getattr(m, name)
+                lines.append(
+                    f'{full}{{namespace="{self.namespace}",worker="{worker_id}"}} {value}'
+                )
+        full = f"{self.prefix}_up"
+        lines.append(f"# HELP {full} Workers currently reporting metrics")
+        lines.append(f"# TYPE {full} gauge")
+        lines.append(f'{full}{{namespace="{self.namespace}"}} {len(live)}')
+        return "\n".join(lines) + "\n"
+
+
+async def run_aggregator(
+    drt, namespace: str, port: int, host: str = "0.0.0.0", expiry: float = 30.0
+) -> None:
+    """Subscribe to kv_metrics and serve /metrics until cancelled."""
+    from dynamo_tpu.runtime.distributed import KV_METRICS_SUBJECT
+
+    agg = MetricsAggregator(namespace, expiry=expiry)
+    ns = drt.namespace(namespace)
+
+    async def consume():
+        # resubscribe forever: a bus hiccup must not silently freeze the
+        # exporter (workers would linger until expiry, then show as zero)
+        backoff = 0.5
+        while True:
+            try:
+                sub = await ns.subscribe(KV_METRICS_SUBJECT)
+                backoff = 0.5
+                async for payload in sub:
+                    try:
+                        msg = (
+                            json.loads(payload)
+                            if isinstance(payload, (bytes, str))
+                            else payload
+                        )
+                        agg.update(
+                            msg["worker_id"],
+                            ForwardPassMetrics.from_dict(msg["metrics"]),
+                        )
+                    except (KeyError, ValueError, TypeError):
+                        logger.warning("malformed kv_metrics payload", exc_info=True)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.warning("kv_metrics subscription lost; retrying", exc_info=True)
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 10.0)
+
+    consumer = asyncio.create_task(consume())
+
+    async def metrics_handler(_request):
+        return web.Response(
+            text=agg.render(), content_type="text/plain", charset="utf-8"
+        )
+
+    app = web.Application()
+    app.add_routes([web.get("/metrics", metrics_handler)])
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    logger.info("metrics aggregator for %r on :%d/metrics", namespace, port)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        consumer.cancel()
+        await runner.cleanup()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="dynamo_tpu metrics aggregator")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9091)
+    p.add_argument("--statestore", default=None)
+    p.add_argument("--bus", default=None)
+    p.add_argument("--expiry", type=float, default=30.0)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+        drt = await DistributedRuntime.create(
+            statestore_url=args.statestore, bus_url=args.bus
+        )
+        await run_aggregator(
+            drt, args.namespace, args.port, host=args.host, expiry=args.expiry
+        )
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

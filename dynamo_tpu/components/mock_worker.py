@@ -1,0 +1,73 @@
+"""Mock worker: publishes synthetic KV metrics + events for dashboard and
+aggregator testing without any model or TPU.
+
+Reference counterpart: `components/metrics/src/bin/mock_worker.rs:158`.
+
+Run:  python -m dynamo_tpu.components.mock_worker --namespace dynamo
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import random
+
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+
+logger = logging.getLogger(__name__)
+
+
+async def run_mock_worker(
+    drt, namespace: str, interval: float = 1.0, worker_id: str | None = None
+) -> None:
+    from dynamo_tpu.runtime.distributed import KV_METRICS_SUBJECT
+
+    ns = drt.namespace(namespace)
+    wid = worker_id or f"mock-{drt.worker_id}"
+    rng = random.Random(hash(wid) & 0xFFFF)
+    slots_total, blocks_total = 16, 1024
+    active = 0
+    while True:
+        active = max(0, min(slots_total, active + rng.randint(-3, 3)))
+        blocks = int(blocks_total * min(1.0, active / slots_total + rng.random() * 0.2))
+        m = ForwardPassMetrics(
+            request_active_slots=active,
+            request_total_slots=slots_total,
+            kv_active_blocks=blocks,
+            kv_total_blocks=blocks_total,
+            num_requests_waiting=rng.randint(0, 4),
+            gpu_cache_usage_perc=blocks / blocks_total,
+            gpu_prefix_cache_hit_rate=rng.random() * 0.6,
+        )
+        await ns.publish(
+            KV_METRICS_SUBJECT, {"worker_id": wid, "metrics": m.to_dict()}
+        )
+        await asyncio.sleep(interval)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="dynamo_tpu mock worker")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--statestore", default=None)
+    p.add_argument("--bus", default=None)
+    p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--worker-id", default=None)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+        drt = await DistributedRuntime.create(
+            statestore_url=args.statestore, bus_url=args.bus
+        )
+        await run_mock_worker(
+            drt, args.namespace, interval=args.interval, worker_id=args.worker_id
+        )
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

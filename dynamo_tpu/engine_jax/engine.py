@@ -45,7 +45,7 @@ from dynamo_tpu.engine_jax.allocator import (
     KvEventSink,
     SequenceAllocation,
 )
-from dynamo_tpu.engine_jax.sampling import sample_tokens
+from dynamo_tpu.engine_jax.sampling import sample_tokens, token_logprobs
 from dynamo_tpu.llm.protocols.common import (
     FinishReason,
     LLMEngineOutput,
@@ -81,6 +81,9 @@ class EngineConfig:
     # a prefix hit (0 = disabled). Sized in blocks; reference credits the
     # equivalent pinned-host tier with +40% TTFT on multi-turn (BASELINE.md).
     host_cache_blocks: int = 0
+    # alternatives computed per step for OpenAI logprobs (the chosen token's
+    # logprob is always computed); a request can ask for at most this many
+    top_logprobs: int = 8
 
     def resolve_num_blocks(self) -> int:
         if self.num_kv_blocks is not None:
@@ -99,8 +102,8 @@ class _Seq:
     __slots__ = (
         "ctx", "request", "prompt", "alloc", "slot", "out_queue", "loop",
         "generated", "emitted", "max_tokens", "eos_ids", "ignore_eos",
-        "temperature", "top_k", "top_p", "seed", "enqueue_t", "first_token_t",
-        "remote", "remote_deadline", "prefill_pos",
+        "temperature", "top_k", "top_p", "seed", "logprobs", "enqueue_t",
+        "first_token_t", "remote", "remote_deadline", "prefill_pos",
     )
 
     def __init__(self, ctx: Context, request: PreprocessedRequest, loop) -> None:
@@ -124,6 +127,8 @@ class _Seq:
         self.top_k = so.top_k if so.top_k is not None else 0
         self.top_p = so.top_p if so.top_p is not None else 1.0
         self.seed = so.seed if so.seed is not None else 0
+        # None = don't emit logprobs; 0 = chosen only; k = with alternatives
+        self.logprobs = so.logprobs
         self.enqueue_t = time.perf_counter()
         self.first_token_t: Optional[float] = None
         self.remote = False  # prefill dispatched to a remote prefill worker
@@ -153,10 +158,13 @@ class _Inflight:
     whole chunk's compute.
     """
 
-    __slots__ = ("out", "tokens", "positions", "lanes")
+    __slots__ = ("out", "lps", "top_ids", "top_lps", "tokens", "positions", "lanes")
 
-    def __init__(self, out, tokens, positions, lanes):
+    def __init__(self, out, lps, top_ids, top_lps, tokens, positions, lanes):
         self.out = out  # [S, k_steps] device
+        self.lps = lps  # [S, k_steps] device, chosen-token logprobs
+        self.top_ids = top_ids  # [S, k_steps, P] device
+        self.top_lps = top_lps  # [S, k_steps, P] device
         self.tokens = tokens  # [S] device, final carry
         self.positions = positions  # [S] device, final carry
         self.lanes = lanes  # List[Optional[_Seq]] snapshot
@@ -242,15 +250,17 @@ class JaxServingEngine(AsyncEngine):
         self.total_prompt_tokens = 0
         self.preemptions = 0
 
-        self._decode_fn = self._build_decode_fn()
-        self._chunk_fn = self._build_chunk_fn()
+        # with/without-logprobs variants, compiled lazily per need
+        self._decode_fns: Dict[bool, Any] = {}
+        self._chunk_fns: Dict[bool, Any] = {}
 
     # -- jitted step functions ----------------------------------------------
 
-    def _build_decode_fn(self):
+    def _build_decode_fn(self, with_lp: bool = False):
         cfg = self.model_config
         k_steps = self.config.decode_steps
         max_pos = self.config.max_model_len - 1
+        n_top = self.config.top_logprobs
 
         def decode(params, cache, tokens, positions, tables, step_key, seeds, temp, topk, topp):
             # tokens/positions: [S]; tables: [S, MB]. Scans k_steps forward+
@@ -270,18 +280,44 @@ class JaxServingEngine(AsyncEngine):
                 keys = jax.vmap(lambda s: jax.random.fold_in(kk, s))(seeds)
                 nxt = sample_tokens(logits[:, 0], keys, temp, topk, topp)
                 new_pos = jnp.where((pos >= 0) & (pos < max_pos), pos + 1, -1)
+                if with_lp:
+                    lp, tids, tlps = token_logprobs(logits[:, 0], nxt, n_top)
+                    return (nxt, new_pos, cache), (nxt, lp, tids, tlps)
                 return (nxt, new_pos, cache), nxt
 
             (toks, pos, cache), out = jax.lax.scan(
                 body, (tokens, positions, cache), jnp.arange(k_steps)
             )
-            return out.T, toks, pos, cache  # [S, k_steps], [S], [S]
+            # outputs are scan-stacked [k_steps, S, ...] → slot-major
+            if with_lp:
+                out, lps, tids, tlps = out
+                return (
+                    out.T, lps.T, tids.transpose(1, 0, 2),
+                    tlps.transpose(1, 0, 2), toks, pos, cache,
+                )
+            return out.T, toks, pos, cache
 
         return jax.jit(decode, donate_argnums=(1,))
 
-    def _build_chunk_fn(self):
+    def _decode(self, want_lp: bool):
+        """The decode variant with/without logprobs (each compiled lazily:
+        the logprobs math + its device→host transfer stay off the hot path
+        when nothing asked for them)."""
+        fn = self._decode_fns.get(want_lp)
+        if fn is None:
+            fn = self._decode_fns[want_lp] = self._build_decode_fn(want_lp)
+        return fn
+
+    def _chunk(self, want_lp: bool):
+        fn = self._chunk_fns.get(want_lp)
+        if fn is None:
+            fn = self._chunk_fns[want_lp] = self._build_chunk_fn(want_lp)
+        return fn
+
+    def _build_chunk_fn(self, with_lp: bool = False):
         cfg = self.model_config
         S = self.config.max_slots
+        n_top = self.config.top_logprobs
 
         def chunk(params, cache, tokens, positions, tables, sample_at, step_key, seeds, temp, topk, topp):
             # tokens/positions: [S, C] (−1 positions = padding); sample_at: [S]
@@ -294,6 +330,9 @@ class JaxServingEngine(AsyncEngine):
             sel = logits[jnp.arange(S), jnp.clip(sample_at, 0)]  # [S, V]
             keys = jax.vmap(lambda s: jax.random.fold_in(step_key, s))(seeds)
             nxt = sample_tokens(sel, keys, temp, topk, topp)
+            if with_lp:
+                lp, tids, tlps = token_logprobs(sel, nxt, n_top)
+                return nxt, lp, tids, tlps, cache
             return nxt, cache
 
         return jax.jit(chunk, donate_argnums=(1,))
@@ -315,14 +354,14 @@ class JaxServingEngine(AsyncEngine):
         svec_f = np.zeros((S,), np.float32)
         ones_f = np.ones((S,), np.float32)
 
-        out, self.cache = self._chunk_fn(
+        out, self.cache = self._chunk(False)(
             self.params, self.cache, jnp.asarray(zeros_sc), jnp.asarray(neg),
             jnp.asarray(tables), jnp.asarray(np.full((S,), -1, np.int32)), key,
             jnp.asarray(svec_i), jnp.asarray(svec_f), jnp.asarray(svec_i),
             jnp.asarray(ones_f),
         )
         jax.device_get(out)
-        out, _, _, self.cache = self._decode_fn(
+        out, _, _, self.cache = self._decode(False)(
             self.params, self.cache, jnp.asarray(svec_i),
             jnp.asarray(np.full((S,), -1, np.int32)), jnp.asarray(tables), key,
             jnp.asarray(svec_i), jnp.asarray(svec_f), jnp.asarray(svec_i),
@@ -581,27 +620,43 @@ class JaxServingEngine(AsyncEngine):
 
         self._step_counter += 1
         step_key = jax.random.fold_in(self._base_key, self._step_counter)
-        sampled, self.cache = self._chunk_fn(
+        want_lp = any(
+            s is not None and s.logprobs is not None for s in self._slots
+        )
+        args = (
             self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(self._tables), jnp.asarray(sample_at), step_key,
             jnp.asarray(self._seeds), jnp.asarray(self._temp),
             jnp.asarray(self._topk), jnp.asarray(self._topp),
         )
-        sampled_np = np.asarray(jax.device_get(sampled))  # [S]
+        if want_lp:
+            sampled, lp, tids, tlps, self.cache = self._chunk(True)(*args)
+            sampled_np, lp_np, tids_np, tlps_np = jax.device_get(
+                (sampled, lp, tids, tlps)
+            )
+        else:
+            sampled, self.cache = self._chunk(False)(*args)
+            sampled_np = jax.device_get(sampled)
+            lp_np = tids_np = tlps_np = None
 
         for i in range(S):
             seq = self._slots[i]
             if seq is None or consumed[i] is None:
                 continue
             self.allocator.note_tokens_computed(seq.alloc, consumed[i])
+            lpinfo = (
+                (float(lp_np[i]), tids_np[i], tlps_np[i])
+                if lp_np is not None
+                else None
+            )
             if seq.prefill_pos is not None:
                 seq.prefill_pos += len(consumed[i])
                 if seq.prefill_pos >= len(seq.prompt):
                     seq.prefill_pos = None
                     seq.first_token_t = time.perf_counter()
-                    self._emit_token(seq, int(sampled_np[i]))
+                    self._emit_token(seq, int(sampled_np[i]), lpinfo=lpinfo)
             else:
-                self._emit_token(seq, int(sampled_np[i]))
+                self._emit_token(seq, int(sampled_np[i]), lpinfo=lpinfo)
 
     def _decode_step(self) -> None:
         """Pipelined decode: dispatch chunk N+1 off the previous dispatch's
@@ -678,17 +733,32 @@ class JaxServingEngine(AsyncEngine):
 
         self._step_counter += 1
         step_key = jax.random.fold_in(self._base_key, self._step_counter)
-        out, toks2, pos2, self.cache = self._decode_fn(
+        want_lp = any(s is not None and s.logprobs is not None for s in lanes)
+        args = (
             self.params, self.cache, toks_in, pos_in,
             jnp.asarray(self._tables), step_key, jnp.asarray(self._seeds),
             jnp.asarray(self._temp), jnp.asarray(self._topk), jnp.asarray(self._topp),
         )
-        prev, self._inflight = self._inflight, _Inflight(out, toks2, pos2, lanes)
+        if want_lp:
+            out, lps, tids, tlps, toks2, pos2, self.cache = self._decode(True)(*args)
+        else:
+            out, toks2, pos2, self.cache = self._decode(False)(*args)
+            lps = tids = tlps = None
+        prev, self._inflight = (
+            self._inflight, _Inflight(out, lps, tids, tlps, toks2, pos2, lanes)
+        )
         if prev is not None:
             self._process_chunk(prev, defer_free=True)
 
     def _process_chunk(self, chunk: _Inflight, defer_free: bool) -> None:
-        out = np.asarray(jax.device_get(chunk.out))  # [S, k_steps]
+        if chunk.lps is not None:
+            out, lps, tids, tlps = jax.device_get(
+                (chunk.out, chunk.lps, chunk.top_ids, chunk.top_lps)
+            )
+        else:
+            out = jax.device_get(chunk.out)
+            lps = tids = tlps = None
+        out = np.asarray(out)  # [S, k_steps]
         for i, seq in enumerate(chunk.lanes):
             if seq is None or seq.slot != i:
                 continue  # empty lane, or finished in an earlier chunk
@@ -698,7 +768,14 @@ class JaxServingEngine(AsyncEngine):
             for j in range(out.shape[1]):
                 self.allocator.note_tokens_computed(seq.alloc, [fed])
                 tok = int(out[i, j])
-                self._emit_token(seq, tok, defer_free=defer_free)
+                self._emit_token(
+                    seq, tok, defer_free=defer_free,
+                    lpinfo=(
+                        (float(lps[i, j]), tids[i, j], tlps[i, j])
+                        if lps is not None
+                        else None
+                    ),
+                )
                 if seq.slot != i:  # finished mid-chunk
                     break
                 fed = tok
@@ -713,7 +790,9 @@ class JaxServingEngine(AsyncEngine):
             self.allocator.free_sequence(alloc)
         self._zombie_allocs.clear()
 
-    def _emit_token(self, seq: _Seq, tok: int, defer_free: bool = False) -> None:
+    def _emit_token(
+        self, seq: _Seq, tok: int, defer_free: bool = False, lpinfo=None
+    ) -> None:
         seq.generated.append(tok)
         seq.emitted += 1
         self.total_generated_tokens += 1
@@ -725,8 +804,20 @@ class JaxServingEngine(AsyncEngine):
         elif seq.total_len >= self.config.max_model_len:
             finish = FinishReason.LENGTH
 
+        log_probs = top_logprobs = None
+        if seq.logprobs is not None and lpinfo is not None:
+            chosen_lp, top_ids, top_lps = lpinfo
+            log_probs = [chosen_lp]
+            if seq.logprobs > 0:
+                k = min(seq.logprobs, len(top_ids))
+                top_logprobs = [
+                    {int(top_ids[p]): float(top_lps[p]) for p in range(k)}
+                ]
         seq.emit(Annotated.from_data(
-            LLMEngineOutput(token_ids=[tok]).to_dict(), id=seq.ctx.id
+            LLMEngineOutput(
+                token_ids=[tok], log_probs=log_probs, top_logprobs=top_logprobs
+            ).to_dict(),
+            id=seq.ctx.id,
         ))
         if finish is not None:
             self._finish(seq, finish, defer_free=defer_free)

@@ -56,3 +56,27 @@ def sample_tokens(
     sampled = jnp.take_along_axis(top_idx, choice[:, None], axis=1)[:, 0]
 
     return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+def token_logprobs(
+    logits: jax.Array,  # [B, V] float32 (raw, temperature-unscaled)
+    tokens: jax.Array,  # [B] int32 sampled tokens
+    n_top: int,
+) -> tuple:
+    """Model log-probabilities for OpenAI ``logprobs`` reporting.
+
+    Returns (chosen_lp [B], top_ids [B, n_top], top_lps [B, n_top]); raw
+    model distribution, not the sampling-modified one. n_top == 0 returns
+    empty [B, 0] alternatives.
+    """
+    b, v = logits.shape
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)  # [B]
+    chosen = jnp.take_along_axis(logits, tokens[:, None], axis=1)[:, 0]
+    chosen_lp = chosen - lse
+    if n_top > 0:
+        top_vals, top_ids = jax.lax.top_k(logits, n_top)
+        top_lps = top_vals - lse[:, None]
+    else:
+        top_ids = jnp.zeros((b, 0), jnp.int32)
+        top_lps = jnp.zeros((b, 0), jnp.float32)
+    return chosen_lp, top_ids.astype(jnp.int32), top_lps

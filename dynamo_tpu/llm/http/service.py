@@ -288,9 +288,57 @@ class HttpService:
         if not chunks:
             return _error_response(500, "engine produced no response")
         full = aggregate_chat_chunks(chunks) if chat else aggregate_completion_chunks(chunks)
+        if (
+            chat
+            and getattr(ctx.data, "tools", None)
+            and getattr(ctx.data, "tool_choice", None) != "none"
+        ):
+            _extract_tool_calls(full)
         guard.mark_ok()
         guard.count_tokens(n_tokens)
         return web.json_response(full.model_dump(exclude_none=True))
+
+
+def _extract_tool_calls(full) -> None:
+    """Best-effort function-call detection on a folded chat response.
+
+    When the request carried ``tools`` and the model answered with a bare
+    JSON object of the common ``{"name": ..., "arguments"|"parameters": ...}``
+    shape (the format llama-3/qwen-style templates train), surface it as an
+    OpenAI ``tool_calls`` entry with finish_reason "tool_calls". Models whose
+    templates emit other wrappers stream through as plain text (parity with
+    the reference, which delegates parsing to its engines).
+    """
+    import uuid as _uuid
+
+    for choice in full.choices:
+        content = choice.message.content
+        if not content:
+            continue
+        text = content.strip()
+        if not (text.startswith("{") and text.endswith("}")):
+            continue
+        try:
+            obj = json.loads(text)
+        except ValueError:
+            continue
+        if not isinstance(obj, dict) or "name" not in obj:
+            continue
+        args = obj.get("arguments", obj.get("parameters"))
+        if args is None:
+            continue
+        choice.message.tool_calls = [
+            {
+                "id": f"call_{_uuid.uuid4().hex[:24]}",
+                "type": "function",
+                "function": {
+                    "name": obj["name"],
+                    "arguments": json.dumps(args) if not isinstance(args, str) else args,
+                },
+            }
+        ]
+        choice.message.content = None
+        choice.finish_reason = "tool_calls"
 
 
 def _chunk_has_content(payload) -> bool:

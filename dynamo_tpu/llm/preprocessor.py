@@ -13,8 +13,10 @@ tokenizer_config.json, with the same helper environment HF uses
 
 from __future__ import annotations
 
+import asyncio
 import datetime
 import json
+from dataclasses import replace
 from typing import AsyncIterator, Optional, Union
 
 import jinja2
@@ -111,8 +113,13 @@ class OpenAIPreprocessor:
         if raw and request.messages:
             prompt = request.messages[-1].text_content()
         else:
+            # tools render through the chat template (HF templates accept a
+            # `tools` kwarg); models trained for function calling see them.
+            # tool_choice "none" suppresses them for this turn.
+            tools = request.tools if request.tool_choice != "none" else None
             prompt = self.formatter.render(
-                [m.model_dump(exclude_none=True) for m in request.messages]
+                [m.model_dump(exclude_none=True) for m in request.messages],
+                tools=tools,
             )
         token_ids = self.tokenizer.encode(prompt)
         return self._build(request, prompt, token_ids, request.stop_list())
@@ -136,10 +143,15 @@ class OpenAIPreprocessor:
         return None
 
     def preprocess_completion(self, request: CompletionRequest) -> PreprocessedRequest:
+        if request.suffix:
+            raise HttpError(
+                400, "suffix (fill-in-the-middle) is not supported by this model"
+            )
         prompt = request.prompt
         if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
             token_ids = [int(t) for t in prompt]
-            prompt_text = None
+            # echo needs the prompt as text even for token-id prompts
+            prompt_text = self.tokenizer.decode(token_ids) if request.echo else None
         else:
             if isinstance(prompt, list):
                 prompt = "".join(prompt)
@@ -185,6 +197,7 @@ class OpenAIPreprocessor:
                 frequency_penalty=request.frequency_penalty,
                 presence_penalty=request.presence_penalty,
                 seed=request.seed,
+                logprobs=_logprobs_request(request),
             ),
             eos_token_ids=list(self.card.eos_token_ids),
             annotations=list((request.nvext.annotations if request.nvext else None) or []),
@@ -193,6 +206,24 @@ class OpenAIPreprocessor:
         if prompt is not None:
             pre._formatted_prompt = prompt  # carried for annotations only
         return pre
+
+
+def _logprobs_request(request) -> Optional[int]:
+    """OpenAI request fields → engine logprobs ask (None = off).
+
+    Chat: ``logprobs: bool`` + ``top_logprobs: int``. Completions:
+    ``logprobs: int`` (number of alternatives; 0 = chosen only).
+    """
+    lp = getattr(request, "logprobs", None)
+    if lp is None or lp is False:
+        return None
+    if lp is True:
+        asked = int(getattr(request, "top_logprobs", None) or 0)
+    else:
+        asked = int(lp)  # completions style: int
+    if not 0 <= asked <= 20:  # OpenAI's documented bound
+        raise HttpError(400, f"top_logprobs must be within [0, 20], got {asked}")
+    return asked
 
 
 class ChatPreprocessorOperator(Operator):
@@ -207,6 +238,40 @@ class ChatPreprocessorOperator(Operator):
     def __init__(self, preprocessor: OpenAIPreprocessor, chat: bool = True):
         self._pre = preprocessor
         self._chat = chat
+
+    def _format_logprobs(self, out: BackendOutput) -> Optional[dict]:
+        """BackendOutput logprobs → OpenAI wire format (chat content entries
+        or the legacy completions lists). Token strings are best-effort
+        single-token decodes."""
+        if out.log_probs is None:
+            return None
+        decode = self._pre.tokenizer.decode
+        tokens = out.token_ids[: len(out.log_probs)]
+        if self._chat:
+            entries = []
+            for i, (tid, lp) in enumerate(zip(tokens, out.log_probs)):
+                entry = {"token": decode([tid]), "logprob": lp}
+                if out.top_logprobs is not None and i < len(out.top_logprobs):
+                    entry["top_logprobs"] = [
+                        {"token": decode([t]), "logprob": l}
+                        for t, l in out.top_logprobs[i].items()
+                    ]
+                entries.append(entry)
+            return {"content": entries} if entries else None
+        if not tokens:
+            return None
+        return {
+            "tokens": [decode([t]) for t in tokens],
+            "token_logprobs": list(out.log_probs[: len(tokens)]),
+            "top_logprobs": [
+                (
+                    {decode([t]): l for t, l in out.top_logprobs[i].items()}
+                    if out.top_logprobs is not None and i < len(out.top_logprobs)
+                    else {}
+                )
+                for i in range(len(tokens))
+            ],
+        }
 
     async def generate(
         self, request: Context[Union[ChatCompletionRequest, CompletionRequest]], next_engine: AsyncEngine
@@ -227,42 +292,103 @@ class ChatPreprocessorOperator(Operator):
         gen = DeltaGenerator(request_id, oai_req.model, chat=self._chat)
         prompt_tokens = len(pre.token_ids)
         completion_tokens = 0
-
         include_usage = bool(
             oai_req.stream_options and oai_req.stream_options.include_usage
         )
+        echo = bool(not self._chat and getattr(oai_req, "echo", None))
+        n = oai_req.n or 1
 
-        async for item in next_engine.generate(request.transfer(pre)):
-            if isinstance(item, Annotated):
-                if item.is_error:
-                    yield item
-                    return
-                if item.data is None:
-                    yield item  # pass through annotation events
+        # n>1: fan out n engine streams (seed-varied), multiplex by choice
+        # index as they produce (reference: protocols/openai n handling; the
+        # engine itself stays single-sequence)
+        queue: asyncio.Queue = asyncio.Queue()
+        _DONE = object()
+
+        def choice_request(i: int) -> PreprocessedRequest:
+            if n == 1:
+                return pre
+            so = replace(
+                pre.sampling_options,
+                seed=(pre.sampling_options.seed or 0) + i if i else pre.sampling_options.seed,
+            )
+            return replace(pre, sampling_options=so)
+
+        # each choice gets its OWN engine context: one choice hitting a stop
+        # string must not cancel its siblings, and downstream request ids
+        # (e.g. disaggregated-prefill bookkeeping) must stay distinct. Parent
+        # cancellation (client disconnect) propagates to every child.
+        if n == 1:
+            child_ctxs = [request.transfer(pre)]
+            prop_task = None
+        else:
+            child_ctxs = [Context(choice_request(i)) for i in range(n)]
+
+            async def propagate_cancel():
+                await request.context.stopped()
+                for c in child_ctxs:
+                    c.context.stop_generating()
+
+            prop_task = asyncio.create_task(propagate_cancel())
+
+        async def pump(i: int):
+            try:
+                async for item in next_engine.generate(child_ctxs[i]):
+                    await queue.put((i, item))
+            finally:
+                await queue.put((i, _DONE))
+
+        tasks = [asyncio.create_task(pump(i)) for i in range(n)]
+        echoed = [not echo] * n  # per choice: prompt already emitted?
+        finished = 0
+        finish_count = 0
+        try:
+            while finished < n:
+                idx, item = await queue.get()
+                if item is _DONE:
+                    finished += 1
                     continue
-                out = item.data
-            else:
-                out = item
-            if not isinstance(out, BackendOutput):
-                raise TypeError(f"expected BackendOutput, got {type(out).__name__}")
+                if isinstance(item, Annotated):
+                    if item.is_error:
+                        yield item
+                        return
+                    if item.data is None:
+                        if idx == 0:
+                            yield item  # pass through annotation events once
+                        continue
+                    out = item.data
+                else:
+                    out = item
+                if not isinstance(out, BackendOutput):
+                    raise TypeError(f"expected BackendOutput, got {type(out).__name__}")
 
-            completion_tokens += len(out.token_ids)
-            if out.text:
-                chunk = gen.text_chunk(out.text)
-                yield Annotated.from_data(chunk.model_dump(exclude_none=True), id=request.id)
-            if out.finish_reason is not None:
-                usage = (
-                    Usage(
-                        prompt_tokens=prompt_tokens,
-                        completion_tokens=completion_tokens,
-                        total_tokens=prompt_tokens + completion_tokens,
+                completion_tokens += len(out.token_ids)
+                text = out.text or ""
+                if not echoed[idx]:
+                    echoed[idx] = True
+                    text = (getattr(pre, "_formatted_prompt", None) or "") + text
+                if text:
+                    chunk = gen.text_chunk(
+                        text, index=idx, logprobs=self._format_logprobs(out)
                     )
-                    if include_usage
-                    else None
-                )
-                chunk = gen.finish_chunk(out.finish_reason, usage=usage)
-                yield Annotated.from_data(chunk.model_dump(exclude_none=True), id=request.id)
-                return
+                    yield Annotated.from_data(chunk.model_dump(exclude_none=True), id=request.id)
+                if out.finish_reason is not None:
+                    finish_count += 1
+                    usage = (
+                        Usage(
+                            prompt_tokens=prompt_tokens,
+                            completion_tokens=completion_tokens,
+                            total_tokens=prompt_tokens + completion_tokens,
+                        )
+                        if include_usage and finish_count == n
+                        else None
+                    )
+                    chunk = gen.finish_chunk(out.finish_reason, index=idx, usage=usage)
+                    yield Annotated.from_data(chunk.model_dump(exclude_none=True), id=request.id)
+        finally:
+            if prop_task is not None:
+                prop_task.cancel()
+            for t in tasks:
+                t.cancel()
 
 
 class DetokenizeOperator(Operator):
@@ -328,12 +454,21 @@ class DetokenizeOperator(Operator):
                 if tail:
                     text_parts.append(tail)
 
+            kept = len(kept_tokens)
             yield Annotated.from_data(
                 BackendOutput(
                     token_ids=kept_tokens,
                     text="".join(text_parts) or None,
                     finish_reason=finish,
                     cum_log_probs=out.cum_log_probs,
+                    log_probs=(
+                        out.log_probs[:kept] if out.log_probs is not None else None
+                    ),
+                    top_logprobs=(
+                        out.top_logprobs[:kept]
+                        if out.top_logprobs is not None
+                        else None
+                    ),
                 ),
                 id=ann_id,
             )

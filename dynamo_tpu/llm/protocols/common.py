@@ -65,6 +65,9 @@ class SamplingOptions:
     frequency_penalty: Optional[float] = None
     presence_penalty: Optional[float] = None
     seed: Optional[int] = None
+    # None = no logprobs; 0 = chosen-token logprob only; k>0 = also the
+    # top-k alternative tokens per step
+    logprobs: Optional[int] = None
 
 
 @dataclass
@@ -112,6 +115,10 @@ class LLMEngineOutput:
     text: Optional[str] = None
     cum_log_probs: Optional[float] = None
     finish_reason: Optional[FinishReason] = None
+    # per-token log-probabilities (parallel to token_ids) and, when the
+    # request asked for alternatives, per-token {token_id: logprob} maps
+    log_probs: Optional[list[float]] = None
+    top_logprobs: Optional[list[dict[int, float]]] = None
     # engine-specific side data (e.g. kv hit-rate annotations)
     extra: dict[str, Any] = field(default_factory=dict)
 
@@ -127,6 +134,13 @@ class LLMEngineOutput:
             out["cum_log_probs"] = self.cum_log_probs
         if self.finish_reason is not None:
             out["finish_reason"] = self.finish_reason.value
+        if self.log_probs is not None:
+            out["log_probs"] = self.log_probs
+        if self.top_logprobs is not None:
+            # JSON object keys are strings; from_dict restores ints
+            out["top_logprobs"] = [
+                {str(k): v for k, v in d.items()} for d in self.top_logprobs
+            ]
         if self.extra:
             out["extra"] = self.extra
         return out
@@ -134,11 +148,18 @@ class LLMEngineOutput:
     @classmethod
     def from_dict(cls, d: dict) -> "LLMEngineOutput":
         fr = d.get("finish_reason")
+        top = d.get("top_logprobs")
         return cls(
             token_ids=list(d.get("token_ids", [])),
             text=d.get("text"),
             cum_log_probs=d.get("cum_log_probs"),
             finish_reason=FinishReason(fr) if fr else None,
+            log_probs=d.get("log_probs"),
+            top_logprobs=(
+                [{int(k): v for k, v in t.items()} for t in top]
+                if top is not None
+                else None
+            ),
             extra=dict(d.get("extra", {})),
         )
 
@@ -154,3 +175,5 @@ class BackendOutput:
     text: Optional[str] = None
     finish_reason: Optional[FinishReason] = None
     cum_log_probs: Optional[float] = None
+    log_probs: Optional[list[float]] = None
+    top_logprobs: Optional[list[dict[int, float]]] = None

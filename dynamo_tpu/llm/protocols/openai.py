@@ -71,6 +71,8 @@ class ChatCompletionRequest(BaseModel):
     logprobs: Optional[bool] = None
     top_logprobs: Optional[int] = None
     min_tokens: Optional[int] = None  # common extension
+    tools: Optional[list[dict]] = None
+    tool_choice: Optional[Union[str, dict]] = None
     nvext: Optional[NvExt] = None
 
     def stop_list(self) -> list[str]:
@@ -99,6 +101,8 @@ class CompletionRequest(BaseModel):
     frequency_penalty: Optional[float] = None
     presence_penalty: Optional[float] = None
     echo: Optional[bool] = None
+    logprobs: Optional[int] = None  # number of alternatives per token
+    suffix: Optional[str] = None  # FIM insertion — rejected unless supported
     nvext: Optional[NvExt] = None
 
     def stop_list(self) -> list[str]:
@@ -124,6 +128,7 @@ class ChatChunkChoice(BaseModel):
     index: int = 0
     delta: ChatDelta = Field(default_factory=ChatDelta)
     finish_reason: Optional[str] = None
+    logprobs: Optional[dict] = None  # {"content": [TokenLogprob, ...]}
 
 
 class ChatCompletionChunk(BaseModel):
@@ -139,6 +144,7 @@ class ChatChoice(BaseModel):
     index: int = 0
     message: ChatMessage = Field(default_factory=lambda: ChatMessage(role="assistant", content=""))
     finish_reason: Optional[str] = None
+    logprobs: Optional[dict] = None
 
 
 class ChatCompletionResponse(BaseModel):
@@ -154,6 +160,8 @@ class CompletionChoice(BaseModel):
     index: int = 0
     text: str = ""
     finish_reason: Optional[str] = None
+    # legacy completions format: {"tokens", "token_logprobs", "top_logprobs"}
+    logprobs: Optional[dict] = None
 
 
 class CompletionChunk(BaseModel):
@@ -203,26 +211,26 @@ class DeltaGenerator:
         self.model = model
         self.chat = chat
         self.created = int(time.time())
-        self._first = True
+        self._started: set[int] = set()  # choice indexes that got their role
         self.usage = Usage()
 
-    def text_chunk(self, text: str, index: int = 0):
+    def text_chunk(self, text: str, index: int = 0, logprobs: Optional[dict] = None):
         if self.chat:
             delta = ChatDelta(content=text)
-            if self._first:
+            if index not in self._started:
                 delta.role = "assistant"
-                self._first = False
+                self._started.add(index)
             return ChatCompletionChunk(
                 id=self.request_id,
                 created=self.created,
                 model=self.model,
-                choices=[ChatChunkChoice(index=index, delta=delta)],
+                choices=[ChatChunkChoice(index=index, delta=delta, logprobs=logprobs)],
             )
         return CompletionChunk(
             id=self.request_id,
             created=self.created,
             model=self.model,
-            choices=[CompletionChoice(index=index, text=text)],
+            choices=[CompletionChoice(index=index, text=text, logprobs=logprobs)],
         )
 
     def finish_chunk(self, reason: FinishReason, index: int = 0, usage: Optional[Usage] = None):
@@ -270,6 +278,9 @@ def aggregate_chat_chunks(chunks: list[dict | ChatCompletionChunk]) -> ChatCompl
                 agg.message.role = ch.delta.role
             if ch.delta.content:
                 agg.message.content = (agg.message.content or "") + ch.delta.content
+            if ch.logprobs and ch.logprobs.get("content"):
+                agg.logprobs = agg.logprobs or {"content": []}
+                agg.logprobs["content"].extend(ch.logprobs["content"])
             if ch.finish_reason:
                 agg.finish_reason = ch.finish_reason
     first = parsed[0]
@@ -296,6 +307,12 @@ def aggregate_completion_chunks(chunks: list[dict | CompletionChunk]) -> Complet
         for ch in chunk.choices:
             agg = by_index.setdefault(ch.index, CompletionChoice(index=ch.index, text=""))
             agg.text += ch.text
+            if ch.logprobs:
+                agg.logprobs = agg.logprobs or {
+                    "tokens": [], "token_logprobs": [], "top_logprobs": [],
+                }
+                for key in ("tokens", "token_logprobs", "top_logprobs"):
+                    agg.logprobs[key].extend(ch.logprobs.get(key, []))
             if ch.finish_reason:
                 agg.finish_reason = ch.finish_reason
     first = parsed[0]

@@ -1,0 +1,80 @@
+"""Metrics aggregator component + mock worker."""
+
+import asyncio
+import urllib.request
+
+from dynamo_tpu.components.metrics import MetricsAggregator, run_aggregator
+from dynamo_tpu.components.mock_worker import run_mock_worker
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.runtime.bus import MessageBusServer
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.statestore import StateStoreServer
+
+
+class TestAggregator:
+    def test_render_and_expiry(self):
+        agg = MetricsAggregator("ns", expiry=0.0)  # everything expires at once
+        agg.update("w1", ForwardPassMetrics(request_active_slots=3))
+        assert agg.live_workers() == {}
+
+        agg = MetricsAggregator("ns", expiry=60.0)
+        agg.update("w1", ForwardPassMetrics(request_active_slots=3, kv_active_blocks=7))
+        agg.update("w2", ForwardPassMetrics(request_active_slots=1))
+        text = agg.render()
+        assert 'dynamo_worker_request_active_slots{namespace="ns",worker="w1"} 3' in text
+        assert 'dynamo_worker_kv_active_blocks{namespace="ns",worker="w1"} 7' in text
+        assert 'dynamo_worker_up{namespace="ns"} 2' in text
+
+    def test_mock_worker_feeds_aggregator_over_bus(self, run):
+        async def go():
+            ss = StateStoreServer(port=0)
+            bus = MessageBusServer(port=0)
+            await ss.start()
+            await bus.start()
+            drt_w = await DistributedRuntime.create(ss.url, bus.url)
+            drt_a = await DistributedRuntime.create(ss.url, bus.url)
+
+            import socket
+
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+
+            agg_task = asyncio.create_task(
+                run_aggregator(drt_a, "dynamo", port, host="127.0.0.1")
+            )
+            await asyncio.sleep(0.2)
+            worker_task = asyncio.create_task(
+                run_mock_worker(drt_w, "dynamo", interval=0.05, worker_id="mock-1")
+            )
+
+            text = ""
+            for _ in range(50):
+                await asyncio.sleep(0.1)
+                try:
+                    text = await asyncio.to_thread(
+                        lambda: urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/metrics", timeout=2
+                        ).read().decode()
+                    )
+                except OSError:
+                    continue
+                if 'worker="mock-1"' in text:
+                    break
+            assert 'worker="mock-1"' in text
+            assert 'dynamo_worker_up{namespace="dynamo"} 1' in text
+
+            worker_task.cancel()
+            agg_task.cancel()
+            for t in (worker_task, agg_task):
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
+            await drt_w.shutdown()
+            await drt_a.shutdown()
+            await ss.stop()
+            await bus.stop()
+
+        run(go())

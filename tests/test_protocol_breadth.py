@@ -1,0 +1,201 @@
+"""Protocol breadth: logprobs, n>1, echo, suffix rejection, usage-in-stream,
+tool-call extraction — through the real operator pipeline (echo engine) and,
+for logprobs, through the real JAX engine on CPU."""
+
+import dataclasses
+import json
+
+import pytest
+
+from dynamo_tpu.llm.engines import EchoEngineCore
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import (
+    ChatPreprocessorOperator,
+    DetokenizeOperator,
+    OpenAIPreprocessor,
+)
+from dynamo_tpu.llm.protocols.common import HttpError
+from dynamo_tpu.llm.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    aggregate_chat_chunks,
+    aggregate_completion_chunks,
+)
+from dynamo_tpu.runtime import Annotated, Context, Pipeline, collect
+
+
+@pytest.fixture(scope="module")
+def card(tmp_path_factory):
+    from tests.fixtures import build_model_dir
+
+    path = build_model_dir(str(tmp_path_factory.mktemp("model")))
+    return ModelDeploymentCard.from_local_path(path, "tiny")
+
+
+def _echo_pipeline(card, chat=True):
+    pre = OpenAIPreprocessor(card)
+    return (
+        Pipeline()
+        .link(ChatPreprocessorOperator(pre, chat=chat))
+        .link(DetokenizeOperator(card, pre.tokenizer))
+        .link_engine(EchoEngineCore(delay_s=0.0))
+    )
+
+
+class TestNChoices:
+    def test_n_choices_stream_and_fold(self, card, run):
+        engine = _echo_pipeline(card)
+        req = ChatCompletionRequest.model_validate(
+            {
+                "model": "tiny", "n": 3, "stream": True, "max_tokens": 8,
+                "messages": [{"role": "user", "content": "abc"}],
+            }
+        )
+        items = run(collect(engine.generate(Context(req))))
+        chunks = [a.data for a in items if a.data is not None]
+        indices = {c["choices"][0]["index"] for c in chunks if c.get("choices")}
+        assert indices == {0, 1, 2}
+        full = aggregate_chat_chunks(chunks)
+        assert len(full.choices) == 3
+        assert all(ch.finish_reason for ch in full.choices)
+
+    def test_usage_on_last_chunk_only(self, card, run):
+        engine = _echo_pipeline(card)
+        req = ChatCompletionRequest.model_validate(
+            {
+                "model": "tiny", "n": 2, "stream": True, "max_tokens": 4,
+                "stream_options": {"include_usage": True},
+                "messages": [{"role": "user", "content": "hello"}],
+            }
+        )
+        items = run(collect(engine.generate(Context(req))))
+        chunks = [a.data for a in items if a.data is not None]
+        with_usage = [c for c in chunks if c.get("usage")]
+        assert len(with_usage) == 1
+        u = with_usage[0]["usage"]
+        assert u["prompt_tokens"] > 0
+        assert u["completion_tokens"] > 0
+        assert u["total_tokens"] == u["prompt_tokens"] + u["completion_tokens"]
+
+
+class TestCompletionsExtras:
+    def test_echo_prepends_prompt(self, card, run):
+        engine = _echo_pipeline(card, chat=False)
+        req = CompletionRequest.model_validate(
+            {"model": "tiny", "prompt": "hello world", "echo": True,
+             "stream": True, "max_tokens": 8}
+        )
+        items = run(collect(engine.generate(Context(req))))
+        chunks = [a.data for a in items if a.data is not None]
+        full = aggregate_completion_chunks(chunks)
+        assert full.choices[0].text.startswith("hello world")
+
+    def test_suffix_rejected(self, card, run):
+        engine = _echo_pipeline(card, chat=False)
+        req = CompletionRequest.model_validate(
+            {"model": "tiny", "prompt": "fn(", "suffix": ")", "max_tokens": 4}
+        )
+        with pytest.raises(HttpError) as exc:
+            run(collect(engine.generate(Context(req))))
+        assert exc.value.status == 400
+
+
+class TestToolCalls:
+    def test_tool_call_extracted_from_json_answer(self, card):
+        from dynamo_tpu.llm.http.service import _extract_tool_calls
+        from dynamo_tpu.llm.protocols.openai import (
+            ChatChoice,
+            ChatCompletionResponse,
+            ChatMessage,
+        )
+
+        full = ChatCompletionResponse(
+            id="x",
+            choices=[ChatChoice(
+                index=0,
+                message=ChatMessage(
+                    role="assistant",
+                    content='{"name": "get_weather", "arguments": {"city": "SF"}}',
+                ),
+                finish_reason="stop",
+            )],
+        )
+        _extract_tool_calls(full)
+        ch = full.choices[0]
+        assert ch.finish_reason == "tool_calls"
+        assert ch.message.content is None
+        call = ch.message.tool_calls[0]
+        assert call["function"]["name"] == "get_weather"
+        assert json.loads(call["function"]["arguments"]) == {"city": "SF"}
+
+    def test_plain_text_untouched(self, card):
+        from dynamo_tpu.llm.http.service import _extract_tool_calls
+        from dynamo_tpu.llm.protocols.openai import (
+            ChatChoice,
+            ChatCompletionResponse,
+            ChatMessage,
+        )
+
+        full = ChatCompletionResponse(
+            id="x",
+            choices=[ChatChoice(
+                index=0,
+                message=ChatMessage(role="assistant", content="just words"),
+                finish_reason="stop",
+            )],
+        )
+        _extract_tool_calls(full)
+        assert full.choices[0].message.content == "just words"
+        assert full.choices[0].message.tool_calls is None
+
+
+class TestLogprobsEngine:
+    def test_jax_engine_emits_logprobs(self, run):
+        """Greedy decode must emit logprob 0-ish rank-1 chosen tokens whose
+        ids appear first in their own top_logprobs (self-consistency)."""
+        import jax
+        import jax.numpy as jnp
+
+        from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+        from dynamo_tpu.llm.protocols.common import (
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+        from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+
+        cfg = dataclasses.replace(LLAMA_PRESETS["tiny"], dtype=jnp.float32)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        eng = JaxServingEngine(
+            cfg, params,
+            EngineConfig(max_slots=2, kv_block_size=8, max_model_len=64,
+                         prefill_chunk=16, top_logprobs=4),
+        )
+        try:
+            req = PreprocessedRequest(
+                token_ids=[3, 1, 4, 1, 5],
+                stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+                sampling_options=SamplingOptions(temperature=0.0, logprobs=3),
+            )
+
+            async def go():
+                toks, lps, tops = [], [], []
+                async for item in eng.generate(Context(req)):
+                    d = item.data or {}
+                    toks.extend(d.get("token_ids", []))
+                    lps.extend(d.get("log_probs") or [])
+                    tops.extend(d.get("top_logprobs") or [])
+                return toks, lps, tops
+
+            toks, lps, tops = run(go())
+            assert len(toks) == 4
+            assert len(lps) == 4 and all(lp <= 0.0 for lp in lps)
+            assert len(tops) == 4
+            for tok, lp, top in zip(toks, lps, tops):
+                assert len(top) == 3
+                ids = [int(k) for k in top.keys()]
+                # greedy: chosen token IS the argmax → first alternative
+                assert ids[0] == tok
+                assert abs(list(top.values())[0] - lp) < 1e-4
+        finally:
+            eng.close()
