@@ -395,7 +395,11 @@ async def run_endpoint(chat_engine, completions_engine, model_name: str, in_spec
             ),
         )
     logger.info("worker %s serving %s at %s", info.worker_id, in_spec, info.address)
-    await drt.wait_closed()
+    from ..runtime.worker import serve_until_shutdown
+
+    # SIGTERM → deregister, drain in-flight RPC, close engine; exit 911 on
+    # overrun (runtime/worker.py documents the codes)
+    await serve_until_shutdown(drt, engine=core_engine)
 
 
 async def run_prefill_worker_main(out_spec: str, in_spec: str, flags: argparse.Namespace) -> None:

@@ -81,9 +81,10 @@ class EngineConfig:
     # a prefix hit (0 = disabled). Sized in blocks; reference credits the
     # equivalent pinned-host tier with +40% TTFT on multi-turn (BASELINE.md).
     host_cache_blocks: int = 0
-    # alternatives computed per step for OpenAI logprobs (the chosen token's
-    # logprob is always computed); a request can ask for at most this many
-    top_logprobs: int = 8
+    # alternatives computed per step for OpenAI logprobs; matches OpenAI's
+    # documented top_logprobs bound so a validated request is never silently
+    # truncated. Computed (and transferred) only when a request asks.
+    top_logprobs: int = 20
 
     def resolve_num_blocks(self) -> int:
         if self.num_kv_blocks is not None:

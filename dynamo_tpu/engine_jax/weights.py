@@ -62,7 +62,12 @@ def _hf_tensors(model_path: str) -> Optional[Dict[str, np.ndarray]]:
 
 
 def load_params(card: ModelDeploymentCard, config: LlamaConfig, seed: int = 0):
-    """Load HF llama weights into the stacked pytree, or random-init."""
+    """Load llama weights (safetensors or GGUF) into the stacked pytree,
+    or random-init when the card has no weight artifacts."""
+    if card.gguf_path:
+        from dynamo_tpu.llm.gguf import gguf_params, read_gguf
+
+        return gguf_params(read_gguf(card.gguf_path), config)
     tensors = _hf_tensors(card.model_path) if card.model_path else None
     if tensors is None:
         logger.info("no safetensors found for %s: random-initializing", card.display_name)

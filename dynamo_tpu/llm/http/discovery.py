@@ -41,7 +41,12 @@ class ModelWatcher:
         self.manager = manager
         self.router_mode = router_mode
         self.kv_block_size = kv_block_size
-        self._clients: Dict[str, object] = {}  # registry key → EndpointClient
+        # entries are per-worker-instance ({kind}/{name}:{instance}); a model
+        # is served by ONE client per (kind, name) and removed only when its
+        # last entry disappears
+        self._entry_model: Dict[str, tuple] = {}  # key → (kind, name)
+        self._model_keys: Dict[tuple, set] = {}  # (kind, name) → entry keys
+        self._clients: Dict[tuple, object] = {}  # (kind, name) → EndpointClient
         self._task: Optional[asyncio.Task] = None
         self._closed = False
 
@@ -60,7 +65,7 @@ class ModelWatcher:
                 await self._task
             except asyncio.CancelledError:
                 pass
-        for key in list(self._clients):
+        for key in list(self._entry_model):
             await self._remove(key)
 
     async def _run(self) -> None:
@@ -91,7 +96,7 @@ class ModelWatcher:
                 except (ConnectionError, RuntimeError):
                     await self.drt.reconnect_store()
                 snapshot = await self.drt.store.get_prefix(self.prefix)
-                for key in list(self._clients):
+                for key in list(self._entry_model):
                     if key not in snapshot:
                         await self._remove(key)
             except Exception:
@@ -99,11 +104,14 @@ class ModelWatcher:
                 backoff = min(backoff * 2, 10.0)
 
     def _parse_key(self, key: str) -> Optional[tuple]:
-        # {ns}/models/{kind}/{name}
+        # {ns}/models/{kind}/{name}[@{instance}] — the instance suffix makes
+        # entries per-worker; llmctl writes suffix-less entries. '@' (not ':')
+        # so ollama-style model names like "llama3:8b" survive intact.
         tail = key[len(self.prefix):]
         if "/" not in tail:
             return None
         kind, name = tail.split("/", 1)
+        name = name.rsplit("@", 1)[0] if "@" in name else name
         return kind, name
 
     async def _add(self, key: str, value: bytes) -> None:
@@ -117,8 +125,14 @@ class ModelWatcher:
         except (ValueError, KeyError):
             logger.warning("malformed model entry at %s", key)
             return
-        if key in self._clients:
-            await self._remove(key)
+        if key in self._entry_model:
+            return  # entry refresh for a model we already serve
+
+        if parsed in self._clients:
+            # another worker's entry for an already-served model: refcount it
+            self._entry_model[key] = parsed
+            self._model_keys[parsed].add(key)
+            return
 
         from dynamo_tpu.runtime.distributed import parse_endpoint_path
 
@@ -135,7 +149,6 @@ class ModelWatcher:
         except (ValueError, KeyError):
             logger.warning("unusable model entry at %s: %r", key, endpoint_path)
             return
-        self._clients[key] = client
         if kind == "chat":
             self.manager.add_chat_model(name, client)
         elif kind == "completions":
@@ -143,20 +156,28 @@ class ModelWatcher:
         else:
             logger.warning("unknown model kind %r at %s", kind, key)
             await client.close()
-            del self._clients[key]
             return
+        self._clients[parsed] = client
+        self._entry_model[key] = parsed
+        self._model_keys[parsed] = {key}
         logger.info("model %r (%s) added via %s", name, kind, endpoint_path)
 
     async def _remove(self, key: str) -> None:
-        parsed = self._parse_key(key)
-        client = self._clients.pop(key, None)
+        parsed = self._entry_model.pop(key, None)
+        if parsed is None:
+            return
+        keys = self._model_keys.get(parsed)
+        if keys is not None:
+            keys.discard(key)
+            if keys:
+                return  # other workers still serve this model
+            del self._model_keys[parsed]
+        client = self._clients.pop(parsed, None)
         if client is not None:
             try:
                 await client.close()
             except Exception:
                 pass
-        if parsed is None:
-            return
         kind, name = parsed
         if kind == "chat":
             self.manager.remove_chat_model(name)

@@ -27,13 +27,32 @@ class ModelDeploymentCard:
     eos_token_ids: list[int] = field(default_factory=list)
     model_config: dict[str, Any] = field(default_factory=dict)
     mdcsum: Optional[str] = None
+    gguf_path: Optional[str] = None  # set when the model came from a .gguf
+
+    @classmethod
+    def from_gguf(cls, path: str, display_name: Optional[str] = None) -> "ModelDeploymentCard":
+        """Build from a single .gguf file: config + tokenizer are extracted
+        to a sidecar HF-layout dir; weights load straight from the GGUF.
+
+        Reference: ModelDeploymentCard::from_gguf (model_card/create.rs:41-96).
+        """
+        from dynamo_tpu.llm.gguf import extract_model_dir
+
+        hf_dir = extract_model_dir(path)
+        name = display_name or os.path.basename(path).removesuffix(".gguf")
+        card = cls.from_local_path(hf_dir, name)
+        card.gguf_path = path
+        return card
 
     @classmethod
     def from_local_path(cls, path: str, display_name: Optional[str] = None) -> "ModelDeploymentCard":
-        """Build from an HF-layout model directory (config.json + tokenizer files).
+        """Build from an HF-layout model directory (config.json + tokenizer
+        files) or a single .gguf file.
 
         Reference: ModelDeploymentCard::from_local_path (model_card/create.rs:41).
         """
+        if path.endswith(".gguf"):
+            return cls.from_gguf(path, display_name)
         name = display_name or os.path.basename(os.path.normpath(path))
         card = cls(display_name=name, model_path=path)
 

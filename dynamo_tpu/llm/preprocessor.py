@@ -297,11 +297,14 @@ class ChatPreprocessorOperator(Operator):
         )
         echo = bool(not self._chat and getattr(oai_req, "echo", None))
         n = oai_req.n or 1
+        if not 1 <= n <= 32:
+            raise HttpError(400, f"n must be within [1, 32], got {n}")
 
         # n>1: fan out n engine streams (seed-varied), multiplex by choice
         # index as they produce (reference: protocols/openai n handling; the
-        # engine itself stays single-sequence)
-        queue: asyncio.Queue = asyncio.Queue()
+        # engine itself stays single-sequence). Bounded queue keeps the
+        # end-to-end pull-based backpressure of the single-stream path.
+        queue: asyncio.Queue = asyncio.Queue(maxsize=16)
         _DONE = object()
 
         def choice_request(i: int) -> PreprocessedRequest:
@@ -331,9 +334,17 @@ class ChatPreprocessorOperator(Operator):
             prop_task = asyncio.create_task(propagate_cancel())
 
         async def pump(i: int):
+            # engine-stream exceptions must reach the caller, not die in the
+            # task (a swallowed error would end the stream looking successful
+            # but truncated); the main loop re-raises them
             try:
-                async for item in next_engine.generate(child_ctxs[i]):
-                    await queue.put((i, item))
+                try:
+                    async for item in next_engine.generate(child_ctxs[i]):
+                        await queue.put((i, item))
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    await queue.put((i, ("__raise__", e)))
             finally:
                 await queue.put((i, _DONE))
 
@@ -347,6 +358,8 @@ class ChatPreprocessorOperator(Operator):
                 if item is _DONE:
                     finished += 1
                     continue
+                if isinstance(item, tuple) and item and item[0] == "__raise__":
+                    raise item[1]
                 if isinstance(item, Annotated):
                     if item.is_error:
                         yield item

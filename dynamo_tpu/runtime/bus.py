@@ -45,22 +45,33 @@ class _Conn:
 
     def __init__(self, writer: asyncio.StreamWriter, maxsize: int = 512):
         self.writer = writer
+        # items are (msg, fut|None): fut resolves True once the frame has
+        # been written to the socket, False if the connection died first
         self.outbox: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
         self.alive = True
         self.dropped = 0
         self.task = asyncio.create_task(self._drain())
 
     async def _drain(self) -> None:
+        fut = None
         try:
             while True:
-                msg = await self.outbox.get()
+                msg, fut = await self.outbox.get()
                 await write_frame(self.writer, msg)
+                if fut is not None and not fut.done():
+                    fut.set_result(True)
+                fut = None
         except (ConnectionError, RuntimeError, OSError, asyncio.CancelledError):
             self.alive = False
-            # discard queued frames so send_reliable callers blocked on a
-            # full outbox wake up (get_nowait wakes putters) and see alive=False
-            while not self.outbox.empty():
-                self.outbox.get_nowait()
+            if fut is not None and not fut.done():  # mid-write casualty
+                fut.set_result(False)
+            self._fail_queued()
+
+    def _fail_queued(self) -> None:
+        while not self.outbox.empty():
+            _, fut = self.outbox.get_nowait()
+            if fut is not None and not fut.done():
+                fut.set_result(False)
 
     def send(self, msg: TwoPartMessage) -> bool:
         """Best-effort enqueue; False = connection dead or outbox full.
@@ -69,7 +80,7 @@ class _Conn:
         if not self.alive:
             return False
         try:
-            self.outbox.put_nowait(msg)
+            self.outbox.put_nowait((msg, None))
             return True
         except asyncio.QueueFull:
             self.dropped += 1
@@ -81,16 +92,25 @@ class _Conn:
             return False
 
     async def send_reliable(self, msg: TwoPartMessage) -> bool:
-        """Guaranteed-order enqueue with backpressure (awaits outbox space);
-        False only if the connection is dead."""
+        """Backpressured enqueue confirmed at SOCKET-WRITE time: resolves
+        True only after the frame actually reached the kernel buffer, False
+        if the connection died first — so a qpush/qpop delivery reported
+        `delivered` can't be silently discarded by a dying drain task (the
+        caller requeues or tries the next waiter instead)."""
         if not self.alive:
             return False
-        await self.outbox.put(msg)
-        return self.alive
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self.outbox.put((msg, fut))
+        if not self.alive:
+            # drain died between the liveness check and our put: its cleanup
+            # may have missed this item, so fail queued entries ourselves
+            self._fail_queued()
+        return await fut
 
     def close(self) -> None:
         self.alive = False
         self.task.cancel()
+        self._fail_queued()
 
 
 class MessageBusServer:

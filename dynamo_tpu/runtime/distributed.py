@@ -235,8 +235,13 @@ class Endpoint:
             for kind in kinds:
                 entry = dict(model_entry, kind=kind, endpoint=self.path)
                 entry.pop("kinds", None)
+                # per-instance entry key (reference endpoint.rs:98-108 keys by
+                # lease id too): N workers serving one model hold N entries,
+                # and one worker's deregistration can't delete the model out
+                # from under the others — the discovery watcher refcounts
                 keys[
                     f"{self.component.namespace.name}/models/{kind}/{name}"
+                    f"@{info.instance_id}"
                 ] = json.dumps(entry).encode()
         for k, v in keys.items():
             await rt.store.put(k, v, lease=lease)

@@ -1,0 +1,90 @@
+"""Worker main-wrapper: signal-driven graceful shutdown with a hard timeout.
+
+Lifecycle on SIGTERM/SIGINT (re-designed from the reference's Worker,
+`lib/runtime/src/worker.rs:59-211`):
+
+1. deregister — the runtime's primary lease is revoked, deleting every
+   lease-attached key (endpoint instances, model entries); client watchers
+   drop the worker from the live set immediately, so no new requests route
+   here;
+2. drain — the RPC server stops accepting connections and waits for
+   in-flight streams to finish (bounded);
+3. close — the serving engine is shut down.
+
+Exit codes:
+- 0   clean shutdown (drain completed inside the window)
+- 911 graceful-shutdown timeout overrun (the whole sequence exceeded
+  ``DYN_TPU_GRACEFUL_SHUTDOWN_TIMEOUT``, default 30 s — same code the
+  reference uses for the same condition)
+
+A second signal during the drain skips straight to the hard exit.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import signal
+import sys
+
+logger = logging.getLogger(__name__)
+
+EXIT_OK = 0
+EXIT_GRACEFUL_TIMEOUT = 911
+
+DEFAULT_TIMEOUT = 30.0
+
+
+def graceful_timeout() -> float:
+    try:
+        return float(os.environ.get("DYN_TPU_GRACEFUL_SHUTDOWN_TIMEOUT", DEFAULT_TIMEOUT))
+    except ValueError:
+        return DEFAULT_TIMEOUT
+
+
+async def serve_until_shutdown(drt, engine=None) -> None:
+    """Block until SIGTERM/SIGINT, then run the graceful sequence.
+
+    ``drt`` is the DistributedRuntime whose shutdown() performs
+    deregister→drain→close-transports; ``engine`` (optional) is closed after
+    the runtime. Exits the process with the codes documented above.
+    """
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    signals_seen = 0
+
+    def on_signal(signame: str) -> None:
+        nonlocal signals_seen
+        signals_seen += 1
+        if signals_seen > 1:
+            logger.warning("second %s during drain: hard exit", signame)
+            os._exit(EXIT_GRACEFUL_TIMEOUT)
+        logger.info("%s received: graceful shutdown begins", signame)
+        stop.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, on_signal, sig.name)
+        except (NotImplementedError, RuntimeError):  # non-main thread / platform
+            pass
+
+    closed = asyncio.create_task(drt.wait_closed())
+    stopped = asyncio.create_task(stop.wait())
+    await asyncio.wait({closed, stopped}, return_when=asyncio.FIRST_COMPLETED)
+    for t in (closed, stopped):
+        t.cancel()
+
+    timeout = graceful_timeout()
+    try:
+        async with asyncio.timeout(timeout):
+            await drt.shutdown()  # lease revoke → RPC drain → transports
+            if engine is not None and hasattr(engine, "close"):
+                engine.close()
+    except TimeoutError:
+        logger.error(
+            "graceful shutdown exceeded %.0fs: exiting %d",
+            timeout, EXIT_GRACEFUL_TIMEOUT,
+        )
+        sys.exit(EXIT_GRACEFUL_TIMEOUT)
+    logger.info("worker shut down cleanly")

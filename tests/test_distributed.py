@@ -187,6 +187,30 @@ class TestMessageBus:
 
         run(go())
 
+    def test_reliable_send_confirms_at_write_time(self, run):
+        """send_reliable must resolve False when the connection dies before
+        the frame hits the socket — a dying drain task used to discard the
+        outbox after reporting success, silently losing queue deliveries."""
+        from dynamo_tpu.runtime.bus import _Conn
+        from dynamo_tpu.runtime.codec import TwoPartMessage
+
+        class DeadWriter:
+            def write(self, data):
+                raise ConnectionResetError("peer gone")
+
+            async def drain(self):
+                raise ConnectionResetError("peer gone")
+
+        async def go():
+            conn = _Conn(DeadWriter())
+            ok = await conn.send_reliable(TwoPartMessage(b"h", b"payload"))
+            assert ok is False, "delivery to a dead connection must not be confirmed"
+            assert conn.alive is False
+            # and subsequent sends short-circuit
+            assert await conn.send_reliable(TwoPartMessage(b"h", b"x")) is False
+
+        run(go())
+
     def test_blocking_pop_wakes_on_push(self, run):
         async def go():
             server = MessageBusServer(port=0)

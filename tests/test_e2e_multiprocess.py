@@ -195,6 +195,46 @@ class TestMultiProcessE2E:
         )
         assert resp2["choices"][0]["message"]["content"]
 
+    def test_sigterm_graceful_shutdown(self, cluster):
+        """SIGTERM → the worker deregisters (keys gone from the registry,
+        not just lease-expired) and exits 0 inside the graceful window."""
+        import asyncio
+
+        from dynamo_tpu.runtime.statestore import StateStoreClient
+
+        async def instances():
+            store = await StateStoreClient.connect(cluster["ss_url"])
+            try:
+                return await store.get_prefix("dynamo/components/")
+            finally:
+                await store.close()
+
+        baseline = len(asyncio.run(instances()))
+        proc = _spawn(
+            cluster["worker_args"], env={"DYN_TPU_TOKEN_ECHO_DELAY_MS": "1"}
+        )
+        try:
+            deadline = time.time() + 20
+            before = {}
+            while time.time() < deadline:
+                before = asyncio.run(instances())
+                if len(before) > baseline:  # the new worker registered
+                    break
+                time.sleep(0.25)
+            assert len(before) > baseline, "test worker never registered"
+            time.sleep(0.5)  # past registration, into serve_until_shutdown
+
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=30)
+            assert rc == 0, f"graceful shutdown exited {rc}"
+
+            # deregistration is immediate (lease revoked), not TTL-expiry
+            after = asyncio.run(instances())
+            assert len(after) < len(before), "worker keys were not deregistered"
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
     def test_worker_death_failover(self, cluster):
         """Second worker joins; killing the first must leave service up
         (requests route to the survivor after lease expiry)."""

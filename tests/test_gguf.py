@@ -1,0 +1,206 @@
+"""GGUF support: synthetic-file round trip.
+
+A minimal GGUF writer (spec-conformant, v3) builds a file from the tiny
+fixture model + tokenizer; the loader must recover config, tokenizer, and
+bit-exact tensors, and the extracted HF dir must drive the real
+ModelDeploymentCard + forward pass.
+"""
+
+import json
+import struct
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.llm import gguf as G
+
+
+def _w_str(buf, s):
+    b = s.encode()
+    buf += struct.pack("<Q", len(b)) + b
+
+
+def _w_kv(buf, key, vtype, value):
+    _w_str(buf, key)
+    buf += struct.pack("<I", vtype)
+    _w_val(buf, vtype, value)
+
+
+def _w_val(buf, vtype, value):
+    if vtype == G.T_STRING:
+        _w_str(buf, value)
+    elif vtype == G.T_ARRAY:
+        etype, items = value
+        buf += struct.pack("<IQ", etype, len(items))
+        for it in items:
+            _w_val(buf, etype, it)
+    elif vtype == G.T_BOOL:
+        buf += struct.pack("<?", value)
+    else:
+        buf += struct.pack(G._SCALAR_FMT[vtype], value)
+
+
+def write_gguf(path, metadata, tensors):
+    """metadata: [(key, vtype, value)]; tensors: {name: np.ndarray f32}."""
+    buf = bytearray()
+    buf += struct.pack("<IIQQ", G.GGUF_MAGIC, 3, len(tensors), len(metadata))
+    for key, vtype, value in metadata:
+        _w_kv(buf, key, vtype, value)
+
+    align = 32
+    offset = 0
+    blobs = []
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr, dtype=np.float32)
+        _w_str(buf, name)
+        dims = tuple(reversed(arr.shape))  # GGUF stores innermost-first
+        buf += struct.pack("<I", len(dims))
+        buf += struct.pack(f"<{len(dims)}Q", *dims)
+        buf += struct.pack("<I", G.GGML_F32)
+        buf += struct.pack("<Q", offset)
+        blob = arr.tobytes()
+        pad = (-len(blob)) % align
+        blobs.append(blob + b"\0" * pad)
+        offset += len(blob) + pad
+
+    pad = (-len(buf)) % align
+    buf += b"\0" * pad
+    for blob in blobs:
+        buf += blob
+    with open(path, "wb") as f:
+        f.write(bytes(buf))
+    return path
+
+
+@pytest.fixture(scope="module")
+def tiny_gguf(tmp_path_factory):
+    """A GGUF export of the tiny llama + the fixture BPE tokenizer."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+    from tests.fixtures import build_tokenizer
+
+    cfg = dataclasses.replace(LLAMA_PRESETS["tiny"], dtype=jnp.float32)
+    tk = build_tokenizer()
+    tkj = json.loads(tk.to_str())
+    vocab = sorted(tkj["model"]["vocab"], key=tkj["model"]["vocab"].get)
+    merges = [
+        m if isinstance(m, str) else " ".join(m) for m in tkj["model"]["merges"]
+    ]
+    cfg = dataclasses.replace(cfg, vocab_size=len(vocab))
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    token_types = [1] * len(vocab)
+    for sp in ("<s>", "</s>", "<|user|>", "<|assistant|>", "<|system|>"):
+        tid = tk.token_to_id(sp)
+        if tid is not None:
+            token_types[tid] = 3  # CONTROL
+
+    md = [
+        ("general.architecture", G.T_STRING, "llama"),
+        ("general.name", G.T_STRING, "tiny-test"),
+        ("llama.embedding_length", G.T_UINT32, cfg.hidden_size),
+        ("llama.block_count", G.T_UINT32, cfg.num_layers),
+        ("llama.feed_forward_length", G.T_UINT32, cfg.intermediate_size),
+        ("llama.attention.head_count", G.T_UINT32, cfg.num_heads),
+        ("llama.attention.head_count_kv", G.T_UINT32, cfg.num_kv_heads),
+        ("llama.rope.freq_base", G.T_FLOAT32, cfg.rope_theta),
+        ("llama.attention.layer_norm_rms_epsilon", G.T_FLOAT32, cfg.rms_norm_eps),
+        ("llama.context_length", G.T_UINT32, 2048),
+        ("tokenizer.ggml.model", G.T_STRING, "gpt2"),
+        ("tokenizer.ggml.tokens", G.T_ARRAY, (G.T_STRING, vocab)),
+        ("tokenizer.ggml.merges", G.T_ARRAY, (G.T_STRING, merges)),
+        ("tokenizer.ggml.token_type", G.T_ARRAY, (G.T_INT32, token_types)),
+        ("tokenizer.ggml.bos_token_id", G.T_UINT32, tk.token_to_id("<s>")),
+        ("tokenizer.ggml.eos_token_id", G.T_UINT32, tk.token_to_id("</s>")),
+    ]
+
+    tensors = {
+        "token_embd.weight": np.asarray(params["embed"]),
+        "output_norm.weight": np.asarray(params["final_norm"]),
+    }
+    if "lm_head" in params:
+        tensors["output.weight"] = np.asarray(params["lm_head"]).T
+    lp = params["layers"]
+    for i in range(cfg.num_layers):
+        tensors[f"blk.{i}.attn_norm.weight"] = np.asarray(lp["attn_norm"][i])
+        tensors[f"blk.{i}.attn_q.weight"] = np.asarray(lp["wq"][i]).T
+        tensors[f"blk.{i}.attn_k.weight"] = np.asarray(lp["wk"][i]).T
+        tensors[f"blk.{i}.attn_v.weight"] = np.asarray(lp["wv"][i]).T
+        tensors[f"blk.{i}.attn_output.weight"] = np.asarray(lp["wo"][i]).T
+        tensors[f"blk.{i}.ffn_norm.weight"] = np.asarray(lp["mlp_norm"][i])
+        tensors[f"blk.{i}.ffn_gate.weight"] = np.asarray(lp["w_gate"][i]).T
+        tensors[f"blk.{i}.ffn_up.weight"] = np.asarray(lp["w_up"][i]).T
+        tensors[f"blk.{i}.ffn_down.weight"] = np.asarray(lp["w_down"][i]).T
+
+    path = str(tmp_path_factory.mktemp("gguf") / "tiny.gguf")
+    write_gguf(path, md, tensors)
+    return path, cfg, params
+
+
+class TestGgufParsing:
+    def test_metadata_and_tensors(self, tiny_gguf):
+        path, cfg, params = tiny_gguf
+        g = G.read_gguf(path)
+        assert g.architecture == "llama"
+        assert int(g.arch_key("block_count")) == cfg.num_layers
+        emb = g.load_tensor("token_embd.weight")
+        np.testing.assert_array_equal(emb, np.asarray(params["embed"]))
+
+    def test_config_dict(self, tiny_gguf):
+        path, cfg, _ = tiny_gguf
+        d = G.model_config_dict(G.read_gguf(path))
+        assert d["hidden_size"] == cfg.hidden_size
+        assert d["num_key_value_heads"] == cfg.num_kv_heads
+        assert d["vocab_size"] == cfg.vocab_size
+        assert d["tie_word_embeddings"] == cfg.tie_embeddings
+
+    def test_tokenizer_roundtrip(self, tiny_gguf, tmp_path):
+        from dynamo_tpu.llm.tokenizer import HFTokenizer
+
+        path, _, _ = tiny_gguf
+        out = G.write_hf_tokenizer(G.read_gguf(path), str(tmp_path))
+        tk = HFTokenizer.from_file(f"{out}/tokenizer.json")
+        ids = tk.encode("hello world")
+        assert ids and tk.decode(ids) == "hello world"
+
+    def test_extract_model_dir_serves_forward(self, tiny_gguf):
+        """GGUF → HF dir → ModelDeploymentCard → gguf weights → greedy step
+        identical to the original params."""
+        import jax.numpy as jnp
+
+        from dynamo_tpu.llm.model_card import ModelDeploymentCard
+        from dynamo_tpu.models.llama import forward, make_kv_cache
+
+        path, cfg, params = tiny_gguf
+        out = G.extract_model_dir(path)
+        card = ModelDeploymentCard.from_local_path(out, "tiny-gguf")
+        assert card.context_length == 2048
+
+        g = G.read_gguf(path)
+        loaded = G.gguf_params(g, cfg, dtype=jnp.float32)
+
+        cache_a = make_kv_cache(cfg, 8, 8, dtype=jnp.float32)
+        cache_b = make_kv_cache(cfg, 8, 8, dtype=jnp.float32)
+        tables = jnp.arange(8, dtype=jnp.int32)[None].repeat(1, 0)
+        toks = jnp.asarray([[3, 1, 4, 1, 5]], jnp.int32)
+        pos = jnp.arange(5)[None]
+        la, _ = forward(params, cfg, toks, pos, cache_a, tables[:, :8])
+        lb, _ = forward(loaded, cfg, toks, pos, cache_b, tables[:, :8])
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=1e-5)
+
+    def test_quantized_tensor_rejected(self, tiny_gguf, tmp_path):
+        path, _, _ = tiny_gguf
+        g = G.read_gguf(path)
+        g.tensors["token_embd.weight"].ggml_type = 2  # Q4_0
+        with pytest.raises(ValueError, match="quantized"):
+            g.load_tensor("token_embd.weight")
+
+    def test_bad_magic_rejected(self, tmp_path):
+        p = tmp_path / "bad.gguf"
+        p.write_bytes(b"NOPE" + b"\0" * 64)
+        with pytest.raises(ValueError, match="not a GGUF"):
+            G.read_gguf(str(p))

@@ -78,6 +78,46 @@ class TestModelDiscovery:
 
         run(go())
 
+    def test_model_survives_one_of_two_workers_leaving(self, run):
+        """Two workers serve the same model; one deregistering must NOT
+        remove the model (per-instance entries + refcounted watcher)."""
+
+        async def go():
+            ss = StateStoreServer(port=0)
+            bus = MessageBusServer(port=0)
+            await ss.start()
+            await bus.start()
+            fe = await DistributedRuntime.create(ss.url, bus.url)
+            manager = ModelManager()
+            watcher = ModelWatcher(fe, "dynamo", manager)
+            watcher.start()
+
+            workers = []
+            for _ in range(2):
+                wk = await DistributedRuntime.create(ss.url, bus.url)
+                ep = wk.namespace("dynamo").component("backend").endpoint("generate")
+                await ep.component.create_service()
+                await ep.serve(Parrot(), model_entry={"name": "shared", "kind": "chat"})
+                workers.append(wk)
+
+            assert await _wait_for(lambda: "shared" in manager.model_names())
+            await workers[0].shutdown()  # deregisters instantly (lease revoke)
+            await asyncio.sleep(1.0)
+            assert "shared" in manager.model_names(), (
+                "model vanished while a worker still serves it"
+            )
+            await workers[1].shutdown()
+            assert await _wait_for(
+                lambda: "shared" not in manager.model_names(), timeout=30.0
+            )
+
+            await watcher.close()
+            await fe.shutdown()
+            await ss.stop()
+            await bus.stop()
+
+        run(go())
+
     def test_llmctl_add_list_remove(self, run):
         async def go():
             ss = StateStoreServer(port=0)
