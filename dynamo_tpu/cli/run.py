@@ -133,6 +133,40 @@ def _token_pipelines(card: ModelDeploymentCard, make_core):
     return build(True), build(False)
 
 
+def _load_user_engine(path: str):
+    """Load a bring-your-own-engine python file.
+
+    The file must expose either an AsyncEngine instance named ``engine`` or
+    a factory ``make_engine()`` returning one, or a module-level async
+    generator function ``generate(request)`` (wrapped automatically).
+    Reference: `lib/engines/python/src/lib.rs:78-382` (pystr:/pytok:).
+    """
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("dyn_user_engine", path)
+    if spec is None or spec.loader is None:
+        raise SystemExit(f"cannot load user engine file {path!r}")
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+
+    if hasattr(module, "engine"):
+        return module.engine
+    if hasattr(module, "make_engine"):
+        return module.make_engine()
+    if hasattr(module, "generate"):
+        from ..runtime.engine import AsyncEngine
+
+        class _FnEngine(AsyncEngine):
+            async def generate(self, request):
+                async for item in module.generate(request):
+                    yield item
+
+        return _FnEngine()
+    raise SystemExit(
+        f"user engine {path!r} must define `engine`, `make_engine()`, or `generate()`"
+    )
+
+
 def build_engine(out_spec: str, flags: argparse.Namespace):
     """Build the OpenAI-level engines for `out=<spec>`.
 
@@ -148,6 +182,32 @@ def build_engine(out_spec: str, flags: argparse.Namespace):
     if out_spec == "echo_full":
         engine = EchoEngineFull()
         return engine, engine, model_name, None
+
+    if out_spec.startswith(("pystr:", "pytok:")):
+        # bring-your-own-engine: a user python file provides the engine
+        # (reference lib/engines/python: same two integration levels)
+        scheme, _, path = out_spec.partition(":")
+        user_engine = _load_user_engine(path)
+        if scheme == "pystr":
+            # OpenAI-request level: the user engine sees plain request dicts
+            # (the reference hands its python engines JSON, not typed models)
+            from ..runtime.engine import AsyncEngine
+
+            class _DictRequests(AsyncEngine):
+                async def generate(self, request):
+                    data = request.data
+                    if hasattr(data, "model_dump"):
+                        data = data.model_dump(exclude_none=True)
+                    async for item in user_engine.generate(request.transfer(data)):
+                        yield item
+
+            eng = _DictRequests()
+            return eng, eng, model_name, None
+        # token level: wrap in the preprocessor/detokenizer pipelines
+        if card is None:
+            raise SystemExit("out=pytok: requires --model-path (tokenizer needed)")
+        chat_eng, comp_eng = _token_pipelines(card, lambda: user_engine)
+        return chat_eng, comp_eng, model_name, user_engine
 
     if out_spec == "echo_core":
         if card is None:
@@ -377,6 +437,11 @@ async def run_endpoint(chat_engine, completions_engine, model_name: str, in_spec
         await attach_kv_publishing(endpoint, core_engine)
         logger.info("kv events + metrics publishing enabled (worker key %s)", drt.worker_id)
     if flags.disagg == "decode" and core_engine is not None:
+        if not hasattr(core_engine, "set_remote_prefill_policy"):
+            raise SystemExit(
+                "--disagg decode needs an engine with remote-prefill support "
+                f"(out=jax); {type(core_engine).__name__} has none"
+            )
         from ..disagg.protocols import DisaggConfig
         from ..disagg.serving import enable_disagg_decode
 

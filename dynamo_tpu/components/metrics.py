@@ -87,40 +87,18 @@ async def run_aggregator(
     drt, namespace: str, port: int, host: str = "0.0.0.0", expiry: float = 30.0
 ) -> None:
     """Subscribe to kv_metrics and serve /metrics until cancelled."""
-    from dynamo_tpu.runtime.distributed import KV_METRICS_SUBJECT
+    from dynamo_tpu.runtime.distributed import KV_METRICS_SUBJECT, resubscribe_forever
 
     agg = MetricsAggregator(namespace, expiry=expiry)
     ns = drt.namespace(namespace)
-
-    async def consume():
-        # resubscribe forever: a bus hiccup must not silently freeze the
-        # exporter (workers would linger until expiry, then show as zero)
-        backoff = 0.5
-        while True:
-            try:
-                sub = await ns.subscribe(KV_METRICS_SUBJECT)
-                backoff = 0.5
-                async for payload in sub:
-                    try:
-                        msg = (
-                            json.loads(payload)
-                            if isinstance(payload, (bytes, str))
-                            else payload
-                        )
-                        agg.update(
-                            msg["worker_id"],
-                            ForwardPassMetrics.from_dict(msg["metrics"]),
-                        )
-                    except (KeyError, ValueError, TypeError):
-                        logger.warning("malformed kv_metrics payload", exc_info=True)
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                logger.warning("kv_metrics subscription lost; retrying", exc_info=True)
-            await asyncio.sleep(backoff)
-            backoff = min(backoff * 2, 10.0)
-
-    consumer = asyncio.create_task(consume())
+    consumer = asyncio.create_task(
+        resubscribe_forever(
+            ns, KV_METRICS_SUBJECT,
+            lambda d: agg.update(
+                d["worker_id"], ForwardPassMetrics.from_dict(d["metrics"])
+            ),
+        )
+    )
 
     async def metrics_handler(_request):
         return web.Response(

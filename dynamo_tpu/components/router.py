@@ -1,0 +1,117 @@
+"""Standalone KV-aware router service.
+
+Serves ``{"token_ids": [...]}` → ``{"worker_id", "overlap_blocks",
+"prefix_hit_rate"}`` as a distributed endpoint, feeding its radix tree from
+the namespace's ``kv_events``/``kv_metrics`` streams — so frontends (or any
+component) can delegate routing decisions instead of embedding the router
+in their client.
+
+Reference counterpart: the `router` component binary
+(`components/router/src/main.rs:50-95`: KvRouter wrapped in an Ingress
+serving `generate`).
+
+Run:  python -m dynamo_tpu.components.router --namespace dynamo
+Call: dyn://{ns}.router.schedule
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, RouterEvent
+from dynamo_tpu.kv_router.router import KvRouter
+from dynamo_tpu.runtime.annotated import Annotated
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+logger = logging.getLogger(__name__)
+
+
+class RouterEngine(AsyncEngine):
+    """AsyncEngine facade over KvRouter: one request in, one decision out."""
+
+    def __init__(self, router: KvRouter):
+        self.router = router
+
+    async def generate(self, request: Context):
+        data = request.data
+        token_ids = data.get("token_ids") if isinstance(data, dict) else None
+        if not token_ids:
+            yield Annotated.from_error("router request needs token_ids")
+            return
+        decision = self.router.schedule(token_ids)
+        if decision is None:
+            yield Annotated.from_error("no workers registered")
+            return
+        blocks = (len(token_ids) + self.router.block_size - 1) // self.router.block_size
+        yield Annotated.from_data(
+            {
+                "worker_id": decision.worker_id,
+                "overlap_blocks": decision.overlap_blocks,
+                "prefix_hit_rate": decision.overlap_blocks / max(blocks, 1),
+            }
+        )
+
+
+async def run_router(drt, namespace: str, block_size: int = 16) -> None:
+    """Register the router endpoint and feed it from the event plane."""
+    from dynamo_tpu.runtime.distributed import (
+        KV_EVENTS_SUBJECT,
+        KV_METRICS_SUBJECT,
+        resubscribe_forever,
+    )
+
+    router = KvRouter(block_size)
+    ns = drt.namespace(namespace)
+    feeds = [
+        asyncio.create_task(resubscribe_forever(
+            ns, KV_EVENTS_SUBJECT,
+            lambda d: router.apply_event(RouterEvent.from_dict(d)),
+        )),
+        asyncio.create_task(resubscribe_forever(
+            ns, KV_METRICS_SUBJECT,
+            lambda d: router.update_worker_metrics(
+                d["worker_id"], ForwardPassMetrics.from_dict(d["metrics"])
+            ),
+        )),
+    ]
+
+    component = ns.component("router")
+    await component.create_service()
+    endpoint = component.endpoint("schedule")
+    info = await endpoint.serve(RouterEngine(router))
+    logger.info("router service %s at dyn://%s.router.schedule", info.worker_id, namespace)
+    try:
+        await drt.wait_closed()
+    finally:
+        for t in feeds:
+            t.cancel()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="dynamo_tpu standalone KV router")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--kv-block-size", type=int, default=16)
+    p.add_argument("--statestore", default=None)
+    p.add_argument("--bus", default=None)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+        from dynamo_tpu.runtime.worker import serve_until_shutdown
+
+        drt = await DistributedRuntime.create(
+            statestore_url=args.statestore, bus_url=args.bus
+        )
+        task = asyncio.create_task(run_router(drt, args.namespace, args.kv_block_size))
+        await serve_until_shutdown(drt)
+        task.cancel()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

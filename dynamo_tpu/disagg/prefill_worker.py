@@ -83,10 +83,12 @@ class PrefillEngine:
         return fn
 
     def prefill(
-        self, token_ids: List[int], cached_tokens: int, sampling: dict
+        self, token_ids: List[int], cached_tokens: int, sampling: dict,
+        as_device: bool = False,
     ) -> Tuple[int, np.ndarray, np.ndarray]:
         """Compute the prompt KV; return (first_token, k_pages, v_pages) where
-        the pages cover blocks from cached_tokens//block_size onward."""
+        the pages cover blocks from cached_tokens//block_size onward.
+        ``as_device=True`` returns jax arrays (same-host device path)."""
         import jax
         import jax.numpy as jnp
 
@@ -117,6 +119,11 @@ class PrefillEngine:
         first_block = cached_tokens // self.block_size
         n_blocks = math.ceil(n / self.block_size)
         idx = jnp.arange(first_block, n_blocks, dtype=jnp.int32)
+        if as_device:
+            # device path: hand the page slices over as jax arrays (the
+            # same-host transfer re-shards them straight into the decode
+            # engine's mesh, no host copy)
+            return first_token, self._cache["k"][:, idx], self._cache["v"][:, idx]
         k = np.asarray(jax.device_get(self._cache["k"][:, idx]))
         v = np.asarray(jax.device_get(self._cache["v"][:, idx]))
         return first_token, k, v

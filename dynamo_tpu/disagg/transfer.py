@@ -80,6 +80,34 @@ class KvTransferServer:
             writer.close()
 
 
+class LocalKvTransfer:
+    """Same-host prefill→decode handoff with pages staying device-resident.
+
+    When prefill and decode engines share a process (one host's chips split
+    between a prefill mesh and a decode mesh), pages move as jax arrays:
+    XLA reshards them across the two meshes at the inject jit boundary —
+    including differing tensor-parallel layouts, since resharding splits or
+    merges the kv-head axis as needed. No host copy, no TCP. This is the
+    TPU device path standing in for the reference's same-node NIXL
+    GPU-to-GPU transfer (SURVEY.md §2.10).
+    """
+
+    def __init__(self, decode_engine):
+        self.decode = decode_engine
+
+    async def send_blocks(
+        self, address: str, request_id: str, first_token: int, block_ids, k, v
+    ) -> None:
+        # address ignored: the target is in-process
+        self.decode.complete_remote_prefill(request_id, first_token, list(block_ids), k, v)
+
+    async def send_failure(self, address: str, request_id: str, message: str) -> None:
+        self.decode.fail_remote_prefill(request_id, message)
+
+    async def close(self) -> None:
+        pass
+
+
 class KvTransferClient:
     """Prefill-worker side: pooled connections to decode workers' servers."""
 

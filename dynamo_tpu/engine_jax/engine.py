@@ -889,7 +889,11 @@ class JaxServingEngine(AsyncEngine):
         MUST run on the engine thread. Donated update (no cache-sized copy);
         the page count is padded to a power of two so at most log2(max_blocks)
         shapes ever compile — an unpadded count would recompile the donated
-        scatter (and stall decode) for every distinct transfer size."""
+        scatter (and stall decode) for every distinct transfer size.
+
+        Accepts host numpy (staged transfers) or jax arrays (the same-host
+        device path: pages flow device→device, resharding across meshes —
+        including differing tp — handled by XLA at the jit boundary)."""
         n = len(block_ids)
         bucket = 1
         while bucket < n:
@@ -899,6 +903,17 @@ class JaxServingEngine(AsyncEngine):
         dt = self.cache["k"].dtype
 
         def pad(vals):
+            if isinstance(vals, jax.Array):
+                widths = [(0, 0), (0, bucket - n)] + [(0, 0)] * (vals.ndim - 2)
+                out = jnp.pad(vals, widths)
+                # commit onto THIS engine's devices: jax.device_put reshards
+                # across meshes, but jit's device check rejects an input
+                # committed to a different mesh (split-chip prefill/decode)
+                if self.mesh is not None:
+                    from dynamo_tpu.parallel.mesh import kv_cache_sharding
+
+                    return jax.device_put(out, kv_cache_sharding(self.mesh))
+                return jax.device_put(out, next(iter(self.cache["k"].devices())))
             out = np.zeros((vals.shape[0], bucket) + vals.shape[2:], vals.dtype)
             out[:, :n] = vals
             return out

@@ -43,6 +43,9 @@ def config_from_card(card: ModelDeploymentCard, dtype: Any = jnp.bfloat16) -> Ll
         rope_theta=float(mc.get("rope_theta", 500000.0)),
         rms_norm_eps=float(mc.get("rms_norm_eps", 1e-5)),
         tie_embeddings=bool(mc.get("tie_word_embeddings", False)),
+        # qwen2 attention carries q/k/v biases (HF config doesn't flag it;
+        # the architecture implies it)
+        qkv_bias=mc.get("model_type") == "qwen2",
         dtype=dtype,
     )
 
@@ -104,6 +107,21 @@ def params_from_hf(tensors: Dict[str, np.ndarray], config: LlamaConfig):
             "wk": stack("model.layers.{}.self_attn.k_proj.weight", lin),
             "wv": stack("model.layers.{}.self_attn.v_proj.weight", lin),
             "wo": stack("model.layers.{}.self_attn.o_proj.weight", lin),
+            **(
+                {
+                    "bq": jnp.asarray(np.stack(
+                        [get(f"model.layers.{i}.self_attn.q_proj.bias") for i in range(c.num_layers)]
+                    ), jnp.float32),
+                    "bk": jnp.asarray(np.stack(
+                        [get(f"model.layers.{i}.self_attn.k_proj.bias") for i in range(c.num_layers)]
+                    ), jnp.float32),
+                    "bv": jnp.asarray(np.stack(
+                        [get(f"model.layers.{i}.self_attn.v_proj.bias") for i in range(c.num_layers)]
+                    ), jnp.float32),
+                }
+                if c.qkv_bias
+                else {}
+            ),
             "mlp_norm": jnp.asarray(
                 np.stack([get(f"model.layers.{i}.post_attention_layernorm.weight") for i in range(c.num_layers)]),
                 jnp.float32,

@@ -323,14 +323,25 @@ def gguf_params(gguf: GgufFile, config, dtype=None) -> dict:
     if "output.weight" in gguf.tensors:
         params["lm_head"] = jnp.asarray(get("output.weight", transpose=True), dt)
 
-    stacks: Dict[str, List[np.ndarray]] = {v: [] for v in _LAYER_MAP.values()}
+    layer_map = dict(_LAYER_MAP)
+    if getattr(config, "qkv_bias", False):
+        # qwen2-family GGUFs carry attention biases
+        layer_map.update({
+            "attn_q.bias": "bq", "attn_k.bias": "bk", "attn_v.bias": "bv",
+        })
+    stacks: Dict[str, List[np.ndarray]] = {v: [] for v in layer_map.values()}
     for i in range(L):
-        for gname, pname in _LAYER_MAP.items():
+        for gname, pname in layer_map.items():
             t = get(f"blk.{i}.{gname}", transpose=gname.startswith(("attn_", "ffn_"))
+                    and gname.endswith(".weight")
                     and not gname.endswith("norm.weight"))
             stacks[pname].append(t)
     for pname, arrs in stacks.items():
         stacked = np.stack(arrs)
-        kind = jnp.float32 if pname.endswith("norm") else dt
+        kind = (
+            jnp.float32
+            if pname.endswith("norm") or pname.startswith("b")
+            else dt
+        )
         params["layers"][pname] = jnp.asarray(stacked, kind)
     return params

@@ -41,6 +41,7 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     rms_norm_eps: float = 1e-5
     tie_embeddings: bool = False
+    qkv_bias: bool = False  # qwen2-family attention biases
     dtype: Any = jnp.bfloat16
 
     @property
@@ -66,6 +67,18 @@ LLAMA_PRESETS: Dict[str, LlamaConfig] = {
     "llama3-70b": LlamaConfig(
         hidden_size=8192, intermediate_size=28672, num_layers=80,
         num_heads=64, num_kv_heads=8, head_dim=128,
+    ),
+    # qwen2 family: same decoder with attention biases + its own dims
+    "qwen2.5-7b": LlamaConfig(
+        vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+        num_layers=28, num_heads=28, num_kv_heads=4, head_dim=128,
+        rope_theta=1000000.0, rms_norm_eps=1e-6, qkv_bias=True,
+    ),
+    "qwen2.5-1.5b": LlamaConfig(
+        vocab_size=151936, hidden_size=1536, intermediate_size=8960,
+        num_layers=28, num_heads=12, num_kv_heads=2, head_dim=128,
+        rope_theta=1000000.0, rms_norm_eps=1e-6, qkv_bias=True,
+        tie_embeddings=True,
     ),
 }
 
@@ -96,6 +109,10 @@ def init_params(rng: jax.Array, config: LlamaConfig) -> Params:
             "w_down": dense(keys[7], (L, F, E), F),
         },
     }
+    if c.qkv_bias:
+        params["layers"]["bq"] = jnp.zeros((L, c.q_dim), jnp.float32)
+        params["layers"]["bk"] = jnp.zeros((L, c.kv_dim), jnp.float32)
+        params["layers"]["bv"] = jnp.zeros((L, c.kv_dim), jnp.float32)
     if not c.tie_embeddings:
         params["lm_head"] = dense(jax.random.fold_in(rng, 99), (E, c.vocab_size), E)
     return params
@@ -118,6 +135,10 @@ def param_logical_axes(config: LlamaConfig) -> Params:
             "w_down": (None, "mlp", "embed"),
         },
     }
+    if config.qkv_bias:
+        axes["layers"]["bq"] = (None, "heads")
+        axes["layers"]["bk"] = (None, "kv_heads")
+        axes["layers"]["bv"] = (None, "kv_heads")
     if not config.tie_embeddings:
         axes["lm_head"] = ("embed", "vocab")
     return axes
@@ -190,9 +211,14 @@ def decoder_layer(
     b, t = positions.shape
 
     x = rms_norm(hidden, lp["attn_norm"], c.rms_norm_eps)
-    q = (x @ lp["wq"]).reshape(b, t, c.num_heads, c.head_dim)
-    k = (x @ lp["wk"]).reshape(b, t, c.num_kv_heads, c.head_dim)
-    v = (x @ lp["wv"]).reshape(b, t, c.num_kv_heads, c.head_dim)
+    q, k, v = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
+    if c.qkv_bias:
+        q = q + lp["bq"].astype(q.dtype)
+        k = k + lp["bk"].astype(k.dtype)
+        v = v + lp["bv"].astype(v.dtype)
+    q = q.reshape(b, t, c.num_heads, c.head_dim)
+    k = k.reshape(b, t, c.num_kv_heads, c.head_dim)
+    v = v.reshape(b, t, c.num_kv_heads, c.head_dim)
     q = apply_rope(q, positions, c.rope_theta)
     k = apply_rope(k, positions, c.rope_theta)
 

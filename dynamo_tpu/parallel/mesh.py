@@ -102,5 +102,14 @@ def logical_to_sharding(mesh: Mesh, *logical_axes: Optional[str]) -> NamedShardi
 
 def kv_cache_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for paged KV cache [layers, blocks, block_size, kv_heads, head_dim]:
-    kv heads over tp, physical blocks replicated within a dp group."""
+    kv heads over tp, physical blocks replicated across dp.
+
+    Replication over dp is deliberate, not an oversight: the pod scaling
+    story for KV capacity is WORKER REPLICAS behind KV-aware routing —
+    each replica owns its whole pool and its own failure domain — exactly
+    the reference's data-parallel model (SURVEY.md §2.12: multiple workers
+    on one endpoint + router). The in-engine dp axis exists to batch slots
+    across chips inside one worker; giving dp groups disjoint pools would
+    re-create the router's placement problem inside the engine for no
+    capacity win over replicas."""
     return logical_to_sharding(mesh, None, "kv_blocks", None, "kv_heads", None)

@@ -39,6 +39,30 @@ KV_EVENTS_SUBJECT = "kv_events"
 KV_METRICS_SUBJECT = "kv_metrics"
 
 
+async def resubscribe_forever(ns, subject: str, apply) -> None:
+    """Deliver each JSON payload on a namespace subject to ``apply(dict)``,
+    resubscribing with exponential backoff across bus outages — a bus hiccup
+    must never silently starve a consumer. One malformed payload is logged
+    and skipped, not fatal. Shared by the KV router feed, the standalone
+    router component, and the metrics aggregator."""
+    backoff = 0.5
+    while True:
+        try:
+            sub = await ns.subscribe(subject)
+            backoff = 0.5
+            async for raw in sub:
+                try:
+                    apply(json.loads(raw) if isinstance(raw, (bytes, str)) else raw)
+                except (ValueError, KeyError, TypeError):
+                    logger.warning("malformed %s payload", subject, exc_info=True)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.warning("%s subscription lost; retrying", subject, exc_info=True)
+        await asyncio.sleep(backoff)
+        backoff = min(backoff * 2, 10.0)
+
+
 def parse_endpoint_path(path: str) -> tuple:
     """dyn://ns.comp.ep → (ns, comp, ep). Reference: protocols.rs:33-302."""
     p = path
@@ -426,27 +450,18 @@ class EndpointClient(AsyncEngine):
         from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, RouterEvent
 
         ns = self.endpoint.component.namespace
-        ev_sub = await ns.subscribe(KV_EVENTS_SUBJECT)
-        met_sub = await ns.subscribe(KV_METRICS_SUBJECT)
-
-        async def events():
-            async for raw in ev_sub:
-                try:
-                    self._router.apply_event(RouterEvent.from_dict(json.loads(raw)))
-                except (ValueError, KeyError):
-                    logger.warning("bad kv event", exc_info=True)
-
-        async def metrics():
-            async for raw in met_sub:
-                try:
-                    d = json.loads(raw)
-                    self._router.update_worker_metrics(
-                        d["worker_id"], ForwardPassMetrics.from_dict(d["metrics"])
-                    )
-                except (ValueError, KeyError):
-                    logger.warning("bad kv metrics", exc_info=True)
-
-        await asyncio.gather(events(), metrics())
+        await asyncio.gather(
+            resubscribe_forever(
+                ns, KV_EVENTS_SUBJECT,
+                lambda d: self._router.apply_event(RouterEvent.from_dict(d)),
+            ),
+            resubscribe_forever(
+                ns, KV_METRICS_SUBJECT,
+                lambda d: self._router.update_worker_metrics(
+                    d["worker_id"], ForwardPassMetrics.from_dict(d["metrics"])
+                ),
+            ),
+        )
 
     async def wait_for_instances(self, n: int = 1, timeout: float = 30.0) -> None:
         """Reference: Client::wait_for_endpoints (client.rs:205-215)."""

@@ -1,0 +1,132 @@
+"""Cross-TP disaggregated transfer: prefill tp=1 → decode tp=2.
+
+The decisive assertion: a tp=2-sharded decode engine fed KV pages computed
+by an unsharded prefill engine produces exactly the same greedy tokens as
+an unsharded local engine — over BOTH transfer paths:
+
+- host-staged (numpy pages; relayout is implicit because the host array is
+  the canonical unsharded layout), and
+- the same-host device path (jax arrays; XLA reshards across the meshes at
+  the inject boundary — the TP split/merge the reference needed a custom
+  kernel for, `kv_rearrange.py`, SURVEY.md §2.10).
+"""
+
+import asyncio
+import dataclasses
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dynamo_tpu.disagg.prefill_worker import PrefillEngine
+from dynamo_tpu.disagg.transfer import LocalKvTransfer
+from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params, param_shardings
+from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+from dynamo_tpu.runtime.engine import Context
+
+BLOCK = 8
+CFG = dataclasses.replace(LLAMA_PRESETS["tiny"], dtype=jnp.float32)
+ENGINE_CFG = EngineConfig(max_slots=2, kv_block_size=BLOCK, max_model_len=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+class ForcedRemotePolicy:
+    """Route every prefill remote; capture the submit for the test driver."""
+
+    def __init__(self):
+        self.submitted = threading.Event()
+        self.request = None
+
+    def should_remote(self, uncached_len: int) -> bool:
+        return True
+
+    def submit(self, request_id, token_ids, block_ids, cached_tokens, sampling):
+        self.request = dict(
+            request_id=request_id, token_ids=token_ids, block_ids=block_ids,
+            cached_tokens=cached_tokens, sampling=sampling,
+        )
+        self.submitted.set()
+
+
+async def _collect(engine, prompt, max_tokens=5):
+    req = PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0),
+    )
+    toks = []
+    async for item in engine.generate(Context(req)):
+        if item.is_error:
+            raise AssertionError(item.error_message())
+        toks.extend((item.data or {}).get("token_ids", []))
+    return toks
+
+
+def _tp2_engine(params):
+    mesh = make_mesh(MeshConfig(tp=2))
+    sharded = jax.device_put(params, param_shardings(CFG, mesh))
+    return JaxServingEngine(
+        CFG, sharded, ENGINE_CFG, mesh=mesh, cache_dtype=jnp.float32
+    )
+
+
+@pytest.mark.parametrize("device_path", [False, True])
+def test_tp1_prefill_feeds_tp2_decode(params, run, device_path):
+    prompt = list(range(3, 43))  # 40 tokens → 5 blocks
+
+    # golden: plain unsharded local engine
+    local = JaxServingEngine(CFG, params, ENGINE_CFG, cache_dtype=jnp.float32)
+    golden = run(_collect(local, prompt))
+    local.close()
+
+    decode = _tp2_engine(params)  # decode mesh = devices [0, 1]
+    # split-chip deployment: the prefill engine lives on a chip OUTSIDE the
+    # decode mesh — the transfer must move pages across committed device sets
+    prefill_params = (
+        jax.device_put(params, jax.devices()[4]) if device_path else params
+    )
+    prefill = PrefillEngine(CFG, prefill_params, max_model_len=128, block_size=BLOCK)
+    policy = ForcedRemotePolicy()
+    decode.set_remote_prefill_policy(policy)
+
+    async def go():
+        task = asyncio.create_task(_collect(decode, prompt))
+        await asyncio.to_thread(policy.submitted.wait, 10.0)
+        sub = policy.request
+        assert sub is not None, "engine never submitted the remote prefill"
+
+        first_tok, k, v = prefill.prefill(
+            sub["token_ids"], sub["cached_tokens"], sub["sampling"],
+            as_device=device_path,
+        )
+        if device_path:
+            assert isinstance(k, jax.Array)
+            xfer = LocalKvTransfer(decode)
+            await xfer.send_blocks(
+                "", sub["request_id"], first_tok, sub["block_ids"], k, v
+            )
+        else:
+            import numpy as np
+
+            assert isinstance(k, np.ndarray)
+            decode.complete_remote_prefill(
+                sub["request_id"], first_tok, sub["block_ids"], k, v
+            )
+        return await task
+
+    toks = run(go())
+    decode.close()
+    assert toks == golden, (
+        f"cross-TP disagg diverged ({'device' if device_path else 'host'} path)"
+    )

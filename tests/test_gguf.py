@@ -199,6 +199,50 @@ class TestGgufParsing:
         with pytest.raises(ValueError, match="quantized"):
             g.load_tensor("token_embd.weight")
 
+    def test_qwen2_biases_load(self, tmp_path):
+        """A qwen2-style GGUF (attention biases) loads into a qkv_bias
+        config with the bias leaves present and bit-exact."""
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from dynamo_tpu.models.llama import LLAMA_PRESETS
+
+        cfg = dataclasses.replace(
+            LLAMA_PRESETS["tiny"], qkv_bias=True, dtype=jnp.float32, vocab_size=64
+        )
+        rng = np.random.default_rng(3)
+        E, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+        tensors = {
+            "token_embd.weight": rng.normal(size=(cfg.vocab_size, E)),
+            "output_norm.weight": np.ones(E),
+            "output.weight": rng.normal(size=(cfg.vocab_size, E)),
+        }
+        for i in range(L):
+            tensors.update({
+                f"blk.{i}.attn_norm.weight": np.ones(E),
+                f"blk.{i}.attn_q.weight": rng.normal(size=(cfg.q_dim, E)),
+                f"blk.{i}.attn_k.weight": rng.normal(size=(cfg.kv_dim, E)),
+                f"blk.{i}.attn_v.weight": rng.normal(size=(cfg.kv_dim, E)),
+                f"blk.{i}.attn_q.bias": rng.normal(size=(cfg.q_dim,)),
+                f"blk.{i}.attn_k.bias": rng.normal(size=(cfg.kv_dim,)),
+                f"blk.{i}.attn_v.bias": rng.normal(size=(cfg.kv_dim,)),
+                f"blk.{i}.attn_output.weight": rng.normal(size=(E, cfg.q_dim)),
+                f"blk.{i}.ffn_norm.weight": np.ones(E),
+                f"blk.{i}.ffn_gate.weight": rng.normal(size=(F, E)),
+                f"blk.{i}.ffn_up.weight": rng.normal(size=(F, E)),
+                f"blk.{i}.ffn_down.weight": rng.normal(size=(E, F)),
+            })
+        path = str(tmp_path / "qwen.gguf")
+        write_gguf(path, [("general.architecture", G.T_STRING, "qwen2")], tensors)
+        params = G.gguf_params(G.read_gguf(path), cfg, dtype=np.float32)
+        assert "bq" in params["layers"]
+        np.testing.assert_allclose(
+            np.asarray(params["layers"]["bk"][1]),
+            tensors["blk.1.attn_k.bias"].astype(np.float32),
+            atol=1e-6,
+        )
+
     def test_bad_magic_rejected(self, tmp_path):
         p = tmp_path / "bad.gguf"
         p.write_bytes(b"NOPE" + b"\0" * 64)
