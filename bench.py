@@ -23,10 +23,10 @@ import json
 import os
 import time
 
-N_REQUESTS = int(os.environ.get("BENCH_REQUESTS", "16"))
+N_REQUESTS = int(os.environ.get("BENCH_REQUESTS", "32"))
 PROMPT_LEN = int(os.environ.get("BENCH_PROMPT_LEN", "128"))
 GEN_TOKENS = int(os.environ.get("BENCH_GEN_TOKENS", "128"))
-MAX_SLOTS = int(os.environ.get("BENCH_SLOTS", "16"))
+MAX_SLOTS = int(os.environ.get("BENCH_SLOTS", "32"))
 DECODE_STEPS = int(os.environ.get("BENCH_DECODE_STEPS", "16"))
 PRESET = os.environ.get("BENCH_PRESET", "llama3.2-1b")
 

@@ -72,6 +72,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--tensor-parallel-size", type=int, default=1)
+    # multi-host meshes (reference MultiNodeConfig, engines.rs:41-59): all
+    # hosts run the same command with their own --node-rank; jax.distributed
+    # joins them into one global device mesh over ICI/DCN
+    p.add_argument("--num-nodes", type=int, default=1)
+    p.add_argument("--node-rank", type=int, default=0)
+    p.add_argument("--coordinator-addr", default=None,
+                   help="host:port of node 0's jax.distributed coordinator")
     p.add_argument("--max-batch-size", type=int, default=8)
     p.add_argument("--kv-block-size", type=int, default=16)
     p.add_argument("--max-model-len", type=int, default=None)
@@ -491,10 +498,35 @@ async def run_prefill_worker_main(out_spec: str, in_spec: str, flags: argparse.N
     await run_prefill_worker(drt, namespace, engine)
 
 
+def init_multihost(flags) -> None:
+    """Join this process into a multi-host JAX runtime (no-op single-node).
+
+    After initialize(), jax.devices() spans every node's chips and meshes
+    built from it ride ICI within a slice and DCN across slices — the TPU
+    analogue of the reference's Ray/torch.distributed multinode bring-up
+    (vllm0_7 ray.rs:66-170, sglang leader/follower)."""
+    if flags.num_nodes <= 1:
+        return
+    if not flags.coordinator_addr:
+        raise SystemExit("--num-nodes > 1 requires --coordinator-addr")
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=flags.coordinator_addr,
+        num_processes=flags.num_nodes,
+        process_id=flags.node_rank,
+    )
+    logger.info(
+        "joined multi-host runtime: node %d/%d, %d global devices",
+        flags.node_rank, flags.num_nodes, jax.device_count(),
+    )
+
+
 async def amain(argv: list[str]) -> None:
     init_logging()
     in_spec, out_spec, rest = parse_io(argv)
     flags = build_parser().parse_args(rest)
+    init_multihost(flags)
     if in_spec.startswith("prefill"):
         await run_prefill_worker_main(out_spec, in_spec, flags)
         return

@@ -63,19 +63,36 @@ async def run_router(drt, namespace: str, block_size: int = 16) -> None:
         resubscribe_forever,
     )
 
+    import time as _time
+
     router = KvRouter(block_size)
     ns = drt.namespace(namespace)
+    last_seen: dict = {}
+
+    def on_metrics(d):
+        wid = d["worker_id"]
+        last_seen[wid] = _time.monotonic()
+        router.update_worker_metrics(wid, ForwardPassMetrics.from_dict(d["metrics"]))
+
+    async def expire_dead_workers(expiry: float = 15.0):
+        # workers publish metrics every ~1s; silence means death (the
+        # embedded router learns this from the instance watch — standalone,
+        # metrics staleness is the liveness signal)
+        while True:
+            await asyncio.sleep(expiry / 3)
+            cutoff = _time.monotonic() - expiry
+            for wid in [w for w, t in last_seen.items() if t < cutoff]:
+                logger.info("worker %s silent > %.0fs: purging from router", wid, expiry)
+                router.remove_worker(wid)
+                del last_seen[wid]
+
     feeds = [
         asyncio.create_task(resubscribe_forever(
             ns, KV_EVENTS_SUBJECT,
             lambda d: router.apply_event(RouterEvent.from_dict(d)),
         )),
-        asyncio.create_task(resubscribe_forever(
-            ns, KV_METRICS_SUBJECT,
-            lambda d: router.update_worker_metrics(
-                d["worker_id"], ForwardPassMetrics.from_dict(d["metrics"])
-            ),
-        )),
+        asyncio.create_task(resubscribe_forever(ns, KV_METRICS_SUBJECT, on_metrics)),
+        asyncio.create_task(expire_dead_workers()),
     ]
 
     component = ns.component("router")

@@ -142,6 +142,40 @@ async def run_prefill_worker(runtime, namespace: str, engine: PrefillEngine) -> 
         if raw is None:
             continue
         req = RemotePrefillRequest.from_dict(json.loads(raw))
+
+        # same-process decode engine → device path: pages stay jax arrays
+        # and land on the decode mesh via device_put, no host staging
+        from dynamo_tpu.disagg.serving import LOCAL_DECODE_ENGINES
+        from dynamo_tpu.disagg.transfer import LocalKvTransfer
+
+        local_engine = LOCAL_DECODE_ENGINES.get(req.engine_id)
+        if local_engine is not None:
+            try:
+                if req.block_size and req.block_size != engine.block_size:
+                    raise ValueError(
+                        f"block_size mismatch: decode worker uses "
+                        f"{req.block_size}, this prefill worker uses "
+                        f"{engine.block_size}"
+                    )
+                if req.model and engine.model and req.model != engine.model:
+                    raise ValueError(
+                        f"model mismatch: decode worker serves {req.model!r}, "
+                        f"this prefill worker loaded {engine.model!r}"
+                    )
+                tok, k, v = await asyncio.to_thread(
+                    engine.prefill, req.token_ids, req.cached_tokens,
+                    req.sampling, True,
+                )
+                await LocalKvTransfer(local_engine).send_blocks(
+                    "", req.request_id, tok, req.block_ids, k, v
+                )
+                logger.info("prefilled %s locally via device path (%d tokens)",
+                            req.request_id, len(req.token_ids))
+            except Exception as e:
+                logger.exception("local prefill failed for %s", req.request_id)
+                local_engine.fail_remote_prefill(req.request_id, str(e))
+            continue
+
         addr = addr_cache.get(req.engine_id)
         if addr is None:
             key = f"{namespace}/{TRANSFER_KEY_PREFIX}{req.engine_id}"

@@ -24,10 +24,16 @@ from dynamo_tpu.disagg.transfer import KvTransferServer
 
 logger = logging.getLogger(__name__)
 
+# In-process decode engines by stable engine id. A prefill worker sharing
+# the process (split-chip single-host deployments) hands pages over the
+# device path (LocalKvTransfer) instead of host-staged TCP.
+LOCAL_DECODE_ENGINES: dict = {}
+
 
 async def enable_disagg_decode(
     endpoint, engine, instance_id: str, config: DisaggConfig | None = None,
     queue_poll_interval: float = 0.25, model: str = "",
+    register_local: bool = True,
 ) -> KvTransferServer:
     ns = endpoint.component.namespace
     rt = ns.runtime
@@ -41,6 +47,8 @@ async def enable_disagg_decode(
     # id) so in-flight prefills still resolve across a lease loss; registered
     # via the endpoint so re-registration restores it
     engine_id = rt.worker_id
+    if register_local:
+        LOCAL_DECODE_ENGINES[engine_id] = engine
     transfer_key = f"{ns.name}/{TRANSFER_KEY_PREFIX}{engine_id}"
     address = f"{rt.advertise_host}:{server.port}".encode()
     if hasattr(endpoint, "_leased_keys"):

@@ -118,6 +118,46 @@ class ModelDeploymentCard:
         return cls(**d)
 
 
+class CardStore:
+    """Persisted model deployment cards, content-addressed by mdcsum.
+
+    Workers publish their card once; frontends/operators fetch it by
+    checksum instead of re-deriving it from model files they may not have.
+    Entries carry an explicit expiry refreshed on publish — stale cards
+    (model deleted, worker gone for good) age out rather than accumulating.
+    Reference: MDC persistence with checksum + expiry
+    (model_card/model.rs:150-193).
+    """
+
+    def __init__(self, store, namespace: str, ttl: float = 24 * 3600.0):
+        self.store = store
+        self.prefix = f"{namespace}/mdc/"
+        self.ttl = ttl
+
+    async def publish(self, card: "ModelDeploymentCard") -> str:
+        import time as _time
+
+        mdcsum = card.mdcsum or card.checksum()
+        payload = dict(card.to_dict(), mdcsum=mdcsum,
+                       expires_at=_time.time() + self.ttl)
+        await self.store.put(
+            self.prefix + mdcsum, json.dumps(payload).encode()
+        )
+        return mdcsum
+
+    async def load(self, mdcsum: str) -> Optional["ModelDeploymentCard"]:
+        import time as _time
+
+        raw = await self.store.get(self.prefix + mdcsum)
+        if raw is None:
+            return None
+        d = json.loads(raw)
+        if d.pop("expires_at", 0) < _time.time():
+            await self.store.delete(self.prefix + mdcsum)  # expired: purge
+            return None
+        return ModelDeploymentCard.from_dict(d)
+
+
 def _token_str(raw: Any) -> Optional[str]:
     """tokenizer_config token entries are either strings or {'content': ...}."""
     if raw is None:

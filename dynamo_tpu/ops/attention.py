@@ -21,6 +21,10 @@ import jax
 import jax.numpy as jnp
 
 
+# tokens of max context below which the jnp decode path outruns the kernel
+_PALLAS_MIN_CONTEXT = int(os.environ.get("DYN_TPU_PALLAS_MIN_CONTEXT", "1024"))
+
+
 @lru_cache(maxsize=1)
 def _use_pallas_decode() -> bool:
     """Pallas decode kernel on TPU backends; jnp fallback elsewhere.
@@ -117,7 +121,15 @@ def paged_attention(
         scale = d ** -0.5
 
     if use_pallas is None:
-        use_pallas = _use_pallas_decode()
+        mode = os.environ.get("DYN_TPU_ATTENTION", "auto")
+        if mode in ("pallas", "jnp"):  # explicit override: honored verbatim
+            use_pallas = mode == "pallas"
+        else:
+            # measured crossover: at short max contexts XLA's fused
+            # gather+einsum beats the kernel's per-page grid overhead; the
+            # kernel wins once the gathered context would be large
+            ctx = block_tables.shape[1] * k_cache.shape[1]
+            use_pallas = _use_pallas_decode() and ctx >= _PALLAS_MIN_CONTEXT
     if t == 1 and soft_cap is None and use_pallas:
         from dynamo_tpu.ops.pallas.paged_attention import paged_attention_decode
 

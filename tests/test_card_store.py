@@ -1,0 +1,48 @@
+"""Persisted model deployment cards (CardStore): publish/load/expiry."""
+
+from dynamo_tpu.llm.model_card import CardStore, ModelDeploymentCard
+from dynamo_tpu.runtime.statestore import StateStoreClient, StateStoreServer
+
+
+class TestCardStore:
+    def test_publish_load_roundtrip(self, run):
+        async def go():
+            ss = StateStoreServer(port=0)
+            await ss.start()
+            store = await StateStoreClient.connect(ss.url)
+            cs = CardStore(store, "dynamo")
+
+            card = ModelDeploymentCard(
+                display_name="m", context_length=2048, model_config={"x": 1}
+            )
+            card.mdcsum = card.checksum()
+            mdcsum = await cs.publish(card)
+
+            got = await cs.load(mdcsum)
+            assert got is not None
+            assert got.display_name == "m"
+            assert got.context_length == 2048
+            assert got.mdcsum == mdcsum
+            assert await cs.load("nope") is None
+
+            await store.close()
+            await ss.stop()
+
+        run(go())
+
+    def test_expired_card_purged(self, run):
+        async def go():
+            ss = StateStoreServer(port=0)
+            await ss.start()
+            store = await StateStoreClient.connect(ss.url)
+            cs = CardStore(store, "dynamo", ttl=-1.0)  # already expired
+
+            card = ModelDeploymentCard(display_name="old")
+            mdcsum = await cs.publish(card)
+            assert await cs.load(mdcsum) is None  # expired → None
+            assert await store.get(cs.prefix + mdcsum) is None  # and purged
+
+            await store.close()
+            await ss.stop()
+
+        run(go())

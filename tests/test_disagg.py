@@ -16,7 +16,7 @@ import pytest
 from dynamo_tpu.disagg.prefill_worker import PrefillEngine, run_prefill_worker
 from dynamo_tpu.disagg.protocols import DisaggConfig, RemotePrefillRequest
 from dynamo_tpu.disagg.router import DisaggPolicy
-from dynamo_tpu.disagg.serving import enable_disagg_decode
+from dynamo_tpu.disagg.serving import LOCAL_DECODE_ENGINES, enable_disagg_decode
 from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
 from dynamo_tpu.llm.protocols.common import (
     PreprocessedRequest,
@@ -95,9 +95,12 @@ def test_disagg_round_trip_matches_local(params, run):
         # decode engine with disagg enabled (everything remote: threshold 8)
         decode = JaxServingEngine(CFG, params, ENGINE_CFG, cache_dtype=jnp.float32)
         ep = rt.namespace("dz").component("decode").endpoint("gen")
+        # register_local=False: these tests exercise the host-staged TCP
+        # transfer plane (the in-process device path has its own tests)
         await enable_disagg_decode(
             ep, decode, "dec-1",
             config=DisaggConfig(max_local_prefill_length=8, max_prefill_queue_size=10),
+            register_local=False,
         )
 
         # prefill worker on its own engine instance
@@ -137,6 +140,7 @@ def test_disagg_second_request_uses_prefix_cache(params, run):
         await enable_disagg_decode(
             ep, decode, "dec-1",
             config=DisaggConfig(max_local_prefill_length=16, max_prefill_queue_size=10),
+            register_local=False,
         )
         pre_engine = PrefillEngine(CFG, params, max_model_len=128, block_size=BLOCK)
         worker_task = asyncio.create_task(run_prefill_worker(rt, "dz3", pre_engine))
@@ -171,6 +175,7 @@ def test_short_prompts_stay_local(params, run):
         await enable_disagg_decode(
             ep, decode, "dec-1",
             config=DisaggConfig(max_local_prefill_length=1000),
+            register_local=False,
         )
         toks = await asyncio.wait_for(collect(decode, [5, 6, 7, 8], max_tokens=3), 60)
         assert len(toks) == 3

@@ -81,6 +81,62 @@ def _tp2_engine(params):
     )
 
 
+def test_inprocess_disagg_uses_device_path(params, run):
+    """The full disagg stack (queue + prefill worker) takes the device path
+    automatically when decode and prefill share a process, with parity."""
+    import logging
+
+    from dynamo_tpu.disagg.protocols import DisaggConfig
+    from dynamo_tpu.disagg.prefill_worker import run_prefill_worker
+    from dynamo_tpu.disagg.serving import LOCAL_DECODE_ENGINES, enable_disagg_decode
+    from dynamo_tpu.runtime.bus import MessageBusServer
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.statestore import StateStoreServer
+
+    async def go():
+        ss, bus = StateStoreServer(port=0), MessageBusServer(port=0)
+        await ss.start()
+        await bus.start()
+        rt = await DistributedRuntime.create(ss.url, bus.url)
+
+        local = JaxServingEngine(CFG, params, ENGINE_CFG, cache_dtype=jnp.float32)
+        prompt = list(range(5, 45))
+        golden = await _collect(local, prompt)
+        local.close()
+
+        decode = JaxServingEngine(CFG, params, ENGINE_CFG, cache_dtype=jnp.float32)
+        ep = rt.namespace("dloc").component("decode").endpoint("gen")
+        await enable_disagg_decode(
+            ep, decode, "dec-1",
+            config=DisaggConfig(max_local_prefill_length=8, max_prefill_queue_size=10),
+        )
+        assert rt.worker_id in LOCAL_DECODE_ENGINES  # device path armed
+
+        pre_engine = PrefillEngine(CFG, params, max_model_len=128, block_size=BLOCK)
+        records = []
+        handler = logging.Handler()
+        handler.emit = lambda rec: records.append(rec.getMessage())
+        plog = logging.getLogger("dynamo_tpu.disagg.prefill_worker")
+        plog.addHandler(handler)
+        plog.setLevel(logging.INFO)
+        worker = asyncio.create_task(run_prefill_worker(rt, "dloc", pre_engine))
+        try:
+            toks = await asyncio.wait_for(_collect(decode, prompt), 60)
+            assert toks == golden
+            assert any("device path" in m for m in records), (
+                "in-process disagg did not take the device path"
+            )
+        finally:
+            worker.cancel()
+            LOCAL_DECODE_ENGINES.clear()
+            decode.close()
+            await rt.shutdown()
+            await ss.stop()
+            await bus.stop()
+
+    run(go())
+
+
 @pytest.mark.parametrize("device_path", [False, True])
 def test_tp1_prefill_feeds_tp2_decode(params, run, device_path):
     prompt = list(range(3, 43))  # 40 tokens → 5 blocks
