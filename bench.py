@@ -33,12 +33,104 @@ PRESET = os.environ.get("BENCH_PRESET", "llama3.2-1b")
 # v5e (TPU v5 lite): 819 GB/s HBM, 197 TFLOP/s bf16. Overridable for other chips.
 HBM_GBPS = float(os.environ.get("BENCH_HBM_GBPS", "819"))
 PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
+# "serve" (default): concurrent-load throughput/TTFT.
+# "multiturn": long-prompt conversations re-sent after device-pool pressure —
+# measures the host KV tier's TTFT win (reference credits +40%).
+MODE = os.environ.get("BENCH_MODE", "serve")
+
+
+def bench_multiturn() -> None:
+    """Multi-turn TTFT with and without the host KV tier.
+
+    Conversations long enough that the device pool can't hold them all are
+    revisited after eviction pressure; with the host tier their KV re-enters
+    HBM instead of being recomputed. Prints one JSON line with TTFT for both
+    configurations."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+    from dynamo_tpu.runtime.engine import Context
+
+    cfg = dataclasses.replace(LLAMA_PRESETS[PRESET], dtype=jnp.bfloat16)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    n_convs = int(os.environ.get("BENCH_CONVS", "8"))
+    turn_len = int(os.environ.get("BENCH_TURN_LEN", "512"))
+    # pool holds ~2.5 conversations: revisits force eviction
+    blocks_per_conv = (turn_len + 64) // 16 + 1
+    num_kv_blocks = int(blocks_per_conv * 2.5)
+
+    rng = np.random.default_rng(0)
+    convs = [rng.integers(0, cfg.vocab_size, turn_len).tolist() for _ in range(n_convs)]
+
+    async def one(engine, prompt):
+        req = PreprocessedRequest(
+            token_ids=prompt,
+            stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+        )
+        t0 = time.perf_counter()
+        ttft = None
+        async for item in engine.generate(Context(req)):
+            if ttft is None and (item.data or {}).get("token_ids"):
+                ttft = time.perf_counter() - t0
+        return ttft
+
+    def run_config(host_blocks: int) -> float:
+        engine = JaxServingEngine(
+            cfg, params,
+            EngineConfig(
+                max_slots=4, kv_block_size=16, max_model_len=turn_len + 64,
+                num_kv_blocks=num_kv_blocks, host_cache_blocks=host_blocks,
+            ),
+        )
+        engine.warmup()
+
+        async def drive():
+            # turn 1: prefill every conversation (evicting earlier ones)
+            for c in convs:
+                await one(engine, c)
+            # turn 2: revisit — device tier mostly evicted
+            ttfts = []
+            for c in convs:
+                ttfts.append(await one(engine, c))
+            return ttfts
+
+        ttfts = asyncio.run(drive())
+        engine.close()
+        return sorted(ttfts)[len(ttfts) // 2]
+
+    cold = run_config(0)
+    warm = run_config(num_kv_blocks * 8)  # host tier holds everything
+    out = {
+        "metric": "multiturn_ttft_p50_ms",
+        "value": round(warm * 1e3, 1),
+        "unit": "ms",
+        "vs_baseline": round(cold / warm, 2),  # x-fold TTFT win from host tier
+        "mode": "multiturn",
+        "model": PRESET,
+        "turn_len": turn_len,
+        "conversations": n_convs,
+        "ttft_p50_no_host_tier_ms": round(cold * 1e3, 1),
+        "ttft_p50_host_tier_ms": round(warm * 1e3, 1),
+    }
+    print(json.dumps(out))
 
 
 def main() -> None:
     from dynamo_tpu.engine_jax.compile_cache import enable_compile_cache
 
     enable_compile_cache()
+    if MODE == "multiturn":
+        bench_multiturn()
+        return
 
     import jax
     import jax.numpy as jnp

@@ -441,7 +441,10 @@ async def run_endpoint(chat_engine, completions_engine, model_name: str, in_spec
         engine, model_entry={"name": model_name, "kinds": ["chat", "completions"]}
     )
     if core_engine is not None and hasattr(core_engine, "metrics_snapshot"):
+        from ..runtime.distributed import serve_stats_endpoint
+
         await attach_kv_publishing(endpoint, core_engine)
+        await serve_stats_endpoint(endpoint, core_engine)  # pull/scrape plane
         logger.info("kv events + metrics publishing enabled (worker key %s)", drt.worker_id)
     if flags.disagg == "decode" and core_engine is not None:
         if not hasattr(core_engine, "set_remote_prefill_policy"):

@@ -69,19 +69,34 @@ async def run_router(drt, namespace: str, block_size: int = 16) -> None:
     ns = drt.namespace(namespace)
     last_seen: dict = {}
 
+    feed_alive = [0.0]  # time of the last metrics delivery from ANY worker
+
     def on_metrics(d):
         wid = d["worker_id"]
-        last_seen[wid] = _time.monotonic()
+        now = _time.monotonic()
+        last_seen[wid] = now
+        feed_alive[0] = now
         router.update_worker_metrics(wid, ForwardPassMetrics.from_dict(d["metrics"]))
 
     async def expire_dead_workers(expiry: float = 15.0):
         # workers publish metrics every ~1s; silence means death (the
         # embedded router learns this from the instance watch — standalone,
-        # metrics staleness is the liveness signal)
+        # metrics staleness is the liveness signal). Before purging, confirm
+        # the BUS itself is reachable: total silence with a dead bus is a
+        # feed outage, but with a healthy bus even a lone silent worker is
+        # genuinely gone.
         while True:
             await asyncio.sleep(expiry / 3)
             cutoff = _time.monotonic() - expiry
-            for wid in [w for w, t in last_seen.items() if t < cutoff]:
+            stale = [w for w, t in last_seen.items() if t < cutoff]
+            if not stale:
+                continue
+            if feed_alive[0] < cutoff:
+                try:
+                    await drt.bus.queue_len("__router_liveness_probe__")
+                except Exception:
+                    continue  # bus unreachable: feed outage, keep state
+            for wid in stale:
                 logger.info("worker %s silent > %.0fs: purging from router", wid, expiry)
                 router.remove_worker(wid)
                 del last_seen[wid]

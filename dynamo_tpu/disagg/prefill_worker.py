@@ -129,6 +129,28 @@ class PrefillEngine:
         return first_token, k, v
 
 
+def _validate_request(req, engine: "PrefillEngine") -> None:
+    """Shared decode↔prefill compatibility checks (both transfer paths)."""
+    if req.block_size and req.block_size != engine.block_size:
+        raise ValueError(
+            f"block_size mismatch: decode worker uses {req.block_size}, "
+            f"this prefill worker uses {engine.block_size}"
+        )
+    if req.model and engine.model and req.model != engine.model:
+        raise ValueError(
+            f"model mismatch: decode worker serves {req.model!r}, "
+            f"this prefill worker loaded {engine.model!r}"
+        )
+
+
+def _validate_pages(req, k) -> None:
+    if k.shape[1] != len(req.block_ids):
+        raise ValueError(
+            f"page count mismatch: computed {k.shape[1]}, decode expects "
+            f"{len(req.block_ids)} (block_size skew?)"
+        )
+
+
 async def run_prefill_worker(runtime, namespace: str, engine: PrefillEngine) -> None:
     """Pop → prefill → ship, forever. Multiple prefill workers share the queue."""
     if runtime.bus is None:
@@ -151,21 +173,12 @@ async def run_prefill_worker(runtime, namespace: str, engine: PrefillEngine) -> 
         local_engine = LOCAL_DECODE_ENGINES.get(req.engine_id)
         if local_engine is not None:
             try:
-                if req.block_size and req.block_size != engine.block_size:
-                    raise ValueError(
-                        f"block_size mismatch: decode worker uses "
-                        f"{req.block_size}, this prefill worker uses "
-                        f"{engine.block_size}"
-                    )
-                if req.model and engine.model and req.model != engine.model:
-                    raise ValueError(
-                        f"model mismatch: decode worker serves {req.model!r}, "
-                        f"this prefill worker loaded {engine.model!r}"
-                    )
+                _validate_request(req, engine)
                 tok, k, v = await asyncio.to_thread(
                     engine.prefill, req.token_ids, req.cached_tokens,
                     req.sampling, True,
                 )
+                _validate_pages(req, k)
                 await LocalKvTransfer(local_engine).send_blocks(
                     "", req.request_id, tok, req.block_ids, k, v
                 )
@@ -197,24 +210,11 @@ async def run_prefill_worker(runtime, namespace: str, engine: PrefillEngine) -> 
             addr = raw_addr.decode()
             addr_cache[req.engine_id] = addr
         try:
-            if req.block_size and req.block_size != engine.block_size:
-                raise ValueError(
-                    f"block_size mismatch: decode worker uses {req.block_size}, "
-                    f"this prefill worker uses {engine.block_size}"
-                )
-            if req.model and engine.model and req.model != engine.model:
-                raise ValueError(
-                    f"model mismatch: decode worker serves {req.model!r}, "
-                    f"this prefill worker loaded {engine.model!r}"
-                )
+            _validate_request(req, engine)
             tok, k, v = await asyncio.to_thread(
                 engine.prefill, req.token_ids, req.cached_tokens, req.sampling
             )
-            if k.shape[1] != len(req.block_ids):
-                raise ValueError(
-                    f"page count mismatch: computed {k.shape[1]}, decode expects "
-                    f"{len(req.block_ids)} (block_size skew?)"
-                )
+            _validate_pages(req, k)
             await client.send_blocks(addr, req.request_id, tok, req.block_ids, k, v)
             logger.info("prefilled %s (%d tokens → %d pages)",
                         req.request_id, len(req.token_ids), k.shape[1])

@@ -49,6 +49,16 @@ async def enable_disagg_decode(
     engine_id = rt.worker_id
     if register_local:
         LOCAL_DECODE_ENGINES[engine_id] = engine
+        # unregister on engine close so queued prefills for a dead engine
+        # fall back to the documented drop-and-timeout path instead of the
+        # device path delivering into a closed engine
+        orig_close = engine.close
+
+        def _close_and_unregister():
+            LOCAL_DECODE_ENGINES.pop(engine_id, None)
+            orig_close()
+
+        engine.close = _close_and_unregister
     transfer_key = f"{ns.name}/{TRANSFER_KEY_PREFIX}{engine_id}"
     address = f"{rt.advertise_host}:{server.port}".encode()
     if hasattr(endpoint, "_leased_keys"):

@@ -153,9 +153,29 @@ class CardStore:
             return None
         d = json.loads(raw)
         if d.pop("expires_at", 0) < _time.time():
-            await self.store.delete(self.prefix + mdcsum)  # expired: purge
+            # expired for THIS read — but deleting here would race a
+            # concurrent publish() refresh; purging is purge_expired()'s job
             return None
         return ModelDeploymentCard.from_dict(d)
+
+    async def purge_expired(self, grace: Optional[float] = None) -> int:
+        """Delete entries expired for longer than ``grace`` (default ttl/2 —
+        a card merely past its expiry may be mid-refresh by its publisher;
+        one well past it is abandoned). Returns the purge count."""
+        import time as _time
+
+        grace = self.ttl / 2 if grace is None else grace
+        cutoff = _time.time() - grace
+        purged = 0
+        for key, raw in (await self.store.get_prefix(self.prefix)).items():
+            try:
+                if json.loads(raw).get("expires_at", 0) < cutoff:
+                    await self.store.delete(key)
+                    purged += 1
+            except ValueError:
+                await self.store.delete(key)
+                purged += 1
+        return purged
 
 
 def _token_str(raw: Any) -> Optional[str]:

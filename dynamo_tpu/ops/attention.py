@@ -21,30 +21,33 @@ import jax
 import jax.numpy as jnp
 
 
-# tokens of max context below which the jnp decode path outruns the kernel
-_PALLAS_MIN_CONTEXT = int(os.environ.get("DYN_TPU_PALLAS_MIN_CONTEXT", "1024"))
-
-
 @lru_cache(maxsize=1)
-def _use_pallas_decode() -> bool:
-    """Pallas decode kernel on TPU backends; jnp fallback elsewhere.
+def _platform_is_tpu() -> bool:
+    try:
+        dev = jax.devices()[0]
+        return dev.platform == "tpu" or dev.device_kind.startswith("TPU")
+    except Exception:
+        return False
 
-    DYN_TPU_ATTENTION=pallas|jnp overrides the autodetection (pallas also
-    works on CPU via the interpreter — slow, test-only). Callers that shard
-    the KV cache over a mesh pass ``use_pallas=False`` per call instead —
-    Mosaic kernels have no GSPMD partitioning rule, so XLA must partition
-    the jnp path there.
+
+def _select_pallas(ctx_tokens: int) -> bool:
+    """One fresh-read policy for the decode attention implementation.
+
+    DYN_TPU_ATTENTION=pallas|jnp forces the choice; auto uses the kernel on
+    TPU only once the max context is past the measured crossover
+    (DYN_TPU_PALLAS_MIN_CONTEXT, default 1024 — below it XLA's fused
+    gather+einsum beats the kernel's per-page grid overhead). Env vars are
+    read at trace time, so tests and operators can flip them live. Callers
+    that shard the KV cache over a mesh pass ``use_pallas=False`` per call
+    instead — Mosaic kernels have no GSPMD partitioning rule.
     """
     mode = os.environ.get("DYN_TPU_ATTENTION", "auto")
     if mode == "pallas":
         return True
     if mode == "jnp":
         return False
-    try:
-        dev = jax.devices()[0]
-        return dev.platform == "tpu" or dev.device_kind.startswith("TPU")
-    except Exception:
-        return False
+    threshold = int(os.environ.get("DYN_TPU_PALLAS_MIN_CONTEXT", "1024"))
+    return _platform_is_tpu() and ctx_tokens >= threshold
 
 
 def write_kv_to_pages(
@@ -121,15 +124,7 @@ def paged_attention(
         scale = d ** -0.5
 
     if use_pallas is None:
-        mode = os.environ.get("DYN_TPU_ATTENTION", "auto")
-        if mode in ("pallas", "jnp"):  # explicit override: honored verbatim
-            use_pallas = mode == "pallas"
-        else:
-            # measured crossover: at short max contexts XLA's fused
-            # gather+einsum beats the kernel's per-page grid overhead; the
-            # kernel wins once the gathered context would be large
-            ctx = block_tables.shape[1] * k_cache.shape[1]
-            use_pallas = _use_pallas_decode() and ctx >= _PALLAS_MIN_CONTEXT
+        use_pallas = _select_pallas(block_tables.shape[1] * k_cache.shape[1])
     if t == 1 and soft_cap is None and use_pallas:
         from dynamo_tpu.ops.pallas.paged_attention import paged_attention_decode
 

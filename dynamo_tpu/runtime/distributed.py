@@ -581,6 +581,21 @@ class KvPublishBridge:
         self._task.cancel()
 
 
+async def serve_stats_endpoint(endpoint: "Endpoint", engine) -> "InstanceInfo":
+    """Register a ``stats`` endpoint on the same component serving the
+    engine's ForwardPassMetrics snapshot on demand — the pull-based scrape
+    plane (reference: NATS $SRV.STATS scrape + EndpointStatsHandler,
+    service.rs:115-242). Push via attach_kv_publishing covers routing;
+    this covers ad-hoc operator/aggregator polls."""
+
+    class _StatsEngine(AsyncEngine):
+        async def generate(self, request: Context):
+            yield Annotated.from_data(engine.metrics_snapshot())
+
+    stats_ep = endpoint.component.endpoint("stats")
+    return await stats_ep.serve(_StatsEngine())
+
+
 async def attach_kv_publishing(
     endpoint: Endpoint, engine, interval: float = 1.0
 ) -> KvPublishBridge:

@@ -112,6 +112,79 @@ async def serve_cmd(args) -> None:
     await sup.shutdown()
 
 
+def build_cmd(args) -> None:
+    """Package a graph into a self-contained deployable bundle.
+
+    Reference parity: `dynamo build` (cli/bentos.py — Bento artifacts). The
+    bundle is a directory (or .tar.gz) holding a manifest (graph entrypoint,
+    resolved service closure, config), the graph's source module, the config
+    file, and a run.sh that launches `dynamo serve` on the bundle — enough to
+    copy to another host and start, without the source checkout.
+    """
+    import importlib
+    import json
+    import shutil
+    import tarfile
+    import time
+
+    graph = resolve_graph(args.graph)
+    services = [s.name for s in graph.dependency_closure()]
+    module_name, _, entry_attr = args.graph.partition(":")
+    module = importlib.import_module(module_name)
+    src = module.__file__
+
+    out = args.output or f"{module_name.rsplit('.', 1)[-1]}_bundle"
+    os.makedirs(out, exist_ok=True)
+    if "." in module_name or hasattr(module, "__path__"):
+        # the graph lives in a package: bundle the whole top-level package
+        # so sibling imports (and __init__.py) survive on the target host;
+        # the entrypoint keeps its dotted path, rooted at the bundle dir
+        top_name = module_name.split(".", 1)[0]
+        top_pkg = importlib.import_module(top_name)
+        top_dir = os.path.dirname(os.path.abspath(top_pkg.__file__))
+        shutil.copytree(
+            top_dir, os.path.join(out, top_name), dirs_exist_ok=True,
+            ignore=shutil.ignore_patterns("__pycache__", "*.pyc"),
+        )
+        bundle_entry = f"{module_name}:{entry_attr}"
+    else:
+        shutil.copy(src, os.path.join(out, os.path.basename(src)))
+        bundle_entry = f"{os.path.splitext(os.path.basename(src))[0]}:{entry_attr}"
+    if args.config_file:
+        shutil.copy(args.config_file, os.path.join(out, "config.yaml"))
+
+    manifest = {
+        "kind": "dynamo_tpu_bundle",
+        "version": 1,
+        "built_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "graph": bundle_entry,
+        "source_graph": args.graph,
+        "services": services,
+        "config": "config.yaml" if args.config_file else None,
+    }
+    with open(os.path.join(out, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+
+    cfg_flag = " -f config.yaml" if args.config_file else ""
+    with open(os.path.join(out, "run.sh"), "w") as f:
+        f.write(
+            "#!/bin/sh\n"
+            "# launch the bundled graph (needs dynamo_tpu on PYTHONPATH)\n"
+            'cd "$(dirname "$0")"\n'
+            f'PYTHONPATH=".:$PYTHONPATH" exec python -m dynamo_tpu.sdk.cli '
+            f'serve {manifest["graph"]}{cfg_flag} "$@"\n'
+        )
+    os.chmod(os.path.join(out, "run.sh"), 0o755)
+
+    if args.tar:
+        tar_path = out.rstrip("/") + ".tar.gz"
+        with tarfile.open(tar_path, "w:gz") as tf:
+            tf.add(out, arcname=os.path.basename(out))
+        print(f"built {tar_path} (services: {', '.join(services)})")
+    else:
+        print(f"built {out}/ (services: {', '.join(services)})")
+
+
 def main() -> None:
     p = argparse.ArgumentParser(prog="dynamo")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -124,8 +197,18 @@ def main() -> None:
     sp.add_argument("--bus-port", type=int, default=0)
     sp.add_argument("--no-infra", action="store_true",
                     help="don't start statestore/bus (use --statestore/--bus)")
+
+    bp = sub.add_parser("build", help="package a graph into a deployable bundle")
+    bp.add_argument("graph", help="module:GraphService")
+    bp.add_argument("-f", "--config-file", default=None)
+    bp.add_argument("-o", "--output", default=None, help="bundle directory")
+    bp.add_argument("--tar", action="store_true", help="also emit .tar.gz")
+
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    if args.cmd == "build":
+        build_cmd(args)
+        return
     asyncio.run(serve_cmd(args))
 
 

@@ -30,17 +30,20 @@ class TestCardStore:
 
         run(go())
 
-    def test_expired_card_purged(self, run):
+    def test_expired_card_hidden_then_purged(self, run):
         async def go():
             ss = StateStoreServer(port=0)
             await ss.start()
             store = await StateStoreClient.connect(ss.url)
-            cs = CardStore(store, "dynamo", ttl=-1.0)  # already expired
+            cs = CardStore(store, "dynamo", ttl=-100.0)  # well past expiry
 
             card = ModelDeploymentCard(display_name="old")
             mdcsum = await cs.publish(card)
-            assert await cs.load(mdcsum) is None  # expired → None
-            assert await store.get(cs.prefix + mdcsum) is None  # and purged
+            assert await cs.load(mdcsum) is None  # expired → hidden
+            # load does NOT delete (a concurrent publish refresh would race)
+            assert await store.get(cs.prefix + mdcsum) is not None
+            assert await cs.purge_expired(grace=10.0) == 1
+            assert await store.get(cs.prefix + mdcsum) is None
 
             await store.close()
             await ss.stop()

@@ -16,7 +16,7 @@ import pytest
 from dynamo_tpu.disagg.prefill_worker import PrefillEngine, run_prefill_worker
 from dynamo_tpu.disagg.protocols import DisaggConfig, RemotePrefillRequest
 from dynamo_tpu.disagg.router import DisaggPolicy
-from dynamo_tpu.disagg.serving import LOCAL_DECODE_ENGINES, enable_disagg_decode
+from dynamo_tpu.disagg.serving import enable_disagg_decode
 from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
 from dynamo_tpu.llm.protocols.common import (
     PreprocessedRequest,

@@ -187,6 +187,47 @@ class TestMessageBus:
 
         run(go())
 
+    def test_stats_scrape_endpoint(self, run):
+        """A worker's stats endpoint serves its metrics snapshot on demand
+        (the pull-based $SRV-scrape analogue)."""
+        from dynamo_tpu.runtime.distributed import (
+            DistributedRuntime,
+            serve_stats_endpoint,
+        )
+        from dynamo_tpu.runtime.statestore import StateStoreServer
+
+        class FakeEngine:
+            def metrics_snapshot(self):
+                return {"request_active_slots": 3, "kv_total_blocks": 99}
+
+        async def go():
+            ss = StateStoreServer(port=0)
+            bus = MessageBusServer(port=0)
+            await ss.start()
+            await bus.start()
+            wk = await DistributedRuntime.create(ss.url, bus.url)
+            caller = await DistributedRuntime.create(ss.url, bus.url)
+            ep = wk.namespace("dynamo").component("backend").endpoint("generate")
+            await ep.component.create_service()
+            await serve_stats_endpoint(ep, FakeEngine())
+
+            client = await (
+                caller.namespace("dynamo").component("backend").endpoint("stats")
+                .client()
+            )
+            await client.wait_for_instances(1, timeout=10)
+            items = [i async for i in client.generate(Context({}))]
+            snap = next(i.data for i in items if i.data)
+            assert snap["request_active_slots"] == 3
+            assert snap["kv_total_blocks"] == 99
+
+            await caller.shutdown()
+            await wk.shutdown()
+            await ss.stop()
+            await bus.stop()
+
+        run(go())
+
     def test_reliable_send_confirms_at_write_time(self, run):
         """send_reliable must resolve False when the connection dies before
         the frame hits the socket — a dying drain task used to discard the

@@ -1,0 +1,32 @@
+"""`dynamo build`: graph → self-contained bundle."""
+
+import argparse
+import json
+import os
+import tarfile
+
+
+class TestBuild:
+    def test_bundle_contents(self, tmp_path):
+        from dynamo_tpu.sdk.cli import build_cmd
+
+        out = str(tmp_path / "bundle")
+        build_cmd(argparse.Namespace(
+            graph="examples.hello_world.hello_world:Frontend",
+            config_file=None, output=out, tar=True,
+        ))
+        manifest = json.load(open(os.path.join(out, "manifest.json")))
+        assert manifest["kind"] == "dynamo_tpu_bundle"
+        assert manifest["services"] == ["Backend", "Middle", "Frontend"]
+        # package graphs bundle the whole top-level package so sibling
+        # imports survive; the dotted entrypoint is preserved
+        assert manifest["graph"] == "examples.hello_world.hello_world:Frontend"
+        assert os.path.exists(
+            os.path.join(out, "examples", "hello_world", "hello_world.py")
+        )
+        run_sh = open(os.path.join(out, "run.sh")).read()
+        assert "serve examples.hello_world.hello_world:Frontend" in run_sh
+        assert os.access(os.path.join(out, "run.sh"), os.X_OK)
+        with tarfile.open(out + ".tar.gz") as tf:
+            names = tf.getnames()
+        assert any(n.endswith("manifest.json") for n in names)
