@@ -30,27 +30,32 @@ def _platform_is_tpu() -> bool:
         return False
 
 
-def _select_pallas(ctx_tokens: int) -> bool:
+def _select_pallas(head_dim: int) -> bool:
     """One fresh-read policy for the decode attention implementation.
 
-    DYN_TPU_ATTENTION=pallas|jnp forces the choice; auto uses the kernel on
-    TPU only once the max context is past the crossover
-    (DYN_TPU_PALLAS_MIN_CONTEXT). Measured on v5e: XLA's fused gather+einsum
-    beats this kernel's one-page-per-grid-step schedule through at least an
-    8k context (80 vs 118 ms/step at batch 8), so the default keeps the
-    kernel out of auto until ~16k where gather materialization dominates;
-    a multi-page double-buffered kernel schedule is the real fix. Env vars
-    are read at trace time, so tests and operators can flip them live.
-    Callers that shard the KV cache over a mesh pass ``use_pallas=False``
-    per call instead — Mosaic kernels have no GSPMD partitioning rule.
+    DYN_TPU_ATTENTION=pallas|jnp forces the choice; auto uses the
+    multi-page double-buffered kernel (paged_attention_decode_v2) on TPU
+    whenever the head dim is lane-aligned (D % 128 == 0 — Mosaic DMA slices
+    must align to the 128-lane tiling). Measured on v5e at D=128: never
+    slower than XLA's gather+einsum, ~2× total (10× on attention compute)
+    by an 8k context. D=64 models (llama3.2-1b) keep the jnp path, which
+    wins there anyway. Env vars are read at trace time, so tests and
+    operators can flip them live. Callers that shard the KV cache over a
+    mesh pass ``use_pallas=False`` per call instead — Mosaic kernels have
+    no GSPMD partitioning rule.
     """
     mode = os.environ.get("DYN_TPU_ATTENTION", "auto")
     if mode == "pallas":
         return True
     if mode == "jnp":
         return False
-    threshold = int(os.environ.get("DYN_TPU_PALLAS_MIN_CONTEXT", "16384"))
-    return _platform_is_tpu() and ctx_tokens >= threshold
+    return _platform_is_tpu() and _v2_supported(head_dim)
+
+
+def _v2_supported(head_dim: int) -> bool:
+    """Single home for the Mosaic DMA-slice alignment constraint (128-lane
+    tiling): both auto-selection and the v2-vs-v1 dispatch consult it."""
+    return head_dim % 128 == 0
 
 
 def write_kv_to_pages(
@@ -127,15 +132,27 @@ def paged_attention(
         scale = d ** -0.5
 
     if use_pallas is None:
-        use_pallas = _select_pallas(block_tables.shape[1] * k_cache.shape[1])
+        use_pallas = _select_pallas(d)
     if t == 1 and soft_cap is None and use_pallas:
-        from dynamo_tpu.ops.pallas.paged_attention import paged_attention_decode
+        from dynamo_tpu.ops.pallas.paged_attention import (
+            paged_attention_decode,
+            paged_attention_decode_v2,
+        )
 
         lengths = jnp.maximum(q_positions[:, 0] + 1, 0)  # padding (pos<0) → 0
-        out = paged_attention_decode(
-            q[:, 0], k_cache, v_cache, block_tables, lengths, scale=scale,
-            interpret=jax.devices()[0].platform == "cpu",
-        )
+        interpret = jax.devices()[0].platform == "cpu"
+        if _v2_supported(d):
+            out = paged_attention_decode_v2(
+                q[:, 0], k_cache, v_cache, block_tables, lengths, scale=scale,
+                interpret=interpret,
+            )
+        else:
+            # lane-misaligned head dim: the per-page-grid schedule (no DMA
+            # slicing constraint) still works when forced
+            out = paged_attention_decode(
+                q[:, 0], k_cache, v_cache, block_tables, lengths, scale=scale,
+                interpret=interpret,
+            )
         return out[:, None]
 
     k = gather_pages(k_cache, block_tables)  # [B, S, KVH, D]

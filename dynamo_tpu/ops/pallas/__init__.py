@@ -5,6 +5,9 @@ The reference implements its KV hot ops as CUDA (`block_copy.cu`, SURVEY.md
 from HBM instead of gather-materialized context copies.
 """
 
-from dynamo_tpu.ops.pallas.paged_attention import paged_attention_decode
+from dynamo_tpu.ops.pallas.paged_attention import (
+    paged_attention_decode,
+    paged_attention_decode_v2,
+)
 
-__all__ = ["paged_attention_decode"]
+__all__ = ["paged_attention_decode", "paged_attention_decode_v2"]

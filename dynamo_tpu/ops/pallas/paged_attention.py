@@ -20,6 +20,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -88,6 +89,162 @@ def _decode_kernel(
         l = l_ref[:, 0]
         denom = jnp.where(l > 0.0, l, 1.0)  # padding lanes produce zeros
         o_ref[0] = (acc_ref[:] / denom[:, None]).astype(o_ref.dtype)
+
+
+def _decode_kernel_v2(
+    # scalar prefetch
+    tables_ref,  # [S, MB]
+    lengths_ref,  # [S]
+    # blocks
+    q_ref,  # [1, H, D] (VMEM, this lane)
+    k_hbm,  # [N, bs, KVH, D] (stays in HBM; paged DMA below)
+    v_hbm,
+    o_ref,  # [1, H, D]
+    # scratch
+    k_buf,  # [2, P, bs, KVH, D] VMEM
+    v_buf,
+    sem,  # DMA semaphores [2, P, 2]
+    *,
+    scale: float,
+    kvh: int,
+    pages_per_chunk: int,
+):
+    s = pl.program_id(0)
+    P = pages_per_chunk
+    bs = k_hbm.shape[1]
+    h, d = q_ref.shape[1], q_ref.shape[2]
+    g = h // kvh
+    mb = tables_ref.shape[1]
+    length = lengths_ref[s]
+    n_pages = lax.div(length + bs - 1, bs)
+    n_chunks = lax.div(length + bs * P - 1, bs * P)
+
+    # trailing in-chunk slots re-fetch the lane's LAST LIVE page: table
+    # entries past the live context are never read (they may be arbitrary
+    # padding), and the buffers always hold finite data — skipping the DMA
+    # instead would leave uninitialized scratch whose NaNs survive masking
+    # through the 0·NaN value contraction
+    last_live = jnp.maximum(n_pages - 1, 0)
+
+    def page_dma(slot, chunk, i, which):
+        pid = tables_ref[s, jnp.minimum(chunk * P + i, last_live)]
+        src, dst = (k_hbm, k_buf) if which == 0 else (v_hbm, v_buf)
+        return pltpu.make_async_copy(
+            src.at[pid], dst.at[slot, i], sem.at[slot, i, which]
+        )
+
+    def start_chunk(slot, chunk):
+        for i in range(P):  # static unroll: P page-granular copies
+            page_dma(slot, chunk, i, 0).start()
+            page_dma(slot, chunk, i, 1).start()
+
+    def wait_chunk(slot, chunk):
+        for i in range(P):
+            page_dma(slot, chunk, i, 0).wait()
+            page_dma(slot, chunk, i, 1).wait()
+
+    @pl.when(n_chunks > 0)
+    def _():
+        start_chunk(0, 0)
+
+    q = q_ref[0].reshape(kvh, g, d).astype(jnp.float32)  # [KVH, G, D]
+
+    def chunk_body(chunk, carry):
+        m, l, acc = carry  # [H], [H], [H, D] f32
+        slot = lax.rem(chunk, 2)
+
+        @pl.when(chunk + 1 < n_chunks)
+        def _():
+            start_chunk(lax.rem(chunk + 1, 2), chunk + 1)
+
+        wait_chunk(slot, chunk)
+        k = k_buf[slot].reshape(P * bs, kvh, d)  # [T, KVH, D]
+        v = v_buf[slot].reshape(P * bs, kvh, d)
+        kt = k.transpose(1, 0, 2).astype(jnp.float32)  # [KVH, T, D]
+        vt = v.transpose(1, 0, 2).astype(jnp.float32)
+
+        scores = lax.dot_general(  # [KVH, G, T]
+            q, kt, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        pos = chunk * (P * bs) + lax.broadcasted_iota(jnp.int32, (kvh, g, P * bs), 2)
+        scores = jnp.where(pos < length, scores, -jnp.inf)
+        flat = scores.reshape(h, P * bs)
+
+        m_new = jnp.maximum(m, flat.max(axis=1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(flat - m_new[:, None])
+        l = l * alpha + p.sum(axis=1)
+        pv = lax.dot_general(  # [KVH, G, D]
+            p.reshape(kvh, g, P * bs), vt,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc = acc * alpha[:, None] + pv.reshape(h, d)
+        return m_new, l, acc
+
+    m0 = jnp.full((h,), -1e30, jnp.float32)
+    l0 = jnp.zeros((h,), jnp.float32)
+    acc0 = jnp.zeros((h, d), jnp.float32)
+    _, l, acc = lax.fori_loop(0, n_chunks, chunk_body, (m0, l0, acc0))
+    denom = jnp.where(l > 0.0, l, 1.0)  # padding lanes produce zeros
+    o_ref[0] = (acc / denom[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "pages_per_chunk", "interpret")
+)
+def paged_attention_decode_v2(
+    q: jax.Array,  # [S, H, D]
+    k_cache: jax.Array,  # [N, bs, KVH, D]
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [S, MB] int32
+    lengths: jax.Array,  # [S] int32; 0 = padding lane
+    *,
+    scale: Optional[float] = None,
+    pages_per_chunk: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash decode over paged KV, multi-page double-buffered schedule.
+
+    The KV pool stays in HBM; each grid step (one lane) streams its pages
+    through two VMEM buffers with page-granular async copies, computing
+    ``pages_per_chunk * block_size`` keys per inner iteration — the MXU
+    sees big tiles and the next chunk's DMA overlaps compute, unlike the
+    one-page-per-grid-step v1 schedule. Loop bound is the lane's true
+    length, so short lanes neither fetch nor compute their padding.
+    """
+    s, h, d = q.shape
+    n, bs, kvh, _ = k_cache.shape
+    mb = block_tables.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    P = min(pages_per_chunk, mb)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s,),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda si, *_: (si, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.HBM),  # whole pool, stays HBM
+            pl.BlockSpec(memory_space=pltpu.HBM),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda si, *_: (si, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, P, bs, kvh, d), k_cache.dtype),
+            pltpu.VMEM((2, P, bs, kvh, d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, P, 2)),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel_v2, scale=scale, kvh=kvh, pages_per_chunk=P
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((s, h, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32), q, k_cache, v_cache)
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "interpret"))

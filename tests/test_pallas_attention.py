@@ -10,7 +10,10 @@ import numpy as np
 import pytest
 
 from dynamo_tpu.ops.attention import paged_attention, write_kv_to_pages
-from dynamo_tpu.ops.pallas.paged_attention import paged_attention_decode
+from dynamo_tpu.ops.pallas.paged_attention import (
+    paged_attention_decode,
+    paged_attention_decode_v2,
+)
 
 
 def _setup(seed, s, h, kvh, d, bs, mb, n_blocks, lengths):
@@ -52,6 +55,49 @@ def test_decode_kernel_gqa_grouping():
     ref = paged_attention(q, k_cache, v_cache, tables, (lens - 1)[:, None])
     got = paged_attention_decode(q[:, 0], k_cache, v_cache, tables, lens, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref[:, 0]), atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "lengths,pages_per_chunk",
+    [
+        ([16, 16, 16, 16], 2),  # page-aligned, 2 pages/chunk
+        ([1, 7, 17, 31], 2),  # ragged, partial pages + partial chunks
+        ([0, 5, 32, 12], 4),  # padding lane; chunk bigger than some lanes
+        ([31, 3, 9, 2], 8),  # pages_per_chunk > MB → clamped
+    ],
+)
+def test_decode_kernel_v2_matches_reference(lengths, pages_per_chunk):
+    """The multi-page double-buffered schedule must match the jnp reference
+    exactly (same contract as v1, different DMA/compute shape)."""
+    s, h, kvh, d, bs, mb = 4, 8, 2, 32, 8, 4
+    q, k_cache, v_cache, tables, lens = _setup(5, s, h, kvh, d, bs, mb, 64, lengths)
+
+    q_positions = (lens - 1)[:, None].astype(jnp.int32)
+    ref = paged_attention(q, k_cache, v_cache, tables, q_positions)
+    got = paged_attention_decode_v2(
+        q[:, 0], k_cache, v_cache, tables, lens,
+        pages_per_chunk=pages_per_chunk, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref[:, 0]), atol=1e-5)
+
+
+@pytest.mark.parametrize("d", [32, 128])  # v1 arm (misaligned) and v2 arm
+def test_paged_attention_dispatch_glue(d):
+    """paged_attention(use_pallas=True) must route through the kernel arms
+    (lengths derivation + v2/v1 pick) with parity vs the jnp path — this is
+    the glue the engine exercises only on real TPU."""
+    s, h, kvh, bs, mb = 4, 8, 2, 8, 4
+    lengths = [9, 17, 1, 0]
+    q, k_cache, v_cache, tables, lens = _setup(9, s, h, kvh, d, bs, mb, 64, lengths)
+    q_positions = (lens - 1)[:, None].astype(jnp.int32)
+
+    ref = paged_attention(
+        q, k_cache, v_cache, tables, q_positions, use_pallas=False
+    )
+    got = paged_attention(
+        q, k_cache, v_cache, tables, q_positions, use_pallas=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
 
 
 def test_decode_kernel_after_scatter_roundtrip():
