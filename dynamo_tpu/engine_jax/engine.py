@@ -142,7 +142,18 @@ class _Seq:
         return len(self.prompt) + len(self.generated)
 
     def emit(self, item) -> None:
-        self.loop.call_soon_threadsafe(self.out_queue.put_nowait, item)
+        # The consumer's event loop can die under us (client teardown, a
+        # finished asyncio.run) while the engine is still processing this
+        # sequence's speculative chunk. Emitting into a dead loop can wedge
+        # the ENGINE THREAD in call_soon_threadsafe's self-pipe write —
+        # observed as permanently leaked blocks + a stuck step loop. Nobody
+        # can receive these items; drop them.
+        if self.loop.is_closed():
+            return
+        try:
+            self.loop.call_soon_threadsafe(self.out_queue.put_nowait, item)
+        except RuntimeError:
+            pass  # loop closed between the check and the call
 
 
 _FINISHED = object()  # sentinel closing a request's output queue

@@ -114,7 +114,6 @@ def _decode_kernel_v2(
     bs = k_hbm.shape[1]
     h, d = q_ref.shape[1], q_ref.shape[2]
     g = h // kvh
-    mb = tables_ref.shape[1]
     length = lengths_ref[s]
     n_pages = lax.div(length + bs - 1, bs)
     n_chunks = lax.div(length + bs * P - 1, bs * P)
@@ -215,11 +214,10 @@ def paged_attention_decode_v2(
     length, so short lanes neither fetch nor compute their padding.
     """
     s, h, d = q.shape
-    n, bs, kvh, _ = k_cache.shape
-    mb = block_tables.shape[1]
+    _, bs, kvh, _ = k_cache.shape
     if scale is None:
         scale = d ** -0.5
-    P = min(pages_per_chunk, mb)
+    P = min(pages_per_chunk, block_tables.shape[1])
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,

@@ -64,6 +64,7 @@ def test_decode_kernel_gqa_grouping():
         ([1, 7, 17, 31], 2),  # ragged, partial pages + partial chunks
         ([0, 5, 32, 12], 4),  # padding lane; chunk bigger than some lanes
         ([31, 3, 9, 2], 8),  # pages_per_chunk > MB → clamped
+        ([31, 25, 17, 32], 1),  # 4 chunks: double-buffer slots reused twice
     ],
 )
 def test_decode_kernel_v2_matches_reference(lengths, pages_per_chunk):

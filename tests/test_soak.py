@@ -1,0 +1,122 @@
+"""Engine soak: sustained churn with cancellations must leak nothing.
+
+Reference test-strategy parity: lib/runtime/tests/soak.rs (long-running
+stress). Scaled to CI: many waves of concurrent requests with mixed
+lengths, early consumer disconnects, and preemption pressure; afterwards
+every slot is free, every KV block is accounted for, and the engine still
+serves correctly.
+"""
+
+import asyncio
+import dataclasses
+import random
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+from dynamo_tpu.runtime.engine import Context
+
+CFG = dataclasses.replace(LLAMA_PRESETS["tiny"], dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def test_engine_soak_no_leaks(params, run):
+    cfg = EngineConfig(
+        max_slots=4, kv_block_size=8, max_model_len=96, num_kv_blocks=24,
+        prefill_chunk=16, decode_steps=2, host_cache_blocks=16,
+    )
+    eng = JaxServingEngine(CFG, params, cfg)
+    rng = random.Random(0)
+
+    async def one(i: int):
+        prompt = [rng.randrange(CFG.vocab_size) for _ in range(rng.randrange(3, 40))]
+        req = PreprocessedRequest(
+            token_ids=prompt,
+            stop_conditions=StopConditions(
+                max_tokens=rng.randrange(2, 12), ignore_eos=True
+            ),
+            sampling_options=SamplingOptions(
+                temperature=rng.choice([0.0, 0.8]), seed=i
+            ),
+        )
+        ctx = Context(req)
+        n = 0
+        cancel_at = rng.randrange(1, 6) if rng.random() < 0.3 else None
+        gen = eng.generate(ctx)
+        try:
+            async for item in gen:
+                if item.is_error:
+                    return n
+                n += len((item.data or {}).get("token_ids", []))
+                if cancel_at is not None and n >= cancel_at:
+                    ctx.context.stop_generating()
+        finally:
+            await gen.aclose()
+        return n
+
+    async def soak():
+        total = 0
+        for wave in range(6):
+            results = await asyncio.gather(*[one(wave * 16 + i) for i in range(16)])
+            total += sum(results)
+        return total
+
+    try:
+        total = run(soak())
+        assert total > 0
+
+        # drain to full quiescence: slots empty AND the final speculative
+        # chunk processed (metrics hit 0/0 a beat before the engine frees the
+        # last zombie allocations, so poll the refcount invariant itself — a
+        # genuine leak persists forever and still fails)
+        async def settled():
+            for _ in range(100):
+                m = eng.metrics_snapshot()
+                if (
+                    m["request_active_slots"] == 0
+                    and m["num_requests_waiting"] == 0
+                    and eng._inflight is None
+                    and not eng._zombie_allocs
+                    and eng.allocator._refcount == {}
+                ):
+                    return m
+                await asyncio.sleep(0.05)
+            return eng.metrics_snapshot()
+
+        m = run(settled())
+        assert m["request_active_slots"] == 0
+        assert m["num_requests_waiting"] == 0
+        # every non-cached block must be back in the free pool: active ==
+        # reuse-pool holdings only (no refcount leaks from cancels/preempts)
+        assert eng.allocator._refcount == {}, (
+            f"leaked refcounts: {eng.allocator._refcount}"
+        )
+
+        # and the engine still serves with exact greedy determinism
+        async def probe():
+            req = PreprocessedRequest(
+                token_ids=[3, 1, 4, 1, 5],
+                stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+                sampling_options=SamplingOptions(temperature=0.0),
+            )
+            toks = []
+            async for item in eng.generate(Context(req)):
+                toks.extend((item.data or {}).get("token_ids", []))
+            return toks
+
+        t1 = run(probe())
+        assert len(t1) == 4
+    finally:
+        eng.close()
