@@ -52,6 +52,8 @@ class MetricsAggregator:
         self.prefix = prefix
         self.expiry = expiry
         self._workers: Dict[str, Tuple[float, ForwardPassMetrics]] = {}
+        # worker → (isl_total, overlap_total, last_event_time)
+        self._hit_totals: Dict[str, Tuple[int, int, float]] = {}
 
     def update(self, worker_id: str, metrics: ForwardPassMetrics) -> None:
         self._workers[worker_id] = (time.monotonic(), metrics)
@@ -63,8 +65,26 @@ class MetricsAggregator:
         }
         return {w: m for w, (t, m) in self._workers.items()}
 
+    def record_hit_rate(self, worker_id: str, isl_blocks: int, overlap_blocks: int) -> None:
+        """Accumulate router KVHitRateEvents (cumulative, counter-style)."""
+        isl, overlap, _ = self._hit_totals.get(worker_id, (0, 0, 0.0))
+        self._hit_totals[worker_id] = (
+            isl + isl_blocks, overlap + overlap_blocks, time.monotonic(),
+        )
+
+    def _prune_hit_totals(self) -> None:
+        # counters for workers the router stopped routing to age out like
+        # the gauges (bounded memory on churn, no lines for dead workers).
+        # Hit counters get a longer horizon: routing decisions are sparser
+        # than the ~1s metrics heartbeat.
+        cutoff = time.monotonic() - max(self.expiry * 10, 300.0)
+        self._hit_totals = {
+            w: t for w, t in self._hit_totals.items() if t[2] >= cutoff
+        }
+
     def render(self) -> str:
         live = self.live_workers()
+        self._prune_hit_totals()
         lines = []
         for name, help_text in GAUGES:
             full = f"{self.prefix}_{name}"
@@ -74,6 +94,17 @@ class MetricsAggregator:
                 value = getattr(m, name)
                 lines.append(
                     f'{full}{{namespace="{self.namespace}",worker="{worker_id}"}} {value}'
+                )
+        for name, idx, help_text in (
+            ("router_isl_blocks_total", 0, "Prompt blocks seen by the KV router"),
+            ("router_hit_blocks_total", 1, "Prompt blocks served from prefix cache"),
+        ):
+            full = f"{self.prefix}_{name}"
+            lines.append(f"# HELP {full} {help_text}")
+            lines.append(f"# TYPE {full} counter")
+            for worker_id, totals in sorted(self._hit_totals.items()):
+                lines.append(
+                    f'{full}{{namespace="{self.namespace}",worker="{worker_id}"}} {totals[idx]}'
                 )
         full = f"{self.prefix}_up"
         lines.append(f"# HELP {full} Workers currently reporting metrics")
@@ -86,18 +117,28 @@ async def run_aggregator(
     drt, namespace: str, port: int, host: str = "0.0.0.0", expiry: float = 30.0
 ) -> None:
     """Subscribe to kv_metrics and serve /metrics until cancelled."""
-    from dynamo_tpu.runtime.distributed import KV_METRICS_SUBJECT, resubscribe_forever
+    from dynamo_tpu.runtime.distributed import (
+        KV_HIT_RATE_SUBJECT,
+        KV_METRICS_SUBJECT,
+        resubscribe_forever,
+    )
 
     agg = MetricsAggregator(namespace, expiry=expiry)
     ns = drt.namespace(namespace)
-    consumer = asyncio.create_task(
-        resubscribe_forever(
+    consumers = [
+        asyncio.create_task(resubscribe_forever(
             ns, KV_METRICS_SUBJECT,
             lambda d: agg.update(
                 d["worker_id"], ForwardPassMetrics.from_dict(d["metrics"])
             ),
-        )
-    )
+        )),
+        asyncio.create_task(resubscribe_forever(
+            ns, KV_HIT_RATE_SUBJECT,
+            lambda d: agg.record_hit_rate(
+                d["worker_id"], d["isl_blocks"], d["overlap_blocks"]
+            ),
+        )),
+    ]
 
     async def metrics_handler(_request):
         return web.Response(
@@ -114,7 +155,8 @@ async def run_aggregator(
     try:
         await asyncio.Event().wait()
     finally:
-        consumer.cancel()
+        for c in consumers:
+            c.cancel()
         await runner.cleanup()
 
 

@@ -60,6 +60,7 @@ async def run_router(drt, namespace: str, block_size: int = 16) -> None:
     from dynamo_tpu.runtime.distributed import (
         KV_EVENTS_SUBJECT,
         KV_METRICS_SUBJECT,
+        hit_rate_sink,
         resubscribe_forever,
     )
 
@@ -67,6 +68,7 @@ async def run_router(drt, namespace: str, block_size: int = 16) -> None:
 
     router = KvRouter(block_size)
     ns = drt.namespace(namespace)
+    router.on_hit_rate = hit_rate_sink(ns)
     last_seen: dict = {}
 
     feed_alive = [0.0]  # time of the last metrics delivery from ANY worker

@@ -84,6 +84,30 @@ class RouterEvent:
         return cls(worker_id=d["worker_id"], event=KvCacheEvent.from_dict(d["event"]))
 
 
+@dataclass(frozen=True)
+class KVHitRateEvent:
+    """Per-scheduling-decision prefix-hit telemetry published on the event
+    plane (reference: KVHitRateEvent on the `kv-hit-rate` subject,
+    kv_router.rs:52-54 / scheduler.rs emission)."""
+
+    worker_id: str
+    isl_blocks: int  # prompt length in blocks
+    overlap_blocks: int  # blocks served from that worker's prefix cache
+
+    def to_dict(self) -> dict:
+        from dataclasses import asdict
+
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "KVHitRateEvent":
+        return cls(
+            worker_id=d["worker_id"],
+            isl_blocks=int(d["isl_blocks"]),
+            overlap_blocks=int(d["overlap_blocks"]),
+        )
+
+
 @dataclass
 class ForwardPassMetrics:
     """Worker load snapshot (reference kv_router/protocols.rs:42-54)."""

@@ -34,6 +34,10 @@ class KvRouter:
         # C++ tree when the toolchain is available, Python tree otherwise
         self.indexer = make_indexer(block_size, salt=salt)
         self.scheduler = KvScheduler(selector)
+        # optional hit-rate telemetry sink: called with a KVHitRateEvent for
+        # every scheduling decision (the transport layer publishes it on the
+        # namespace `kv_hit_rate` subject; reference kv_router.rs:52-54)
+        self.on_hit_rate = None
 
     # -- event/metrics ingestion (wired to transports by the runtime layer) --
 
@@ -59,4 +63,15 @@ class KvRouter:
                 "scheduled %d tokens → %s (overlap=%d blocks, logit=%.3f)",
                 len(token_ids), decision.worker_id, decision.overlap_blocks, decision.logit,
             )
+            if self.on_hit_rate is not None:
+                from dynamo_tpu.kv_router.protocols import KVHitRateEvent
+
+                try:
+                    self.on_hit_rate(KVHitRateEvent(
+                        worker_id=decision.worker_id,
+                        isl_blocks=isl_blocks,
+                        overlap_blocks=decision.overlap_blocks,
+                    ))
+                except Exception:
+                    logger.warning("hit-rate sink failed", exc_info=True)
         return decision

@@ -37,6 +37,29 @@ logger = logging.getLogger(__name__)
 
 KV_EVENTS_SUBJECT = "kv_events"
 KV_METRICS_SUBJECT = "kv_metrics"
+KV_HIT_RATE_SUBJECT = "kv_hit_rate"
+
+
+def hit_rate_sink(ns) -> "Callable":
+    """A KvRouter.on_hit_rate sink publishing KVHitRateEvents on the
+    namespace `kv_hit_rate` subject. Holds strong task references (the loop
+    only keeps weak ones) and swallows publish failures quietly — a bus
+    outage must not spam the request hot path."""
+    loop = asyncio.get_running_loop()
+    inflight: set = set()
+
+    async def _publish(payload: dict) -> None:
+        try:
+            await ns.publish(KV_HIT_RATE_SUBJECT, payload)
+        except Exception:
+            logger.debug("hit-rate publish failed", exc_info=True)
+
+    def sink(ev) -> None:
+        task = loop.create_task(_publish(ev.to_dict()))
+        inflight.add(task)
+        task.add_done_callback(inflight.discard)
+
+    return sink
 
 
 async def resubscribe_forever(ns, subject: str, apply) -> None:
@@ -375,6 +398,11 @@ class EndpointClient(AsyncEngine):
             self._router = KvRouter(block_size=self.kv_block_size)
             if rt.bus is not None:
                 self._kv_task = asyncio.create_task(self._kv_feed())
+                # hit-rate telemetry: every routing decision publishes a
+                # KVHitRateEvent (reference kv-hit-rate subject)
+                self._router.on_hit_rate = hit_rate_sink(
+                    self.endpoint.component.namespace
+                )
 
     async def _watch_loop(self) -> None:
         """Consume watch events; if the statestore connection drops, reconnect

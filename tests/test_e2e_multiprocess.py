@@ -46,11 +46,13 @@ def _wait_port(port: int, timeout: float = 20.0) -> bool:
 def _spawn(args, env=None):
     e = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
     e.update(env or {})
+    # DEVNULL: nothing drains these pipes, and a chatty child (jit warnings,
+    # request logs) filling a PIPE buffer would block and wedge the cluster
     return subprocess.Popen(
         [sys.executable, *args],
         env=e,
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
         cwd=REPO,
     )
 
@@ -124,6 +126,101 @@ def cluster(tmp_path_factory):
             p.wait(timeout=10)
         except subprocess.TimeoutExpired:
             p.kill()
+
+
+class TestDisaggMultiProcess:
+    """Disaggregated prefill/decode across real OS processes: decode worker,
+    prefill worker, discovery frontend — the flagship reference path
+    (SURVEY §3.4) with every hop on real sockets. Both workers random-init
+    the tiny fixture model with the same seed, so a disaggregated completion
+    must equal the aggregated one token-for-token."""
+
+    def test_disagg_completion_matches_aggregated(self, tmp_path):
+        from tests.fixtures import build_model_dir
+
+        model_dir = build_model_dir(str(tmp_path / "model"))
+        ss_port, bus_port, http_port = _free_port(), _free_port(), _free_port()
+        ss_url, bus_url = f"127.0.0.1:{ss_port}", f"127.0.0.1:{bus_port}"
+
+        procs = {}
+        try:
+            procs["ss"] = _spawn(["-m", "dynamo_tpu.runtime.statestore",
+                                  "--host", "127.0.0.1", "--port", str(ss_port)])
+            procs["bus"] = _spawn(["-m", "dynamo_tpu.runtime.bus",
+                                   "--host", "127.0.0.1", "--port", str(bus_port)])
+            assert _wait_port(ss_port) and _wait_port(bus_port)
+
+            common = ["--model-path", model_dir, "--model-name", "tiny",
+                      "--statestore", ss_url, "--bus", bus_url,
+                      "--max-model-len", "128", "--kv-block-size", "8"]
+            procs["decode"] = _spawn([
+                "-m", "dynamo_tpu.cli.run", "in=dyn://dynamo.backend.generate",
+                "out=jax", *common, "--disagg", "decode",
+                "--max-local-prefill-length", "8",
+            ])
+            procs["prefill"] = _spawn([
+                "-m", "dynamo_tpu.cli.run", "in=prefill:dynamo", "out=jax", *common,
+            ])
+            procs["frontend"] = _spawn([
+                "-m", "dynamo_tpu.cli.run", "in=http", "out=discover",
+                "--statestore", ss_url, "--bus", bus_url, "--port", str(http_port),
+            ])
+            assert _wait_port(http_port)
+            base = f"http://127.0.0.1:{http_port}"
+
+            deadline = time.time() + 90  # includes tiny-model jit warmup
+            body = None
+            prompt = "the quick brown fox jumps over the lazy dog " * 2
+            while time.time() < deadline:
+                try:
+                    body = _http_json(
+                        f"{base}/v1/completions",
+                        {"model": "tiny", "prompt": prompt, "max_tokens": 6,
+                         "temperature": 0},
+                        timeout=30,
+                    )
+                    break
+                except Exception:
+                    time.sleep(1.0)
+            assert body and body["choices"][0]["finish_reason"], body
+            disagg_text = body["choices"][0]["text"]
+
+            # aggregated reference: same weights (seed-deterministic init) on
+            # a plain single-process server
+            agg_port = _free_port()
+            procs["agg"] = _spawn([
+                "-m", "dynamo_tpu.cli.run", "in=http", "out=jax",
+                "--model-path", model_dir, "--model-name", "tiny",
+                "--max-model-len", "128", "--kv-block-size", "8",
+                "--port", str(agg_port),
+            ])
+            assert _wait_port(agg_port)
+            deadline = time.time() + 90
+            agg_body = None
+            while time.time() < deadline:
+                try:
+                    agg_body = _http_json(
+                        f"http://127.0.0.1:{agg_port}/v1/completions",
+                        {"model": "tiny", "prompt": prompt, "max_tokens": 6,
+                         "temperature": 0},
+                        timeout=30,
+                    )
+                    break
+                except Exception:
+                    time.sleep(1.0)
+            assert agg_body, "aggregated server never answered"
+            assert disagg_text == agg_body["choices"][0]["text"], (
+                "disaggregated completion diverged from aggregated"
+            )
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs.values():
+                try:
+                    p.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    p.kill()
 
 
 class TestMultiProcessE2E:

@@ -25,6 +25,68 @@ class TestAggregator:
         assert 'dynamo_worker_kv_active_blocks{namespace="ns",worker="w1"} 7' in text
         assert 'dynamo_worker_up{namespace="ns"} 2' in text
 
+    def test_hit_rate_events_accumulate(self):
+        agg = MetricsAggregator("ns")
+        agg.record_hit_rate("w1", isl_blocks=8, overlap_blocks=6)
+        agg.record_hit_rate("w1", isl_blocks=4, overlap_blocks=0)
+        text = agg.render()
+        assert 'dynamo_worker_router_isl_blocks_total{namespace="ns",worker="w1"} 12' in text
+        assert 'dynamo_worker_router_hit_blocks_total{namespace="ns",worker="w1"} 6' in text
+
+    def test_router_publishes_hit_rate_to_aggregator(self, run):
+        """KvRouter decision → kv_hit_rate subject → aggregator counters."""
+        import json
+
+        from dynamo_tpu.kv.tokens import compute_block_hashes_for_seq
+        from dynamo_tpu.kv_router.router import KvRouter
+        from dynamo_tpu.runtime.distributed import KV_HIT_RATE_SUBJECT
+
+        async def go():
+            ss = StateStoreServer(port=0)
+            bus = MessageBusServer(port=0)
+            await ss.start()
+            await bus.start()
+            pub_rt = await DistributedRuntime.create(ss.url, bus.url)
+            sub_rt = await DistributedRuntime.create(ss.url, bus.url)
+
+            ns = pub_rt.namespace("dynamo")
+            sub = await sub_rt.namespace("dynamo").subscribe(KV_HIT_RATE_SUBJECT)
+
+            router = KvRouter(block_size=4)
+            loop = asyncio.get_running_loop()
+            router.on_hit_rate = lambda ev: loop.create_task(
+                ns.publish(KV_HIT_RATE_SUBJECT, ev.to_dict())
+            )
+            prompt = list(range(16))
+            hashes = compute_block_hashes_for_seq(prompt, 4)
+            from dynamo_tpu.kv_router.protocols import (
+                KvCacheEvent,
+                RouterEvent,
+                StoredBlock,
+                StoredBlocks,
+            )
+
+            router.apply_event(RouterEvent("wA", KvCacheEvent(0, StoredBlocks(
+                parent_hash=None,
+                blocks=[StoredBlock(h, 0) for h in hashes],
+            ))))
+            router.update_worker_metrics("wA", ForwardPassMetrics(request_total_slots=8))
+            decision = router.schedule(prompt)
+            assert decision.worker_id == "wA"
+
+            raw = await asyncio.wait_for(sub.__aiter__().__anext__(), 5)
+            ev = json.loads(raw)
+            assert ev["worker_id"] == "wA"
+            assert ev["overlap_blocks"] == 4  # every stored block matched
+            assert ev["isl_blocks"] == 4
+
+            await pub_rt.shutdown()
+            await sub_rt.shutdown()
+            await ss.stop()
+            await bus.stop()
+
+        run(go())
+
     def test_mock_worker_feeds_aggregator_over_bus(self, run):
         async def go():
             ss = StateStoreServer(port=0)
