@@ -214,10 +214,11 @@ class JaxServingEngine(AsyncEngine):
             model_config, self.num_blocks, engine_config.kv_block_size,
             dtype=cache_dtype or model_config.dtype,
         )
-        # Mosaic kernels can't be auto-partitioned over a sharded cache; this
-        # engine's jitted steps force the jnp attention there (per-engine, so
-        # an unsharded engine in the same process keeps the Pallas kernel)
-        self._use_pallas: Optional[bool] = False if mesh is not None else None
+        # attention impl is auto-selected (platform + head-dim rule,
+        # ops/attention.py); on a sharded cache the kernel runs per-tp-shard
+        # under shard_map — `mesh` is passed into forward so the kernel tier
+        # stays live in sharded (70B-path) configs instead of falling back
+        # to jnp
         if mesh is not None:
             from dynamo_tpu.parallel.mesh import kv_cache_sharding
 
@@ -286,7 +287,7 @@ class JaxServingEngine(AsyncEngine):
                 toks, pos, cache = carry
                 logits, cache = forward(
                     params, cfg, toks[:, None], pos[:, None], cache, tables,
-                    use_pallas=self._use_pallas,
+                    mesh=self.mesh,
                 )
                 kk = jax.random.fold_in(step_key, k)
                 keys = jax.vmap(lambda s: jax.random.fold_in(kk, s))(seeds)
@@ -336,8 +337,7 @@ class JaxServingEngine(AsyncEngine):
             # index of the token whose logits to sample, −1 → output unused.
             # One shape serves any mix of prefilling and decoding lanes.
             logits, cache = forward(
-                params, cfg, tokens, positions, cache, tables,
-                use_pallas=self._use_pallas,
+                params, cfg, tokens, positions, cache, tables, mesh=self.mesh,
             )
             sel = logits[jnp.arange(S), jnp.clip(sample_at, 0)]  # [S, V]
             keys = jax.vmap(lambda s: jax.random.fold_in(step_key, s))(seeds)

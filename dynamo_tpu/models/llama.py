@@ -200,6 +200,7 @@ def decoder_layer(
     *,
     soft_cap: Optional[float] = None,
     use_pallas: Optional[bool] = None,
+    mesh=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decoder layer: returns (hidden, k_page, v_page).
 
@@ -225,7 +226,7 @@ def decoder_layer(
     k_page, v_page = write_kv_to_pages(k_page, v_page, k, v, positions, block_tables)
     attn = paged_attention(
         q, k_page, v_page, block_tables, positions, soft_cap=soft_cap,
-        use_pallas=use_pallas,
+        use_pallas=use_pallas, mesh=mesh,
     )
     attn = attn.reshape(b, t, c.q_dim) @ lp["wo"]
     hidden = hidden + attn
@@ -245,7 +246,8 @@ def forward(
     block_tables: jax.Array,  # [B, max_blocks]
     *,
     soft_cap: Optional[float] = None,
-    use_pallas: Optional[bool] = None,  # None = auto; False forced for sharded caches
+    use_pallas: Optional[bool] = None,  # None = auto (DYN_TPU_ATTENTION + platform)
+    mesh=None,  # set when the cache is sharded: kernels run under shard_map
 ) -> Tuple[jax.Array, KVCache]:
     """One forward step (prefill if T>1, decode if T==1).
 
@@ -260,7 +262,7 @@ def forward(
         lp, k_page, v_page = xs  # layer params + this layer's page pool
         hidden, k_page, v_page = decoder_layer(
             lp, c, carry, positions, k_page, v_page, block_tables,
-            soft_cap=soft_cap, use_pallas=use_pallas,
+            soft_cap=soft_cap, use_pallas=use_pallas, mesh=mesh,
         )
         return hidden, (k_page, v_page)
 

@@ -40,9 +40,9 @@ def _select_pallas(head_dim: int) -> bool:
     slower than XLA's gather+einsum, ~2× total (10× on attention compute)
     by an 8k context. D=64 models (llama3.2-1b) keep the jnp path, which
     wins there anyway. Env vars are read at trace time, so tests and
-    operators can flip them live. Callers that shard the KV cache over a
-    mesh pass ``use_pallas=False`` per call instead — Mosaic kernels have
-    no GSPMD partitioning rule.
+    operators can flip them live. Callers with a cache sharded over a mesh
+    pass ``mesh=`` so the kernel runs under shard_map (Mosaic kernels have
+    no GSPMD partitioning rule; shard_map sidesteps auto-partitioning).
     """
     mode = os.environ.get("DYN_TPU_ATTENTION", "auto")
     if mode == "pallas":
@@ -56,6 +56,17 @@ def _v2_supported(head_dim: int) -> bool:
     """Single home for the Mosaic DMA-slice alignment constraint (128-lane
     tiling): both auto-selection and the v2-vs-v1 dispatch consult it."""
     return head_dim % 128 == 0
+
+
+def _tp_divisible(mesh, h: int, kvh: int) -> bool:
+    """Can the head axes split evenly over the mesh's tp axis? (shard_map
+    requires exact divisibility, unlike GSPMD's padded auto-partitioning.)"""
+    from dynamo_tpu.parallel.mesh import AXIS_TP
+
+    if AXIS_TP not in mesh.axis_names:
+        return True
+    tp = mesh.shape[AXIS_TP]
+    return h % tp == 0 and kvh % tp == 0
 
 
 def write_kv_to_pages(
@@ -114,6 +125,7 @@ def paged_attention(
     scale: Optional[float] = None,
     soft_cap: Optional[float] = None,
     use_pallas: Optional[bool] = None,
+    mesh=None,
 ) -> jax.Array:
     """Causal attention of ``q`` against the paged context (reference impl).
 
@@ -133,15 +145,26 @@ def paged_attention(
 
     if use_pallas is None:
         use_pallas = _select_pallas(d)
+    if use_pallas and mesh is not None and not _tp_divisible(mesh, h, kvh):
+        # shard_map needs the head axes to split evenly over tp; an uneven
+        # mesh (e.g. tp=16 over KVH=8) keeps the GSPMD-partitioned jnp path
+        use_pallas = False
     if t == 1 and soft_cap is None and use_pallas:
         from dynamo_tpu.ops.pallas.paged_attention import (
             paged_attention_decode,
+            paged_attention_decode_sharded,
             paged_attention_decode_v2,
         )
 
         lengths = jnp.maximum(q_positions[:, 0] + 1, 0)  # padding (pos<0) → 0
         interpret = jax.devices()[0].platform == "cpu"
-        if _v2_supported(d):
+        if mesh is not None:
+            # sharded cache: run the kernel per tp shard under shard_map
+            out = paged_attention_decode_sharded(
+                q[:, 0], k_cache, v_cache, block_tables, lengths, mesh=mesh,
+                scale=scale, interpret=interpret,
+            )
+        elif _v2_supported(d):
             out = paged_attention_decode_v2(
                 q[:, 0], k_cache, v_cache, block_tables, lengths, scale=scale,
                 interpret=interpret,

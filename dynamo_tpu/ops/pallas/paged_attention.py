@@ -245,6 +245,67 @@ def paged_attention_decode_v2(
     )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32), q, k_cache, v_cache)
 
 
+def paged_attention_decode_sharded(
+    q: jax.Array,  # [S, H, D] — H sharded over tp
+    k_cache: jax.Array,  # [N, bs, KVH, D] — KVH sharded over tp
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [S, MB] int32, replicated
+    lengths: jax.Array,  # [S] int32, replicated
+    *,
+    mesh,
+    scale: Optional[float] = None,
+    pages_per_chunk: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """The decode kernel on a sharded KV cache, via ``shard_map`` over tp.
+
+    Mosaic kernels have no GSPMD partitioning rule, so a sharded cache can't
+    flow into ``pallas_call`` under plain jit — but the computation is
+    embarrassingly parallel over the tp axis: KV heads are the sharded axis
+    (parallel/mesh.py ``kv_cache_sharding``), each kv head's query-head group
+    is co-located by the Megatron head sharding, and every shard's page-pool
+    slice is complete for its heads. ``shard_map`` runs the kernel per-shard
+    with zero collectives; the output's head axis comes back sharded exactly
+    like q, so the downstream ``attn @ wo`` contraction proceeds as in the
+    jnp path. This is what lets the kernel tier run in sharded (70B-path)
+    configs instead of falling back to jnp — the reference's kernel tier
+    runs in every config (lib/llm/src/kernels/block_copy.cu:41).
+
+    Other mesh axes (dp/pp/sp) see fully-replicated inputs and replicated
+    outputs; ``check_vma=False`` because pallas_call can't be rep-checked.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from dynamo_tpu.ops.attention import _v2_supported
+    from dynamo_tpu.parallel.mesh import AXIS_TP
+
+    d = q.shape[-1]
+    if scale is None:
+        scale = d ** -0.5
+    tp = AXIS_TP if AXIS_TP in mesh.axis_names else None
+    qspec = P(None, tp, None)
+    kvspec = P(None, None, tp, None)
+
+    def local(qs, ks, vs, tbl, ln):
+        # head_dim is not sharded, so the v2 lane-alignment rule is unchanged
+        if _v2_supported(d):
+            return paged_attention_decode_v2(
+                qs, ks, vs, tbl, ln, scale=scale,
+                pages_per_chunk=pages_per_chunk, interpret=interpret,
+            )
+        return paged_attention_decode(
+            qs, ks, vs, tbl, ln, scale=scale, interpret=interpret
+        )
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(qspec, kvspec, kvspec, P(None, None), P(None)),
+        out_specs=qspec, check_vma=False,
+    )
+    return fn(q, k_cache, v_cache, block_tables, lengths)
+
+
 @functools.partial(jax.jit, static_argnames=("scale", "interpret"))
 def paged_attention_decode(
     q: jax.Array,  # [S, H, D] one query token per lane

@@ -50,6 +50,29 @@ def test_engine_greedy_parity_on_mesh(params, expected, run, dp, tp):
         eng.close()
 
 
+def test_engine_greedy_parity_on_mesh_with_pallas(params, expected, run, monkeypatch):
+    """The kernel tier must stay live on a sharded mesh (VERDICT r2 item 1):
+    with Pallas forced, the engine's decode steps run the kernel per tp shard
+    under shard_map (interpret mode on CPU) and still match the unsharded jnp
+    reference exactly."""
+    monkeypatch.setenv("DYN_TPU_ATTENTION", "pallas")
+    mesh = make_mesh(MeshConfig(dp=2, tp=2))
+    sharded = jax.device_put(params, param_shardings(CFG, mesh))
+    eng = JaxServingEngine(CFG, sharded, ENGINE_CFG, mesh=mesh)
+    try:
+
+        async def go():
+            return await asyncio.gather(
+                *[collect_tokens(eng, p, max_tokens=5) for p in PROMPTS]
+            )
+
+        results = run(go())
+        for p, (toks, _) in zip(PROMPTS, results):
+            assert toks == expected[tuple(p)], f"prompt {p} pallas-on-mesh"
+    finally:
+        eng.close()
+
+
 def test_driver_dryrun_multichip_in_process():
     """The driver's entry point must run under the already-provisioned 8-device
     CPU backend (regression for round-1's rc=1)."""

@@ -120,3 +120,58 @@ def test_decode_kernel_after_scatter_roundtrip():
     ref = paged_attention(q, k_cache, v_cache, tables, (lens - 1)[:, None])
     got = paged_attention_decode(q[:, 0], k_cache, v_cache, tables, lens, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref[:, 0]), atol=1e-5)
+
+
+def test_decode_kernel_sharded_matches_reference():
+    """The kernel must run on a tp-sharded cache via shard_map (the 70B-path
+    config — VERDICT r2 item 1: no more jnp fallback for sharded engines),
+    with parity vs the unsharded jnp reference, including through the
+    paged_attention glue inside jit."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dynamo_tpu.ops.pallas.paged_attention import paged_attention_decode_sharded
+    from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    s, h, kvh, d, bs, mb = 4, 8, 2, 32, 8, 4
+    lengths = [9, 17, 1, 0]
+    q, k_cache, v_cache, tables, lens = _setup(3, s, h, kvh, d, bs, mb, 64, lengths)
+    q_positions = (lens - 1)[:, None].astype(jnp.int32)
+    ref = paged_attention(q, k_cache, v_cache, tables, q_positions, use_pallas=False)
+
+    mesh = make_mesh(MeshConfig(dp=2, tp=2))
+    qs = jax.device_put(q[:, 0], NamedSharding(mesh, P(None, "tp", None)))
+    ks = jax.device_put(k_cache, NamedSharding(mesh, P(None, None, "tp", None)))
+    vs = jax.device_put(v_cache, NamedSharding(mesh, P(None, None, "tp", None)))
+
+    got = paged_attention_decode_sharded(
+        qs, ks, vs, tables, lens, mesh=mesh, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref[:, 0]), atol=1e-5)
+
+    # through the dispatch glue, inside jit (how the engine's step fn calls it)
+    @jax.jit
+    def run(q, k, v, t, p):
+        return paged_attention(q, k, v, t, p, use_pallas=True, mesh=mesh)
+
+    got2 = run(q, ks, vs, tables, q_positions)
+    np.testing.assert_allclose(np.asarray(got2[:, 0]), np.asarray(ref[:, 0]), atol=1e-5)
+
+
+def test_sharded_dispatch_uneven_tp_falls_back():
+    """tp that doesn't divide the head axes (e.g. tp=4 over KVH=2) must keep
+    the GSPMD jnp path instead of crashing in shard_map's divisibility check."""
+    import jax
+
+    from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    s, h, kvh, d, bs, mb = 4, 8, 2, 32, 8, 4
+    q, k_cache, v_cache, tables, lens = _setup(7, s, h, kvh, d, bs, mb, 64, [9, 17, 1, 5])
+    q_positions = (lens - 1)[:, None].astype(jnp.int32)
+    ref = paged_attention(q, k_cache, v_cache, tables, q_positions, use_pallas=False)
+
+    mesh = make_mesh(MeshConfig(tp=4))  # kvh=2 % 4 != 0 → jnp fallback
+    got = paged_attention(
+        q, k_cache, v_cache, tables, q_positions, use_pallas=True, mesh=mesh
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
