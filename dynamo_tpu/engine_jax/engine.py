@@ -45,7 +45,12 @@ from dynamo_tpu.engine_jax.allocator import (
     KvEventSink,
     SequenceAllocation,
 )
-from dynamo_tpu.engine_jax.sampling import sample_tokens, token_logprobs
+from dynamo_tpu.engine_jax.sampling import (
+    apply_penalties,
+    sample_tokens,
+    token_logprobs,
+    update_counts,
+)
 from dynamo_tpu.llm.protocols.common import (
     FinishReason,
     LLMEngineOutput,
@@ -105,6 +110,7 @@ class _Seq:
         "generated", "emitted", "max_tokens", "eos_ids", "ignore_eos",
         "temperature", "top_k", "top_p", "seed", "logprobs", "enqueue_t",
         "first_token_t", "remote", "remote_deadline", "prefill_pos",
+        "freq_pen", "pres_pen", "out_tokens",
     )
 
     def __init__(self, ctx: Context, request: PreprocessedRequest, loop) -> None:
@@ -128,6 +134,11 @@ class _Seq:
         self.top_k = so.top_k if so.top_k is not None else 0
         self.top_p = so.top_p if so.top_p is not None else 1.0
         self.seed = so.seed if so.seed is not None else 0
+        self.freq_pen = so.frequency_penalty or 0.0
+        self.pres_pen = so.presence_penalty or 0.0
+        # all output tokens ever emitted — unlike `generated`, survives
+        # preemption; rebuilds the device penalty-count row on re-admission
+        self.out_tokens: List[int] = []
         # None = don't emit logprobs; 0 = chosen only; k = with alternatives
         self.logprobs = so.logprobs
         self.enqueue_t = time.perf_counter()
@@ -140,6 +151,10 @@ class _Seq:
     @property
     def total_len(self) -> int:
         return len(self.prompt) + len(self.generated)
+
+    @property
+    def penalized(self) -> bool:
+        return self.freq_pen != 0.0 or self.pres_pen != 0.0
 
     def emit(self, item) -> None:
         # The consumer's event loop can die under us (client teardown, a
@@ -236,6 +251,20 @@ class JaxServingEngine(AsyncEngine):
         self._topk = np.zeros((S,), np.int32)
         self._topp = np.ones((S,), np.float32)
         self._seeds = np.zeros((S,), np.int32)
+        self._freqp = np.zeros((S,), np.float32)
+        self._presp = np.zeros((S,), np.float32)
+
+        # frequency/presence penalties: [S, V] output-token count buffer,
+        # device-resident, maintained in-jit (sampling.apply_penalties /
+        # update_counts). Allocated lazily on the first penalized request;
+        # the dummy stands in when no lane is penalized so the two step-fn
+        # variants share one signature. `_counts_lanes` records which _Seq
+        # each row's contents belong to (identity), so admissions into a
+        # slot reset + rebuild only the rows that changed.
+        self._counts: Optional[jax.Array] = None
+        self._dummy_counts = jnp.zeros((S, 1), jnp.int32)
+        self._counts_lanes: List[Optional[_Seq]] = [None] * S
+        self._counts_sync_fns: Dict[Tuple[int, int], Any] = {}
 
         self._base_key = jax.random.PRNGKey(0)
         self._step_counter = 0
@@ -263,19 +292,20 @@ class JaxServingEngine(AsyncEngine):
         self.total_prompt_tokens = 0
         self.preemptions = 0
 
-        # with/without-logprobs variants, compiled lazily per need
-        self._decode_fns: Dict[bool, Any] = {}
-        self._chunk_fns: Dict[bool, Any] = {}
+        # (with_logprobs, with_penalties) variants, compiled lazily per need
+        self._decode_fns: Dict[Tuple[bool, bool], Any] = {}
+        self._chunk_fns: Dict[Tuple[bool, bool], Any] = {}
 
     # -- jitted step functions ----------------------------------------------
 
-    def _build_decode_fn(self, with_lp: bool = False):
+    def _build_decode_fn(self, with_lp: bool = False, with_pen: bool = False):
         cfg = self.model_config
         k_steps = self.config.decode_steps
         max_pos = self.config.max_model_len - 1
         n_top = self.config.top_logprobs
 
-        def decode(params, cache, tokens, positions, tables, step_key, seeds, temp, topk, topp):
+        def decode(params, cache, counts, tokens, positions, tables, step_key,
+                   seeds, temp, topk, topp, freqp, presp):
             # tokens/positions: [S]; tables: [S, MB]. Scans k_steps forward+
             # sample iterations, feeding each sampled token back in — one
             # dispatch yields [S, k_steps] tokens. The final carry (tokens,
@@ -283,56 +313,67 @@ class JaxServingEngine(AsyncEngine):
             # device-resident state without a host round trip (pipelined
             # decode); a lane whose position would pass max_pos goes to -1 so
             # speculative steps never scatter into a block past its table.
+            # The penalty-count buffer rides the same carry, so within-chunk
+            # repeats are penalized too.
             def body(carry, k):
-                toks, pos, cache = carry
+                toks, pos, cache, counts = carry
                 logits, cache = forward(
                     params, cfg, toks[:, None], pos[:, None], cache, tables,
                     mesh=self.mesh,
                 )
                 kk = jax.random.fold_in(step_key, k)
                 keys = jax.vmap(lambda s: jax.random.fold_in(kk, s))(seeds)
-                nxt = sample_tokens(logits[:, 0], keys, temp, topk, topp)
+                sel = logits[:, 0]
+                sampled_from = (
+                    apply_penalties(sel, counts, freqp, presp) if with_pen else sel
+                )
+                nxt = sample_tokens(sampled_from, keys, temp, topk, topp)
+                if with_pen:
+                    counts = update_counts(counts, nxt, pos >= 0)
                 new_pos = jnp.where((pos >= 0) & (pos < max_pos), pos + 1, -1)
                 if with_lp:
-                    lp, tids, tlps = token_logprobs(logits[:, 0], nxt, n_top)
-                    return (nxt, new_pos, cache), (nxt, lp, tids, tlps)
-                return (nxt, new_pos, cache), nxt
+                    lp, tids, tlps = token_logprobs(sel, nxt, n_top)
+                    return (nxt, new_pos, cache, counts), (nxt, lp, tids, tlps)
+                return (nxt, new_pos, cache, counts), nxt
 
-            (toks, pos, cache), out = jax.lax.scan(
-                body, (tokens, positions, cache), jnp.arange(k_steps)
+            (toks, pos, cache, counts), out = jax.lax.scan(
+                body, (tokens, positions, cache, counts), jnp.arange(k_steps)
             )
             # outputs are scan-stacked [k_steps, S, ...] → slot-major
             if with_lp:
                 out, lps, tids, tlps = out
                 return (
                     out.T, lps.T, tids.transpose(1, 0, 2),
-                    tlps.transpose(1, 0, 2), toks, pos, cache,
+                    tlps.transpose(1, 0, 2), toks, pos, cache, counts,
                 )
-            return out.T, toks, pos, cache
+            return out.T, toks, pos, cache, counts
 
-        return jax.jit(decode, donate_argnums=(1,))
+        return jax.jit(decode, donate_argnums=(1, 2))
 
-    def _decode(self, want_lp: bool):
-        """The decode variant with/without logprobs (each compiled lazily:
-        the logprobs math + its device→host transfer stay off the hot path
-        when nothing asked for them)."""
-        fn = self._decode_fns.get(want_lp)
+    def _decode(self, want_lp: bool, want_pen: bool = False):
+        """The decode variant with/without logprobs/penalties (each compiled
+        lazily: the logprobs math + its device→host transfer, and the
+        penalty-count scatter, stay off the hot path when nothing asked)."""
+        key = (want_lp, want_pen)
+        fn = self._decode_fns.get(key)
         if fn is None:
-            fn = self._decode_fns[want_lp] = self._build_decode_fn(want_lp)
+            fn = self._decode_fns[key] = self._build_decode_fn(want_lp, want_pen)
         return fn
 
-    def _chunk(self, want_lp: bool):
-        fn = self._chunk_fns.get(want_lp)
+    def _chunk(self, want_lp: bool, want_pen: bool = False):
+        key = (want_lp, want_pen)
+        fn = self._chunk_fns.get(key)
         if fn is None:
-            fn = self._chunk_fns[want_lp] = self._build_chunk_fn(want_lp)
+            fn = self._chunk_fns[key] = self._build_chunk_fn(want_lp, want_pen)
         return fn
 
-    def _build_chunk_fn(self, with_lp: bool = False):
+    def _build_chunk_fn(self, with_lp: bool = False, with_pen: bool = False):
         cfg = self.model_config
         S = self.config.max_slots
         n_top = self.config.top_logprobs
 
-        def chunk(params, cache, tokens, positions, tables, sample_at, step_key, seeds, temp, topk, topp):
+        def chunk(params, cache, counts, tokens, positions, tables, sample_at,
+                  step_key, seeds, temp, topk, topp, freqp, presp):
             # tokens/positions: [S, C] (−1 positions = padding); sample_at: [S]
             # index of the token whose logits to sample, −1 → output unused.
             # One shape serves any mix of prefilling and decoding lanes.
@@ -341,13 +382,90 @@ class JaxServingEngine(AsyncEngine):
             )
             sel = logits[jnp.arange(S), jnp.clip(sample_at, 0)]  # [S, V]
             keys = jax.vmap(lambda s: jax.random.fold_in(step_key, s))(seeds)
-            nxt = sample_tokens(sel, keys, temp, topk, topp)
+            sampled_from = (
+                apply_penalties(sel, counts, freqp, presp) if with_pen else sel
+            )
+            nxt = sample_tokens(sampled_from, keys, temp, topk, topp)
+            if with_pen:
+                counts = update_counts(counts, nxt, sample_at >= 0)
             if with_lp:
                 lp, tids, tlps = token_logprobs(sel, nxt, n_top)
-                return nxt, lp, tids, tlps, cache
-            return nxt, cache
+                return nxt, lp, tids, tlps, cache, counts
+            return nxt, cache, counts
 
-        return jax.jit(chunk, donate_argnums=(1,))
+        return jax.jit(chunk, donate_argnums=(1, 2))
+
+    # -- penalty-count buffer -------------------------------------------------
+
+    def _counts_sync_fn(self, rbucket: int, pbucket: int):
+        """Tiny jitted reset+rebuild of penalty-count rows. Bucketed shapes
+        (powers of two) bound the number of compilations; padded entries use
+        row index S, dropped by the scatters."""
+        fn = self._counts_sync_fns.get((rbucket, pbucket))
+        if fn is None:
+
+            def sync(counts, reset_rows, add_rows, add_toks):
+                counts = counts.at[reset_rows].set(0, mode="drop")
+                return counts.at[add_rows, add_toks].add(1, mode="drop")
+
+            fn = self._counts_sync_fns[(rbucket, pbucket)] = jax.jit(
+                sync, donate_argnums=(0,)
+            )
+        return fn
+
+    def _release_counts(self) -> None:
+        """No penalized lane is running: free the [S, V] device buffer and
+        the strong _Seq references held by the row tracking. Rebuilt from
+        out_tokens on the next penalized admission."""
+        if self._counts is not None:
+            self._counts = None
+            self._counts_lanes = [None] * self.config.max_slots
+
+    def _sync_counts(self, lanes: List[Optional["_Seq"]]) -> None:
+        """Bring the device count buffer in line with the current lane set:
+        rows whose sequence changed since the last penalized dispatch are
+        zeroed and rebuilt from that sequence's emitted output tokens (so
+        penalties survive preemption and remote prefill). Rows whose lane is
+        unchanged were maintained in-jit and are left alone. Rows of
+        NON-penalized lanes are skipped entirely — apply_penalties multiplies
+        them by zero, so their contents are never read, and rebuilding them
+        (potentially thousands of out_tokens across a busy engine) would
+        stall every lane the moment the first penalized request lands."""
+        S = self.config.max_slots
+        if self._counts is None:
+            self._counts = jnp.zeros(
+                (S, self.model_config.vocab_size), jnp.int32
+            )
+        changed = [
+            i for i in range(S)
+            if self._counts_lanes[i] is not lanes[i]
+            and lanes[i] is not None and lanes[i].penalized
+        ]
+        if not changed:
+            self._counts_lanes = list(lanes)
+            return
+        pairs: List[Tuple[int, int]] = []
+        for i in changed:
+            seq = lanes[i]
+            if seq.out_tokens:
+                pairs.extend((i, t) for t in seq.out_tokens)
+        rb, pb = 1, 1
+        while rb < len(changed):
+            rb *= 2
+        while pb < max(len(pairs), 1):
+            pb *= 2
+        reset = np.full((rb,), S, np.int32)
+        reset[: len(changed)] = changed
+        add_rows = np.full((pb,), S, np.int32)
+        add_toks = np.zeros((pb,), np.int32)
+        for j, (r, t) in enumerate(pairs):
+            add_rows[j] = r
+            add_toks[j] = t
+        self._counts = self._counts_sync_fn(rb, pb)(
+            self._counts, jnp.asarray(reset), jnp.asarray(add_rows),
+            jnp.asarray(add_toks),
+        )
+        self._counts_lanes = list(lanes)
 
     def warmup(self) -> None:
         """Compile the chunk and decode step functions before serving traffic.
@@ -366,18 +484,19 @@ class JaxServingEngine(AsyncEngine):
         svec_f = np.zeros((S,), np.float32)
         ones_f = np.ones((S,), np.float32)
 
-        out, self.cache = self._chunk(False)(
-            self.params, self.cache, jnp.asarray(zeros_sc), jnp.asarray(neg),
-            jnp.asarray(tables), jnp.asarray(np.full((S,), -1, np.int32)), key,
+        out, self.cache, self._dummy_counts = self._chunk(False)(
+            self.params, self.cache, self._dummy_counts, jnp.asarray(zeros_sc),
+            jnp.asarray(neg), jnp.asarray(tables),
+            jnp.asarray(np.full((S,), -1, np.int32)), key,
             jnp.asarray(svec_i), jnp.asarray(svec_f), jnp.asarray(svec_i),
-            jnp.asarray(ones_f),
+            jnp.asarray(ones_f), jnp.asarray(svec_f), jnp.asarray(svec_f),
         )
         jax.device_get(out)
-        out, _, _, self.cache = self._decode(False)(
-            self.params, self.cache, jnp.asarray(svec_i),
+        out, _, _, self.cache, self._dummy_counts = self._decode(False)(
+            self.params, self.cache, self._dummy_counts, jnp.asarray(svec_i),
             jnp.asarray(np.full((S,), -1, np.int32)), jnp.asarray(tables), key,
             jnp.asarray(svec_i), jnp.asarray(svec_f), jnp.asarray(svec_i),
-            jnp.asarray(ones_f),
+            jnp.asarray(ones_f), jnp.asarray(svec_f), jnp.asarray(svec_f),
         )
         jax.device_get(out)
 
@@ -608,6 +727,8 @@ class JaxServingEngine(AsyncEngine):
             self._topk[i] = 0
             self._topp[i] = 1.0
             self._seeds[i] = 0
+            self._freqp[i] = 0.0
+            self._presp[i] = 0.0
             if seq is None:
                 continue
             self._tables[i, : len(seq.alloc.block_ids)] = seq.alloc.block_ids
@@ -615,6 +736,8 @@ class JaxServingEngine(AsyncEngine):
             self._topk[i] = seq.top_k
             self._topp[i] = seq.top_p
             self._seeds[i] = seq.seed & 0x7FFFFFFF
+            self._freqp[i] = seq.freq_pen
+            self._presp[i] = seq.pres_pen
             if seq.prefill_pos is not None:
                 n = min(C, len(seq.prompt) - seq.prefill_pos)
                 chunk_toks = seq.prompt[seq.prefill_pos : seq.prefill_pos + n]
@@ -635,21 +758,34 @@ class JaxServingEngine(AsyncEngine):
         want_lp = any(
             s is not None and s.logprobs is not None for s in self._slots
         )
+        want_pen = any(s is not None and s.penalized for s in self._slots)
+        if want_pen:
+            self._sync_counts(list(self._slots))
+        counts_in = self._counts if want_pen else self._dummy_counts
         args = (
-            self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
+            self.params, self.cache, counts_in, jnp.asarray(tokens),
+            jnp.asarray(positions),
             jnp.asarray(self._tables), jnp.asarray(sample_at), step_key,
             jnp.asarray(self._seeds), jnp.asarray(self._temp),
             jnp.asarray(self._topk), jnp.asarray(self._topp),
+            jnp.asarray(self._freqp), jnp.asarray(self._presp),
         )
         if want_lp:
-            sampled, lp, tids, tlps, self.cache = self._chunk(True)(*args)
+            sampled, lp, tids, tlps, self.cache, counts_out = self._chunk(
+                True, want_pen
+            )(*args)
             sampled_np, lp_np, tids_np, tlps_np = jax.device_get(
                 (sampled, lp, tids, tlps)
             )
         else:
-            sampled, self.cache = self._chunk(False)(*args)
+            sampled, self.cache, counts_out = self._chunk(False, want_pen)(*args)
             sampled_np = jax.device_get(sampled)
             lp_np = tids_np = tlps_np = None
+        if want_pen:
+            self._counts = counts_out
+        else:
+            self._dummy_counts = counts_out
+            self._release_counts()
 
         for i in range(S):
             seq = self._slots[i]
@@ -728,6 +864,8 @@ class JaxServingEngine(AsyncEngine):
                 self._topk[i] = 0
                 self._topp[i] = 1.0
                 self._seeds[i] = 0
+                self._freqp[i] = 0.0
+                self._presp[i] = 0.0
                 continue
             self._positions[i] = seq.total_len - 1
             self._last_tokens[i] = seq.generated[-1] if seq.generated else seq.prompt[-1]
@@ -736,6 +874,8 @@ class JaxServingEngine(AsyncEngine):
             self._topk[i] = seq.top_k
             self._topp[i] = seq.top_p
             self._seeds[i] = seq.seed & 0x7FFFFFFF
+            self._freqp[i] = seq.freq_pen
+            self._presp[i] = seq.pres_pen
 
         if self._inflight is None:
             toks_in = jnp.asarray(self._last_tokens)
@@ -746,16 +886,30 @@ class JaxServingEngine(AsyncEngine):
         self._step_counter += 1
         step_key = jax.random.fold_in(self._base_key, self._step_counter)
         want_lp = any(s is not None and s.logprobs is not None for s in lanes)
+        want_pen = any(s is not None and s.penalized for s in lanes)
+        if want_pen:
+            self._sync_counts(lanes)
+        counts_in = self._counts if want_pen else self._dummy_counts
         args = (
-            self.params, self.cache, toks_in, pos_in,
+            self.params, self.cache, counts_in, toks_in, pos_in,
             jnp.asarray(self._tables), step_key, jnp.asarray(self._seeds),
             jnp.asarray(self._temp), jnp.asarray(self._topk), jnp.asarray(self._topp),
+            jnp.asarray(self._freqp), jnp.asarray(self._presp),
         )
         if want_lp:
-            out, lps, tids, tlps, toks2, pos2, self.cache = self._decode(True)(*args)
+            out, lps, tids, tlps, toks2, pos2, self.cache, counts_out = (
+                self._decode(True, want_pen)(*args)
+            )
         else:
-            out, toks2, pos2, self.cache = self._decode(False)(*args)
+            out, toks2, pos2, self.cache, counts_out = self._decode(
+                False, want_pen
+            )(*args)
             lps = tids = tlps = None
+        if want_pen:
+            self._counts = counts_out
+        else:
+            self._dummy_counts = counts_out
+            self._release_counts()
         prev, self._inflight = (
             self._inflight, _Inflight(out, lps, tids, tlps, toks2, pos2, lanes)
         )
@@ -806,6 +960,7 @@ class JaxServingEngine(AsyncEngine):
         self, seq: _Seq, tok: int, defer_free: bool = False, lpinfo=None
     ) -> None:
         seq.generated.append(tok)
+        seq.out_tokens.append(tok)
         seq.emitted += 1
         self.total_generated_tokens += 1
         finish: Optional[FinishReason] = None

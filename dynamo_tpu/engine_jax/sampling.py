@@ -22,8 +22,42 @@ import jax
 import jax.numpy as jnp
 
 # static candidate budget for top-k/top-p; raising it trades step time for
-# exactness of very flat sampling distributions
+# exactness of very flat sampling distributions. The preprocessor clamps
+# requested top_k to this bound (with a warning) so the API never silently
+# serves a different distribution than validated.
 CANDIDATES = 64
+
+
+def apply_penalties(
+    logits: jax.Array,  # [B, V] float32
+    counts: jax.Array,  # [B, V] int — output-token occurrence counts
+    frequency_penalty: jax.Array,  # [B]
+    presence_penalty: jax.Array,  # [B]
+) -> jax.Array:
+    """OpenAI-semantics repetition penalties over *output* token counts.
+
+    ``logit[t] -= freq * count[t] + presence * (count[t] > 0)`` — the counts
+    buffer is maintained in-jit by the engine's step functions (one
+    scatter-add per sampled token), so penalties cost two [B, V] elementwise
+    ops and never leave the device. Reference: penalties flow through
+    SamplingOptions (lib/llm/src/protocols/common.rs:52-644).
+    """
+    cf = counts.astype(jnp.float32)
+    return (
+        logits
+        - frequency_penalty[:, None] * cf
+        - presence_penalty[:, None] * (cf > 0.0)
+    )
+
+
+def update_counts(
+    counts: jax.Array,  # [B, V] int32
+    tokens: jax.Array,  # [B] int32 sampled this step
+    active: jax.Array,  # [B] bool — lanes whose sample is real (not padding)
+) -> jax.Array:
+    """Scatter-add this step's sampled tokens into the count buffer."""
+    b = counts.shape[0]
+    return counts.at[jnp.arange(b), tokens].add(active.astype(counts.dtype))
 
 
 def sample_tokens(

@@ -16,10 +16,19 @@ from __future__ import annotations
 import asyncio
 import datetime
 import json
+import logging
 from dataclasses import replace
 from typing import AsyncIterator, Optional, Union
 
 import jinja2
+
+# mirror of engine_jax.sampling.CANDIDATES — the in-jit sampler's static
+# top-k/top-p candidate budget. Mirrored (not imported) so the frontend
+# process never pays a jax import; tests assert the two stay equal.
+SAMPLING_CANDIDATES = 64
+_TOPK_CLAMP_WARNED = False
+
+logger = logging.getLogger(__name__)
 
 from ..runtime.annotated import Annotated
 from ..runtime.engine import AsyncEngine, Context
@@ -181,6 +190,27 @@ class OpenAIPreprocessor:
                 f"is {self.card.context_length}",
             )
         max_tokens = budget if max_tokens is None else min(max_tokens, budget)
+        for name in ("frequency_penalty", "presence_penalty"):
+            val = getattr(request, name, None)
+            if val is not None and not -2.0 <= val <= 2.0:
+                raise HttpError(
+                    400, f"{name} must be within [-2, 2], got {val}"
+                )
+        top_k = request.top_k
+        if top_k is not None and top_k > SAMPLING_CANDIDATES:
+            # the in-jit sampler draws from a static top-CANDIDATES window
+            # (engine_jax/sampling.py); clamp instead of silently serving a
+            # different distribution than requested. Warn once — a client
+            # SDK defaulting to a big top_k would otherwise spam every
+            # request at WARNING level.
+            global _TOPK_CLAMP_WARNED
+            logger.log(
+                logging.DEBUG if _TOPK_CLAMP_WARNED else logging.WARNING,
+                "top_k=%d exceeds the sampler's candidate budget %d; clamping",
+                top_k, SAMPLING_CANDIDATES,
+            )
+            _TOPK_CLAMP_WARNED = True
+            top_k = SAMPLING_CANDIDATES
         pre = PreprocessedRequest(
             token_ids=token_ids,
             stop_conditions=StopConditions(
@@ -193,7 +223,7 @@ class OpenAIPreprocessor:
                 n=request.n,
                 temperature=request.temperature,
                 top_p=request.top_p,
-                top_k=request.top_k,
+                top_k=top_k,
                 frequency_penalty=request.frequency_penalty,
                 presence_penalty=request.presence_penalty,
                 seed=request.seed,

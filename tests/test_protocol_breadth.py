@@ -199,3 +199,97 @@ class TestLogprobsEngine:
                 assert abs(list(top.values())[0] - lp) < 1e-4
         finally:
             eng.close()
+
+
+class TestPenalties:
+    """Frequency/presence penalties must actually shape sampling (VERDICT r2
+    W3: the API previously accepted them and silently ignored them)."""
+
+    def _engine(self):
+        import jax
+        import jax.numpy as jnp
+
+        from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+        from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+
+        cfg = dataclasses.replace(LLAMA_PRESETS["tiny"], dtype=jnp.float32)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        return JaxServingEngine(
+            cfg, params,
+            EngineConfig(max_slots=2, kv_block_size=8, max_model_len=64,
+                         prefill_chunk=16, decode_steps=4),
+        )
+
+    def test_repetition_suppressed(self, run):
+        from collections import Counter
+
+        from dynamo_tpu.llm.protocols.common import (
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+
+        async def gen(eng, **so):
+            req = PreprocessedRequest(
+                token_ids=[3, 1, 4, 1, 5],
+                stop_conditions=StopConditions(max_tokens=20, ignore_eos=True),
+                sampling_options=SamplingOptions(temperature=0.0, **so),
+            )
+            toks = []
+            async for item in eng.generate(Context(req)):
+                toks.extend((item.data or {}).get("token_ids", []))
+            return toks
+
+        eng = self._engine()
+        try:
+            plain = run(gen(eng))
+            pen = run(gen(eng, frequency_penalty=1.5, presence_penalty=1.0))
+        finally:
+            eng.close()
+        assert len(plain) == len(pen) == 20
+        # greedy decode of the tiny model repeats tokens; penalties must
+        # change the output and reduce repetition
+        assert max(Counter(plain).values()) > 1, "baseline should repeat"
+        assert pen != plain
+        assert max(Counter(pen).values()) < max(Counter(plain).values())
+        # identical until the first repeat would have occurred: penalties
+        # depend only on *emitted output* counts, not the prompt
+        first_rep = next(i for i, t in enumerate(plain) if t in plain[:i])
+        assert pen[:first_rep] == plain[:first_rep]
+
+    def test_penalty_out_of_range_rejected(self, card):
+        from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+
+        pre = OpenAIPreprocessor(card)
+        req = ChatCompletionRequest.model_validate(
+            {
+                "model": "tiny", "max_tokens": 4, "frequency_penalty": 2.5,
+                "messages": [{"role": "user", "content": "hi"}],
+            }
+        )
+        with pytest.raises(HttpError) as exc:
+            pre.preprocess_chat(req)
+        assert exc.value.status == 400
+        assert "frequency_penalty" in exc.value.message
+
+    def test_top_k_clamped_to_candidate_budget(self, card):
+        from dynamo_tpu.llm.preprocessor import (
+            SAMPLING_CANDIDATES,
+            OpenAIPreprocessor,
+        )
+
+        pre = OpenAIPreprocessor(card)
+        req = ChatCompletionRequest.model_validate(
+            {
+                "model": "tiny", "max_tokens": 4, "top_k": 1000,
+                "messages": [{"role": "user", "content": "hi"}],
+            }
+        )
+        out = pre.preprocess_chat(req)
+        assert out.sampling_options.top_k == SAMPLING_CANDIDATES
+
+    def test_candidate_budget_mirror_in_sync(self):
+        from dynamo_tpu.engine_jax.sampling import CANDIDATES
+        from dynamo_tpu.llm.preprocessor import SAMPLING_CANDIDATES
+
+        assert SAMPLING_CANDIDATES == CANDIDATES
