@@ -56,7 +56,15 @@ from dynamo_tpu.llm.protocols.common import (
     LLMEngineOutput,
     PreprocessedRequest,
 )
-from dynamo_tpu.models.llama import LlamaConfig, forward, make_kv_cache
+from dynamo_tpu.models.llama import (
+    LlamaConfig,
+    flush_window,
+    forward,
+    forward_window,
+    gather_history,
+    lm_head,
+    make_kv_cache,
+)
 from dynamo_tpu.runtime.annotated import Annotated
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 
@@ -90,6 +98,19 @@ class EngineConfig:
     # documented top_logprobs bound so a validated request is never silently
     # truncated. Computed (and transferred) only when a request asks.
     top_logprobs: int = 20
+    # admission-wave coalescing: when the engine is idle and requests are
+    # still arriving, wait up to this long (seconds) for the wave to finish
+    # landing so every prompt prefills in ONE chunk dispatch instead of the
+    # stragglers eating a whole extra chunk of TTFT. A lone request pays at
+    # most one poll interval (~3 ms); an idle engine with a full wave pays
+    # nothing extra at all (the wave fills the slots and the wait ends).
+    admission_window: float = 0.02
+    # budget for the dense decode-history buffer ([L, S, max_model_len] K+V,
+    # gathered once per decode dispatch). Under it: dense windowed decode
+    # (faster — measured ~1.4x over paged DMA at 2k ctx on v5e). Over it:
+    # the Pallas kernel streams live pages from HBM with zero extra
+    # residency (the 70B/long-context regime). DYN_TPU_ATTENTION overrides.
+    dense_history_max_bytes: int = 2 << 30
 
     def resolve_num_blocks(self) -> int:
         if self.num_kv_blocks is not None:
@@ -292,17 +313,37 @@ class JaxServingEngine(AsyncEngine):
         self.total_prompt_tokens = 0
         self.preemptions = 0
 
-        # (with_logprobs, with_penalties) variants, compiled lazily per need
-        self._decode_fns: Dict[Tuple[bool, bool], Any] = {}
-        self._chunk_fns: Dict[Tuple[bool, bool], Any] = {}
+        # (with_logprobs, with_penalties, with_sampling) variants, compiled
+        # lazily per need
+        self._decode_fns: Dict[Tuple[bool, bool, bool], Any] = {}
+        self._chunk_fns: Dict[Tuple[bool, bool, bool], Any] = {}
+
+        # decode history tier, fixed at build time (the attention policy env
+        # vars are read here rather than per-trace). Both tiers are window-
+        # buffered; see ops/attention.py decode_uses_pallas for the policy.
+        from dynamo_tpu.ops.attention import decode_uses_pallas
+
+        mc, ec = model_config, engine_config
+        dtype_size = jnp.dtype(cache_dtype or mc.dtype).itemsize
+        hist_bytes = (
+            2 * mc.num_layers * ec.max_slots * ec.max_blocks_per_seq
+            * ec.kv_block_size * mc.num_kv_heads * mc.head_dim * dtype_size
+        )
+        self._decode_dense = not decode_uses_pallas(
+            mc.head_dim, mesh, mc.num_heads, mc.num_kv_heads,
+            dense_history_bytes=hist_bytes,
+            dense_history_budget=ec.dense_history_max_bytes,
+        )
 
     # -- jitted step functions ----------------------------------------------
 
-    def _build_decode_fn(self, with_lp: bool = False, with_pen: bool = False):
+    def _build_decode_fn(self, with_lp: bool = False, with_pen: bool = False,
+                         with_sample: bool = True):
         cfg = self.model_config
         k_steps = self.config.decode_steps
         max_pos = self.config.max_model_len - 1
         n_top = self.config.top_logprobs
+        dense = self._decode_dense
 
         def decode(params, cache, counts, tokens, positions, tables, step_key,
                    seeds, temp, topk, topp, freqp, presp):
@@ -315,30 +356,61 @@ class JaxServingEngine(AsyncEngine):
             # speculative steps never scatter into a block past its table.
             # The penalty-count buffer rides the same carry, so within-chunk
             # repeats are penalized too.
+            #
+            # The decode scan is windowed in BOTH attention tiers: the pool is
+            # READ-ONLY inside the scan; each step's K/V go to a [L, S, W]
+            # window buffer riding the carry (models/llama.py forward_window),
+            # flushed to pages in ONE scatter per dispatch — per-step pool
+            # scatters cost more than the step's whole matmul work on TPU.
+            # Only the history read differs (ops/attention.py
+            # decode_uses_pallas): the jnp tier pre-gathers pages to a dense
+            # buffer once per dispatch (per-step gathers lower to serialized
+            # page slices); the kernel tier streams pages HBM→VMEM in the
+            # Pallas kernel and merges the window partial flash-decoding
+            # style via the kernel's softmax stats.
+            base = positions
+            wshape = (
+                cfg.num_layers, self.config.max_slots, k_steps,
+                cfg.num_kv_heads, cfg.head_dim,
+            )
+            wk0 = jnp.zeros(wshape, cache["k"].dtype)
+            wv0 = jnp.zeros(wshape, cache["v"].dtype)
+            if dense:
+                hist_k, hist_v = gather_history(cache, tables)
+                history = ("dense", hist_k, hist_v)
+            else:
+                interpret = jax.devices()[0].platform == "cpu"
+                history = ("paged", cache, tables, self.mesh, interpret)
+
             def body(carry, k):
-                toks, pos, cache, counts = carry
-                logits, cache = forward(
-                    params, cfg, toks[:, None], pos[:, None], cache, tables,
-                    mesh=self.mesh,
+                toks, pos, counts, wk, wv = carry
+                sel, wk, wv = forward_window(
+                    params, cfg, toks, pos, history, base, wk, wv, k,
                 )
-                kk = jax.random.fold_in(step_key, k)
-                keys = jax.vmap(lambda s: jax.random.fold_in(kk, s))(seeds)
-                sel = logits[:, 0]
+                if with_sample:
+                    kk = jax.random.fold_in(step_key, k)
+                    keys = jax.vmap(lambda s: jax.random.fold_in(kk, s))(seeds)
+                else:
+                    keys = None  # unused by the greedy-only sampler
                 sampled_from = (
-                    apply_penalties(sel, counts, freqp, presp) if with_pen else sel
+                    apply_penalties(sel, counts, freqp, presp)
+                    if with_pen else sel
                 )
-                nxt = sample_tokens(sampled_from, keys, temp, topk, topp)
+                nxt = sample_tokens(sampled_from, keys, temp, topk, topp,
+                                    greedy_only=not with_sample)
                 if with_pen:
                     counts = update_counts(counts, nxt, pos >= 0)
                 new_pos = jnp.where((pos >= 0) & (pos < max_pos), pos + 1, -1)
                 if with_lp:
                     lp, tids, tlps = token_logprobs(sel, nxt, n_top)
-                    return (nxt, new_pos, cache, counts), (nxt, lp, tids, tlps)
-                return (nxt, new_pos, cache, counts), nxt
+                    return (nxt, new_pos, counts, wk, wv), (nxt, lp, tids, tlps)
+                return (nxt, new_pos, counts, wk, wv), nxt
 
-            (toks, pos, cache, counts), out = jax.lax.scan(
-                body, (tokens, positions, cache, counts), jnp.arange(k_steps)
+            (toks, pos, counts, wk, wv), out = jax.lax.scan(
+                body, (tokens, positions, counts, wk0, wv0),
+                jnp.arange(k_steps),
             )
+            cache = flush_window(cache, tables, base, wk, wv, max_pos)
             # outputs are scan-stacked [k_steps, S, ...] → slot-major
             if with_lp:
                 out, lps, tids, tlps = out
@@ -350,24 +422,32 @@ class JaxServingEngine(AsyncEngine):
 
         return jax.jit(decode, donate_argnums=(1, 2))
 
-    def _decode(self, want_lp: bool, want_pen: bool = False):
-        """The decode variant with/without logprobs/penalties (each compiled
-        lazily: the logprobs math + its device→host transfer, and the
-        penalty-count scatter, stay off the hot path when nothing asked)."""
-        key = (want_lp, want_pen)
+    def _decode(self, want_lp: bool, want_pen: bool = False,
+                want_sample: bool = True):
+        """The decode variant with/without logprobs/penalties/sampling (each
+        compiled lazily: the logprobs math + its device→host transfer, the
+        penalty-count scatter, and the top-k/categorical sampling block stay
+        off the hot path when no live lane asked for them)."""
+        key = (want_lp, want_pen, want_sample)
         fn = self._decode_fns.get(key)
         if fn is None:
-            fn = self._decode_fns[key] = self._build_decode_fn(want_lp, want_pen)
+            fn = self._decode_fns[key] = self._build_decode_fn(
+                want_lp, want_pen, want_sample
+            )
         return fn
 
-    def _chunk(self, want_lp: bool, want_pen: bool = False):
-        key = (want_lp, want_pen)
+    def _chunk(self, want_lp: bool, want_pen: bool = False,
+               want_sample: bool = True):
+        key = (want_lp, want_pen, want_sample)
         fn = self._chunk_fns.get(key)
         if fn is None:
-            fn = self._chunk_fns[key] = self._build_chunk_fn(want_lp, want_pen)
+            fn = self._chunk_fns[key] = self._build_chunk_fn(
+                want_lp, want_pen, want_sample
+            )
         return fn
 
-    def _build_chunk_fn(self, with_lp: bool = False, with_pen: bool = False):
+    def _build_chunk_fn(self, with_lp: bool = False, with_pen: bool = False,
+                        with_sample: bool = True):
         cfg = self.model_config
         S = self.config.max_slots
         n_top = self.config.top_logprobs
@@ -377,15 +457,25 @@ class JaxServingEngine(AsyncEngine):
             # tokens/positions: [S, C] (−1 positions = padding); sample_at: [S]
             # index of the token whose logits to sample, −1 → output unused.
             # One shape serves any mix of prefilling and decoding lanes.
-            logits, cache = forward(
+            # The LM head runs on the gathered [S, E] sample positions only —
+            # never on the full [S, C, E] chunk (at C=128 that head matmul and
+            # its [S, C, vocab] float32 logits dwarf the useful work and sat
+            # directly on the TTFT critical path).
+            h, cache = forward(
                 params, cfg, tokens, positions, cache, tables, mesh=self.mesh,
+                hidden_only=True,
             )
-            sel = logits[jnp.arange(S), jnp.clip(sample_at, 0)]  # [S, V]
-            keys = jax.vmap(lambda s: jax.random.fold_in(step_key, s))(seeds)
+            hs = h[jnp.arange(S), jnp.clip(sample_at, 0)]  # [S, E]
+            sel = lm_head(params, cfg, hs)  # [S, V]
+            if with_sample:
+                keys = jax.vmap(lambda s: jax.random.fold_in(step_key, s))(seeds)
+            else:
+                keys = None
             sampled_from = (
                 apply_penalties(sel, counts, freqp, presp) if with_pen else sel
             )
-            nxt = sample_tokens(sampled_from, keys, temp, topk, topp)
+            nxt = sample_tokens(sampled_from, keys, temp, topk, topp,
+                                greedy_only=not with_sample)
             if with_pen:
                 counts = update_counts(counts, nxt, sample_at >= 0)
             if with_lp:
@@ -484,21 +574,24 @@ class JaxServingEngine(AsyncEngine):
         svec_f = np.zeros((S,), np.float32)
         ones_f = np.ones((S,), np.float32)
 
-        out, self.cache, self._dummy_counts = self._chunk(False)(
-            self.params, self.cache, self._dummy_counts, jnp.asarray(zeros_sc),
-            jnp.asarray(neg), jnp.asarray(tables),
-            jnp.asarray(np.full((S,), -1, np.int32)), key,
-            jnp.asarray(svec_i), jnp.asarray(svec_f), jnp.asarray(svec_i),
-            jnp.asarray(ones_f), jnp.asarray(svec_f), jnp.asarray(svec_f),
-        )
-        jax.device_get(out)
-        out, _, _, self.cache, self._dummy_counts = self._decode(False)(
-            self.params, self.cache, self._dummy_counts, jnp.asarray(svec_i),
-            jnp.asarray(np.full((S,), -1, np.int32)), jnp.asarray(tables), key,
-            jnp.asarray(svec_i), jnp.asarray(svec_f), jnp.asarray(svec_i),
-            jnp.asarray(ones_f), jnp.asarray(svec_f), jnp.asarray(svec_f),
-        )
-        jax.device_get(out)
+        # both sampling variants of both step fns: a first non-greedy (or
+        # first all-greedy) request must never eat a mid-serving compile
+        for want_sample in (False, True):
+            out, self.cache, self._dummy_counts = self._chunk(False, False, want_sample)(
+                self.params, self.cache, self._dummy_counts, jnp.asarray(zeros_sc),
+                jnp.asarray(neg), jnp.asarray(tables),
+                jnp.asarray(np.full((S,), -1, np.int32)), key,
+                jnp.asarray(svec_i), jnp.asarray(svec_f), jnp.asarray(svec_i),
+                jnp.asarray(ones_f), jnp.asarray(svec_f), jnp.asarray(svec_f),
+            )
+            jax.device_get(out)
+            out, _, _, self.cache, self._dummy_counts = self._decode(False, False, want_sample)(
+                self.params, self.cache, self._dummy_counts, jnp.asarray(svec_i),
+                jnp.asarray(np.full((S,), -1, np.int32)), jnp.asarray(tables), key,
+                jnp.asarray(svec_i), jnp.asarray(svec_f), jnp.asarray(svec_i),
+                jnp.asarray(ones_f), jnp.asarray(svec_f), jnp.asarray(svec_f),
+            )
+            jax.device_get(out)
 
     # -- AsyncEngine interface ----------------------------------------------
 
@@ -571,6 +664,7 @@ class JaxServingEngine(AsyncEngine):
                         return
                 self._run_posted()
                 self._sweep_remote_timeouts()
+                self._coalesce_admission_wave()
                 self._admit()
                 self._dispatch_step()
         except Exception:
@@ -597,6 +691,30 @@ class JaxServingEngine(AsyncEngine):
             fn()
 
     # -- scheduling ----------------------------------------------------------
+
+    def _coalesce_admission_wave(self) -> None:
+        """Hold the first dispatch briefly while an admission wave is still
+        landing (engine idle, pending requests growing, free slots left), so
+        the whole wave prefills together. Without this, whichever requests
+        happen to be queued when the engine thread first wakes prefill alone
+        and every straggler's TTFT grows by a full extra chunk dispatch."""
+        window = self.config.admission_window
+        if window <= 0:
+            return
+        if self._inflight is not None or any(s is not None for s in self._slots):
+            return  # engine busy: dispatch cadence already set by compute
+        deadline = time.perf_counter() + window
+        with self._cond:
+            prev = len(self._pending)
+            while (
+                0 < prev < self.config.max_slots
+                and not self._shutdown
+                and time.perf_counter() < deadline
+            ):
+                self._cond.wait(timeout=0.001)
+                if len(self._pending) == prev:
+                    return  # wave stopped growing
+                prev = len(self._pending)
 
     def _admit(self) -> None:
         """Move pending requests into free slots; run their prefill."""
@@ -759,6 +877,9 @@ class JaxServingEngine(AsyncEngine):
             s is not None and s.logprobs is not None for s in self._slots
         )
         want_pen = any(s is not None and s.penalized for s in self._slots)
+        want_sample = any(
+            s is not None and s.temperature > 0.0 for s in self._slots
+        )
         if want_pen:
             self._sync_counts(list(self._slots))
         counts_in = self._counts if want_pen else self._dummy_counts
@@ -770,15 +891,24 @@ class JaxServingEngine(AsyncEngine):
             jnp.asarray(self._topk), jnp.asarray(self._topp),
             jnp.asarray(self._freqp), jnp.asarray(self._presp),
         )
+        # copy_to_host_async right after dispatch: the host-fetch path has a
+        # ~100 ms fixed latency on a tunneled chip when started cold at get
+        # time; started here it overlaps the chunk's own compute (measured
+        # 120 ms -> <1 ms residual get)
         if want_lp:
             sampled, lp, tids, tlps, self.cache, counts_out = self._chunk(
-                True, want_pen
+                True, want_pen, want_sample
             )(*args)
+            for arr in (sampled, lp, tids, tlps):
+                arr.copy_to_host_async()
             sampled_np, lp_np, tids_np, tlps_np = jax.device_get(
                 (sampled, lp, tids, tlps)
             )
         else:
-            sampled, self.cache, counts_out = self._chunk(False, want_pen)(*args)
+            sampled, self.cache, counts_out = self._chunk(
+                False, want_pen, want_sample
+            )(*args)
+            sampled.copy_to_host_async()
             sampled_np = jax.device_get(sampled)
             lp_np = tids_np = tlps_np = None
         if want_pen:
@@ -854,6 +984,27 @@ class JaxServingEngine(AsyncEngine):
             if not any(lanes):
                 return
 
+        # Don't dispatch a chunk nothing needs: if every active lane provably
+        # reaches a length stop within the already-in-flight chunk, a
+        # speculative dispatch would compute decode_steps of garbage that the
+        # NEXT admission wave then queues behind (at large decode_steps that
+        # stalls a whole wave's TTFT by a full chunk).
+        def lane_needs_more(seq: "_Seq") -> bool:
+            ahead = k if (
+                self._inflight is not None
+                and seq.slot is not None
+                and self._inflight.lanes[seq.slot] is seq
+            ) else 0
+            if seq.emitted + ahead >= seq.max_tokens:
+                return False
+            if seq.total_len + ahead >= self.config.max_model_len:
+                return False
+            return True
+
+        if not any(lane_needs_more(s) for s in lanes if s is not None):
+            self._drain_inflight()
+            return
+
         for i in range(S):
             seq = self._slots[i]
             self._tables[i, :] = 0
@@ -887,6 +1038,7 @@ class JaxServingEngine(AsyncEngine):
         step_key = jax.random.fold_in(self._base_key, self._step_counter)
         want_lp = any(s is not None and s.logprobs is not None for s in lanes)
         want_pen = any(s is not None and s.penalized for s in lanes)
+        want_sample = any(s is not None and s.temperature > 0.0 for s in lanes)
         if want_pen:
             self._sync_counts(lanes)
         counts_in = self._counts if want_pen else self._dummy_counts
@@ -898,11 +1050,11 @@ class JaxServingEngine(AsyncEngine):
         )
         if want_lp:
             out, lps, tids, tlps, toks2, pos2, self.cache, counts_out = (
-                self._decode(True, want_pen)(*args)
+                self._decode(True, want_pen, want_sample)(*args)
             )
         else:
             out, toks2, pos2, self.cache, counts_out = self._decode(
-                False, want_pen
+                False, want_pen, want_sample
             )(*args)
             lps = tids = tlps = None
         if want_pen:
@@ -913,6 +1065,12 @@ class JaxServingEngine(AsyncEngine):
         prev, self._inflight = (
             self._inflight, _Inflight(out, lps, tids, tlps, toks2, pos2, lanes)
         )
+        # start the host copies now: by the time this chunk is processed (one
+        # pipelined dispatch later) the fetch has ridden the previous chunk's
+        # compute window and the blocking get is ~free (vs ~100 ms cold)
+        for arr in (out, lps, tids, tlps):
+            if arr is not None:
+                arr.copy_to_host_async()
         if prev is not None:
             self._process_chunk(prev, defer_free=True)
 

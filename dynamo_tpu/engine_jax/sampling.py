@@ -66,10 +66,20 @@ def sample_tokens(
     temperature: jax.Array,  # [B]
     top_k: jax.Array,  # [B] int32
     top_p: jax.Array,  # [B]
+    *,
+    greedy_only: bool = False,
 ) -> jax.Array:
-    """Sample one token per row. Returns [B] int32."""
+    """Sample one token per row. Returns [B] int32.
+
+    ``greedy_only`` (static) compiles just the argmax: when no lane in the
+    batch has temperature > 0, the top-k partial sort, softmax/cumsum and
+    categorical draw are dead weight — several ms per decode step at a 128k
+    vocab, paid every step of every dispatch. The engine picks the variant
+    per dispatch from the live lanes' sampling options."""
     b, v = logits.shape
     greedy = jnp.argmax(logits, axis=-1)
+    if greedy_only:
+        return greedy.astype(jnp.int32)
 
     c = min(CANDIDATES, v)
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]

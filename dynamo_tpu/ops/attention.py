@@ -58,6 +58,56 @@ def _v2_supported(head_dim: int) -> bool:
     return head_dim % 128 == 0
 
 
+def decode_uses_pallas(
+    head_dim: int,
+    mesh,
+    num_heads: int,
+    num_kv_heads: int,
+    dense_history_bytes: int = 0,
+    dense_history_budget: Optional[int] = None,
+) -> bool:
+    """Should the engine's decode dispatch read history through the Pallas
+    kernel (paged, streams live pages HBM→VMEM) instead of the dense
+    pre-gathered buffer (jnp einsums over [L, S, Smax])?
+
+    Both tiers are window-buffered (no per-step pool writes). Measured on
+    v5e: the dense tier wins whenever its buffer is affordable — a once-per-
+    dispatch gather plus contiguous reads beat per-step paged DMA by ~1.4×
+    at 2k context. The kernel tier wins when the dense buffer is NOT
+    affordable: it reads only live pages (dense always reads the full
+    padded [S, max_model_len] history and duplicates prefix-shared pages
+    per lane), so the policy is a memory budget, not a speed heuristic:
+
+    - ``DYN_TPU_ATTENTION=jnp``    → dense, always.
+    - ``DYN_TPU_ATTENTION=pallas`` → kernel, always (if usable).
+    - auto → kernel iff the dense history buffer would exceed
+      ``dense_history_budget`` bytes (the engine passes its config's
+      ``dense_history_max_bytes``) — e.g. a 70B tp8 slice at 8k context ×
+      32 lanes needs a ~10 GB/chip dense buffer; the kernel serves that
+      regime with zero extra HBM.
+
+    Usability: TPU platform, and on a sharded mesh the head axes must split
+    evenly over tp (shard_map divisibility). D % 128 != 0 falls back to the
+    per-page-grid v1 kernel schedule, which has no DMA-slice alignment
+    constraint.
+    """
+    mode = os.environ.get("DYN_TPU_ATTENTION", "auto")
+    if mode == "jnp":
+        return False
+    if mesh is not None and not _tp_divisible(mesh, num_heads, num_kv_heads):
+        return False  # shard_map divisibility: kernel can't run at all
+    if mode == "pallas":
+        # honor the force even off-TPU — interpret mode is how CPU tests
+        # cover the kernel-tier decode path
+        return True
+    if not _platform_is_tpu():
+        return False
+    return (
+        dense_history_budget is not None
+        and dense_history_bytes > dense_history_budget
+    )
+
+
 def _tp_divisible(mesh, h: int, kvh: int) -> bool:
     """Can the head axes split evenly over the mesh's tp axis? (shard_map
     requires exact divisibility, unlike GSPMD's padded auto-partitioning.)"""

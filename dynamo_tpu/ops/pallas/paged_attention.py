@@ -34,14 +34,17 @@ def _decode_kernel(
     k_ref,  # [1, bs, KVH, D] — the page selected by index_map
     v_ref,  # [1, bs, KVH, D]
     o_ref,  # [1, H, D]
-    # scratch
-    m_ref,  # [H, 1] f32 running max
-    l_ref,  # [H, 1] f32 running denominator
-    acc_ref,  # [H, D] f32 running numerator
-    *,
+    *rest,  # with_stats: ms_ref [1,H], ls_ref [1,H] outputs, then scratch;
+            # else just scratch: m_ref [H,1], l_ref [H,1], acc_ref [H,D]
     scale: float,
     kvh: int,
+    with_stats: bool = False,
 ):
+    if with_stats:
+        ms_ref, ls_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        ms_ref = ls_ref = None
+        m_ref, l_ref, acc_ref = rest
     s = pl.program_id(0)
     j = pl.program_id(1)
     bs = k_ref.shape[1]
@@ -89,6 +92,11 @@ def _decode_kernel(
         l = l_ref[:, 0]
         denom = jnp.where(l > 0.0, l, 1.0)  # padding lanes produce zeros
         o_ref[0] = (acc_ref[:] / denom[:, None]).astype(o_ref.dtype)
+        if with_stats:
+            # clamp -inf (no live keys) to a finite sentinel: downstream
+            # merges exponentiate (m - m_total) and -inf - -inf would NaN
+            ms_ref[0, 0] = jnp.maximum(m_ref[:, 0], -1e30)
+            ls_ref[0, 0] = l
 
 
 def _decode_kernel_v2(
@@ -100,15 +108,18 @@ def _decode_kernel_v2(
     k_hbm,  # [N, bs, KVH, D] (stays in HBM; paged DMA below)
     v_hbm,
     o_ref,  # [1, H, D]
-    # scratch
-    k_buf,  # [2, P, bs, KVH, D] VMEM
-    v_buf,
-    sem,  # DMA semaphores [2, P, 2]
-    *,
+    *rest,  # with_stats: ms_ref [1,H], ls_ref [1,H] outputs, then scratch;
+            # else just scratch: k_buf, v_buf [2,P,bs,KVH,D] VMEM, sem
     scale: float,
     kvh: int,
     pages_per_chunk: int,
+    with_stats: bool = False,
 ):
+    if with_stats:
+        ms_ref, ls_ref, k_buf, v_buf, sem = rest
+    else:
+        ms_ref = ls_ref = None
+        k_buf, v_buf, sem = rest
     s = pl.program_id(0)
     P = pages_per_chunk
     bs = k_hbm.shape[1]
@@ -185,13 +196,16 @@ def _decode_kernel_v2(
     m0 = jnp.full((h,), -1e30, jnp.float32)
     l0 = jnp.zeros((h,), jnp.float32)
     acc0 = jnp.zeros((h, d), jnp.float32)
-    _, l, acc = lax.fori_loop(0, n_chunks, chunk_body, (m0, l0, acc0))
+    m, l, acc = lax.fori_loop(0, n_chunks, chunk_body, (m0, l0, acc0))
     denom = jnp.where(l > 0.0, l, 1.0)  # padding lanes produce zeros
     o_ref[0] = (acc / denom[:, None]).astype(o_ref.dtype)
+    if with_stats:
+        ms_ref[0, 0] = m
+        ls_ref[0, 0] = l
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "pages_per_chunk", "interpret")
+    jax.jit, static_argnames=("scale", "pages_per_chunk", "interpret", "return_stats")
 )
 def paged_attention_decode_v2(
     q: jax.Array,  # [S, H, D]
@@ -203,7 +217,8 @@ def paged_attention_decode_v2(
     scale: Optional[float] = None,
     pages_per_chunk: int = 8,
     interpret: bool = False,
-) -> jax.Array:
+    return_stats: bool = False,
+):
     """Flash decode over paged KV, multi-page double-buffered schedule.
 
     The KV pool stays in HBM; each grid step (one lane) streams its pages
@@ -219,6 +234,11 @@ def paged_attention_decode_v2(
         scale = d ** -0.5
     P = min(pages_per_chunk, block_tables.shape[1])
 
+    out_specs = [pl.BlockSpec((1, h, d), lambda si, *_: (si, 0, 0))]
+    out_shape = [jax.ShapeDtypeStruct((s, h, d), q.dtype)]
+    if return_stats:
+        out_specs += [pl.BlockSpec((1, 1, h), lambda si, *_: (si, 0, 0))] * 2
+        out_shape += [jax.ShapeDtypeStruct((s, 1, h), jnp.float32)] * 2
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(s,),
@@ -227,7 +247,7 @@ def paged_attention_decode_v2(
             pl.BlockSpec(memory_space=pltpu.HBM),  # whole pool, stays HBM
             pl.BlockSpec(memory_space=pltpu.HBM),
         ],
-        out_specs=pl.BlockSpec((1, h, d), lambda si, *_: (si, 0, 0)),
+        out_specs=out_specs if return_stats else out_specs[0],
         scratch_shapes=[
             pltpu.VMEM((2, P, bs, kvh, d), k_cache.dtype),
             pltpu.VMEM((2, P, bs, kvh, d), v_cache.dtype),
@@ -235,14 +255,19 @@ def paged_attention_decode_v2(
         ],
     )
     kernel = functools.partial(
-        _decode_kernel_v2, scale=scale, kvh=kvh, pages_per_chunk=P
+        _decode_kernel_v2, scale=scale, kvh=kvh, pages_per_chunk=P,
+        with_stats=return_stats,
     )
-    return pl.pallas_call(
+    res = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((s, h, d), q.dtype),
+        out_shape=out_shape if return_stats else out_shape[0],
         grid_spec=grid_spec,
         interpret=interpret,
     )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32), q, k_cache, v_cache)
+    if return_stats:
+        out, m, l = res
+        return out, m[:, 0], l[:, 0]
+    return res
 
 
 def paged_attention_decode_sharded(
@@ -256,7 +281,8 @@ def paged_attention_decode_sharded(
     scale: Optional[float] = None,
     pages_per_chunk: int = 8,
     interpret: bool = False,
-) -> jax.Array:
+    return_stats: bool = False,
+):
     """The decode kernel on a sharded KV cache, via ``shard_map`` over tp.
 
     Mosaic kernels have no GSPMD partitioning rule, so a sharded cache can't
@@ -293,20 +319,24 @@ def paged_attention_decode_sharded(
             return paged_attention_decode_v2(
                 qs, ks, vs, tbl, ln, scale=scale,
                 pages_per_chunk=pages_per_chunk, interpret=interpret,
+                return_stats=return_stats,
             )
         return paged_attention_decode(
-            qs, ks, vs, tbl, ln, scale=scale, interpret=interpret
+            qs, ks, vs, tbl, ln, scale=scale, interpret=interpret,
+            return_stats=return_stats,
         )
 
+    # stats are per-head: sharded over tp exactly like q's head axis
+    out_specs = (qspec, P(None, tp), P(None, tp)) if return_stats else qspec
     fn = shard_map(
         local, mesh=mesh,
         in_specs=(qspec, kvspec, kvspec, P(None, None), P(None)),
-        out_specs=qspec, check_vma=False,
+        out_specs=out_specs, check_vma=False,
     )
     return fn(q, k_cache, v_cache, block_tables, lengths)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+@functools.partial(jax.jit, static_argnames=("scale", "interpret", "return_stats"))
 def paged_attention_decode(
     q: jax.Array,  # [S, H, D] one query token per lane
     k_cache: jax.Array,  # [N, bs, KVH, D]
@@ -316,8 +346,12 @@ def paged_attention_decode(
     *,
     scale: Optional[float] = None,
     interpret: bool = False,
-) -> jax.Array:
-    """Flash decode over paged KV. Returns [S, H, D] in q's dtype."""
+    return_stats: bool = False,
+):
+    """Flash decode over paged KV. Returns [S, H, D] in q's dtype; with
+    ``return_stats`` also the flash-softmax row max and denominator
+    ([S, H] f32 each) for merging with out-of-pool context (the engine's
+    decode window)."""
     s, h, d = q.shape
     _, bs, kvh, _ = k_cache.shape
     mb = block_tables.shape[1]
@@ -330,6 +364,11 @@ def paged_attention_decode(
         last = jnp.maximum(pl.cdiv(lengths[si], bs) - 1, 0)
         return (tables[si, jnp.minimum(ji, last)], 0, 0, 0)
 
+    out_specs = [pl.BlockSpec((1, h, d), lambda si, ji, *_: (si, 0, 0))]
+    out_shape = [jax.ShapeDtypeStruct((s, h, d), q.dtype)]
+    if return_stats:
+        out_specs += [pl.BlockSpec((1, 1, h), lambda si, ji, *_: (si, 0, 0))] * 2
+        out_shape += [jax.ShapeDtypeStruct((s, 1, h), jnp.float32)] * 2
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(s, mb),
@@ -338,7 +377,7 @@ def paged_attention_decode(
             pl.BlockSpec((1, bs, kvh, d), page_index),
             pl.BlockSpec((1, bs, kvh, d), page_index),
         ],
-        out_specs=pl.BlockSpec((1, h, d), lambda si, ji, *_: (si, 0, 0)),
+        out_specs=out_specs if return_stats else out_specs[0],
         scratch_shapes=[
             pltpu.VMEM((h, 1), jnp.float32),
             pltpu.VMEM((h, 1), jnp.float32),
@@ -346,10 +385,16 @@ def paged_attention_decode(
         ],
     )
 
-    kernel = functools.partial(_decode_kernel, scale=scale, kvh=kvh)
-    return pl.pallas_call(
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, kvh=kvh, with_stats=return_stats
+    )
+    res = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((s, h, d), q.dtype),
+        out_shape=out_shape if return_stats else out_shape[0],
         grid_spec=grid_spec,
         interpret=interpret,
     )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32), q, k_cache, v_cache)
+    if return_stats:
+        out, m, l = res
+        return out, m[:, 0], l[:, 0]
+    return res

@@ -175,3 +175,54 @@ def test_sharded_dispatch_uneven_tp_falls_back():
         q, k_cache, v_cache, tables, q_positions, use_pallas=True, mesh=mesh
     )
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
+def test_decode_return_stats_merge_contract():
+    """return_stats (m, l) must compose: merging a pool partial (kernel over
+    the first `split` positions) with a window partial (jnp flash over the
+    rest) flash-decoding style must equal full-context attention. This is the
+    contract the engine's windowed kernel decode relies on
+    (models/llama.py _paged_window_attention)."""
+    s, h, kvh, d, bs, mb = 4, 8, 4, 32, 8, 6
+    lens = [33, 17, 48, 9]
+    q, k_cache, v_cache, tables, lengths = _setup(11, s, h, kvh, d, bs, mb, 64, lens)
+    split = jnp.maximum(lengths - 5, 0)  # pool holds positions < split
+
+    q_positions = (lengths - 1)[:, None].astype(jnp.int32)
+    ref = paged_attention(q, k_cache, v_cache, tables, q_positions, use_pallas=False)
+
+    o_p, m_p, l_p = paged_attention_decode(
+        q[:, 0], k_cache, v_cache, tables, split, interpret=True,
+        return_stats=True,
+    )
+    assert m_p.shape == (s, h) and l_p.shape == (s, h)
+
+    # window = the last 5 positions, gathered densely from the pool
+    from dynamo_tpu.ops.attention import gather_pages
+
+    gk = gather_pages(k_cache, tables)  # [S, MB*bs, KVH, D]
+    gv = gather_pages(v_cache, tables)
+    w = 5
+    idx = split[:, None] + jnp.arange(w)[None, :]  # [S, w] positions
+    valid = idx < lengths[:, None]
+    wk = jnp.take_along_axis(gk, jnp.clip(idx, 0)[..., None, None].repeat(kvh, 2).repeat(d, 3), axis=1)
+    wv = jnp.take_along_axis(gv, jnp.clip(idx, 0)[..., None, None].repeat(kvh, 2).repeat(d, 3), axis=1)
+
+    g = h // kvh
+    qg = q[:, 0].reshape(s, kvh, g, d)
+    scores = jnp.einsum("bngd,bwnd->bngw", qg.astype(jnp.float32), wk.astype(jnp.float32)) * (d ** -0.5)
+    scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
+    m_w = jnp.maximum(scores.max(-1), -1e30).reshape(s, h)
+    p = jnp.exp(scores - m_w.reshape(s, kvh, g)[..., None])
+    l_w = p.sum(-1).reshape(s, h)
+    num_w = jnp.einsum("bngw,bwnd->bngd", p, wv.astype(jnp.float32)).reshape(s, h, d)
+
+    m_t = jnp.maximum(m_p, m_w)
+    a_p = jnp.exp(m_p - m_t) * l_p
+    a_w = jnp.exp(m_w - m_t)
+    denom = a_p + a_w * l_w
+    merged = (o_p.astype(jnp.float32) * a_p[..., None] + num_w * a_w[..., None]) / jnp.maximum(denom, 1e-30)[..., None]
+
+    np.testing.assert_allclose(
+        np.asarray(merged), np.asarray(ref[:, 0]).astype(np.float32), atol=2e-5
+    )
