@@ -50,6 +50,29 @@ from ..runtime.logging_util import init as init_logging
 logger = logging.getLogger(__name__)
 
 
+def _resolve_model_path(spec):
+    """--model-path accepts a local dir/.gguf OR a hub repo id (org/name):
+    repo ids resolve via the fixture hub / HF cache / download
+    (llm/model_card.py resolve_repo; reference hub.rs)."""
+    from dynamo_tpu.llm.model_card import looks_like_repo_id, resolve_repo
+
+    if spec and looks_like_repo_id(spec):
+        return resolve_repo(spec)
+    return spec
+
+
+def _load_card(flags):
+    """Build the model card from --model-path, resolving hub repo ids; a
+    repo id also becomes the served model name (unless --model-name)."""
+    from dynamo_tpu.llm.model_card import looks_like_repo_id
+
+    spec = flags.model_path
+    name = flags.model_name
+    if name is None and spec and looks_like_repo_id(spec):
+        name = spec
+    return ModelDeploymentCard.from_local_path(_resolve_model_path(spec), name)
+
+
 def parse_io(args: list[str]) -> tuple[str, str, list[str]]:
     """Extract in=/out= positional specs (reference: opt.rs:23-217)."""
     in_spec, out_spec, rest = "http", "echo_full", []
@@ -72,6 +95,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--pipeline-parallel-size", type=int, default=1,
+                   help="GPipe layer stages over the pp mesh axis")
+    p.add_argument("--context-parallel-size", type=int, default=1,
+                   help="ring-attention sequence shards over the sp mesh axis")
     # multi-host meshes (reference MultiNodeConfig, engines.rs:41-59): all
     # hosts run the same command with their own --node-rank; jax.distributed
     # joins them into one global device mesh over ICI/DCN
@@ -183,7 +210,7 @@ def build_engine(out_spec: str, flags: argparse.Namespace):
     """
     card: Optional[ModelDeploymentCard] = None
     if flags.model_path:
-        card = ModelDeploymentCard.from_local_path(flags.model_path, flags.model_name)
+        card = _load_card(flags)
     model_name = flags.model_name or (card.display_name if card else out_spec)
 
     if out_spec == "echo_full":
@@ -243,6 +270,8 @@ def build_engine(out_spec: str, flags: argparse.Namespace):
             kv_block_size=flags.kv_block_size,
             max_model_len=flags.max_model_len,
             tensor_parallel_size=flags.tensor_parallel_size,
+            pipeline_parallel_size=flags.pipeline_parallel_size,
+            context_parallel_size=flags.context_parallel_size,
             host_cache_blocks=flags.host_cache_blocks,
             **extra,
         )
@@ -269,7 +298,7 @@ async def build_remote_client(out_spec: str, flags: argparse.Namespace):
     # the reference tokenizes frontend-side before its KV router (SURVEY §3.4)
     route_token_fn = None
     if flags.router_mode == "kv" and flags.model_path:
-        card = ModelDeploymentCard.from_local_path(flags.model_path, flags.model_name)
+        card = _load_card(flags)
         pre = OpenAIPreprocessor(card)
         route_token_fn = pre.route_token_ids
     client = await drt.namespace(ns).component(comp).endpoint(ep).client(
@@ -464,7 +493,7 @@ async def run_endpoint(chat_engine, completions_engine, model_name: str, in_spec
             # identity = card checksum, NOT the served alias (--model-name):
             # prefill and decode workers loading the same weights must agree
             model=(
-                ModelDeploymentCard.from_local_path(flags.model_path).mdcsum or ""
+                ModelDeploymentCard.from_local_path(_resolve_model_path(flags.model_path)).mdcsum or ""
                 if flags.model_path
                 else ""
             ),
@@ -486,7 +515,7 @@ async def run_prefill_worker_main(out_spec: str, in_spec: str, flags: argparse.N
     namespace = in_spec.split(":", 1)[1] if ":" in in_spec else "dynamo"
     if not flags.model_path:
         raise SystemExit("prefill worker requires --model-path")
-    card = ModelDeploymentCard.from_local_path(flags.model_path, flags.model_name)
+    card = _load_card(flags)
     model_config = config_from_card(card)
     params = load_params(card, model_config)
     engine = PrefillEngine(

@@ -32,6 +32,13 @@ from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
 
 logger = logging.getLogger(__name__)
 
+
+def _escape_label(v: str) -> str:
+    """Escape a Prometheus text-format label value (backslash, quote,
+    newline) — an id containing any of these would otherwise corrupt the
+    whole /metrics exposition."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
 GAUGES = [
     ("request_active_slots", "Decode slots currently occupied"),
     ("request_total_slots", "Total decode slots"),
@@ -90,10 +97,12 @@ class MetricsAggregator:
             full = f"{self.prefix}_{name}"
             lines.append(f"# HELP {full} {help_text}")
             lines.append(f"# TYPE {full} gauge")
+            ns_esc = _escape_label(self.namespace)
             for worker_id, m in sorted(live.items()):
                 value = getattr(m, name)
+                w_esc = _escape_label(str(worker_id))
                 lines.append(
-                    f'{full}{{namespace="{self.namespace}",worker="{worker_id}"}} {value}'
+                    f'{full}{{namespace="{ns_esc}",worker="{w_esc}"}} {value}'
                 )
         for name, idx, help_text in (
             ("router_isl_blocks_total", 0, "Prompt blocks seen by the KV router"),
@@ -104,12 +113,12 @@ class MetricsAggregator:
             lines.append(f"# TYPE {full} counter")
             for worker_id, totals in sorted(self._hit_totals.items()):
                 lines.append(
-                    f'{full}{{namespace="{self.namespace}",worker="{worker_id}"}} {totals[idx]}'
+                    f'{full}{{namespace="{_escape_label(self.namespace)}",worker="{_escape_label(str(worker_id))}"}} {totals[idx]}'
                 )
         full = f"{self.prefix}_up"
         lines.append(f"# HELP {full} Workers currently reporting metrics")
         lines.append(f"# TYPE {full} gauge")
-        lines.append(f'{full}{{namespace="{self.namespace}"}} {len(live)}')
+        lines.append(f'{full}{{namespace="{_escape_label(self.namespace)}"}} {len(live)}')
         return "\n".join(lines) + "\n"
 
 

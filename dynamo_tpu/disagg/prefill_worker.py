@@ -1,14 +1,26 @@
-"""Prefill-only engine + the prefill worker loop.
+"""Batched prefill engine + the prefill worker loop.
 
 A prefill worker pops RemotePrefillRequests from the shared work queue,
-computes the prompt KV (full prompt — it has no access to the decode
-worker's cached prefix KV), samples the first output token with the
-request's sampling params, and ships the *uncached-suffix* pages to the
-decode worker's transfer server.
+computes the prompt KV, samples the first output token with the request's
+sampling params, and ships the *uncached-suffix* pages to the decode
+worker's transfer server.
+
+Two things make it cheap on repeat traffic:
+
+- **Batched, chunked prefill**: requests run through a full
+  :class:`~dynamo_tpu.engine_jax.engine.JaxServingEngine` capped at one
+  output token, so N concurrent remote prefills share [slots, chunk]
+  dispatches (and the engine's own prefix cache) instead of running
+  batch-1 sequentially.
+- **Prefix read-back**: when the decode worker already holds the prompt's
+  prefix KV (multi-turn), the worker READS those pages over the transfer
+  plane (``read_blocks``) and seeds them into the engine's prefix cache,
+  so only the suffix is computed — matching the reference's
+  ``computed_block_ids`` + NIXL ``read_blocks`` semantics
+  (vllm_v0.7.2 patch remote_prefill.py / nixl.py:1067-1467).
 
 Reference parity: PrefillWorker (examples/llm/components/prefill_worker.py:
-34-181) — re-designed around the scratch-page prefill engine instead of a
-patched vLLM.
+34-181) — re-designed around the serving engine instead of a patched vLLM.
 """
 
 from __future__ import annotations
@@ -17,6 +29,7 @@ import asyncio
 import json
 import logging
 import math
+import uuid
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -26,107 +39,158 @@ from dynamo_tpu.disagg.protocols import (
     TRANSFER_KEY_PREFIX,
     RemotePrefillRequest,
 )
-from dynamo_tpu.disagg.transfer import KvTransferClient
+from dynamo_tpu.disagg.transfer import KvTransferClient, _engine_call
 
 logger = logging.getLogger(__name__)
 
 
 class PrefillEngine:
-    """Sequential prefill-only engine with a single-sequence scratch page pool."""
+    """Prefill-only wrapper over the batched serving engine.
+
+    Each prefill is a max_tokens=1 request whose pages are parked on finish
+    (engine hold_pages) and extracted for shipping; concurrent prefills
+    batch into shared chunk dispatches.
+    """
 
     def __init__(self, model_config, params, max_model_len: int = 2048,
-                 block_size: int = 16, min_bucket: int = 16, model: str = ""):
-        import jax
+                 block_size: int = 16, min_bucket: int = 16, model: str = "",
+                 slots: int = 4, prefill_chunk: int = 256):
+        from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
 
-        from dynamo_tpu.models.llama import make_kv_cache
-
+        del min_bucket  # kept for constructor compatibility (bucketed v1 engine)
         self.model_config = model_config
-        self.params = params
         self.block_size = block_size
         self.model = model
         self.max_model_len = max_model_len
-        self.max_blocks = math.ceil(max_model_len / block_size)
-        self.min_bucket = min_bucket
-        self._cache = make_kv_cache(model_config, self.max_blocks, block_size)
-        self._tables = np.arange(self.max_blocks, dtype=np.int32)[None, :]
-        self._fns: Dict[int, object] = {}
-        self._key = jax.random.PRNGKey(0)
-        self._counter = 0
+        self.engine = JaxServingEngine(
+            model_config, params,
+            EngineConfig(
+                max_slots=slots,
+                kv_block_size=block_size,
+                max_model_len=max_model_len,
+                decode_steps=1,
+                prefill_chunk=min(prefill_chunk, max_model_len),
+            ),
+        )
+        # tokens actually computed: per-request (keyed until returned) and
+        # the most recent value (tests assert delta-only computation)
+        self._computed: Dict[str, int] = {}
+        self.last_computed_tokens: int = -1
 
-    def _bucket(self, n: int) -> int:
-        b = self.min_bucket
-        while b < n:
-            b *= 2
-        return min(b, self.max_model_len)
+    def warmup(self) -> None:
+        self.engine.warmup()
 
-    def _fn(self, bucket: int):
-        fn = self._fns.get(bucket)
-        if fn is not None:
-            return fn
-        import jax
-        import jax.numpy as jnp
+    def close(self) -> None:
+        self.engine.close()
 
-        from dynamo_tpu.engine_jax.sampling import sample_tokens
-        from dynamo_tpu.models.llama import forward
+    async def prefill_request(
+        self,
+        token_ids: List[int],
+        cached_tokens: int,
+        sampling: dict,
+        prefix_kv: Optional[Tuple] = None,
+        as_device: bool = False,
+    ) -> Tuple[int, object, object, int]:
+        """Compute the prompt KV; return (first_token, k_pages, v_pages,
+        computed_tokens) covering blocks from ``cached_tokens // block_size``
+        onward.
 
-        cfg = self.model_config
+        ``prefix_kv`` = (k, v) pages for the full blocks of
+        ``token_ids[:cached_tokens]`` read from the decode worker: they are
+        seeded into the engine's prefix cache first, so the engine computes
+        only the suffix. ``as_device=True`` returns jax arrays (same-host
+        device path)."""
+        from dynamo_tpu.llm.protocols.common import (
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+        from dynamo_tpu.runtime.engine import Context
 
-        def prefill(params, cache, tokens, positions, table, sample_at, key, temp, topk, topp):
-            logits, cache = forward(params, cfg, tokens, positions, cache, table)
-            tok = sample_tokens(
-                logits[:, sample_at], key[None], temp[None], topk[None], topp[None]
+        n = len(token_ids)
+        if n > self.max_model_len - 1:
+            raise ValueError(
+                f"prompt {n} exceeds prefill max_model_len {self.max_model_len}"
             )
-            return tok[0], cache
+        if prefix_kv is not None and cached_tokens % self.block_size == 0:
+            k_pre, v_pre = prefix_kv
+            seeded = await _engine_call(
+                self.engine,
+                lambda: self.engine.seed_external_prefix(
+                    token_ids[:cached_tokens], k_pre, v_pre
+                ),
+            )
+            if seeded:
+                logger.debug("seeded %d prefix blocks from decode worker", seeded)
 
-        fn = jax.jit(prefill, donate_argnums=(1,))
-        self._fns[bucket] = fn
-        return fn
+        req = PreprocessedRequest(
+            token_ids=list(token_ids),
+            stop_conditions=StopConditions(max_tokens=1, ignore_eos=True),
+            sampling_options=SamplingOptions(
+                temperature=sampling.get("temperature"),
+                top_k=sampling.get("top_k"),
+                top_p=sampling.get("top_p"),
+                seed=sampling.get("seed"),
+            ),
+        )
+        ctx = Context(req, request_id=f"prefill-{uuid.uuid4().hex}")
+        self.engine.hold_pages(ctx.id)
+        first_token: Optional[int] = None
+        try:
+            async for item in self.engine.generate(ctx):
+                if item.event == "error":
+                    raise RuntimeError(
+                        f"prefill engine error: {'; '.join(item.comment)}"
+                    )
+                d = item.data or {}
+                ids = d.get("token_ids") or []
+                if ids and first_token is None:
+                    first_token = int(ids[0])
+            if first_token is None:
+                raise RuntimeError("prefill produced no token")
+            first_block = cached_tokens // self.block_size
+            n_blocks = math.ceil(n / self.block_size)
+
+            def extract():
+                alloc = self.engine._held_allocs.get(ctx.id)
+                if alloc is not None:
+                    computed = n - alloc.cached_tokens
+                    self._computed[ctx.id] = computed
+                    # concurrent requests each get their own count from the
+                    # returned tuple; this field is the LAST finished one
+                    # (sync-path and test convenience only)
+                    self.last_computed_tokens = computed
+                return self.engine.take_held_pages(
+                    ctx.id, first_block, n_blocks, as_device=as_device
+                )
+
+            k, v = await _engine_call(self.engine, extract)
+            return first_token, k, v, self._computed.pop(ctx.id, -1)
+        except BaseException:
+            self.engine.post(lambda: self.engine.release_held(ctx.id))
+            raise
 
     def prefill(
         self, token_ids: List[int], cached_tokens: int, sampling: dict,
         as_device: bool = False,
     ) -> Tuple[int, np.ndarray, np.ndarray]:
-        """Compute the prompt KV; return (first_token, k_pages, v_pages) where
-        the pages cover blocks from cached_tokens//block_size onward.
-        ``as_device=True`` returns jax arrays (same-host device path)."""
-        import jax
-        import jax.numpy as jnp
-
-        n = len(token_ids)
-        if n > self.max_model_len:
-            raise ValueError(f"prompt {n} exceeds prefill max_model_len {self.max_model_len}")
-        bucket = self._bucket(n)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :n] = token_ids
-        positions = np.full((1, bucket), -1, np.int32)
-        positions[0, :n] = np.arange(n)
-
-        self._counter += 1
-        key = jax.random.fold_in(self._key, self._counter)
-        if sampling.get("seed"):
-            key = jax.random.fold_in(key, int(sampling["seed"]))
-
-        fn = self._fn(bucket)
-        tok, self._cache = fn(
-            self.params, self._cache, tokens, positions,
-            self._tables[:, : self.max_blocks], n - 1, key,
-            jnp.float32(sampling.get("temperature") or 0.0),
-            jnp.int32(sampling.get("top_k") or 0),
-            jnp.float32(sampling.get("top_p") or 1.0),
+        """Synchronous convenience wrapper (no prefix read-back). Safe to
+        call with or without a running event loop — inside one, the request
+        runs on a private loop in a worker thread (and blocks the caller,
+        like any sync compute would)."""
+        coro = self.prefill_request(
+            token_ids, cached_tokens, sampling, as_device=as_device
         )
-        first_token = int(tok)
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            tok, k, v, _ = asyncio.run(coro)
+            return tok, k, v
+        import concurrent.futures
 
-        first_block = cached_tokens // self.block_size
-        n_blocks = math.ceil(n / self.block_size)
-        idx = jnp.arange(first_block, n_blocks, dtype=jnp.int32)
-        if as_device:
-            # device path: hand the page slices over as jax arrays (the
-            # same-host transfer re-shards them straight into the decode
-            # engine's mesh, no host copy)
-            return first_token, self._cache["k"][:, idx], self._cache["v"][:, idx]
-        k = np.asarray(jax.device_get(self._cache["k"][:, idx]))
-        v = np.asarray(jax.device_get(self._cache["v"][:, idx]))
-        return first_token, k, v
+        with concurrent.futures.ThreadPoolExecutor(1) as ex:
+            tok, k, v, _ = ex.submit(asyncio.run, coro).result()
+            return tok, k, v
 
 
 def _validate_request(req, engine: "PrefillEngine") -> None:
@@ -152,19 +216,19 @@ def _validate_pages(req, k) -> None:
 
 
 async def run_prefill_worker(runtime, namespace: str, engine: PrefillEngine) -> None:
-    """Pop → prefill → ship, forever. Multiple prefill workers share the queue."""
+    """Pop → prefill → ship, forever. Multiple prefill workers share the
+    queue; within one worker, up to the engine's slot count of requests run
+    concurrently (they batch into shared chunk dispatches)."""
     if runtime.bus is None:
         raise RuntimeError("prefill worker needs the message bus")
     client = KvTransferClient()
     addr_cache: Dict[str, str] = {}
     queue = f"{namespace}.{PREFILL_QUEUE}"
+    sem = asyncio.Semaphore(engine.engine.config.max_slots)
+    tasks: set = set()
     logger.info("prefill worker consuming %s", queue)
-    while True:
-        raw = await runtime.bus.queue_pop(queue, block=True)
-        if raw is None:
-            continue
-        req = RemotePrefillRequest.from_dict(json.loads(raw))
 
+    async def handle(req: RemotePrefillRequest) -> None:
         # same-process decode engine → device path: pages stay jax arrays
         # and land on the decode mesh via device_put, no host staging
         from dynamo_tpu.disagg.serving import LOCAL_DECODE_ENGINES
@@ -172,56 +236,95 @@ async def run_prefill_worker(runtime, namespace: str, engine: PrefillEngine) -> 
 
         local_engine = LOCAL_DECODE_ENGINES.get(req.engine_id)
         if local_engine is not None:
-            try:
-                _validate_request(req, engine)
-                tok, k, v = await asyncio.to_thread(
-                    engine.prefill, req.token_ids, req.cached_tokens,
-                    req.sampling, True,
-                )
-                _validate_pages(req, k)
-                await LocalKvTransfer(local_engine).send_blocks(
-                    "", req.request_id, tok, req.block_ids, k, v
-                )
-                logger.info("prefilled %s locally via device path (%d tokens)",
-                            req.request_id, len(req.token_ids))
-            except Exception as e:
-                logger.exception("local prefill failed for %s", req.request_id)
-                local_engine.fail_remote_prefill(req.request_id, str(e))
-            continue
+            transfer = LocalKvTransfer(local_engine)
+            addr = ""
+        else:
+            addr = addr_cache.get(req.engine_id)
+            if addr is None:
+                key = f"{namespace}/{TRANSFER_KEY_PREFIX}{req.engine_id}"
+                raw_addr = None
+                for delay in (0, 0.2, 0.5, 1.0):  # brief re-registration races
+                    if delay:
+                        await asyncio.sleep(delay)
+                    raw_addr = await runtime.store.get(key)
+                    if raw_addr is not None:
+                        break
+                if raw_addr is None:
+                    # can't reach the decode worker to report failure either;
+                    # its engine-side remote_prefill_timeout falls the request
+                    # back to local prefill
+                    logger.error(
+                        "no transfer address for engine %s; dropping %s "
+                        "(decode worker will fall back after timeout)",
+                        req.engine_id, req.request_id,
+                    )
+                    return
+                addr = raw_addr.decode()
+                addr_cache[req.engine_id] = addr
+            transfer = client
 
-        addr = addr_cache.get(req.engine_id)
-        if addr is None:
-            key = f"{namespace}/{TRANSFER_KEY_PREFIX}{req.engine_id}"
-            raw_addr = None
-            for delay in (0, 0.2, 0.5, 1.0):  # brief re-registration races
-                if delay:
-                    await asyncio.sleep(delay)
-                raw_addr = await runtime.store.get(key)
-                if raw_addr is not None:
-                    break
-            if raw_addr is None:
-                # can't reach the decode worker to report failure either; its
-                # engine-side remote_prefill_timeout falls the request back to
-                # local prefill
-                logger.error("no transfer address for engine %s; dropping %s "
-                             "(decode worker will fall back after timeout)",
-                             req.engine_id, req.request_id)
-                continue
-            addr = raw_addr.decode()
-            addr_cache[req.engine_id] = addr
         try:
             _validate_request(req, engine)
-            tok, k, v = await asyncio.to_thread(
-                engine.prefill, req.token_ids, req.cached_tokens, req.sampling
+            # decode worker holds the prompt's prefix KV: read it instead of
+            # recomputing the shared history (multi-turn's flagship win)
+            prefix_kv = None
+            if req.cached_tokens > 0 and req.prefix_block_ids:
+                try:
+                    prefix_kv = await transfer.read_blocks(
+                        addr, req.prefix_block_ids
+                    )
+                except Exception:
+                    logger.warning(
+                        "prefix read_blocks failed for %s; recomputing full "
+                        "prompt", req.request_id, exc_info=True,
+                    )
+            tok, k, v, computed = await engine.prefill_request(
+                req.token_ids, req.cached_tokens, req.sampling,
+                prefix_kv=prefix_kv, as_device=local_engine is not None,
             )
             _validate_pages(req, k)
-            await client.send_blocks(addr, req.request_id, tok, req.block_ids, k, v)
-            logger.info("prefilled %s (%d tokens → %d pages)",
-                        req.request_id, len(req.token_ids), k.shape[1])
+            await transfer.send_blocks(
+                addr, req.request_id, tok, req.block_ids, k, v
+            )
+            logger.info(
+                "prefilled %s%s (%d tokens, computed %d → %d pages)",
+                req.request_id,
+                " locally via device path" if local_engine is not None else "",
+                len(req.token_ids), computed, k.shape[1],
+            )
         except Exception as e:
             logger.exception("prefill failed for %s", req.request_id)
+            if local_engine is not None:
+                local_engine.fail_remote_prefill(req.request_id, str(e))
+                return
             addr_cache.pop(req.engine_id, None)
             try:
                 await client.send_failure(addr, req.request_id, str(e))
             except (ConnectionError, OSError):
-                logger.warning("could not report prefill failure for %s", req.request_id)
+                logger.warning(
+                    "could not report prefill failure for %s", req.request_id
+                )
+
+    try:
+        while True:
+            raw = await runtime.bus.queue_pop(queue, block=True)
+            if raw is None:
+                continue
+            req = RemotePrefillRequest.from_dict(json.loads(raw))
+            await sem.acquire()
+
+            async def run_one(r=req):
+                try:
+                    await handle(r)
+                finally:
+                    sem.release()
+
+            t = asyncio.create_task(run_one())
+            tasks.add(t)
+            t.add_done_callback(tasks.discard)
+    finally:
+        # cancelling the worker must stop in-flight prefills too (the
+        # sequential loop this replaced stopped everything on cancel);
+        # otherwise they race the engine teardown that usually follows
+        for t in list(tasks):
+            t.cancel()

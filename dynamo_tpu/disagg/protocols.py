@@ -26,6 +26,12 @@ class RemotePrefillRequest:
     # jax scatter (round-1 advisor finding)
     block_size: int = 0  # 0 = unknown (older producers)
     model: str = ""  # served model identity; "" = unknown
+    # decode-side physical pages backing the cached prefix (tokens
+    # [0, cached_tokens)): the prefill worker READS these over the transfer
+    # plane and computes only the suffix, instead of recomputing the shared
+    # history (reference: computed_block_ids + nixl read_blocks,
+    # vllm_v0.7.2 patch remote_prefill.py / nixl.py:1067-1467)
+    prefix_block_ids: List[int] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {
@@ -37,6 +43,7 @@ class RemotePrefillRequest:
             "sampling": self.sampling,
             "block_size": self.block_size,
             "model": self.model,
+            "prefix_block_ids": self.prefix_block_ids,
         }
 
     @classmethod
@@ -50,6 +57,7 @@ class RemotePrefillRequest:
             sampling=dict(d.get("sampling", {})),
             block_size=int(d.get("block_size", 0)),
             model=str(d.get("model", "")),
+            prefix_block_ids=list(d.get("prefix_block_ids", [])),
         )
 
 

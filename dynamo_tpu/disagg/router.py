@@ -51,7 +51,8 @@ class DisaggPolicy:
             return False  # queue backed up: prefill locally (backpressure)
         return True
 
-    def submit(self, request_id, token_ids, block_ids, cached_tokens, sampling) -> None:
+    def submit(self, request_id, token_ids, block_ids, cached_tokens,
+               sampling, prefix_block_ids=()) -> None:
         req = RemotePrefillRequest(
             request_id=request_id,
             engine_id=self.engine_id,
@@ -61,6 +62,7 @@ class DisaggPolicy:
             sampling=dict(sampling),
             block_size=self.block_size,
             model=self.model,
+            prefix_block_ids=list(prefix_block_ids),
         )
         self._enqueue(req)
 

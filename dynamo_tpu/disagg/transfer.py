@@ -23,6 +23,23 @@ from dynamo_tpu.runtime.codec import TwoPartMessage, read_frame, write_frame
 logger = logging.getLogger(__name__)
 
 
+def _engine_call(engine, fn):
+    """Run ``fn`` on the engine thread, await the result from asyncio."""
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+
+    def run():
+        try:
+            r = fn()
+        except Exception as e:  # delivered to the awaiting caller
+            loop.call_soon_threadsafe(fut.set_exception, e)
+            return
+        loop.call_soon_threadsafe(fut.set_result, r)
+
+    engine.post(run)
+    return fut
+
+
 def _pack(arr: np.ndarray) -> bytes:
     # bfloat16 isn't a standard numpy dtype everywhere: ship as raw bytes +
     # dtype string (ml_dtypes provides bfloat16 in this stack)
@@ -70,6 +87,26 @@ class KvTransferServer:
                     self.engine.complete_remote_prefill(
                         h["request_id"], h["first_token"], h["block_ids"], k, v
                     )
+                elif h.get("op") == "read_blocks":
+                    # prefill worker reading this decode worker's cached
+                    # prefix pages (so it computes only the suffix)
+                    k, v = await _engine_call(
+                        self.engine,
+                        lambda: self.engine.extract_blocks(h["block_ids"]),
+                    )
+                    k_raw, v_raw = _pack(k), _pack(v)
+                    await write_frame(
+                        writer,
+                        TwoPartMessage(
+                            json.dumps({
+                                "id": h.get("id"), "ok": True,
+                                "dtype": k.dtype.name, "shape": list(k.shape),
+                                "k_bytes": len(k_raw),
+                            }).encode(),
+                            k_raw + v_raw,
+                        ),
+                    )
+                    continue
                 elif h.get("op") == "prefill_failed":
                     self.engine.fail_remote_prefill(h["request_id"], h.get("message", ""))
                 await write_frame(
@@ -103,6 +140,13 @@ class LocalKvTransfer:
 
     async def send_failure(self, address: str, request_id: str, message: str) -> None:
         self.decode.fail_remote_prefill(request_id, message)
+
+    async def read_blocks(self, address: str, block_ids) -> tuple:
+        """Device path: pages come back as jax arrays, never touching host."""
+        return await _engine_call(
+            self.decode,
+            lambda: self.decode.extract_blocks(list(block_ids), as_device=True),
+        )
 
     async def close(self) -> None:
         pass
@@ -150,6 +194,27 @@ class KvTransferClient:
                 writer, TwoPartMessage(json.dumps(header).encode(), k_raw + v_raw)
             )
             await read_frame(reader)  # ack
+
+    async def read_blocks(self, address: str, block_ids) -> tuple:
+        """Pull KV pages from a decode worker's pool by physical id.
+        Returns (k, v) numpy [L, n, bs, KVH, D]."""
+        reader, writer = await self._conn(address)
+        async with self._locks[address]:
+            await write_frame(
+                writer,
+                TwoPartMessage(
+                    json.dumps(
+                        {"op": "read_blocks", "block_ids": list(map(int, block_ids))}
+                    ).encode(),
+                    b"",
+                ),
+            )
+            frame = await read_frame(reader)
+        h = json.loads(frame.header)
+        k_len = h["k_bytes"]
+        k = _unpack(frame.body[:k_len], h["dtype"], h["shape"])
+        v = _unpack(frame.body[k_len:], h["dtype"], h["shape"])
+        return k, v
 
     async def send_failure(self, address: str, request_id: str, message: str) -> None:
         reader, writer = await self._conn(address)

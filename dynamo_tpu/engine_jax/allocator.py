@@ -225,6 +225,60 @@ class BlockAllocator:
             host_hits=host_hits,
         )
 
+    def seed_cached(self, token_ids: Sequence[int]) -> List[Tuple[int, int]]:
+        """Register externally-computed KV (pages read from another worker,
+        e.g. a decode worker's cached prefix) as prefix-cache content.
+
+        Covers the full blocks of ``token_ids``; returns
+        ``[(logical_block_index, physical_block_id)]`` for blocks that were
+        NOT already cached — the caller must inject those pages before any
+        allocation can hit them (engine thread makes that atomic). Blocks
+        whose hash is already resident are skipped. Stops early (partial
+        prefix, still correct) if the pool can't yield a free page.
+
+        Seeded blocks land refcount-0 in the LRU reuse pool, exactly like a
+        freed sequence's sealed blocks — so a subsequent
+        :meth:`allocate_sequence` for a prompt starting with these tokens
+        prefix-hits them. Reference semantics: the decode→prefill
+        ``read_blocks`` path of the patched vLLM's NIXL connector
+        (vllm_v0.7.2 patch nixl.py:1067-1467), where remote prefill reads
+        the decode worker's prefix-hit blocks and computes only the rest."""
+        n_full = len(token_ids) // self.block_size
+        if n_full == 0:
+            return []
+        covered = token_ids[: n_full * self.block_size]
+        seq_hashes = compute_block_hashes_for_seq(covered, self.block_size, self.salt)
+        to_inject: List[Tuple[int, int]] = []
+        run_stored: List[Tuple[int, List[int]]] = []
+        run_parent: Optional[int] = None
+
+        def flush_run():
+            if run_stored and self._sink is not None:
+                self._sink.blocks_stored(run_parent, list(run_stored))
+            run_stored.clear()
+
+        for i, h in enumerate(seq_hashes):
+            if h in self._by_hash:
+                flush_run()
+                run_parent = h
+                continue
+            if not self._reserve_capacity(1):
+                break
+            bid = self._take_free()
+            self._by_hash[h] = bid
+            self._hash_of[bid] = h
+            to_inject.append((i, bid))
+            if not run_stored:
+                run_parent = seq_hashes[i - 1] if i > 0 else None
+            run_stored.append(
+                (h, list(covered[i * self.block_size : (i + 1) * self.block_size]))
+            )
+        flush_run()
+        # refcount 1 → 0 with a hash ⇒ cached (LRU reuse pool)
+        for _, bid in to_inject:
+            self._release_one(bid)
+        return to_inject
+
     def grow(self, alloc: SequenceAllocation, n_tokens: int) -> bool:
         """Ensure capacity for a sequence now ``n_tokens`` long (decode growth)."""
         needed = self.blocks_needed(n_tokens)

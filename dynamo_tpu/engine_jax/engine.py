@@ -307,6 +307,15 @@ class JaxServingEngine(AsyncEngine):
         self._awaiting: Dict[str, _Seq] = {}
         self._posted: Deque[Any] = deque()  # host fns to run on the engine thread
 
+        # prefill-worker mode: requests whose pages are parked on finish so
+        # the worker can extract them (hold_pages / take_held_pages)
+        self._hold_ids: set = set()
+        self._held_allocs: Dict[str, SequenceAllocation] = {}
+
+        # host-tier spills in flight: (pairs, k_dev, v_dev) whose async host
+        # copies haven't been harvested into the host pool yet
+        self._pending_spills: Deque[Tuple[List[Tuple[int, int]], Any, Any]] = deque()
+
         # stats
         self.total_requests = 0
         self.total_generated_tokens = 0
@@ -334,6 +343,45 @@ class JaxServingEngine(AsyncEngine):
             dense_history_bytes=hist_bytes,
             dense_history_budget=ec.dense_history_max_bytes,
         )
+
+        # pipeline parallelism: when the mesh has a pp axis > 1, step fns
+        # route through parallel/pipeline.py's GPipe schedule (layer stages
+        # + microbatched slots over ICI ppermute) instead of the
+        # single-program layer scan
+        from dynamo_tpu.parallel.mesh import AXIS_PP, AXIS_SP
+
+        self._pp = (
+            mesh.shape[AXIS_PP]
+            if mesh is not None and AXIS_PP in mesh.axis_names
+            else 1
+        )
+        if self._pp > 1:
+            if mc.num_layers % self._pp:
+                raise ValueError(
+                    f"num_layers {mc.num_layers} not divisible by pp {self._pp}"
+                )
+            if ec.max_slots % self._pp:
+                raise ValueError(
+                    f"max_slots {ec.max_slots} not divisible by pp {self._pp}"
+                    " (slots are the GPipe microbatch axis)"
+                )
+
+        # sequence parallelism: prefill chunks ring-attend over sp
+        # (models/llama.py forward_chunk_sp); decode is a single position
+        # per lane, which sp neither helps nor hinders
+        self._sp = (
+            mesh.shape[AXIS_SP]
+            if mesh is not None and AXIS_SP in mesh.axis_names
+            else 1
+        )
+        if self._sp > 1:
+            if ec.prefill_chunk % self._sp:
+                raise ValueError(
+                    f"prefill_chunk {ec.prefill_chunk} not divisible by sp "
+                    f"{self._sp} (the chunk's sequence axis shards over sp)"
+                )
+            if self._pp > 1:
+                raise ValueError("pp and sp cannot be combined yet")
 
     # -- jitted step functions ----------------------------------------------
 
@@ -368,6 +416,53 @@ class JaxServingEngine(AsyncEngine):
             # page slices); the kernel tier streams pages HBM→VMEM in the
             # Pallas kernel and merges the window partial flash-decoding
             # style via the kernel's softmax stats.
+            if self._pp > 1:
+                # pipeline decode: each step is a pipelined single-token
+                # forward; the cache rides the scan carry (pages stay on
+                # their stage's shard, written by decoder_layer per step).
+                # The window structure is not used — GPipe's microbatch
+                # schedule already amortizes the per-layer cost, and pages
+                # are written stage-locally with no cross-stage scatter.
+                from dynamo_tpu.parallel.pipeline import pipeline_forward
+
+                def body_pp(carry, k):
+                    toks, pos, cache, counts = carry
+                    logits, cache = pipeline_forward(
+                        params, cfg, toks[:, None], pos[:, None], cache,
+                        tables, self.mesh,
+                    )
+                    if with_sample:
+                        kk = jax.random.fold_in(step_key, k)
+                        keys = jax.vmap(lambda s: jax.random.fold_in(kk, s))(seeds)
+                    else:
+                        keys = None
+                    sel = logits[:, 0]
+                    sampled_from = (
+                        apply_penalties(sel, counts, freqp, presp)
+                        if with_pen else sel
+                    )
+                    nxt = sample_tokens(sampled_from, keys, temp, topk, topp,
+                                        greedy_only=not with_sample)
+                    if with_pen:
+                        counts = update_counts(counts, nxt, pos >= 0)
+                    new_pos = jnp.where((pos >= 0) & (pos < max_pos), pos + 1, -1)
+                    if with_lp:
+                        lp, tids, tlps = token_logprobs(sel, nxt, n_top)
+                        return (nxt, new_pos, cache, counts), (nxt, lp, tids, tlps)
+                    return (nxt, new_pos, cache, counts), nxt
+
+                (toks, pos, cache, counts), out = jax.lax.scan(
+                    body_pp, (tokens, positions, cache, counts),
+                    jnp.arange(k_steps),
+                )
+                if with_lp:
+                    out, lps, tids, tlps = out
+                    return (
+                        out.T, lps.T, tids.transpose(1, 0, 2),
+                        tlps.transpose(1, 0, 2), toks, pos, cache, counts,
+                    )
+                return out.T, toks, pos, cache, counts
+
             base = positions
             wshape = (
                 cfg.num_layers, self.config.max_slots, k_steps,
@@ -461,10 +556,25 @@ class JaxServingEngine(AsyncEngine):
             # never on the full [S, C, E] chunk (at C=128 that head matmul and
             # its [S, C, vocab] float32 logits dwarf the useful work and sat
             # directly on the TTFT critical path).
-            h, cache = forward(
-                params, cfg, tokens, positions, cache, tables, mesh=self.mesh,
-                hidden_only=True,
-            )
+            if self._pp > 1:
+                from dynamo_tpu.parallel.pipeline import pipeline_forward
+
+                h, cache = pipeline_forward(
+                    params, cfg, tokens, positions, cache, tables, self.mesh,
+                    hidden_only=True,
+                )
+            elif self._sp > 1:
+                from dynamo_tpu.models.llama import forward_chunk_sp
+
+                h, cache = forward_chunk_sp(
+                    params, cfg, tokens, positions, cache, tables, self.mesh,
+                    hidden_only=True,
+                )
+            else:
+                h, cache = forward(
+                    params, cfg, tokens, positions, cache, tables,
+                    mesh=self.mesh, hidden_only=True,
+                )
             hs = h[jnp.arange(S), jnp.clip(sample_at, 0)]  # [S, E]
             sel = lm_head(params, cfg, hs)  # [S, V]
             if with_sample:
@@ -654,6 +764,7 @@ class JaxServingEngine(AsyncEngine):
                         and not self._posted
                         and not any(self._slots)
                         and self._inflight is None
+                        and not self._pending_spills
                     ):
                         if self._awaiting:
                             # wake periodically to sweep remote-prefill timeouts
@@ -661,9 +772,20 @@ class JaxServingEngine(AsyncEngine):
                             break
                         self._cond.wait()
                     if self._shutdown:
+                        # drain posted callbacks before exiting: callers of
+                        # post() (transfer-plane _engine_call) await futures
+                        # these resolve — dropping them would hang the
+                        # awaiting task forever on a close() race
+                        self._run_posted()
                         return
                 self._run_posted()
                 self._sweep_remote_timeouts()
+                # idle = nothing to stall: drain spills fully so revisits
+                # after an idle gap see their prefixes in the host tier
+                self._harvest_spills(
+                    force=not self._pending and not any(self._slots)
+                    and self._inflight is None
+                )
                 self._coalesce_admission_wave()
                 self._admit()
                 self._dispatch_step()
@@ -678,6 +800,7 @@ class JaxServingEngine(AsyncEngine):
     def post(self, fn) -> None:
         """Schedule a host function to run on the engine thread (thread-safe).
         The only way external code may touch the cache or allocator."""
+        self._ensure_thread()
         with self._cond:
             self._posted.append(fn)
             self._cond.notify()
@@ -796,6 +919,10 @@ class JaxServingEngine(AsyncEngine):
                         "temperature": seq.temperature, "top_k": seq.top_k,
                         "top_p": seq.top_p, "seed": seq.seed,
                     },
+                    # pages backing the cached prefix: the prefill worker
+                    # reads these (transfer-plane read_blocks) instead of
+                    # recomputing the shared history
+                    prefix_block_ids=list(alloc.block_ids[:first_suffix_block]),
                 )
                 continue  # holds no slot while prefill runs remotely
 
@@ -1152,7 +1279,14 @@ class JaxServingEngine(AsyncEngine):
             self._slots[seq.slot] = None
             seq.slot = None
         if seq.alloc is not None:
-            if defer_free:
+            if seq.ctx.id in self._hold_ids:
+                # prefill-worker mode: park the pages for extraction; the
+                # caller frees via take_held_pages/release_held. Safe without
+                # zombie-parking: held requests are prompt-only (finish in
+                # the chunk step), so no speculative decode writes them.
+                self._held_allocs[seq.ctx.id] = seq.alloc
+                seq.alloc = None
+            elif defer_free:
                 # the in-flight speculative chunk may still write into these
                 # blocks; park them until it has been fetched
                 self._zombie_allocs.append(seq.alloc)
@@ -1191,13 +1325,70 @@ class JaxServingEngine(AsyncEngine):
         (called from the engine thread; submit must be thread-safe)."""
         self._remote_policy = policy
 
-    def extract_blocks(self, block_ids: List[int]):
-        """Copy KV pages out of HBM → host numpy ([L, n, bs, KVH, D] ×2).
+    def extract_blocks(self, block_ids: List[int], as_device: bool = False):
+        """Copy KV pages out of the pool ([L, n, bs, KVH, D] ×2): host numpy,
+        or device arrays with ``as_device`` (same-host transfers keep pages
+        on-device and let XLA reshard at the destination's inject boundary).
         MUST run on the engine thread (e.g. via post())."""
         idx = jnp.asarray(block_ids, jnp.int32)
-        k = np.asarray(jax.device_get(self.cache["k"][:, idx]))
-        v = np.asarray(jax.device_get(self.cache["v"][:, idx]))
-        return k, v
+        if as_device:
+            return self.cache["k"][:, idx], self.cache["v"][:, idx]
+        k_dev = self.cache["k"][:, idx]
+        v_dev = self.cache["v"][:, idx]
+        k_dev.copy_to_host_async()
+        v_dev.copy_to_host_async()
+        return np.asarray(jax.device_get(k_dev)), np.asarray(jax.device_get(v_dev))
+
+    def seed_external_prefix(self, token_ids: List[int], k_pages, v_pages) -> int:
+        """Register externally-computed prefix KV (pages read from another
+        worker) into this engine's prefix cache: allocator registration +
+        page injection, atomically on the engine thread. ``k_pages`` covers
+        ALL full blocks of ``token_ids`` ([L, n_full, bs, KVH, D]); already-
+        cached blocks are skipped. Returns the number of blocks seeded.
+        MUST run on the engine thread (via post())."""
+        pairs = self.allocator.seed_cached(token_ids)
+        if not pairs:
+            return 0
+        block_ids = [bid for _, bid in pairs]
+        sel = [i for i, _ in pairs]
+        if isinstance(k_pages, jax.Array):
+            idx = jnp.asarray(sel, jnp.int32)
+            self.inject_blocks(block_ids, k_pages[:, idx], v_pages[:, idx])
+        else:
+            self.inject_blocks(block_ids, k_pages[:, sel], v_pages[:, sel])
+        return len(pairs)
+
+    # -- held allocations (prefill-worker page extraction) --------------------
+
+    def hold_pages(self, request_id: str) -> None:
+        """Mark a request's pages to be parked (not freed) when it finishes,
+        so a caller can extract them afterwards. Thread-safe; call before
+        submitting the request. Pair with :meth:`release_held`."""
+        self._hold_ids.add(request_id)
+
+    def take_held_pages(
+        self, request_id: str, first_block: int, n_blocks: int,
+        as_device: bool = False,
+    ):
+        """Extract pages [first_block, n_blocks) of a finished held request,
+        then release its allocation. MUST run on the engine thread."""
+        self._hold_ids.discard(request_id)
+        alloc = self._held_allocs.pop(request_id, None)
+        if alloc is None:
+            raise KeyError(f"no held allocation for request {request_id}")
+        try:
+            ids = alloc.block_ids[first_block:n_blocks]
+            return self.extract_blocks(ids, as_device=as_device)
+        finally:
+            self.allocator.free_sequence(alloc)
+
+    def release_held(self, request_id: str) -> None:
+        """Free a held allocation without extracting (error paths).
+        MUST run on the engine thread."""
+        self._hold_ids.discard(request_id)
+        alloc = self._held_allocs.pop(request_id, None)
+        if alloc is not None:
+            self.allocator.free_sequence(alloc)
 
     def _inject_fn(self):
         if not hasattr(self, "_inject_jit"):
@@ -1250,19 +1441,52 @@ class JaxServingEngine(AsyncEngine):
     # -- host KV tier ---------------------------------------------------------
 
     def _offload_blocks(self, pairs: List[Tuple[int, int]]) -> None:
-        """Spill evicted device blocks to the host pool (engine thread only;
-        called by the allocator while the device contents are still valid —
-        nothing can overwrite the pages before this device_get completes
-        because all dispatches happen on this thread, after it returns)."""
+        """Spill evicted device blocks to the host pool — WITHOUT stalling the
+        eviction path (which runs inside admission: a synchronous device_get
+        here stalls every decode lane for a host-transfer round trip, W4 of
+        the round-2 review; the reference overlaps tier copies with its
+        CopyStream, lib/llm/src/kv/layer.rs:100-1132).
+
+        Engine thread only. The gather into fresh device buffers is enqueued
+        BEFORE any subsequent dispatch that could overwrite the freed pages
+        (single device stream executes in order), so the snapshot is
+        consistent; the host copy then rides along asynchronously and is
+        harvested by :meth:`_harvest_spills` once ready."""
         idx = jnp.asarray([bid for _, bid in pairs], jnp.int32)
-        k = np.asarray(jax.device_get(self.cache["k"][:, idx]))
-        v = np.asarray(jax.device_get(self.cache["v"][:, idx]))
-        for i, (h, _) in enumerate(pairs):
-            # copies, not views: a view would pin the whole batch array in
-            # host RAM for as long as any one entry stays in the pool
-            self.host_pool.put(
-                h, np.ascontiguousarray(k[:, i]), np.ascontiguousarray(v[:, i])
-            )
+        k = self.cache["k"][:, idx]
+        v = self.cache["v"][:, idx]
+        k.copy_to_host_async()
+        v.copy_to_host_async()
+        self._pending_spills.append((pairs, k, v))
+
+    def _harvest_spills(self, force: bool = False) -> None:
+        """Move completed async spills into the host pool (engine thread).
+        Non-blocking by default (only entries whose copies are ready);
+        ``force`` drains everything (close/idle). A deep backlog is force-
+        drained so pending device snapshots can't pile up unboundedly."""
+        if not self._pending_spills:
+            return
+        if len(self._pending_spills) > 8:
+            force = True
+        while self._pending_spills:
+            pairs, k, v = self._pending_spills[0]
+            if not force:
+                try:
+                    if not (k.is_ready() and v.is_ready()):
+                        return
+                except AttributeError:  # backend without is_ready: block
+                    pass
+            self._pending_spills.popleft()
+            k_np = np.asarray(jax.device_get(k))
+            v_np = np.asarray(jax.device_get(v))
+            for i, (h, _) in enumerate(pairs):
+                # copies, not views: a view would pin the whole batch array
+                # in host RAM for as long as any one entry stays in the pool
+                self.host_pool.put(
+                    h,
+                    np.ascontiguousarray(k_np[:, i]),
+                    np.ascontiguousarray(v_np[:, i]),
+                )
 
     def _inject_host_hits(self, alloc: SequenceAllocation) -> None:
         """Load host-tier prefix hits back into the sequence's device pages
@@ -1386,6 +1610,9 @@ def build_jax_serving_engine(
     event_sink: Optional[KvEventSink] = None,
     decode_steps: int = 4,
     host_cache_blocks: int = 0,
+    pipeline_parallel_size: int = 1,
+    context_parallel_size: int = 1,
+    data_parallel_size: int = 1,
 ) -> JaxServingEngine:
     """CLI/SDK entry: model + engine from a ModelDeploymentCard."""
     from dynamo_tpu.engine_jax.weights import config_from_card, load_params
@@ -1396,8 +1623,12 @@ def build_jax_serving_engine(
     params = load_params(card, model_config, seed=seed)
 
     mesh = None
-    if tensor_parallel_size > 1:
-        mesh = make_mesh(MeshConfig(tp=tensor_parallel_size))
+    mesh_cfg = MeshConfig(
+        dp=data_parallel_size, pp=pipeline_parallel_size,
+        tp=tensor_parallel_size, sp=context_parallel_size,
+    )
+    if mesh_cfg.size > 1:
+        mesh = make_mesh(mesh_cfg)
         params = jax.device_put(params, param_shardings(model_config, mesh))
 
     engine_config = EngineConfig(

@@ -40,7 +40,9 @@ T_FLOAT32, T_BOOL, T_STRING, T_ARRAY, T_UINT64, T_INT64, T_FLOAT64 = (
 # ggml tensor dtypes we can load directly
 GGML_F32, GGML_F16 = 0, 1
 GGML_BF16 = 30
-_LOADABLE = {GGML_F32: np.float32, GGML_F16: np.float16}
+# BF16 has no portable numpy dtype: read raw uint16 and upconvert via a
+# <<16 bit shift into float32 (exact — bf16 is float32's top half)
+_LOADABLE = {GGML_F32: np.float32, GGML_F16: np.float16, GGML_BF16: np.uint16}
 
 _SCALAR_FMT = {
     T_UINT8: "<B", T_INT8: "<b", T_UINT16: "<H", T_INT16: "<h",
@@ -82,14 +84,17 @@ class GgufFile:
         if info.ggml_type not in _LOADABLE:
             raise ValueError(
                 f"tensor {name!r} has ggml type {info.ggml_type} (quantized?) — "
-                "only F32/F16 GGUF tensors are loadable; re-export unquantized"
+                "only F32/F16/BF16 GGUF tensors are loadable; re-export unquantized"
             )
         dt = _LOADABLE[info.ggml_type]
         count = int(np.prod(info.shape)) if info.shape else 1
         with open(self.path, "rb") as f:
             f.seek(self.data_start + info.offset)
             raw = f.read(count * np.dtype(dt).itemsize)
-        return np.frombuffer(raw, dtype=dt).reshape(info.shape)
+        arr = np.frombuffer(raw, dtype=dt)
+        if info.ggml_type == GGML_BF16:
+            arr = (arr.astype(np.uint32) << 16).view(np.float32)
+        return arr.reshape(info.shape)
 
 
 def _read_str(f: BinaryIO) -> str:

@@ -47,6 +47,7 @@ class ModelWatcher:
         self._entry_model: Dict[str, tuple] = {}  # key → (kind, name)
         self._model_keys: Dict[tuple, set] = {}  # (kind, name) → entry keys
         self._clients: Dict[tuple, object] = {}  # (kind, name) → EndpointClient
+        self._endpoint_paths: Dict[tuple, str] = {}  # (kind, name) → dyn path
         self._task: Optional[asyncio.Task] = None
         self._closed = False
 
@@ -129,7 +130,19 @@ class ModelWatcher:
             return  # entry refresh for a model we already serve
 
         if parsed in self._clients:
-            # another worker's entry for an already-served model: refcount it
+            # another worker's entry for an already-served model: refcount it.
+            # Traffic flows through the FIRST entry's endpoint path — if this
+            # entry points somewhere else, its worker will never see requests
+            # for this model name; surface that instead of silently dropping
+            # it (ADVICE r2: endpoint-path divergence was invisible).
+            known = self._endpoint_paths.get(parsed)
+            if known is not None and endpoint_path != known:
+                logger.warning(
+                    "model %s/%s registered at %r by %s, but traffic is "
+                    "routed to %r (first registration wins; align the "
+                    "endpoint paths or use a distinct model name)",
+                    kind, name, endpoint_path, key, known,
+                )
             self._entry_model[key] = parsed
             self._model_keys[parsed].add(key)
             return
@@ -158,6 +171,7 @@ class ModelWatcher:
             await client.close()
             return
         self._clients[parsed] = client
+        self._endpoint_paths[parsed] = endpoint_path
         self._entry_model[key] = parsed
         self._model_keys[parsed] = {key}
         logger.info("model %r (%s) added via %s", name, kind, endpoint_path)
@@ -173,6 +187,7 @@ class ModelWatcher:
                 return  # other workers still serve this model
             del self._model_keys[parsed]
         client = self._clients.pop(parsed, None)
+        self._endpoint_paths.pop(parsed, None)
         if client is not None:
             try:
                 await client.close()

@@ -45,6 +45,20 @@ class ModelDeploymentCard:
         return card
 
     @classmethod
+    def from_repo(
+        cls, repo_id: str, display_name: Optional[str] = None,
+        revision: Optional[str] = None,
+    ) -> "ModelDeploymentCard":
+        """Build from a hub repo id (``org/name``): resolve to local files —
+        fixture hub (``DYN_HUB_DIR``), then the HF cache, then a download —
+        and delegate to :meth:`from_local_path`.
+
+        Reference: hub download resolution (launch/dynamo-run/src/hub.rs).
+        """
+        path = resolve_repo(repo_id, revision=revision)
+        return cls.from_local_path(path, display_name or repo_id)
+
+    @classmethod
     def from_local_path(cls, path: str, display_name: Optional[str] = None) -> "ModelDeploymentCard":
         """Build from an HF-layout model directory (config.json + tokenizer
         files) or a single .gguf file.
@@ -176,6 +190,50 @@ class CardStore:
                 await self.store.delete(key)
                 purged += 1
         return purged
+
+
+def looks_like_repo_id(spec: str) -> bool:
+    """``org/name`` (exactly one slash, no existing file/dir of that name)."""
+    return (
+        not os.path.exists(spec)
+        and spec.count("/") == 1
+        and not spec.startswith((".", "/", "~"))
+        and all(p for p in spec.split("/"))
+    )
+
+
+def resolve_repo(repo_id: str, revision: Optional[str] = None) -> str:
+    """Resolve a hub repo id to a local model directory.
+
+    Order (first hit wins):
+    1. ``DYN_HUB_DIR``: an operator-managed local hub — a directory holding
+       one model dir per repo, named ``org--name`` (also how tests provide a
+       fixture hub without network).
+    2. The HF cache (``snapshot_download(local_files_only=True)``) — a model
+       already pulled by any HF tool serves without touching the network.
+    3. A fresh ``snapshot_download`` of configs + tokenizer + safetensors/
+       gguf (reference downloads the same set, hub.rs).
+    """
+    hub_dir = os.environ.get("DYN_HUB_DIR")
+    if hub_dir:
+        cand = os.path.join(hub_dir, repo_id.replace("/", "--"))
+        if os.path.isdir(cand):
+            return cand
+    from huggingface_hub import snapshot_download
+
+    patterns = [
+        "*.json", "*.safetensors", "*.gguf", "tokenizer*", "*.model",
+    ]
+    try:
+        return snapshot_download(
+            repo_id, revision=revision, local_files_only=True,
+            allow_patterns=patterns,
+        )
+    except Exception:
+        pass
+    return snapshot_download(
+        repo_id, revision=revision, allow_patterns=patterns
+    )
 
 
 def _token_str(raw: Any) -> Optional[str]:

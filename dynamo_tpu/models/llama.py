@@ -123,22 +123,23 @@ def param_logical_axes(config: LlamaConfig) -> Params:
     axes: Params = {
         "embed": ("vocab", "embed"),
         "final_norm": ("embed",),
+        # leading axis = stacked layers → pipeline stages when pp > 1
         "layers": {
-            "attn_norm": (None, "embed"),
-            "wq": (None, "embed", "heads"),
-            "wk": (None, "embed", "kv_heads"),
-            "wv": (None, "embed", "kv_heads"),
-            "wo": (None, "heads", "embed"),
-            "mlp_norm": (None, "embed"),
-            "w_gate": (None, "embed", "mlp"),
-            "w_up": (None, "embed", "mlp"),
-            "w_down": (None, "mlp", "embed"),
+            "attn_norm": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "mlp_norm": ("layers", "embed"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
         },
     }
     if config.qkv_bias:
-        axes["layers"]["bq"] = (None, "heads")
-        axes["layers"]["bk"] = (None, "kv_heads")
-        axes["layers"]["bv"] = (None, "kv_heads")
+        axes["layers"]["bq"] = ("layers", "heads")
+        axes["layers"]["bk"] = ("layers", "kv_heads")
+        axes["layers"]["bv"] = ("layers", "kv_heads")
     if not config.tie_embeddings:
         axes["lm_head"] = ("embed", "vocab")
     return axes
@@ -476,6 +477,141 @@ def forward_window(
     )
     h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
     return lm_head(params, c, h)[:, 0], new_wk, new_wv
+
+
+def _history_partial(
+    c: LlamaConfig,
+    q: jax.Array,  # [B, T, H, D] (rope applied)
+    gk: jax.Array,  # [B, Smax, KVH, D] gathered pool pages
+    gv: jax.Array,
+    chunk_start: jax.Array,  # [B] history = positions < chunk_start
+    q_positions: jax.Array,  # [B, T]; < 0 = padding
+    scale: float,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Flash partial of chunk queries against pre-chunk paged history:
+    (unnormalized numerator [B,T,H,D] f32, row max [B,H,T], denom [B,H,T])."""
+    b, t, h, d = q.shape
+    kvh = gk.shape[2]
+    g = h // kvh
+    smax = gk.shape[1]
+    qg = q.reshape(b, t, kvh, g, d)
+    scores = jnp.einsum(
+        "btngd,bsnd->bngts", qg, gk, preferred_element_type=jnp.float32
+    ) * scale  # [B, KVH, G, T, S]
+    kv_pos = jnp.arange(smax)[None, :]
+    mask = (kv_pos < chunk_start[:, None])[:, None, None, None, :]
+    mask = mask & (q_positions >= 0)[:, None, None, :, None]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    m = jnp.maximum(scores.max(axis=-1), -1e30)  # [B, KVH, G, T]
+    p = jnp.exp(scores - m[..., None])
+    l = p.sum(axis=-1)
+    num = jnp.einsum("bngts,bsnd->btngd", p, gv.astype(jnp.float32))
+    return (
+        num.reshape(b, t, h, d),
+        m.reshape(b, h, t),
+        l.reshape(b, h, t),
+    )
+
+
+def forward_chunk_sp(
+    params: Params,
+    config: LlamaConfig,
+    tokens: jax.Array,  # [B, C] int32
+    positions: jax.Array,  # [B, C]; < 0 = padding
+    kv_cache: KVCache,
+    block_tables: jax.Array,  # [B, MB]
+    mesh,
+    *,
+    hidden_only: bool = False,
+) -> Tuple[jax.Array, KVCache]:
+    """Sequence-parallel prefill chunk: same contract as :func:`forward`.
+
+    The chunk's sequence axis is sharded over the ``sp`` mesh axis; within-
+    chunk causal attention runs as ring attention (K/V shards rotate over
+    ICI, parallel/ring_attention.py) and pre-chunk history is a flash
+    partial against the paged pool, merged flash-decoding style. This is
+    what makes sp a SERVING axis rather than a tested-but-unused module:
+    long prompts prefill with their activations and attention split across
+    the ring. (The reference has no sequence parallelism at all —
+    SURVEY.md §2.12 — this is a TPU-native extension.)
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dynamo_tpu.ops.attention import gather_pages, write_kv_to_pages
+    from dynamo_tpu.parallel.mesh import AXIS_SP
+    from dynamo_tpu.parallel.ring_attention import ring_attention
+
+    c = config
+    d = c.head_dim
+    scale = d ** -0.5
+    h = params["embed"][jnp.clip(tokens, 0)]  # [B, C, E]
+    h = jax.lax.with_sharding_constraint(
+        h, NamedSharding(mesh, P(None, AXIS_SP, None))
+    )
+    chunk_start = jnp.where(positions[:, 0] >= 0, positions[:, 0], 0)  # [B]
+
+    def layer_body(carry, xs):
+        lp, k_page, v_page = xs
+        hidden = carry
+        b, t = positions.shape
+
+        x = rms_norm(hidden, lp["attn_norm"], c.rms_norm_eps)
+        q, k, v = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
+        if c.qkv_bias:
+            q = q + lp["bq"].astype(q.dtype)
+            k = k + lp["bk"].astype(k.dtype)
+            v = v + lp["bv"].astype(v.dtype)
+        q = q.reshape(b, t, c.num_heads, c.head_dim)
+        k = k.reshape(b, t, c.num_kv_heads, c.head_dim)
+        v = v.reshape(b, t, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, positions, c.rope_theta)
+        k = apply_rope(k, positions, c.rope_theta)
+
+        k_page, v_page = write_kv_to_pages(
+            k_page, v_page, k, v, positions, block_tables
+        )
+
+        # in-chunk causal part: ring over sp (positions drive causality)
+        num_r, m_r, l_r = ring_attention(
+            q, k, v, positions, positions, mesh, scale=scale,
+            return_stats=True,
+        )
+        # pre-chunk history from the pool (masked to < chunk_start, so the
+        # scatter above can never double-count the chunk's own tokens)
+        gk = gather_pages(k_page, block_tables)
+        gv = gather_pages(v_page, block_tables)
+        num_h, m_h, l_h = _history_partial(
+            c, q, gk, gv, chunk_start, positions, scale
+        )
+
+        m_t = jnp.maximum(m_r, m_h)  # [B, H, T]
+        a_r = jnp.exp(m_r - m_t)
+        a_h = jnp.exp(m_h - m_t)
+        den = a_r * l_r + a_h * l_h
+        num = (
+            num_r.astype(jnp.float32) * a_r.transpose(0, 2, 1)[..., None]
+            + num_h * a_h.transpose(0, 2, 1)[..., None]
+        )
+        attn = jnp.where(
+            (den > 0.0).transpose(0, 2, 1)[..., None],
+            num / jnp.maximum(den, 1e-30).transpose(0, 2, 1)[..., None],
+            0.0,
+        ).astype(hidden.dtype)
+
+        hidden = hidden + attn.reshape(b, t, c.q_dim) @ lp["wo"]
+        x = rms_norm(hidden, lp["mlp_norm"], c.rms_norm_eps)
+        gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+        mlp = (gate * (x @ lp["w_up"])) @ lp["w_down"]
+        return hidden + mlp, (k_page, v_page)
+
+    h, (new_k, new_v) = jax.lax.scan(
+        layer_body, h, (params["layers"], kv_cache["k"], kv_cache["v"])
+    )
+    h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
+    cache = {"k": new_k, "v": new_v}
+    if hidden_only:
+        return h, cache
+    return lm_head(params, c, h), cache
 
 
 def flush_window(

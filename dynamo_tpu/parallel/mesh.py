@@ -102,7 +102,8 @@ def logical_to_sharding(mesh: Mesh, *logical_axes: Optional[str]) -> NamedShardi
 
 def kv_cache_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for paged KV cache [layers, blocks, block_size, kv_heads, head_dim]:
-    kv heads over tp, physical blocks replicated across dp.
+    layers over pp (each pipeline stage owns its layers' pages), kv heads
+    over tp, physical blocks replicated across dp.
 
     Replication over dp is deliberate, not an oversight: the pod scaling
     story for KV capacity is WORKER REPLICAS behind KV-aware routing —
@@ -112,4 +113,4 @@ def kv_cache_sharding(mesh: Mesh) -> NamedSharding:
     across chips inside one worker; giving dp groups disjoint pools would
     re-create the router's placement problem inside the engine for no
     capacity win over replicas."""
-    return logical_to_sharding(mesh, None, "kv_blocks", None, "kv_heads", None)
+    return logical_to_sharding(mesh, "layers", "kv_blocks", None, "kv_heads", None)

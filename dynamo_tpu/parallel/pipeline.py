@@ -40,6 +40,8 @@ def pipeline_forward(
     num_microbatches: Optional[int] = None,
     soft_cap: Optional[float] = None,
     use_pallas: Optional[bool] = None,
+    hidden_only: bool = False,  # skip the LM head (engine chunk path
+                                # applies it at sampled positions only)
 ) -> Tuple[jax.Array, KVCache]:
     """Pipelined equivalent of models/llama.forward (same contract).
 
@@ -133,6 +135,9 @@ def pipeline_forward(
     )
     h = out_mb.reshape(b, t, -1)
     h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
-    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
-    logits = (h @ head).astype(jnp.float32)
-    return logits, {"k": new_k, "v": new_v}
+    cache = {"k": new_k, "v": new_v}
+    if hidden_only:
+        return h, cache
+    from dynamo_tpu.models.llama import lm_head
+
+    return lm_head(params, config, h), cache

@@ -68,8 +68,14 @@ def ring_attention(
     mesh: Mesh,
     *,
     scale: Optional[float] = None,
-) -> jax.Array:
-    """Exact causal attention with Q/K/V sharded over sp. Returns q's dtype."""
+    return_stats: bool = False,
+):
+    """Exact causal attention with Q/K/V sharded over sp. Returns q's dtype.
+
+    ``return_stats`` additionally returns the flash-softmax running max and
+    denominator ([B, H, T] f32, T sharded like q) and leaves the output
+    UNNORMALIZED — for merging with out-of-ring context (the serving
+    engine's paged-history partial, models/llama.py forward_chunk_sp)."""
     d = q.shape[-1]
     if scale is None:
         scale = d ** -0.5
@@ -77,11 +83,13 @@ def ring_attention(
 
     spec = P(None, AXIS_SP)
     qspec = P(None, AXIS_SP, None, None)
+    stat_spec = P(None, None, AXIS_SP)
+    out_specs = (qspec, stat_spec, stat_spec) if return_stats else qspec
 
     @partial(
         shard_map, mesh=mesh,
         in_specs=(qspec, qspec, qspec, spec, spec),
-        out_specs=qspec, check_vma=False,
+        out_specs=out_specs, check_vma=False,
     )
     def ring(q, k, v, q_pos, kv_pos):
         perm = [(i, (i + 1) % sp) for i in range(sp)]
@@ -121,6 +129,10 @@ def ring_attention(
         (_, _, _, num, m, den, seen), _ = jax.lax.scan(
             step, (k, v, kv_pos, num0, m0, den0, seen0), jnp.arange(sp)
         )
+        if return_stats:
+            # rows that saw nothing keep m = -1e30 / den = 0, which a
+            # flash-decoding merge treats as zero weight
+            return num, m, den
         den = jnp.where(seen, den, 1.0)  # padding queries → zeros
         out = num / den.transpose(0, 2, 1)[..., None]
         return out.astype(q.dtype)

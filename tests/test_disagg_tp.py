@@ -51,10 +51,11 @@ class ForcedRemotePolicy:
     def should_remote(self, uncached_len: int) -> bool:
         return True
 
-    def submit(self, request_id, token_ids, block_ids, cached_tokens, sampling):
+    def submit(self, request_id, token_ids, block_ids, cached_tokens, sampling,
+               **kw):
         self.request = dict(
             request_id=request_id, token_ids=token_ids, block_ids=block_ids,
-            cached_tokens=cached_tokens, sampling=sampling,
+            cached_tokens=cached_tokens, sampling=sampling, **kw,
         )
         self.submitted.set()
 

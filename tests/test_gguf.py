@@ -248,3 +248,28 @@ class TestGgufParsing:
         p.write_bytes(b"NOPE" + b"\0" * 64)
         with pytest.raises(ValueError, match="not a GGUF"):
             G.read_gguf(str(p))
+
+
+def test_bf16_tensor_loads_exactly(tmp_path):
+    """BF16 GGUF tensors (the natural export for a bf16-serving stack) load
+    via the uint16 <<16 upconversion, bit-exact."""
+    vals = np.array([[1.5, -2.25], [0.0078125, -65504.0]], np.float32)
+    bf16_raw = (vals.view(np.uint32) >> 16).astype(np.uint16)  # truncate to bf16
+    # hand-write a single-tensor GGUF with ggml type BF16
+    buf = bytearray()
+    buf += struct.pack("<IIQQ", G.GGUF_MAGIC, 3, 1, 1)
+    _w_kv(buf, "general.architecture", G.T_STRING, "llama")
+    _w_str(buf, "w")
+    dims = tuple(reversed(vals.shape))
+    buf += struct.pack("<I", len(dims))
+    buf += struct.pack(f"<{len(dims)}Q", *dims)
+    buf += struct.pack("<I", G.GGML_BF16)
+    buf += struct.pack("<Q", 0)
+    buf += b"\0" * ((-len(buf)) % 32)
+    buf += bf16_raw.tobytes()
+    p = tmp_path / "bf16.gguf"
+    p.write_bytes(bytes(buf))
+
+    got = G.read_gguf(str(p)).load_tensor("w")
+    expected = (bf16_raw.astype(np.uint32) << 16).view(np.float32)
+    np.testing.assert_array_equal(got, expected)

@@ -150,3 +150,29 @@ class TestOpenAITypes:
     def test_aggregate_empty_raises(self):
         with pytest.raises(ValueError):
             aggregate_chat_chunks([])
+
+
+def test_model_card_from_repo_via_fixture_hub(tmp_path, monkeypatch):
+    """A hub repo id resolves through the DYN_HUB_DIR fixture hub and serves
+    as a model card — no network (reference parity: hub.rs download path)."""
+    from tests.fixtures import build_model_dir
+
+    from dynamo_tpu.llm.model_card import (
+        ModelDeploymentCard,
+        looks_like_repo_id,
+        resolve_repo,
+    )
+
+    hub = tmp_path / "hub"
+    hub.mkdir()
+    build_model_dir(str(hub / "test-org--tiny"))
+    monkeypatch.setenv("DYN_HUB_DIR", str(hub))
+
+    assert looks_like_repo_id("test-org/tiny")
+    assert not looks_like_repo_id("/some/abs/path")
+    assert not looks_like_repo_id(str(hub))  # existing dir is a path
+
+    assert resolve_repo("test-org/tiny") == str(hub / "test-org--tiny")
+    card = ModelDeploymentCard.from_repo("test-org/tiny")
+    assert card.display_name == "test-org/tiny"
+    assert card.tokenizer_file and card.model_config
