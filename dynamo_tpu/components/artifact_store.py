@@ -1,0 +1,244 @@
+"""Artifact store: upload/list/fetch built graph bundles + deployment CRUD.
+
+The deploy half of the build→deploy story: `dynamo build` packages a graph
+into a bundle; this service stores bundle tarballs content-addressed by
+digest, keeps their manifests queryable, and records *deployments* (a
+named intent to run a bundle with a config) that an operator or controller
+reconciles onto machines.
+
+Reference parity: the api-store (deploy/dynamo/api-store/
+ai_dynamo_store/api/{dynamo,components,deployments}.py) — re-designed as
+a dependency-free aiohttp service with disk-backed artifacts.
+
+HTTP surface:
+    POST   /v1/artifacts            body = .tar.gz, headers: X-Bundle-Name
+    GET    /v1/artifacts            list (name, digest, size, manifest)
+    GET    /v1/artifacts/{digest}   download the tarball
+    DELETE /v1/artifacts/{digest}
+    POST   /v1/deployments          {"name", "artifact", "config"}
+    GET    /v1/deployments[/name]
+    DELETE /v1/deployments/{name}
+
+Run:  python -m dynamo_tpu.components.artifact_store --root /var/lib/dynamo
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import io
+import json
+import logging
+import os
+import tarfile
+import time
+from typing import Optional
+
+from aiohttp import web
+
+logger = logging.getLogger(__name__)
+
+MAX_BUNDLE_BYTES = 512 << 20
+
+
+class ArtifactStore:
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(os.path.join(root, "artifacts"), exist_ok=True)
+        os.makedirs(os.path.join(root, "deployments"), exist_ok=True)
+
+    # -- artifacts -----------------------------------------------------------
+
+    def _artifact_path(self, digest: str) -> str:
+        if not digest.isalnum():
+            raise web.HTTPBadRequest(text="bad digest")
+        return os.path.join(self.root, "artifacts", digest)
+
+    def put_artifact(self, name: str, blob: bytes) -> dict:
+        digest = hashlib.sha256(blob).hexdigest()[:32]
+        path = self._artifact_path(digest)
+        manifest = self._extract_manifest(blob)
+        meta = {
+            "name": name,
+            "digest": digest,
+            "size": len(blob),
+            "manifest": manifest,
+            "created_at": time.time(),
+        }
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "bundle.tar.gz"), "wb") as f:
+            f.write(blob)
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        return meta
+
+    @staticmethod
+    def _extract_manifest(blob: bytes) -> Optional[dict]:
+        try:
+            with tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz") as tf:
+                for m in tf.getmembers():
+                    if os.path.basename(m.name) == "manifest.json":
+                        f = tf.extractfile(m)
+                        if f is not None:
+                            return json.load(f)
+        except (tarfile.TarError, ValueError, json.JSONDecodeError):
+            pass
+        return None
+
+    def list_artifacts(self) -> list:
+        out = []
+        base = os.path.join(self.root, "artifacts")
+        for digest in sorted(os.listdir(base)):
+            meta_path = os.path.join(base, digest, "meta.json")
+            if os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    out.append(json.load(f))
+        return out
+
+    def get_artifact(self, digest: str) -> Optional[str]:
+        path = os.path.join(self._artifact_path(digest), "bundle.tar.gz")
+        return path if os.path.exists(path) else None
+
+    def delete_artifact(self, digest: str) -> bool:
+        import shutil
+
+        path = self._artifact_path(digest)
+        if not os.path.isdir(path):
+            return False
+        shutil.rmtree(path)
+        return True
+
+    # -- deployments ---------------------------------------------------------
+
+    def _deployment_path(self, name: str) -> str:
+        safe = name.replace("/", "_")
+        return os.path.join(self.root, "deployments", f"{safe}.json")
+
+    def put_deployment(self, name: str, artifact: str, config: dict) -> dict:
+        if self.get_artifact(artifact) is None:
+            raise web.HTTPNotFound(text=f"artifact {artifact} not found")
+        dep = {
+            "name": name,
+            "artifact": artifact,
+            "config": config,
+            "updated_at": time.time(),
+        }
+        with open(self._deployment_path(name), "w") as f:
+            json.dump(dep, f)
+        return dep
+
+    def list_deployments(self) -> list:
+        base = os.path.join(self.root, "deployments")
+        out = []
+        for fn in sorted(os.listdir(base)):
+            with open(os.path.join(base, fn)) as f:
+                out.append(json.load(f))
+        return out
+
+    def get_deployment(self, name: str) -> Optional[dict]:
+        path = self._deployment_path(name)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    def delete_deployment(self, name: str) -> bool:
+        path = self._deployment_path(name)
+        if not os.path.exists(path):
+            return False
+        os.unlink(path)
+        return True
+
+
+def build_app(store: ArtifactStore) -> web.Application:
+    app = web.Application(client_max_size=MAX_BUNDLE_BYTES)
+
+    async def post_artifact(request: web.Request) -> web.Response:
+        name = request.headers.get("X-Bundle-Name", "bundle")
+        blob = await request.read()
+        if not blob:
+            raise web.HTTPBadRequest(text="empty body")
+        meta = store.put_artifact(name, blob)
+        return web.json_response(meta, status=201)
+
+    async def list_artifacts(_request: web.Request) -> web.Response:
+        return web.json_response({"artifacts": store.list_artifacts()})
+
+    async def get_artifact(request: web.Request) -> web.StreamResponse:
+        path = store.get_artifact(request.match_info["digest"])
+        if path is None:
+            raise web.HTTPNotFound()
+        return web.FileResponse(path)
+
+    async def delete_artifact(request: web.Request) -> web.Response:
+        if not store.delete_artifact(request.match_info["digest"]):
+            raise web.HTTPNotFound()
+        return web.json_response({"deleted": True})
+
+    async def post_deployment(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            name, artifact = body["name"], body["artifact"]
+        except (ValueError, KeyError):
+            raise web.HTTPBadRequest(text="need {name, artifact, config?}")
+        dep = store.put_deployment(name, artifact, body.get("config") or {})
+        return web.json_response(dep, status=201)
+
+    async def list_deployments(_request: web.Request) -> web.Response:
+        return web.json_response({"deployments": store.list_deployments()})
+
+    async def get_deployment(request: web.Request) -> web.Response:
+        dep = store.get_deployment(request.match_info["name"])
+        if dep is None:
+            raise web.HTTPNotFound()
+        return web.json_response(dep)
+
+    async def delete_deployment(request: web.Request) -> web.Response:
+        if not store.delete_deployment(request.match_info["name"]):
+            raise web.HTTPNotFound()
+        return web.json_response({"deleted": True})
+
+    async def health(_request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    app.router.add_post("/v1/artifacts", post_artifact)
+    app.router.add_get("/v1/artifacts", list_artifacts)
+    app.router.add_get("/v1/artifacts/{digest}", get_artifact)
+    app.router.add_delete("/v1/artifacts/{digest}", delete_artifact)
+    app.router.add_post("/v1/deployments", post_deployment)
+    app.router.add_get("/v1/deployments", list_deployments)
+    app.router.add_get("/v1/deployments/{name}", get_deployment)
+    app.router.add_delete("/v1/deployments/{name}", delete_deployment)
+    app.router.add_get("/health", health)
+    return app
+
+
+async def serve(root: str, host: str, port: int):
+    app = build_app(ArtifactStore(root))
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    actual = runner.addresses[0][1] if runner.addresses else port
+    logger.info("artifact store on %s:%s (root %s)", host, actual, root)
+    return runner
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="dynamo_tpu artifact store")
+    ap.add_argument("--root", default="./dynamo_artifacts")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=7411)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        await serve(args.root, args.host, args.port)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -266,13 +266,31 @@ async def run_prefill_worker(runtime, namespace: str, engine: PrefillEngine) -> 
         try:
             _validate_request(req, engine)
             # decode worker holds the prompt's prefix KV: read it instead of
-            # recomputing the shared history (multi-turn's flagship win)
+            # recomputing the shared history (multi-turn's flagship win).
+            # Every page's registered hash must equal the hash chain of the
+            # prefix tokens: a request that sat in the queue past the decode
+            # side's fallback can find its pages freed and REUSED, and
+            # seeding those would poison this engine's prefix cache with
+            # wrong KV under correct hashes.
             prefix_kv = None
             if req.cached_tokens > 0 and req.prefix_block_ids:
                 try:
-                    prefix_kv = await transfer.read_blocks(
+                    k_pre, v_pre, got_hashes = await transfer.read_blocks(
                         addr, req.prefix_block_ids
                     )
+                    from dynamo_tpu.kv.tokens import compute_block_hashes_for_seq
+
+                    expect = compute_block_hashes_for_seq(
+                        req.token_ids[: req.cached_tokens], engine.block_size
+                    )
+                    if list(got_hashes) == list(expect):
+                        prefix_kv = (k_pre, v_pre)
+                    else:
+                        logger.warning(
+                            "prefix pages for %s changed since enqueue "
+                            "(stale read); recomputing full prompt",
+                            req.request_id,
+                        )
                 except Exception:
                     logger.warning(
                         "prefix read_blocks failed for %s; recomputing full "

@@ -89,11 +89,16 @@ class KvTransferServer:
                     )
                 elif h.get("op") == "read_blocks":
                     # prefill worker reading this decode worker's cached
-                    # prefix pages (so it computes only the suffix)
-                    k, v = await _engine_call(
-                        self.engine,
-                        lambda: self.engine.extract_blocks(h["block_ids"]),
-                    )
+                    # prefix pages (so it computes only the suffix). Each
+                    # page's registered content hash ships along so the
+                    # reader can verify the pages were not freed + reused
+                    # since the request was enqueued — stale reads would
+                    # otherwise poison its prefix cache with wrong KV.
+                    def _extract(ids=h["block_ids"]):
+                        k, v = self.engine.extract_blocks(ids)
+                        return k, v, self.engine.block_hashes_of(ids)
+
+                    k, v, hashes = await _engine_call(self.engine, _extract)
                     k_raw, v_raw = _pack(k), _pack(v)
                     await write_frame(
                         writer,
@@ -101,7 +106,7 @@ class KvTransferServer:
                             json.dumps({
                                 "id": h.get("id"), "ok": True,
                                 "dtype": k.dtype.name, "shape": list(k.shape),
-                                "k_bytes": len(k_raw),
+                                "k_bytes": len(k_raw), "hashes": hashes,
                             }).encode(),
                             k_raw + v_raw,
                         ),
@@ -142,11 +147,16 @@ class LocalKvTransfer:
         self.decode.fail_remote_prefill(request_id, message)
 
     async def read_blocks(self, address: str, block_ids) -> tuple:
-        """Device path: pages come back as jax arrays, never touching host."""
-        return await _engine_call(
-            self.decode,
-            lambda: self.decode.extract_blocks(list(block_ids), as_device=True),
-        )
+        """Device path: pages come back as jax arrays, never touching host.
+        Hashes ride along for the same staleness validation as the TCP
+        path."""
+        ids = list(block_ids)
+
+        def _extract():
+            k, v = self.decode.extract_blocks(ids, as_device=True)
+            return k, v, self.decode.block_hashes_of(ids)
+
+        return await _engine_call(self.decode, _extract)
 
     async def close(self) -> None:
         pass
@@ -197,7 +207,8 @@ class KvTransferClient:
 
     async def read_blocks(self, address: str, block_ids) -> tuple:
         """Pull KV pages from a decode worker's pool by physical id.
-        Returns (k, v) numpy [L, n, bs, KVH, D]."""
+        Returns (k, v, hashes): numpy [L, n, bs, KVH, D] pages plus each
+        page's registered content hash (-1 = no longer registered)."""
         reader, writer = await self._conn(address)
         async with self._locks[address]:
             await write_frame(
@@ -214,7 +225,7 @@ class KvTransferClient:
         k_len = h["k_bytes"]
         k = _unpack(frame.body[:k_len], h["dtype"], h["shape"])
         v = _unpack(frame.body[k_len:], h["dtype"], h["shape"])
-        return k, v
+        return k, v, h.get("hashes") or [-1] * k.shape[1]
 
     async def send_failure(self, address: str, request_id: str, message: str) -> None:
         reader, writer = await self._conn(address)

@@ -141,6 +141,11 @@ class BlockAllocator:
     def usage(self) -> float:
         return self.active_blocks / self.num_blocks if self.num_blocks else 0.0
 
+    def hash_of_block(self, block_id: int) -> int:
+        """Registered content hash of a physical page, or -1 (free/partial/
+        reused pages have none)."""
+        return self._hash_of.get(block_id, -1)
+
     def blocks_needed(self, n_tokens: int) -> int:
         return (n_tokens + self.block_size - 1) // self.block_size
 

@@ -799,11 +799,18 @@ class JaxServingEngine(AsyncEngine):
 
     def post(self, fn) -> None:
         """Schedule a host function to run on the engine thread (thread-safe).
-        The only way external code may touch the cache or allocator."""
-        self._ensure_thread()
+        The only way external code may touch the cache or allocator. After
+        close(), the fn runs INLINE on the caller thread: the step thread's
+        shutdown drain only covers callbacks it observed, and a post racing
+        the drain would otherwise never run — hanging any _engine_call
+        future awaiting it."""
         with self._cond:
-            self._posted.append(fn)
-            self._cond.notify()
+            if not self._shutdown:
+                self._ensure_thread()
+                self._posted.append(fn)
+                self._cond.notify()
+                return
+        fn()
 
     def _run_posted(self) -> None:
         while True:
@@ -1338,6 +1345,13 @@ class JaxServingEngine(AsyncEngine):
         k_dev.copy_to_host_async()
         v_dev.copy_to_host_async()
         return np.asarray(jax.device_get(k_dev)), np.asarray(jax.device_get(v_dev))
+
+    def block_hashes_of(self, block_ids: List[int]) -> List[int]:
+        """The allocator-registered content hash per physical page (-1 for a
+        page with no registered hash — free, partial, or reused). Lets a
+        remote reader verify pages still hold the content it expects; MUST
+        run on the engine thread."""
+        return [self.allocator.hash_of_block(bid) for bid in block_ids]
 
     def seed_external_prefix(self, token_ids: List[int], k_pages, v_pages) -> int:
         """Register externally-computed prefix KV (pages read from another

@@ -1,0 +1,152 @@
+"""Generic RAII object pool.
+
+Items return to the pool when their handle is released — explicitly, via
+context manager, or by garbage collection (a finalizer guards against
+leaked handles). ``SharedPoolItem`` adds refcounted sharing: the item
+returns when the LAST holder releases. This is the generic reuse
+primitive the KV block allocator specializes (allocator.py is its own
+implementation for the pool-critical path); use this one for everything
+else that is expensive to create and cheap to reset.
+
+Reference parity: Pool/PoolItem/SharedPoolItem (lib/runtime/src/utils/
+pool.rs:23-427) — re-designed around Python context managers + weakref
+finalizers instead of Drop impls.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import deque
+from typing import Any, Callable, Deque, Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class PoolItem(Generic[T]):
+    """A checked-out item; returns to its pool on release (once)."""
+
+    def __init__(self, pool: "Pool[T]", value: T):
+        self._pool = pool
+        self.value = value
+        self._released = False
+        # guard against leaked handles: gc returns the item too
+        self._finalizer = weakref.finalize(self, pool._return_value, value)
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._finalizer.detach()
+        self._pool._return_value(self.value)
+
+    def __enter__(self) -> T:
+        return self.value
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class SharedPoolItem(Generic[T]):
+    """Refcounted handle: ``share()`` hands out another holder; the value
+    returns to the pool when the last holder releases."""
+
+    def __init__(self, pool: "Pool[T]", value: T):
+        self._pool = pool
+        self.value = value
+        self._lock = threading.Lock()
+        self._refs = 1
+        self._returned = False
+
+    def share(self) -> "SharedPoolItem[T]":
+        with self._lock:
+            if self._returned:
+                raise RuntimeError("cannot share a fully-released item")
+            self._refs += 1
+        return self
+
+    def release(self) -> None:
+        with self._lock:
+            if self._returned:
+                return
+            self._refs -= 1
+            if self._refs > 0:
+                return
+            self._returned = True
+        self._pool._return_value(self.value)
+
+    def __enter__(self) -> T:
+        return self.value
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class Pool(Generic[T]):
+    """Bounded pool of reusable values.
+
+    ``factory`` creates values on demand up to ``max_size`` live at once
+    (None = unbounded); ``reset`` (optional) runs on every return before
+    the value becomes reusable; ``acquire`` blocks until a value is free
+    (or raises after ``timeout``)."""
+
+    def __init__(
+        self,
+        factory: Callable[[], T],
+        max_size: Optional[int] = None,
+        reset: Optional[Callable[[T], None]] = None,
+    ):
+        self._factory = factory
+        self._reset = reset
+        self._max = max_size
+        self._free: Deque[T] = deque()
+        self._live = 0
+        self._cond = threading.Condition()
+
+    def acquire(self, timeout: Optional[float] = None) -> PoolItem[T]:
+        return PoolItem(self, self._take(timeout))
+
+    def acquire_shared(self, timeout: Optional[float] = None) -> SharedPoolItem[T]:
+        return SharedPoolItem(self, self._take(timeout))
+
+    def _take(self, timeout: Optional[float]) -> T:
+        with self._cond:
+            while True:
+                if self._free:
+                    return self._free.popleft()
+                if self._max is None or self._live < self._max:
+                    self._live += 1
+                    break  # create outside the lock
+                if not self._cond.wait(timeout=timeout):
+                    raise TimeoutError("pool exhausted")
+        try:
+            return self._factory()
+        except BaseException:
+            with self._cond:
+                self._live -= 1
+                self._cond.notify()
+            raise
+
+    def _return_value(self, value: T) -> None:
+        if self._reset is not None:
+            try:
+                self._reset(value)
+            except Exception:
+                # a value that can't reset is dropped, freeing its slot
+                with self._cond:
+                    self._live -= 1
+                    self._cond.notify()
+                return
+        with self._cond:
+            self._free.append(value)
+            self._cond.notify()
+
+    @property
+    def free_count(self) -> int:
+        with self._cond:
+            return len(self._free)
+
+    @property
+    def live_count(self) -> int:
+        with self._cond:
+            return self._live

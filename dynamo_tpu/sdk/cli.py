@@ -185,6 +185,57 @@ def build_cmd(args) -> None:
         print(f"built {out}/ (services: {', '.join(services)})")
 
 
+def deploy_cmd(args) -> None:
+    """Push a built bundle to the artifact store and (optionally) create a
+    named deployment record there.
+
+    Reference parity: `dynamo deploy`/cloud pushing artifacts to the
+    api-store (deploy/dynamo/sdk/src/dynamo/sdk/cli/deploy.py:464,
+    deploy/dynamo/api-store) — here against
+    components/artifact_store.py's HTTP surface.
+    """
+    import json
+    import urllib.request
+
+    bundle = args.bundle
+    if os.path.isdir(bundle):
+        raise SystemExit(
+            f"{bundle} is a directory — build with --tar (the store takes "
+            "a .tar.gz)"
+        )
+    with open(bundle, "rb") as f:
+        blob = f.read()
+    name = args.name or os.path.basename(bundle).removesuffix(".tar.gz")
+    base = args.store.rstrip("/")
+
+    req = urllib.request.Request(
+        f"{base}/v1/artifacts", data=blob, method="POST",
+        headers={"X-Bundle-Name": name,
+                 "Content-Type": "application/gzip"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        meta = json.load(resp)
+    print(f"pushed {name} → {meta['digest']} ({meta['size']} bytes)")
+
+    if args.config_file and not args.create:
+        args.create = True  # a config only means anything on a deployment
+    if args.create:
+        config = {}
+        if args.config_file:
+            with open(args.config_file) as f:
+                config = json.load(f)
+        dep_req = urllib.request.Request(
+            f"{base}/v1/deployments",
+            data=json.dumps(
+                {"name": name, "artifact": meta["digest"], "config": config}
+            ).encode(),
+            method="POST", headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(dep_req) as resp:
+            dep = json.load(resp)
+        print(f"deployment {dep['name']} → artifact {dep['artifact']}")
+
+
 def main() -> None:
     p = argparse.ArgumentParser(prog="dynamo")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -204,10 +255,23 @@ def main() -> None:
     bp.add_argument("-o", "--output", default=None, help="bundle directory")
     bp.add_argument("--tar", action="store_true", help="also emit .tar.gz")
 
+    dp = sub.add_parser("deploy", help="push a bundle to the artifact store")
+    dp.add_argument("bundle", help="path to a bundle .tar.gz (build --tar)")
+    dp.add_argument("--store", default="http://127.0.0.1:7411",
+                    help="artifact store base url")
+    dp.add_argument("--name", default=None, help="artifact/deployment name")
+    dp.add_argument("--create", action="store_true",
+                    help="also create a deployment record")
+    dp.add_argument("-f", "--config-file", default=None,
+                    help="JSON config stored on the deployment")
+
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(name)s: %(message)s")
     if args.cmd == "build":
         build_cmd(args)
+        return
+    if args.cmd == "deploy":
+        deploy_cmd(args)
         return
     asyncio.run(serve_cmd(args))
 

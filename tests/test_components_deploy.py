@@ -1,0 +1,200 @@
+"""Artifact store + deploy CLI + generic pool + indexer variants."""
+
+import asyncio
+import io
+import json
+import tarfile
+import threading
+
+import pytest
+
+from dynamo_tpu.components.artifact_store import ArtifactStore, build_app, serve
+from dynamo_tpu.kv_router.indexer import (
+    KvIndexer,
+    KvIndexerFrequency,
+    KvIndexerSharded,
+)
+from dynamo_tpu.kv_router.protocols import (
+    KvCacheEvent,
+    RemovedBlocks,
+    RouterEvent,
+    StoredBlock,
+    StoredBlocks,
+)
+from dynamo_tpu.runtime.pool import Pool
+
+
+def _bundle_tar(manifest: dict) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        data = json.dumps(manifest).encode()
+        info = tarfile.TarInfo("bundle/manifest.json")
+        info.size = len(data)
+        tf.addfile(info, io.BytesIO(data))
+    return buf.getvalue()
+
+
+def test_artifact_store_roundtrip(tmp_path):
+    store = ArtifactStore(str(tmp_path))
+    blob = _bundle_tar({"kind": "dynamo_tpu_bundle", "graph": "g:G"})
+    meta = store.put_artifact("demo", blob)
+    assert meta["manifest"]["graph"] == "g:G"
+    assert store.list_artifacts()[0]["digest"] == meta["digest"]
+    assert store.get_artifact(meta["digest"]) is not None
+
+    dep = store.put_deployment("prod", meta["digest"], {"replicas": 2})
+    assert store.get_deployment("prod")["config"]["replicas"] == 2
+    assert store.delete_deployment("prod")
+    assert store.get_deployment("prod") is None
+    assert store.delete_artifact(meta["digest"])
+    assert store.get_artifact(meta["digest"]) is None
+
+
+def test_artifact_store_http_and_deploy_cli(tmp_path, run, capsys):
+    """End to end over HTTP: serve the store, push a bundle through the
+    `dynamo deploy` CLI command, create + fetch the deployment."""
+    blob = _bundle_tar({"kind": "dynamo_tpu_bundle", "graph": "g:G"})
+    bundle_path = tmp_path / "demo_bundle.tar.gz"
+    bundle_path.write_bytes(blob)
+
+    async def go():
+        runner = await serve(str(tmp_path / "root"), "127.0.0.1", 0)
+        port = runner.addresses[0][1]
+
+        import argparse
+
+        from dynamo_tpu.sdk.cli import deploy_cmd
+
+        args = argparse.Namespace(
+            bundle=str(bundle_path), store=f"http://127.0.0.1:{port}",
+            name=None, create=True, config_file=None,
+        )
+        await asyncio.to_thread(deploy_cmd, args)
+
+        import aiohttp
+
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{port}/v1/deployments") as r:
+                deps = (await r.json())["deployments"]
+            assert deps and deps[0]["name"] == "demo_bundle"
+            async with s.get(
+                f"http://127.0.0.1:{port}/v1/artifacts/{deps[0]['artifact']}"
+            ) as r:
+                assert await r.read() == blob
+        await runner.cleanup()
+
+    run(go())
+    out = capsys.readouterr().out
+    assert "pushed demo_bundle" in out
+
+
+def _stored(worker, hashes, parent=None):
+    return RouterEvent(
+        worker_id=worker,
+        event=KvCacheEvent(
+            event_id=1,
+            data=StoredBlocks(
+                parent_hash=parent,
+                blocks=[StoredBlock(block_hash=h, tokens_hash=h) for h in hashes],
+            ),
+        ),
+    )
+
+
+def test_sharded_indexer_matches_single():
+    plain = KvIndexer(block_size=4)
+    sharded = KvIndexerSharded(block_size=4, num_shards=3, native=False)
+    for idx in (plain, sharded):
+        idx.apply_event(_stored("w1", [10, 11, 12]))
+        idx.apply_event(_stored("w2", [10, 11]))
+        idx.apply_event(_stored("w3", [99]))
+    assert sharded.find_matches([10, 11, 12]) == plain.find_matches([10, 11, 12])
+    sharded.remove_worker("w1")
+    plain.remove_worker("w1")
+    assert sharded.find_matches([10, 11, 12]) == plain.find_matches([10, 11, 12])
+    assert sharded.event_count == plain.event_count
+
+
+def test_frequency_indexer_counts_and_expires():
+    now = [0.0]
+    idx = KvIndexerFrequency(block_size=4, ttl=10.0, clock=lambda: now[0])
+    idx.apply_event(_stored("w1", [5, 6]))
+    idx.find_matches([5, 6])
+    idx.find_matches([5, 6])
+    assert idx.frequency(5) == 2 and idx.frequency(6) == 2
+    now[0] = 5.0
+    idx.find_matches([5])
+    assert idx.frequency(5) == 3
+    now[0] = 16.0  # 6 last seen at t=0 → expired; 5 at t=5 → expired too
+    assert idx.frequency(6) == 0
+    assert idx.expire() >= 0
+    assert idx.frequency(5) == 0
+    # one worker's removal does NOT erase the counter (others may still
+    # hold the block); only the ttl ages it out
+    now[0] = 20.0
+    idx.find_matches([5])
+    idx.apply_event(RouterEvent(
+        worker_id="w1",
+        event=KvCacheEvent(event_id=2, data=RemovedBlocks(block_hashes=[5])),
+    ))
+    assert idx.frequency(5) == 1
+
+
+def test_pool_raii_and_sharing():
+    created = []
+    pool = Pool(lambda: created.append(1) or object(), max_size=2)
+    a = pool.acquire()
+    b = pool.acquire()
+    assert pool.live_count == 2
+    with pytest.raises(TimeoutError):
+        pool.acquire(timeout=0.05)
+    a.release()
+    c = pool.acquire(timeout=1.0)  # reuses a's value
+    assert len(created) == 2
+    assert c.value is a.value
+    b.release()
+
+    # context-manager release
+    with c:
+        pass
+    assert pool.free_count == 2
+
+    # shared handle returns only on last release
+    s = pool.acquire_shared()
+    s2 = s.share()
+    s.release()
+    assert pool.free_count == 1  # still held by s2
+    s2.release()
+    assert pool.free_count == 2
+
+    # blocked acquire wakes when another thread releases
+    x = pool.acquire()
+    y = pool.acquire()
+    got = []
+
+    def waiter():
+        item = pool.acquire(timeout=5.0)
+        got.append(item)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    x.release()
+    t.join(timeout=5.0)
+    assert got and got[0].value is x.value
+    y.release()
+    got[0].release()
+
+
+def test_pool_reset_failure_drops_value():
+    calls = []
+
+    def bad_reset(v):
+        calls.append(v)
+        raise RuntimeError("cannot reset")
+
+    pool = Pool(lambda: object(), max_size=1, reset=bad_reset)
+    item = pool.acquire()
+    item.release()
+    assert calls  # reset ran
+    assert pool.free_count == 0 and pool.live_count == 0
+    pool.acquire(timeout=1.0)  # slot was freed: a new value can be created

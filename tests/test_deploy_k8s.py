@@ -1,0 +1,100 @@
+"""deploy/k8s manifest validation (the CI-side check the VERDICT asked for):
+every document parses, Deployments reference the framework image and
+importable module entrypoints, Services select pods that exist, and the
+kustomization covers every manifest."""
+
+import importlib
+import os
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+K8S_DIR = os.path.join(os.path.dirname(__file__), "..", "deploy", "k8s")
+
+
+def _docs():
+    for fn in sorted(os.listdir(K8S_DIR)):
+        if not fn.endswith(".yaml"):
+            continue
+        with open(os.path.join(K8S_DIR, fn)) as f:
+            for doc in yaml.safe_load_all(f):
+                if doc:
+                    yield fn, doc
+
+
+def test_all_manifests_parse_with_kind_and_name():
+    docs = list(_docs())
+    assert len(docs) >= 8
+    for fn, doc in docs:
+        assert "kind" in doc, fn
+        if doc["kind"] != "Kustomization":  # kustomizations have no metadata
+            assert doc["metadata"]["name"], fn
+
+
+def test_deployment_entrypoints_are_importable_modules():
+    """Container args are ["-m", "<module>", ...]: the module must exist —
+    a renamed module would otherwise only fail at pod start."""
+    seen = 0
+    for fn, doc in _docs():
+        if doc["kind"] != "Deployment":
+            continue
+        for c in doc["spec"]["template"]["spec"]["containers"]:
+            assert c["image"].startswith("dynamo-tpu"), (fn, c["image"])
+            args = c.get("args", [])
+            assert args[0] == "-m", (fn, args)
+            importlib.import_module(args[1])
+            seen += 1
+    assert seen >= 5
+
+
+def test_services_select_existing_deployments():
+    deploy_labels = {}
+    services = []
+    for fn, doc in _docs():
+        if doc["kind"] == "Deployment":
+            labels = doc["spec"]["template"]["metadata"]["labels"]
+            ports = set()
+            for c in doc["spec"]["template"]["spec"]["containers"]:
+                for p in c.get("ports", []):
+                    ports.add(p["containerPort"])
+            deploy_labels[frozenset(labels.items())] = ports
+        elif doc["kind"] == "Service":
+            services.append((fn, doc))
+    for fn, svc in services:
+        sel = frozenset(svc["spec"]["selector"].items())
+        matches = [
+            ports for labels, ports in deploy_labels.items() if sel <= labels
+        ]
+        assert matches, f"{fn}: service selects no deployment"
+        for p in svc["spec"]["ports"]:
+            assert any(p["targetPort"] in ports for ports in matches), (
+                f"{fn}: targetPort {p['targetPort']} not exposed by any "
+                "matching deployment"
+            )
+
+
+def test_kustomization_covers_every_manifest():
+    with open(os.path.join(K8S_DIR, "kustomization.yaml")) as f:
+        kust = yaml.safe_load(f)
+    listed = set(kust["resources"])
+    on_disk = {
+        fn for fn in os.listdir(K8S_DIR)
+        if fn.endswith(".yaml") and fn != "kustomization.yaml"
+    }
+    assert listed == on_disk
+
+
+def test_statestore_bus_addresses_consistent():
+    """Every worker/frontend/metrics arg pair --statestore/--bus points at
+    the in-cluster service DNS names and ports the plane services expose."""
+    expect = {"--statestore": "statestore:37901", "--bus": "bus:37902"}
+    for fn, doc in _docs():
+        if doc["kind"] != "Deployment":
+            continue
+        for c in doc["spec"]["template"]["spec"]["containers"]:
+            args = c.get("args", [])
+            for flag, want in expect.items():
+                if flag in args:
+                    got = args[args.index(flag) + 1]
+                    assert got == want, (fn, flag, got)
