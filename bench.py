@@ -261,8 +261,16 @@ def main() -> None:
     warmup_s = time.perf_counter() - t0
 
     rng = np.random.default_rng(0)
-    prompts = [
-        rng.integers(0, cfg.vocab_size, PROMPT_LEN).tolist() for _ in range(N_REQUESTS)
+    # several independent waves (median reported): a shared chip's noisy
+    # neighbors swing single-wave numbers by ~20%. Every wave gets fresh
+    # prompts so nothing hits the prefix cache.
+    n_waves = max(1, int(os.environ.get("BENCH_WAVES", "3")))
+    waves = [
+        [
+            rng.integers(0, cfg.vocab_size, PROMPT_LEN).tolist()
+            for _ in range(N_REQUESTS)
+        ]
+        for _ in range(n_waves)
     ]
     # warmup uses its own prompts so the timed set stays prefix-cache-cold
     warm_prompts = [
@@ -293,15 +301,20 @@ def main() -> None:
     # the timed set so no timed request hits the prefix cache
     asyncio.run(run_batch(warm_prompts))
 
-    t0 = time.perf_counter()
-    results = asyncio.run(run_batch(prompts))
-    elapsed = time.perf_counter() - t0
+    per_wave = []
+    for wave in waves:
+        t0 = time.perf_counter()
+        results = asyncio.run(run_batch(wave))
+        elapsed = time.perf_counter() - t0
+        out = sum(n for _, n in results)
+        ttfts = sorted(t for t, _ in results if t is not None)
+        per_wave.append((out / elapsed, elapsed, out, ttfts))
     engine.close()
 
-    total_out = sum(n for _, n in results)
+    # median wave by throughput; its own TTFT distribution rides along
+    per_wave.sort(key=lambda w: w[0])
+    tok_s, elapsed, total_out, ttfts = per_wave[len(per_wave) // 2]
     total_processed = total_out + N_REQUESTS * PROMPT_LEN
-    ttfts = sorted(t for t, _ in results if t is not None)
-    tok_s = total_out / elapsed
     tok_s_chip = tok_s / max(n_chips, 1)
 
     # weight-bandwidth decode roofline: every step re-reads the params once

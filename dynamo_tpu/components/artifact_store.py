@@ -69,8 +69,13 @@ class ArtifactStore:
         os.makedirs(path, exist_ok=True)
         with open(os.path.join(path, "bundle.tar.gz"), "wb") as f:
             f.write(blob)
-        with open(os.path.join(path, "meta.json"), "w") as f:
+        # atomic rename: put_artifact runs on a worker thread, and a
+        # concurrent list_artifacts on the event loop must never see a
+        # half-written meta.json
+        tmp = os.path.join(path, ".meta.json.tmp")
+        with open(tmp, "w") as f:
             json.dump(meta, f)
+        os.replace(tmp, os.path.join(path, "meta.json"))
         return meta
 
     @staticmethod
@@ -159,7 +164,9 @@ def build_app(store: ArtifactStore) -> web.Application:
         blob = await request.read()
         if not blob:
             raise web.HTTPBadRequest(text="empty body")
-        meta = store.put_artifact(name, blob)
+        # hashing + tar parsing + writing a bundle of up to 512MB must not
+        # stall the event loop (health probes, concurrent fetches)
+        meta = await asyncio.to_thread(store.put_artifact, name, blob)
         return web.json_response(meta, status=201)
 
     async def list_artifacts(_request: web.Request) -> web.Response:

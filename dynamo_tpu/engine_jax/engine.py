@@ -60,6 +60,7 @@ from dynamo_tpu.models.llama import (
     LlamaConfig,
     flush_window,
     forward,
+    forward_chunk,
     forward_window,
     gather_history,
     lm_head,
@@ -571,9 +572,12 @@ class JaxServingEngine(AsyncEngine):
                     hidden_only=True,
                 )
             else:
-                h, cache = forward(
+                # history/fresh split (models/llama.py forward_chunk): the
+                # page scatter runs off the attention critical path instead
+                # of serializing scatter -> gather -> einsum per layer
+                h, cache = forward_chunk(
                     params, cfg, tokens, positions, cache, tables,
-                    mesh=self.mesh, hidden_only=True,
+                    hidden_only=True,
                 )
             hs = h[jnp.arange(S), jnp.clip(sample_at, 0)]  # [S, E]
             sel = lm_head(params, cfg, hs)  # [S, V]

@@ -46,6 +46,9 @@ def config_from_card(card: ModelDeploymentCard, dtype: Any = jnp.bfloat16) -> Ll
         # qwen2 attention carries q/k/v biases (HF config doesn't flag it;
         # the architecture implies it)
         qkv_bias=mc.get("model_type") == "qwen2",
+        # mixtral family: sparse MoE MLP, experts over the ep mesh axis
+        num_experts=int(mc.get("num_local_experts", 0)),
+        num_experts_per_tok=int(mc.get("num_experts_per_tok", 2)),
         dtype=dtype,
     )
 
@@ -76,6 +79,52 @@ def load_params(card: ModelDeploymentCard, config: LlamaConfig, seed: int = 0):
         logger.info("no safetensors found for %s: random-initializing", card.display_name)
         return init_params(jax.random.PRNGKey(seed), config)
     return params_from_hf(tensors, config)
+
+
+def _mlp_weights(tensors: Dict[str, np.ndarray], c: LlamaConfig) -> Dict[str, Any]:
+    """Dense llama/qwen2 MLP or mixtral sparse-MoE expert weights, stacked
+    [L, ...] (and [L, X, ...] over experts). HF mixtral names:
+    block_sparse_moe.gate (router) + experts.M.{w1,w3,w2} = gate/up/down."""
+    dt = c.dtype
+
+    def lin(name: str) -> np.ndarray:
+        return np.ascontiguousarray(tensors[name].T)
+
+    if c.num_experts > 1:
+        def experts(fmt: str) -> jnp.ndarray:
+            return jnp.asarray(
+                np.stack([
+                    np.stack([
+                        lin(fmt.format(i, x)) for x in range(c.num_experts)
+                    ])
+                    for i in range(c.num_layers)
+                ]),
+                dt,
+            )
+
+        return {
+            "moe_router": jnp.asarray(
+                np.stack([
+                    lin(f"model.layers.{i}.block_sparse_moe.gate.weight")
+                    for i in range(c.num_layers)
+                ]),
+                jnp.float32,
+            ),
+            "w_gate": experts("model.layers.{}.block_sparse_moe.experts.{}.w1.weight"),
+            "w_up": experts("model.layers.{}.block_sparse_moe.experts.{}.w3.weight"),
+            "w_down": experts("model.layers.{}.block_sparse_moe.experts.{}.w2.weight"),
+        }
+    return {
+        "w_gate": jnp.asarray(
+            np.stack([lin(f"model.layers.{i}.mlp.gate_proj.weight") for i in range(c.num_layers)]), dt
+        ),
+        "w_up": jnp.asarray(
+            np.stack([lin(f"model.layers.{i}.mlp.up_proj.weight") for i in range(c.num_layers)]), dt
+        ),
+        "w_down": jnp.asarray(
+            np.stack([lin(f"model.layers.{i}.mlp.down_proj.weight") for i in range(c.num_layers)]), dt
+        ),
+    }
 
 
 def params_from_hf(tensors: Dict[str, np.ndarray], config: LlamaConfig):
@@ -126,9 +175,7 @@ def params_from_hf(tensors: Dict[str, np.ndarray], config: LlamaConfig):
                 np.stack([get(f"model.layers.{i}.post_attention_layernorm.weight") for i in range(c.num_layers)]),
                 jnp.float32,
             ),
-            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight", lin),
-            "w_up": stack("model.layers.{}.mlp.up_proj.weight", lin),
-            "w_down": stack("model.layers.{}.mlp.down_proj.weight", lin),
+            **_mlp_weights(tensors, c),
         },
     }
     if not c.tie_embeddings:

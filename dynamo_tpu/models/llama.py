@@ -42,6 +42,11 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     tie_embeddings: bool = False
     qkv_bias: bool = False  # qwen2-family attention biases
+    # sparse MoE MLP (mixtral family): > 1 activates ops/moe.py in every
+    # serving path's MLP block; 0/1 = dense MLP
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    expert_capacity_factor: float = 2.0  # serving: generous, rare drops
     dtype: Any = jnp.bfloat16
 
     @property
@@ -80,6 +85,17 @@ LLAMA_PRESETS: Dict[str, LlamaConfig] = {
         rope_theta=1000000.0, rms_norm_eps=1e-6, qkv_bias=True,
         tie_embeddings=True,
     ),
+    # mixtral family: llama attention + sparse MoE MLP (expert parallel)
+    "tiny-moe": LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16, rope_theta=10000.0,
+        num_experts=4, num_experts_per_tok=2,
+    ),
+    "mixtral-8x7b": LlamaConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+        rope_theta=1000000.0, num_experts=8, num_experts_per_tok=2,
+    ),
 }
 
 
@@ -94,6 +110,20 @@ def init_params(rng: jax.Array, config: LlamaConfig) -> Params:
         return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(c.dtype)
 
     L, E, F = c.num_layers, c.hidden_size, c.intermediate_size
+    if c.num_experts > 1:  # sparse MoE MLP: per-expert FFN + router
+        X = c.num_experts
+        mlp_weights = {
+            "moe_router": dense(keys[5], (L, E, X), E).astype(jnp.float32),
+            "w_gate": dense(keys[6], (L, X, E, F), E),
+            "w_up": dense(keys[7], (L, X, E, F), E),
+            "w_down": dense(jax.random.fold_in(rng, 42), (L, X, F, E), F),
+        }
+    else:
+        mlp_weights = {
+            "w_gate": dense(keys[5], (L, E, F), E),
+            "w_up": dense(keys[6], (L, E, F), E),
+            "w_down": dense(keys[7], (L, F, E), F),
+        }
     params: Params = {
         "embed": dense(keys[0], (c.vocab_size, E), E),
         "final_norm": jnp.ones((E,), jnp.float32),
@@ -104,9 +134,7 @@ def init_params(rng: jax.Array, config: LlamaConfig) -> Params:
             "wv": dense(keys[3], (L, E, c.kv_dim), E),
             "wo": dense(keys[4], (L, c.q_dim, E), c.q_dim),
             "mlp_norm": jnp.ones((L, E), jnp.float32),
-            "w_gate": dense(keys[5], (L, E, F), E),
-            "w_up": dense(keys[6], (L, E, F), E),
-            "w_down": dense(keys[7], (L, F, E), F),
+            **mlp_weights,
         },
     }
     if c.qkv_bias:
@@ -131,9 +159,21 @@ def param_logical_axes(config: LlamaConfig) -> Params:
             "wv": ("layers", "embed", "kv_heads"),
             "wo": ("layers", "heads", "embed"),
             "mlp_norm": ("layers", "embed"),
-            "w_gate": ("layers", "embed", "mlp"),
-            "w_up": ("layers", "embed", "mlp"),
-            "w_down": ("layers", "mlp", "embed"),
+            **(
+                {
+                    # MoE: experts shard over ep, FFN width over tp
+                    "moe_router": ("layers", "embed", None),
+                    "w_gate": ("layers", "experts", "embed", "mlp"),
+                    "w_up": ("layers", "experts", "embed", "mlp"),
+                    "w_down": ("layers", "experts", "mlp", "embed"),
+                }
+                if config.num_experts > 1
+                else {
+                    "w_gate": ("layers", "embed", "mlp"),
+                    "w_up": ("layers", "embed", "mlp"),
+                    "w_down": ("layers", "mlp", "embed"),
+                }
+            ),
         },
     }
     if config.qkv_bias:
@@ -188,6 +228,59 @@ def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return rotated.astype(x.dtype)
 
 
+def project_qkv(
+    lp: Params, c: LlamaConfig, hidden: jax.Array, positions: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared attention-input block: pre-norm, Q/K/V projections (+ qwen2
+    biases), head reshape, rope. One implementation for every layer body
+    (decode window, prefill chunk, sp chunk, pipeline stage) so the paths
+    cannot drift."""
+    b, t = positions.shape
+    x = rms_norm(hidden, lp["attn_norm"], c.rms_norm_eps)
+    q, k, v = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
+    if c.qkv_bias:
+        q = q + lp["bq"].astype(q.dtype)
+        k = k + lp["bk"].astype(k.dtype)
+        v = v + lp["bv"].astype(v.dtype)
+    q = q.reshape(b, t, c.num_heads, c.head_dim)
+    k = k.reshape(b, t, c.num_kv_heads, c.head_dim)
+    v = v.reshape(b, t, c.num_kv_heads, c.head_dim)
+    q = apply_rope(q, positions, c.rope_theta)
+    k = apply_rope(k, positions, c.rope_theta)
+    return q, k, v
+
+
+def mlp_block(
+    lp: Params, c: LlamaConfig, hidden: jax.Array, positions: jax.Array
+) -> jax.Array:
+    """Shared MLP block (post-norm + FFN + residual): dense silu-gate, or
+    the sparse MoE FFN (ops/moe.py, experts over the ep mesh axis) when the
+    config declares experts — every serving path gets MoE for free.
+    ``positions`` (< 0 = padding) masks padding tokens out of MoE routing
+    so they cannot consume expert capacity ahead of real tokens."""
+    x = rms_norm(hidden, lp["mlp_norm"], c.rms_norm_eps)
+    if c.num_experts > 1:
+        from dynamo_tpu.ops.moe import MoeConfig, moe_mlp
+
+        mcfg = MoeConfig(
+            hidden_size=c.hidden_size,
+            intermediate_size=c.intermediate_size,
+            num_experts=c.num_experts,
+            top_k=c.num_experts_per_tok,
+            capacity_factor=c.expert_capacity_factor,
+        )
+        moe_params = {
+            "router": lp["moe_router"],
+            "w_gate": lp["w_gate"],
+            "w_up": lp["w_up"],
+            "w_down": lp["w_down"],
+        }
+        out, _aux = moe_mlp(moe_params, mcfg, x, token_valid=positions >= 0)
+        return hidden + out.astype(hidden.dtype)
+    gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+    return hidden + (gate * (x @ lp["w_up"])) @ lp["w_down"]
+
+
 # -- forward -----------------------------------------------------------------
 
 def decoder_layer(
@@ -212,30 +305,14 @@ def decoder_layer(
     c = config
     b, t = positions.shape
 
-    x = rms_norm(hidden, lp["attn_norm"], c.rms_norm_eps)
-    q, k, v = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
-    if c.qkv_bias:
-        q = q + lp["bq"].astype(q.dtype)
-        k = k + lp["bk"].astype(k.dtype)
-        v = v + lp["bv"].astype(v.dtype)
-    q = q.reshape(b, t, c.num_heads, c.head_dim)
-    k = k.reshape(b, t, c.num_kv_heads, c.head_dim)
-    v = v.reshape(b, t, c.num_kv_heads, c.head_dim)
-    q = apply_rope(q, positions, c.rope_theta)
-    k = apply_rope(k, positions, c.rope_theta)
-
+    q, k, v = project_qkv(lp, c, hidden, positions)
     k_page, v_page = write_kv_to_pages(k_page, v_page, k, v, positions, block_tables)
     attn = paged_attention(
         q, k_page, v_page, block_tables, positions, soft_cap=soft_cap,
         use_pallas=use_pallas, mesh=mesh,
     )
-    attn = attn.reshape(b, t, c.q_dim) @ lp["wo"]
-    hidden = hidden + attn
-
-    x = rms_norm(hidden, lp["mlp_norm"], c.rms_norm_eps)
-    gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-    mlp = (gate * (x @ lp["w_up"])) @ lp["w_down"]
-    return hidden + mlp, k_page, v_page
+    hidden = hidden + attn.reshape(b, t, c.q_dim) @ lp["wo"]
+    return mlp_block(lp, c, hidden, positions), k_page, v_page
 
 
 def lm_head(params: Params, config: LlamaConfig, h: jax.Array) -> jax.Array:
@@ -441,18 +518,7 @@ def forward_window(
         hidden = carry
         b = hidden.shape[0]
 
-        x = rms_norm(hidden, lp["attn_norm"], c.rms_norm_eps)
-        q, k, v = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
-        if c.qkv_bias:
-            q = q + lp["bq"].astype(q.dtype)
-            k = k + lp["bk"].astype(k.dtype)
-            v = v + lp["bv"].astype(v.dtype)
-        q = q.reshape(b, 1, c.num_heads, c.head_dim)
-        k = k.reshape(b, 1, c.num_kv_heads, c.head_dim)
-        v = v.reshape(b, 1, c.num_kv_heads, c.head_dim)
-        q = apply_rope(q, pos2, c.rope_theta)
-        k = apply_rope(k, pos2, c.rope_theta)
-
+        q, k, v = project_qkv(lp, c, hidden, pos2)
         wk = jax.lax.dynamic_update_slice(wk, k, (0, wslot, 0, 0))
         wv = jax.lax.dynamic_update_slice(wv, v, (0, wslot, 0, 0))
         if mode == "dense":
@@ -465,11 +531,7 @@ def forward_window(
                 mesh, interpret,
             )
         hidden = hidden + attn.reshape(b, 1, c.q_dim) @ lp["wo"]
-
-        x = rms_norm(hidden, lp["mlp_norm"], c.rms_norm_eps)
-        gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-        mlp = (gate * (x @ lp["w_up"])) @ lp["w_down"]
-        return hidden + mlp, (wk, wv)
+        return mlp_block(lp, c, hidden, pos2), (wk, wv)
 
     h, (new_wk, new_wv) = jax.lax.scan(
         layer_body, h,
@@ -511,6 +573,111 @@ def _history_partial(
         m.reshape(b, h, t),
         l.reshape(b, h, t),
     )
+
+
+def _chunk_self_partial(
+    c: LlamaConfig,
+    q: jax.Array,  # [B, T, H, D] (rope applied)
+    k: jax.Array,  # [B, T, KVH, D] this chunk's fresh keys (rope applied)
+    v: jax.Array,
+    positions: jax.Array,  # [B, T]; < 0 = padding
+    scale: float,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Flash partial of chunk queries against the chunk's OWN keys (causal
+    by position): (numerator [B,T,H,D] f32, max [B,H,T], denom [B,H,T])."""
+    b, t, h, d = q.shape
+    kvh = k.shape[2]
+    g = h // kvh
+    qg = q.reshape(b, t, kvh, g, d)
+    scores = jnp.einsum(
+        "btngd,bsnd->bngts", qg, k, preferred_element_type=jnp.float32
+    ) * scale  # [B, KVH, G, T, T]
+    causal = positions[:, None, :] <= positions[:, :, None]  # kv_pos <= q_pos
+    valid = (positions >= 0)[:, :, None] & (positions >= 0)[:, None, :]
+    mask = (causal & valid)[:, None, None, :, :]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    m = jnp.maximum(scores.max(axis=-1), -1e30)
+    p = jnp.exp(scores - m[..., None])
+    num = jnp.einsum("bngts,bsnd->btngd", p, v.astype(jnp.float32))
+    return (
+        num.reshape(b, t, h, d),
+        m.reshape(b, h, t),
+        p.sum(axis=-1).reshape(b, h, t),
+    )
+
+
+def forward_chunk(
+    params: Params,
+    config: LlamaConfig,
+    tokens: jax.Array,  # [B, C] int32
+    positions: jax.Array,  # [B, C]; < 0 = padding
+    kv_cache: KVCache,
+    block_tables: jax.Array,  # [B, MB]
+    *,
+    hidden_only: bool = False,
+) -> Tuple[jax.Array, KVCache]:
+    """Prefill-chunk forward with the history/fresh attention split — the
+    same contract as :func:`forward`, restructured for the TPU scheduler.
+
+    :func:`forward` scatters the chunk's K/V into pages and then gathers
+    them back for attention, chaining scatter → gather → einsum on every
+    layer's critical path. Here attention = flash-merge of a pool-history
+    partial (pages < each lane's chunk start — by construction everything
+    already flushed) with an in-chunk causal partial over the fresh K/V in
+    hand, so the page scatter (still needed for later chunks/decode) runs
+    OFF the critical path, concurrent with the attention math."""
+    c = config
+    scale = c.head_dim ** -0.5
+    h = params["embed"][jnp.clip(tokens, 0)]  # [B, C, E]
+    chunk_start = jnp.where(positions[:, 0] >= 0, positions[:, 0], 0)  # [B]
+
+    def layer_body(carry, xs):
+        lp, k_page, v_page = xs
+        hidden = carry
+        b, t = positions.shape
+
+        q, k, v = project_qkv(lp, c, hidden, positions)
+
+        from dynamo_tpu.ops.attention import gather_pages, write_kv_to_pages
+
+        new_k, new_v = write_kv_to_pages(
+            k_page, v_page, k, v, positions, block_tables
+        )
+        # history partial reads the PRE-SCATTER pool: masked to
+        # < chunk_start, those pages are identical either way, and using
+        # the old buffers keeps the gather independent of the scatter
+        gk = gather_pages(k_page, block_tables)
+        gv = gather_pages(v_page, block_tables)
+        num_h, m_h, l_h = _history_partial(
+            c, q, gk, gv, chunk_start, positions, scale
+        )
+        num_s, m_s, l_s = _chunk_self_partial(c, q, k, v, positions, scale)
+
+        m_t = jnp.maximum(m_h, m_s)
+        a_h = jnp.exp(m_h - m_t)
+        a_s = jnp.exp(m_s - m_t)
+        den = a_h * l_h + a_s * l_s
+        num = (
+            num_h * a_h.transpose(0, 2, 1)[..., None]
+            + num_s * a_s.transpose(0, 2, 1)[..., None]
+        )
+        attn = jnp.where(
+            (den > 0.0).transpose(0, 2, 1)[..., None],
+            num / jnp.maximum(den, 1e-30).transpose(0, 2, 1)[..., None],
+            0.0,
+        ).astype(hidden.dtype)
+
+        hidden = hidden + attn.reshape(b, t, c.q_dim) @ lp["wo"]
+        return mlp_block(lp, c, hidden, positions), (new_k, new_v)
+
+    h, (new_k, new_v) = jax.lax.scan(
+        layer_body, h, (params["layers"], kv_cache["k"], kv_cache["v"])
+    )
+    h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
+    cache = {"k": new_k, "v": new_v}
+    if hidden_only:
+        return h, cache
+    return lm_head(params, c, h), cache
 
 
 def forward_chunk_sp(
@@ -555,18 +722,7 @@ def forward_chunk_sp(
         hidden = carry
         b, t = positions.shape
 
-        x = rms_norm(hidden, lp["attn_norm"], c.rms_norm_eps)
-        q, k, v = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
-        if c.qkv_bias:
-            q = q + lp["bq"].astype(q.dtype)
-            k = k + lp["bk"].astype(k.dtype)
-            v = v + lp["bv"].astype(v.dtype)
-        q = q.reshape(b, t, c.num_heads, c.head_dim)
-        k = k.reshape(b, t, c.num_kv_heads, c.head_dim)
-        v = v.reshape(b, t, c.num_kv_heads, c.head_dim)
-        q = apply_rope(q, positions, c.rope_theta)
-        k = apply_rope(k, positions, c.rope_theta)
-
+        q, k, v = project_qkv(lp, c, hidden, positions)
         k_page, v_page = write_kv_to_pages(
             k_page, v_page, k, v, positions, block_tables
         )
@@ -599,10 +755,7 @@ def forward_chunk_sp(
         ).astype(hidden.dtype)
 
         hidden = hidden + attn.reshape(b, t, c.q_dim) @ lp["wo"]
-        x = rms_norm(hidden, lp["mlp_norm"], c.rms_norm_eps)
-        gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-        mlp = (gate * (x @ lp["w_up"])) @ lp["w_down"]
-        return hidden + mlp, (k_page, v_page)
+        return mlp_block(lp, c, hidden, positions), (k_page, v_page)
 
     h, (new_k, new_v) = jax.lax.scan(
         layer_body, h, (params["layers"], kv_cache["k"], kv_cache["v"])

@@ -1,0 +1,169 @@
+"""Sparse mixture-of-experts MLP with expert parallelism over the ``ep``
+mesh axis.
+
+GShard/Switch-style static dispatch, which is the TPU-native shape for
+MoE: top-k routing becomes a one-hot dispatch tensor with a fixed per-
+expert capacity, expert batches form via einsum (no dynamic shapes, no
+host control flow), each expert's FFN runs with the expert axis sharded
+over ``ep`` (XLA inserts the all-to-alls at the dispatch/combine
+einsums), and outputs recombine weighted by the router probabilities.
+Tokens overflowing an expert's capacity fall through with zero
+contribution from that expert (standard capacity-factor semantics).
+
+The reference has NO expert parallelism (SURVEY.md §2.12: EP absent —
+a DeepSeek config tweak only); this module is the TPU-native extension
+completing the dp/pp/tp/sp/ep mesh story. Sharding follows the standard
+recipe: annotate the expert axis (parallel/mesh.py logical rule
+``experts`` → ep), let GSPMD place the collectives.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MoeConfig:
+    hidden_size: int
+    intermediate_size: int  # per-expert FFN width
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+
+    def capacity(self, n_tokens: int) -> int:
+        """Static per-expert token capacity for an n_tokens batch."""
+        c = math.ceil(n_tokens * self.top_k / self.num_experts * self.capacity_factor)
+        return max(self.top_k, c)
+
+
+def init_moe_params(rng: jax.Array, cfg: MoeConfig, dtype=jnp.bfloat16) -> Dict[str, Any]:
+    e, f, x = cfg.hidden_size, cfg.intermediate_size, cfg.num_experts
+    ks = jax.random.split(rng, 4)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(dtype)
+
+    return {
+        "router": dense(ks[0], (e, x), e).astype(jnp.float32),
+        "w_gate": dense(ks[1], (x, e, f), e),
+        "w_up": dense(ks[2], (x, e, f), e),
+        "w_down": dense(ks[3], (x, f, e), f),
+    }
+
+
+def moe_param_logical_axes() -> Dict[str, Tuple[Optional[str], ...]]:
+    """Logical sharding per leaf (resolved by parallel/mesh.py): the expert
+    axis shards over ep, the FFN width over tp — ep × tp compose."""
+    return {
+        "router": ("embed", None),  # tiny; replicated
+        "w_gate": ("experts", "embed", "mlp"),
+        "w_up": ("experts", "embed", "mlp"),
+        "w_down": ("experts", "mlp", "embed"),
+    }
+
+
+def moe_mlp(
+    params: Dict[str, Any],
+    cfg: MoeConfig,
+    x: jax.Array,  # [B, T, E]
+    *,
+    router_noise_key: Optional[jax.Array] = None,
+    token_valid: Optional[jax.Array] = None,  # [B, T] bool; None = all valid
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Sparse MoE FFN. Returns (output [B, T, E], aux) where aux carries the
+    load-balancing loss term and routing stats.
+
+    ``router_noise_key`` adds train-time exploration noise; None (serving)
+    routes deterministically. ``token_valid`` masks padding tokens OUT of
+    routing entirely — the serving engine's batches are padded to static
+    shapes, and identically-zero padding rows would otherwise all route to
+    the same experts and burn their capacity ahead of real tokens (dropping
+    real tokens' expert contributions).
+    """
+    b, t, e = x.shape
+    n = b * t
+    xe = cfg.num_experts
+    cap = cfg.capacity(n)
+    xt = x.reshape(n, e)
+
+    logits = (xt.astype(jnp.float32)) @ params["router"]  # [N, X]
+    if router_noise_key is not None:
+        logits = logits + jax.random.normal(router_noise_key, logits.shape) * 0.01
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # top-k expert choices per token, renormalized over the chosen experts
+    top_p, top_idx = jax.lax.top_k(probs, cfg.top_k)  # [N, K]
+    top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
+
+    # position of each (token, choice) within its expert's capacity:
+    # one-hot over experts per choice rank, cumsum over tokens. Later
+    # choice ranks stack after earlier ones (k-major ordering).
+    onehot = jax.nn.one_hot(top_idx, xe, dtype=jnp.int32)  # [N, K, X]
+    if token_valid is not None:
+        valid_n = token_valid.reshape(n).astype(jnp.int32)
+        onehot = onehot * valid_n[:, None, None]  # padding claims no slot
+    prio = onehot.transpose(1, 0, 2).reshape(cfg.top_k * n, xe)  # k-major
+    pos_flat = jnp.cumsum(prio, axis=0) - prio  # arrival index per expert
+    pos = pos_flat.reshape(cfg.top_k, n, xe).transpose(1, 0, 2)  # [N, K, X]
+    within = (pos < cap) & (onehot > 0)
+
+    # dispatch [N, X, C]: routes token n to its expert slot; combine adds
+    # the router weight
+    slot = jnp.where(within, pos, cap)  # [N, K, X]; cap = dropped
+    disp_k = jax.nn.one_hot(slot, cap + 1, dtype=jnp.float32)[..., :cap]  # [N,K,X,C]
+    dispatch = disp_k.sum(axis=1)  # [N, X, C] (an expert appears once per token)
+    combine = (disp_k * top_p[:, :, None, None]).sum(axis=1)  # [N, X, C]
+
+    # expert batches; the X axis is sharded over ep (GSPMD all-to-all)
+    expert_in = jnp.einsum("nxc,ne->xce", dispatch.astype(x.dtype), xt)
+    gate = jax.nn.silu(
+        jnp.einsum("xce,xef->xcf", expert_in, params["w_gate"]).astype(jnp.float32)
+    ).astype(x.dtype)
+    up = jnp.einsum("xce,xef->xcf", expert_in, params["w_up"])
+    expert_out = jnp.einsum("xcf,xfe->xce", gate * up, params["w_down"])
+
+    out = jnp.einsum("nxc,xce->ne", combine.astype(x.dtype), expert_out)
+
+    # GShard aux loss: mean fraction routed x mean router prob, per expert
+    frac = onehot.sum(axis=1).astype(jnp.float32).mean(axis=0)  # [X]
+    imp = probs.mean(axis=0)
+    routed = within.any(axis=-1).astype(jnp.float32)  # [N, K]
+    if token_valid is not None:
+        vf = token_valid.reshape(n).astype(jnp.float32)
+        n_valid = jnp.maximum(vf.sum() * cfg.top_k, 1.0)
+        dropped = 1.0 - (routed * vf[:, None]).sum() / n_valid
+    else:
+        dropped = 1.0 - routed.mean()
+    aux = {
+        "load_balancing_loss": (frac * imp).sum() * xe,
+        "dropped_fraction": dropped,
+    }
+    return out.reshape(b, t, e), aux
+
+
+def moe_mlp_reference(params, cfg: MoeConfig, x: jax.Array) -> jax.Array:
+    """Dense per-token reference (no capacity, no drops) for parity tests:
+    every token gets its exact top-k mixture."""
+    b, t, e = x.shape
+    xt = x.reshape(-1, e)
+    logits = xt.astype(jnp.float32) @ params["router"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_idx = jax.lax.top_k(probs, cfg.top_k)
+    top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
+
+    def ffn(xe_, wi):  # all experts for one token, then select
+        gate = jax.nn.silu(
+            jnp.einsum("e,xef->xf", xe_, params["w_gate"]).astype(jnp.float32)
+        ).astype(x.dtype)
+        up = jnp.einsum("e,xef->xf", xe_, params["w_up"])
+        return jnp.einsum("xf,xfe->xe", gate * up, params["w_down"])
+
+    all_out = jax.vmap(ffn, in_axes=(0, None))(xt, None)  # [N, X, E]
+    sel = jnp.take_along_axis(all_out, top_idx[:, :, None], axis=1)  # [N, K, E]
+    out = (sel * top_p[:, :, None].astype(x.dtype)).sum(axis=1)
+    return out.reshape(b, t, e)

@@ -10,6 +10,8 @@ engines, SURVEY.md §2.12 — here they are native):
 - ``sp``: sequence/context parallel — ring-attention axis for long context
   (parallel/ring_attention.py; a TPU-native extension — the reference has
   none, SURVEY.md §2.12)
+- ``ep``: expert parallel — MoE expert axis (ops/moe.py GShard-style
+  dispatch/combine; the reference has no EP either, SURVEY.md §2.12)
 
 The design follows the standard JAX recipe: pick a mesh, annotate shardings
 with PartitionSpec, let XLA insert the collectives over ICI.
@@ -28,6 +30,7 @@ AXIS_DP = "dp"
 AXIS_PP = "pp"
 AXIS_TP = "tp"
 AXIS_SP = "sp"
+AXIS_EP = "ep"
 
 
 @dataclass(frozen=True)
@@ -38,18 +41,19 @@ class MeshConfig:
     pp: int = 1
     tp: int = 1
     sp: int = 1
+    ep: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.pp * self.tp * self.sp
+        return self.dp * self.pp * self.tp * self.sp * self.ep
 
     @property
     def axis_names(self) -> Tuple[str, ...]:
-        return (AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP)
+        return (AXIS_DP, AXIS_PP, AXIS_SP, AXIS_EP, AXIS_TP)
 
     @property
     def shape(self) -> Tuple[int, ...]:
-        return (self.dp, self.pp, self.sp, self.tp)
+        return (self.dp, self.pp, self.sp, self.ep, self.tp)
 
 
 def make_mesh(config: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -77,6 +81,7 @@ _LOGICAL_RULES = {
     "kv_heads": AXIS_TP,  # attention kv heads (GQA)
     "mlp": AXIS_TP,  # MLP intermediate dim
     "vocab": AXIS_TP,  # embedding/unembedding vocab dim
+    "experts": AXIS_EP,  # MoE expert axis (ops/moe.py)
     "embed": None,  # model dim: replicated (Megatron-style TP)
     "kv_blocks": None,  # paged-KV physical block axis: replicated across tp
 }

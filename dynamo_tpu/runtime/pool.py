@@ -23,6 +23,14 @@ from typing import Any, Callable, Deque, Generic, Optional, TypeVar
 T = TypeVar("T")
 
 
+def _finalize_shared(pool: "Pool", value, state: dict) -> None:
+    # no lock: the finalizer only runs once the handle is unreachable, so
+    # no release() can race it
+    if not state["returned"]:
+        state["returned"] = True
+        pool._return_value(value)
+
+
 class PoolItem(Generic[T]):
     """A checked-out item; returns to its pool on release (once)."""
 
@@ -56,23 +64,29 @@ class SharedPoolItem(Generic[T]):
         self.value = value
         self._lock = threading.Lock()
         self._refs = 1
-        self._returned = False
+        self._state = {"returned": False}
+        # leaked-handle guard: share() hands out THIS object, so if it is
+        # garbage collected nobody can ever release — force-return then
+        self._finalizer = weakref.finalize(
+            self, _finalize_shared, pool, value, self._state
+        )
 
     def share(self) -> "SharedPoolItem[T]":
         with self._lock:
-            if self._returned:
+            if self._state["returned"]:
                 raise RuntimeError("cannot share a fully-released item")
             self._refs += 1
         return self
 
     def release(self) -> None:
         with self._lock:
-            if self._returned:
+            if self._state["returned"]:
                 return
             self._refs -= 1
             if self._refs > 0:
                 return
-            self._returned = True
+            self._state["returned"] = True
+        self._finalizer.detach()
         self._pool._return_value(self.value)
 
     def __enter__(self) -> T:
@@ -110,6 +124,9 @@ class Pool(Generic[T]):
         return SharedPoolItem(self, self._take(timeout))
 
     def _take(self, timeout: Optional[float]) -> T:
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
         with self._cond:
             while True:
                 if self._free:
@@ -117,7 +134,13 @@ class Pool(Generic[T]):
                 if self._max is None or self._live < self._max:
                     self._live += 1
                     break  # create outside the lock
-                if not self._cond.wait(timeout=timeout):
+                # wait on the REMAINING time: each wakeup can lose the freed
+                # value to another thread, and restarting the full timeout
+                # every time would let a contended acquire block unboundedly
+                remaining = None if deadline is None else deadline - _time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError("pool exhausted")
+                if not self._cond.wait(timeout=remaining):
                     raise TimeoutError("pool exhausted")
         try:
             return self._factory()

@@ -47,7 +47,6 @@ print(f"OK rank {rank}")
 
 @pytest.mark.timeout(120)
 def test_two_process_distributed_bringup(tmp_path):
-    port = socket.socket().getsockname()  # noqa: unused — pick a free port below
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     addr = f"127.0.0.1:{s.getsockname()[1]}"
