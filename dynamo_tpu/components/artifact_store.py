@@ -67,8 +67,12 @@ class ArtifactStore:
             "created_at": time.time(),
         }
         os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, "bundle.tar.gz"), "wb") as f:
+        # atomic: a re-POST of an existing digest must never let a reader
+        # stream a half-rewritten tarball
+        tmp_blob = os.path.join(path, ".bundle.tar.gz.tmp")
+        with open(tmp_blob, "wb") as f:
             f.write(blob)
+        os.replace(tmp_blob, os.path.join(path, "bundle.tar.gz"))
         # atomic rename: put_artifact runs on a worker thread, and a
         # concurrent list_artifacts on the event loop must never see a
         # half-written meta.json

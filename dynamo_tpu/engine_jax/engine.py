@@ -769,6 +769,7 @@ class JaxServingEngine(AsyncEngine):
                         and not any(self._slots)
                         and self._inflight is None
                         and not self._pending_spills
+                        and self._counts is None  # idle pass frees it first
                     ):
                         if self._awaiting:
                             # wake periodically to sweep remote-prefill timeouts
@@ -784,12 +785,18 @@ class JaxServingEngine(AsyncEngine):
                         return
                 self._run_posted()
                 self._sweep_remote_timeouts()
-                # idle = nothing to stall: drain spills fully so revisits
-                # after an idle gap see their prefixes in the host tier
-                self._harvest_spills(
-                    force=not self._pending and not any(self._slots)
+                idle = (
+                    not self._pending and not any(self._slots)
                     and self._inflight is None
                 )
+                # idle = nothing to stall: drain spills fully so revisits
+                # after an idle gap see their prefixes in the host tier,
+                # and drop the [S, V] penalty-count buffer (16 MB at a
+                # 128k vocab) a final dispatch with penalized lanes left
+                # allocated — no later dispatch would ever release it
+                self._harvest_spills(force=idle)
+                if idle:
+                    self._release_counts()
                 self._coalesce_admission_wave()
                 self._admit()
                 self._dispatch_step()

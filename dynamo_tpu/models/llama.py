@@ -626,6 +626,8 @@ def forward_chunk(
     already flushed) with an in-chunk causal partial over the fresh K/V in
     hand, so the page scatter (still needed for later chunks/decode) runs
     OFF the critical path, concurrent with the attention math."""
+    from dynamo_tpu.ops.attention import gather_pages, write_kv_to_pages
+
     c = config
     scale = c.head_dim ** -0.5
     h = params["embed"][jnp.clip(tokens, 0)]  # [B, C, E]
@@ -637,9 +639,6 @@ def forward_chunk(
         b, t = positions.shape
 
         q, k, v = project_qkv(lp, c, hidden, positions)
-
-        from dynamo_tpu.ops.attention import gather_pages, write_kv_to_pages
-
         new_k, new_v = write_kv_to_pages(
             k_page, v_page, k, v, positions, block_tables
         )

@@ -129,15 +129,20 @@ def moe_mlp(
 
     out = jnp.einsum("nxc,xce->ne", combine.astype(x.dtype), expert_out)
 
-    # GShard aux loss: mean fraction routed x mean router prob, per expert
-    frac = onehot.sum(axis=1).astype(jnp.float32).mean(axis=0)  # [X]
-    imp = probs.mean(axis=0)
+    # GShard aux loss: mean fraction routed x mean router prob, per expert —
+    # averaged over VALID tokens only (padding rows all route identically
+    # and would both dilute frac and skew imp toward the zero vector's
+    # favorite expert)
     routed = within.any(axis=-1).astype(jnp.float32)  # [N, K]
     if token_valid is not None:
         vf = token_valid.reshape(n).astype(jnp.float32)
-        n_valid = jnp.maximum(vf.sum() * cfg.top_k, 1.0)
-        dropped = 1.0 - (routed * vf[:, None]).sum() / n_valid
+        nv = jnp.maximum(vf.sum(), 1.0)
+        frac = onehot.sum(axis=1).astype(jnp.float32).sum(axis=0) / nv  # [X]
+        imp = (probs * vf[:, None]).sum(axis=0) / nv
+        dropped = 1.0 - (routed * vf[:, None]).sum() / (nv * cfg.top_k)
     else:
+        frac = onehot.sum(axis=1).astype(jnp.float32).mean(axis=0)  # [X]
+        imp = probs.mean(axis=0)
         dropped = 1.0 - routed.mean()
     aux = {
         "load_balancing_loss": (frac * imp).sum() * xe,

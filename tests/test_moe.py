@@ -55,11 +55,10 @@ def test_runs_on_ep_mesh_with_parity(params):
 
     for mesh_cfg in (MeshConfig(ep=2), MeshConfig(ep=2, tp=2)):
         mesh = make_mesh(mesh_cfg)
+        axes = moe_param_logical_axes()
         sharded = {
-            k: jax.device_put(v, logical_to_sharding(mesh, *ax))
-            for (k, ax), v in zip(
-                moe_param_logical_axes().items(), params.values()
-            )
+            k: jax.device_put(v, logical_to_sharding(mesh, *axes[k]))
+            for k, v in params.items()
         }
         got, _ = jax.jit(lambda p, x_: moe_mlp(p, CFG, x_))(sharded, x)
         np.testing.assert_allclose(

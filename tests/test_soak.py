@@ -120,3 +120,100 @@ def test_engine_soak_no_leaks(params, run):
         assert len(t1) == 4
     finally:
         eng.close()
+
+
+def test_engine_soak_deep_dispatch_windowed(params, run):
+    """Same invariants under the windowed-decode machinery's worst case:
+    dispatch depth (decode_steps) larger than most generations, so finishes
+    land mid-dispatch, the speculation guard and zombie window churn, and
+    window flushes interleave with preemptions, penalties, and async host
+    spills."""
+    cfg = EngineConfig(
+        max_slots=4, kv_block_size=8, max_model_len=96, num_kv_blocks=20,
+        prefill_chunk=16, decode_steps=8, host_cache_blocks=12,
+    )
+    eng = JaxServingEngine(CFG, params, cfg)
+    rng = random.Random(7)
+
+    async def one(i: int):
+        prompt = [rng.randrange(CFG.vocab_size) for _ in range(rng.randrange(3, 40))]
+        req = PreprocessedRequest(
+            token_ids=prompt,
+            stop_conditions=StopConditions(
+                max_tokens=rng.randrange(1, 20), ignore_eos=True
+            ),
+            sampling_options=SamplingOptions(
+                temperature=rng.choice([0.0, 0.8]),
+                seed=i,
+                frequency_penalty=rng.choice([None, 0.7]),
+                presence_penalty=rng.choice([None, 0.4]),
+            ),
+        )
+        ctx = Context(req)
+        n = 0
+        cancel_at = rng.randrange(1, 5) if rng.random() < 0.25 else None
+        gen = eng.generate(ctx)
+        try:
+            async for item in gen:
+                if item.is_error:
+                    return n
+                n += len((item.data or {}).get("token_ids", []))
+                if cancel_at is not None and n >= cancel_at:
+                    ctx.context.stop_generating()
+        finally:
+            await gen.aclose()
+        return n
+
+    async def soak():
+        total = 0
+        for wave in range(5):
+            results = await asyncio.gather(*[one(wave * 12 + i) for i in range(12)])
+            total += sum(results)
+        return total
+
+    try:
+        total = run(soak())
+        assert total > 0
+
+        async def settled():
+            for _ in range(100):
+                m = eng.metrics_snapshot()
+                if (
+                    m["request_active_slots"] == 0
+                    and m["num_requests_waiting"] == 0
+                    and eng._inflight is None
+                    and not eng._zombie_allocs
+                    and eng.allocator._refcount == {}
+                    and not eng._pending_spills
+                    and eng._counts is None  # released on the idle pass
+                ):
+                    return m
+                await asyncio.sleep(0.05)
+            return eng.metrics_snapshot()
+
+        m = run(settled())
+        assert m["request_active_slots"] == 0
+        assert eng.allocator._refcount == {}, (
+            f"leaked refcounts: {eng.allocator._refcount}"
+        )
+        assert not eng._pending_spills, "unharvested spills leaked"
+        assert not eng._held_allocs and not eng._hold_ids, "held pages leaked"
+        # penalty buffer released once no penalized lane runs
+        assert eng._counts is None, "penalty count buffer leaked"
+
+        async def probe():
+            req = PreprocessedRequest(
+                token_ids=[3, 1, 4, 1, 5],
+                stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+                sampling_options=SamplingOptions(temperature=0.0),
+            )
+            toks = []
+            async for item in eng.generate(Context(req)):
+                toks.extend((item.data or {}).get("token_ids", []))
+            return toks
+
+        a = run(probe())
+        b = run(probe())
+        assert a == b and len(a) == 4
+    finally:
+        eng.close()
