@@ -4,6 +4,8 @@
     python -m dynamo_tpu.cli.llmctl http add completion-models <name> <dyn://ns.comp.ep>
     python -m dynamo_tpu.cli.llmctl [--namespace ns] http list
     python -m dynamo_tpu.cli.llmctl http remove chat-models <name>
+    python -m dynamo_tpu.cli.llmctl disagg get
+    python -m dynamo_tpu.cli.llmctl disagg set --max-local-prefill-length 2000
 
 Writes/deletes ``{ns}/models/{kind}/{name}`` entries WITHOUT a lease (they
 outlive this process, like the reference's `for_cli` etcd config) so an
@@ -43,6 +45,15 @@ def build_parser() -> argparse.ArgumentParser:
     rm = verbs.add_parser("remove")
     rm.add_argument("list_name", choices=sorted(_KIND_BY_LIST))
     rm.add_argument("name")
+
+    disagg = sub.add_parser(
+        "disagg", help="live-tune conditional-disagg thresholds"
+    )
+    dverbs = disagg.add_subparsers(dest="verb", required=True)
+    dverbs.add_parser("get")
+    dset = dverbs.add_parser("set")
+    dset.add_argument("--max-local-prefill-length", type=int, default=None)
+    dset.add_argument("--max-prefill-queue-size", type=int, default=None)
     return p
 
 
@@ -57,6 +68,25 @@ async def amain(argv: list) -> int:
     url = args.statestore or os.environ.get("DYN_TPU_STATESTORE", "127.0.0.1:37901")
     store = await StateStoreClient.connect(url)
     try:
+        if args.plane == "disagg":
+            from dynamo_tpu.disagg.protocols import CONFIG_KEY, DisaggConfig
+
+            namespace = args.namespace or "dynamo"
+            key = f"{namespace}/{CONFIG_KEY}"
+            raw = await store.get(key)
+            cfg = DisaggConfig.from_dict(json.loads(raw)) if raw else DisaggConfig()
+            if args.verb == "set":
+                if args.max_local_prefill_length is not None:
+                    cfg.max_local_prefill_length = args.max_local_prefill_length
+                if args.max_prefill_queue_size is not None:
+                    cfg.max_prefill_queue_size = args.max_prefill_queue_size
+                # decode workers watch this key (disagg/router.py) and apply
+                # the new thresholds without restarting
+                await store.put(key, json.dumps(cfg.to_dict()).encode())
+                print(f"updated: {cfg.to_dict()}")
+            else:
+                print(json.dumps(cfg.to_dict()))
+            return 0
         if args.verb == "add":
             kind = _KIND_BY_LIST[args.list_name]
             ns, comp, ep = parse_endpoint_path(args.endpoint)

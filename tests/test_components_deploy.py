@@ -198,3 +198,51 @@ def test_pool_reset_failure_drops_value():
     assert calls  # reset ran
     assert pool.free_count == 0 and pool.live_count == 0
     pool.acquire(timeout=1.0)  # slot was freed: a new value can be created
+
+
+def test_llmctl_disagg_get_set_roundtrip(run, capsys):
+    """`llmctl disagg set` writes the watched config key; a live policy
+    picks the new thresholds up without restart (disagg/router.py)."""
+    import asyncio
+    import json as _json
+
+    from dynamo_tpu.cli.llmctl import amain
+    from dynamo_tpu.disagg.protocols import CONFIG_KEY, DisaggConfig
+    from dynamo_tpu.disagg.router import DisaggPolicy, watch_disagg_config
+    from dynamo_tpu.runtime.statestore import StateStoreClient, StateStoreServer
+
+    async def go():
+        ss = StateStoreServer(port=0)
+        await ss.start()
+        try:
+            policy = DisaggPolicy(
+                "e1", DisaggConfig(), enqueue=lambda r: None, queue_len=lambda: 0
+            )
+            store = await StateStoreClient.connect(ss.url)
+            watcher = asyncio.create_task(
+                watch_disagg_config(store, "dz", policy)
+            )
+            await asyncio.sleep(0.1)
+
+            rc = await amain([
+                "--statestore", ss.url, "--namespace", "dz",
+                "disagg", "set", "--max-local-prefill-length", "2222",
+            ])
+            assert rc == 0
+            for _ in range(50):
+                if policy.config.max_local_prefill_length == 2222:
+                    break
+                await asyncio.sleep(0.05)
+            assert policy.config.max_local_prefill_length == 2222
+
+            rc = await amain(["--statestore", ss.url, "--namespace", "dz",
+                              "disagg", "get"])
+            assert rc == 0
+            watcher.cancel()
+            await store.close()
+        finally:
+            await ss.stop()
+
+    run(go())
+    out = capsys.readouterr().out
+    assert '"max_local_prefill_length": 2222' in out
