@@ -133,24 +133,37 @@ class ArtifactStore:
             "config": config,
             "updated_at": time.time(),
         }
-        with open(self._deployment_path(name), "w") as f:
+        # tmp + rename (same pattern as put_artifact): a crash mid-write must
+        # not leave a truncated JSON that turns every list/get into a 500
+        path = self._deployment_path(name)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(dep, f)
+        os.replace(tmp, path)
         return dep
 
     def list_deployments(self) -> list:
         base = os.path.join(self.root, "deployments")
         out = []
         for fn in sorted(os.listdir(base)):
-            with open(os.path.join(base, fn)) as f:
-                out.append(json.load(f))
+            if not fn.endswith(".json"):
+                continue  # skip orphaned .tmp files from a crashed writer
+            try:
+                with open(os.path.join(base, fn)) as f:
+                    out.append(json.load(f))
+            except (json.JSONDecodeError, OSError):
+                continue  # a corrupt entry must not take the listing down
         return out
 
     def get_deployment(self, name: str) -> Optional[dict]:
         path = self._deployment_path(name)
         if not os.path.exists(path):
             return None
-        with open(path) as f:
-            return json.load(f)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (json.JSONDecodeError, OSError):
+            return None  # corrupt legacy entry → 404, consistent with listing
 
     def delete_deployment(self, name: str) -> bool:
         path = self._deployment_path(name)

@@ -280,8 +280,13 @@ async def run_prefill_worker(runtime, namespace: str, engine: PrefillEngine) -> 
                     )
                     from dynamo_tpu.kv.tokens import compute_block_hashes_for_seq
 
+                    # the registered hashes chain from the DECODE side's
+                    # salt (carried on the request) — using a local default
+                    # here would make every check fail under a salted
+                    # deployment, silently disabling the prefix read
                     expect = compute_block_hashes_for_seq(
-                        req.token_ids[: req.cached_tokens], engine.block_size
+                        req.token_ids[: req.cached_tokens], engine.block_size,
+                        salt=bytes.fromhex(req.salt_hex) if req.salt_hex else None,
                     )
                     if list(got_hashes) == list(expect):
                         prefix_kv = (k_pre, v_pre)

@@ -32,6 +32,12 @@ class RemotePrefillRequest:
     # history (reference: computed_block_ids + nixl read_blocks,
     # vllm_v0.7.2 patch remote_prefill.py / nixl.py:1067-1467)
     prefix_block_ids: List[int] = field(default_factory=list)
+    # hex of the decode-side allocator's block-hash salt ("" = unsalted).
+    # The prefix staleness check recomputes the decode side's registered
+    # hashes, which chain from ITS salt — without carrying it, a salted
+    # deployment would fail the check on every request and silently disable
+    # the prefix-read optimization (full recompute each time).
+    salt_hex: str = ""
 
     def to_dict(self) -> dict:
         return {
@@ -44,6 +50,7 @@ class RemotePrefillRequest:
             "block_size": self.block_size,
             "model": self.model,
             "prefix_block_ids": self.prefix_block_ids,
+            "salt_hex": self.salt_hex,
         }
 
     @classmethod
@@ -58,6 +65,7 @@ class RemotePrefillRequest:
             block_size=int(d.get("block_size", 0)),
             model=str(d.get("model", "")),
             prefix_block_ids=list(d.get("prefix_block_ids", [])),
+            salt_hex=str(d.get("salt_hex", "")),
         )
 
 

@@ -32,15 +32,20 @@ class DisaggPolicy:
         queue_len: Callable[[], int],
         block_size: int = 0,
         model: str = "",
+        salt: Optional[bytes] = None,
     ):
         """enqueue: thread-safe submit of a RemotePrefillRequest.
-        queue_len: cheap read of the (cached) prefill queue depth."""
+        queue_len: cheap read of the (cached) prefill queue depth.
+        salt: the decode engine allocator's block-hash salt, carried on the
+        wire so the prefill worker validates prefix pages against the same
+        hash chain."""
         self.engine_id = engine_id
         self.config = config
         self._enqueue = enqueue
         self._queue_len = queue_len
         self.block_size = block_size
         self.model = model
+        self.salt = salt
 
     # engine-thread side -------------------------------------------------------
 
@@ -63,6 +68,7 @@ class DisaggPolicy:
             block_size=self.block_size,
             model=self.model,
             prefix_block_ids=list(prefix_block_ids),
+            salt_hex=self.salt.hex() if self.salt else "",
         )
         self._enqueue(req)
 

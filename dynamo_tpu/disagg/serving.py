@@ -100,6 +100,7 @@ async def enable_disagg_decode(
         queue_len=lambda: depth[0],
         block_size=getattr(getattr(engine, "allocator", None), "block_size", 0),
         model=model,
+        salt=getattr(getattr(engine, "allocator", None), "salt", None),
     )
     engine.set_remote_prefill_policy(policy)
 

@@ -307,6 +307,12 @@ class JaxServingEngine(AsyncEngine):
         self._remote_policy: Optional[Any] = None
         self._awaiting: Dict[str, _Seq] = {}
         self._posted: Deque[Any] = deque()  # host fns to run on the engine thread
+        # serializes posted-callback execution once close() removes the
+        # engine thread as the single executor (post-close inline runs).
+        # Reentrant: a posted callback may itself post (e.g. a failed
+        # complete_remote_prefill falls back via fail_remote_prefill), and
+        # post-close that nested post runs inline on the same thread.
+        self._posted_exec_lock = threading.RLock()
 
         # prefill-worker mode: requests whose pages are parked on finish so
         # the worker can extract them (hold_pages / take_held_pages)
@@ -821,7 +827,12 @@ class JaxServingEngine(AsyncEngine):
                 self._posted.append(fn)
                 self._cond.notify()
                 return
-        fn()
+        # inline path: serialize against the step thread's shutdown drain and
+        # any other post-close caller — two teardown threads (e.g. concurrent
+        # transfer-plane _engine_calls) must not mutate allocator/cache state
+        # concurrently when the engine thread no longer serializes them
+        with self._posted_exec_lock:
+            fn()
 
     def _run_posted(self) -> None:
         while True:
@@ -829,7 +840,8 @@ class JaxServingEngine(AsyncEngine):
                 if not self._posted:
                     return
                 fn = self._posted.popleft()
-            fn()
+            with self._posted_exec_lock:
+                fn()
 
     # -- scheduling ----------------------------------------------------------
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import re
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -192,14 +193,20 @@ class CardStore:
         return purged
 
 
+_REPO_ID_PART = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+
 def looks_like_repo_id(spec: str) -> bool:
-    """``org/name`` (exactly one slash, no existing file/dir of that name)."""
-    return (
-        not os.path.exists(spec)
-        and spec.count("/") == 1
-        and not spec.startswith((".", "/", "~"))
-        and all(p for p in spec.split("/"))
-    )
+    """``org/name``: exactly one slash, hub-legal segments, and no existing
+    file/dir of that name. Deliberately cwd-independent beyond the existence
+    check — a dir named after the org must not shadow a valid hub id; the
+    mistyped-local-path case gets its clear error in :func:`resolve_repo`."""
+    if os.path.exists(spec) or spec.count("/") != 1:
+        return False
+    if spec.startswith((".", "/", "~")):
+        return False
+    org, name = spec.split("/")
+    return bool(_REPO_ID_PART.match(org) and _REPO_ID_PART.match(name))
 
 
 def resolve_repo(repo_id: str, revision: Optional[str] = None) -> str:
@@ -231,9 +238,22 @@ def resolve_repo(repo_id: str, revision: Optional[str] = None) -> str:
         )
     except Exception:
         pass
-    return snapshot_download(
-        repo_id, revision=revision, allow_patterns=patterns
-    )
+    try:
+        return snapshot_download(
+            repo_id, revision=revision, allow_patterns=patterns
+        )
+    except Exception as e:
+        # a failed hub fetch whose org segment exists as a local directory is
+        # almost certainly a mistyped relative path (e.g. models/llama) —
+        # surface that interpretation instead of a bare hub error
+        parent = repo_id.split("/")[0]
+        if os.path.isdir(parent):
+            raise FileNotFoundError(
+                f"{repo_id!r}: not found on the hub, and no local file "
+                f"{repo_id!r} exists (directory {parent!r} does — mistyped "
+                "local path?)"
+            ) from e
+        raise
 
 
 def _token_str(raw: Any) -> Optional[str]:
