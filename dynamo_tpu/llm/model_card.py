@@ -243,17 +243,39 @@ def resolve_repo(repo_id: str, revision: Optional[str] = None) -> str:
             repo_id, revision=revision, allow_patterns=patterns
         )
     except Exception as e:
-        # a failed hub fetch whose org segment exists as a local directory is
-        # almost certainly a mistyped relative path (e.g. models/llama) —
-        # surface that interpretation instead of a bare hub error
-        parent = repo_id.split("/")[0]
-        if os.path.isdir(parent):
+        # a NOT-FOUND hub answer for an id whose org segment exists as a
+        # local directory is almost certainly a mistyped relative path
+        # (e.g. models/llama) — surface that interpretation. Transient
+        # network/auth failures propagate untouched: rewriting those would
+        # mislead a user whose hub id is actually valid.
+        if _is_hub_not_found(e) and os.path.isdir(repo_id.split("/")[0]):
             raise FileNotFoundError(
                 f"{repo_id!r}: not found on the hub, and no local file "
-                f"{repo_id!r} exists (directory {parent!r} does — mistyped "
-                "local path?)"
+                f"{repo_id!r} exists (directory {repo_id.split('/')[0]!r} "
+                "does — mistyped local path?)"
             ) from e
         raise
+
+
+def _is_hub_not_found(e: Exception) -> bool:
+    try:
+        from huggingface_hub.utils import (
+            EntryNotFoundError,
+            LocalEntryNotFoundError,
+            RepositoryNotFoundError,
+            RevisionNotFoundError,
+        )
+    except ImportError:
+        return False
+    return isinstance(
+        e,
+        (
+            RepositoryNotFoundError,
+            RevisionNotFoundError,
+            EntryNotFoundError,
+            LocalEntryNotFoundError,
+        ),
+    )
 
 
 def _token_str(raw: Any) -> Optional[str]:

