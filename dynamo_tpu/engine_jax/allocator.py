@@ -50,6 +50,23 @@ class SequenceAllocation:
     # can't invalidate them). The engine must inject each into
     # block_ids[index] before any compute touches the sequence.
     host_hits: List[Tuple[int, int, Any, Any]] = field(default_factory=list)
+    # full-prompt block hashes this sequence advertised as in-flight (it will
+    # compute + seal them); unregistered on free if still unsealed
+    pending_hashes: List[int] = field(default_factory=list)
+
+
+class InflightPrefix:
+    """Returned by :meth:`BlockAllocator.allocate_sequence` when another live
+    sequence is currently computing this prompt's next prefix block: the
+    caller should keep the request pending and retry — once the owner seals
+    the shared blocks they become ordinary prefix-cache hits, so the shared
+    prefill is computed exactly once (reference: the reserved/shared in-flight
+    block registry, lib/llm/src/kv/reserved.rs:23-127)."""
+
+    __slots__ = ("seq_hash",)
+
+    def __init__(self, seq_hash: int):
+        self.seq_hash = seq_hash
 
 
 class HostKvPool:
@@ -121,9 +138,15 @@ class BlockAllocator:
         self._hash_of: Dict[int, int] = {}  # block id → sequence hash
         # refcount-0 blocks with valid contents, LRU order (oldest first)
         self._cached: "OrderedDict[int, None]" = OrderedDict()
+        # in-flight registry: sequence hash → physical page a live sequence
+        # is about to compute into. A concurrent request sharing that prefix
+        # waits for the seal instead of prefilling the same content twice.
+        self._inflight: Dict[int, int] = {}
         # counters for metrics
         self.hit_tokens = 0
         self.probe_tokens = 0
+        self.inflight_waits = 0  # admission deferrals onto an in-flight prefill
+        self.shared_prefill_tokens = 0  # tokens served by joining one
 
     def set_sink(self, sink: Optional[KvEventSink]) -> None:
         self._sink = sink
@@ -141,6 +164,11 @@ class BlockAllocator:
     def usage(self) -> float:
         return self.active_blocks / self.num_blocks if self.num_blocks else 0.0
 
+    def inflight_pending(self, seq_hash: int) -> bool:
+        """Is a live sequence still mid-prefill on this block hash? (Cheap
+        check a parked request uses to avoid re-probing its whole prompt.)"""
+        return seq_hash in self._inflight
+
     def hash_of_block(self, block_id: int) -> int:
         """Registered content hash of a physical page, or -1 (free/partial/
         reused pages have none)."""
@@ -155,12 +183,19 @@ class BlockAllocator:
 
     # -- allocation ----------------------------------------------------------
 
-    def allocate_sequence(self, token_ids: Sequence[int]) -> Optional[SequenceAllocation]:
+    def allocate_sequence(
+        self, token_ids: Sequence[int], wait_inflight: bool = True
+    ) -> Optional[SequenceAllocation]:
         """Allocate pages for a prompt, reusing prefix-cached blocks.
 
-        Returns None if not enough pages are available (caller re-queues).
-        The last prompt token is never served from cache: its logits are needed
-        to sample the first output token, so at least one position is computed.
+        Returns None if not enough pages are available (caller re-queues),
+        or an :class:`InflightPrefix` when ``wait_inflight`` and another live
+        sequence is mid-prefill on this prompt's next prefix block (caller
+        re-queues; after the owner seals, the retry turns into ordinary
+        prefix hits — one prefill compute for N concurrent identical
+        prefixes). The last prompt token is never served from cache: its
+        logits are needed to sample the first output token, so at least one
+        position is computed.
         """
         seq_hashes = compute_block_hashes_for_seq(token_ids, self.block_size, self.salt)
         self.probe_tokens += len(token_ids)
@@ -185,6 +220,13 @@ class BlockAllocator:
                     break
                 host_hits.append((j, seq_hashes[j], item[0], item[1]))
                 j += 1
+
+        # shared in-flight prefill: if the next missing block is being
+        # computed RIGHT NOW by a live sequence, don't prefill it again
+        j0 = len(reused) + len(host_hits)
+        if wait_inflight and j0 < max_cacheable and seq_hashes[j0] in self._inflight:
+            self.inflight_waits += 1
+            return InflightPrefix(seq_hashes[j0])
 
         # acquire matches FIRST so LRU eviction below can't reclaim them
         for bid in reused:
@@ -218,6 +260,15 @@ class BlockAllocator:
             parent = seq_hashes[host_hits[0][0] - 1] if host_hits[0][0] > 0 else None
             self._sink.blocks_stored(parent, stored)
 
+        # advertise the full-prompt blocks this sequence will compute so a
+        # concurrent request with the same prefix joins instead of recomputing
+        pending: List[int] = []
+        for idx in range(j0, len(seq_hashes)):
+            h = seq_hashes[idx]
+            if h not in self._by_hash and h not in self._inflight:
+                self._inflight[h] = block_ids[idx]
+                pending.append(h)
+
         # hashing state covers only tokens whose KV exists (the cached prefix);
         # note_tokens_computed extends it as prefill/decode computes the rest
         return SequenceAllocation(
@@ -228,6 +279,7 @@ class BlockAllocator:
             cached_tokens=cached_tokens,
             sealed_blocks=len(reused) + len(host_hits),
             host_hits=host_hits,
+            pending_hashes=pending,
         )
 
     def seed_cached(self, token_ids: Sequence[int]) -> List[Tuple[int, int]]:
@@ -306,6 +358,7 @@ class BlockAllocator:
         parent = sealed[0].parent_hash
         for blk in sealed:
             bid = alloc.block_ids[blk.position]
+            self._inflight.pop(blk.block_hash, None)  # promise fulfilled
             prior = self._hash_of.get(bid)
             if prior is not None and prior != blk.block_hash:
                 self._unregister(bid)
@@ -319,7 +372,14 @@ class BlockAllocator:
 
     def free_sequence(self, alloc: SequenceAllocation) -> None:
         """Release a finished sequence's pages. Hash-registered blocks become
-        reusable cache; unhashed (partial) blocks return to the free list."""
+        reusable cache; unhashed (partial) blocks return to the free list.
+        Unfulfilled in-flight promises are withdrawn so a waiting request
+        stops waiting and computes the prefix itself."""
+        own = set(alloc.block_ids)
+        for h in alloc.pending_hashes:
+            if self._inflight.get(h) in own:
+                self._inflight.pop(h, None)
+        alloc.pending_hashes = []
         for bid in alloc.block_ids:
             self._release_one(bid)
         alloc.block_ids = []

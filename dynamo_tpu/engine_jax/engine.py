@@ -42,6 +42,7 @@ import numpy as np
 from dynamo_tpu.engine_jax.allocator import (
     BlockAllocator,
     HostKvPool,
+    InflightPrefix,
     KvEventSink,
     SequenceAllocation,
 )
@@ -112,6 +113,10 @@ class EngineConfig:
     # the Pallas kernel streams live pages from HBM with zero extra
     # residency (the 70B/long-context regime). DYN_TPU_ATTENTION overrides.
     dense_history_max_bytes: int = 2 << 30
+    # weight-only quantization: "int8" halves the decode weight stream
+    # (per-output-channel absmax, models/llama.py quantize_params_int8).
+    # Single-chip path; mesh-sharded configs keep bf16.
+    quantize: Optional[str] = None
 
     def resolve_num_blocks(self) -> int:
         if self.num_kv_blocks is not None:
@@ -132,7 +137,7 @@ class _Seq:
         "generated", "emitted", "max_tokens", "eos_ids", "ignore_eos",
         "temperature", "top_k", "top_p", "seed", "logprobs", "enqueue_t",
         "first_token_t", "remote", "remote_deadline", "prefill_pos",
-        "freq_pen", "pres_pen", "out_tokens",
+        "freq_pen", "pres_pen", "out_tokens", "joined_inflight", "wait_hash",
     )
 
     def __init__(self, ctx: Context, request: PreprocessedRequest, loop) -> None:
@@ -167,6 +172,8 @@ class _Seq:
         self.first_token_t: Optional[float] = None
         self.remote = False  # prefill dispatched to a remote prefill worker
         self.remote_deadline: Optional[float] = None
+        self.joined_inflight = False  # parked behind a concurrent identical prefix
+        self.wait_hash: Optional[int] = None  # the in-flight hash it's parked on
         # next prompt position to compute while prefilling; None = decoding
         self.prefill_pos: Optional[int] = None
 
@@ -194,6 +201,27 @@ class _Seq:
 
 
 _FINISHED = object()  # sentinel closing a request's output queue
+
+
+class _DevMirror:
+    """Host→device upload cache: re-uploads only when the host array changed.
+
+    On a tunneled chip every `jnp.asarray` is a separate transfer with
+    fixed latency; the sampling vectors change only on lane changes, so in
+    steady-state decode they hit this cache every dispatch."""
+
+    __slots__ = ("_host", "_dev", "_put")
+
+    def __init__(self, put=None):
+        self._host: Optional[np.ndarray] = None
+        self._dev = None
+        self._put = put or jnp.asarray
+
+    def get(self, host_arr: np.ndarray):
+        if self._dev is None or not np.array_equal(self._host, host_arr):
+            self._host = host_arr.copy()
+            self._dev = self._put(host_arr)
+        return self._dev
 
 
 class _Inflight:
@@ -233,8 +261,24 @@ class JaxServingEngine(AsyncEngine):
     ):
         self.model_config = model_config
         self.config = engine_config
+        if engine_config.quantize == "int8":
+            if mesh is not None:
+                raise ValueError(
+                    "int8 weight quantization is single-chip only: the "
+                    "sharding specs describe the unquantized param tree"
+                )
+            from dynamo_tpu.models.llama import quantize_params_int8
+
+            params = quantize_params_int8(params, model_config)
+        elif engine_config.quantize:
+            raise ValueError(f"unknown quantize mode {engine_config.quantize!r}")
         self.params = params
         self.mesh = mesh
+        # multihost lockstep: every host array entering a global-mesh jit is
+        # built as a replicated global array (jnp.asarray cannot span
+        # processes); single-host configs take the plain path
+        self._multihost = mesh is not None and jax.process_count() > 1
+        self._dispatch_hook = None  # multihost leader: broadcast dispatches
         self.num_blocks = engine_config.resolve_num_blocks()
         self.host_pool = (
             HostKvPool(engine_config.host_cache_blocks)
@@ -247,21 +291,33 @@ class JaxServingEngine(AsyncEngine):
             offload=self._offload_blocks if self.host_pool is not None else None,
         )
 
-        cache = make_kv_cache(
-            model_config, self.num_blocks, engine_config.kv_block_size,
-            dtype=cache_dtype or model_config.dtype,
-        )
         # attention impl is auto-selected (platform + head-dim rule,
         # ops/attention.py); on a sharded cache the kernel runs per-tp-shard
         # under shard_map — `mesh` is passed into forward so the kernel tier
         # stays live in sharded (70B-path) configs instead of falling back
-        # to jnp
+        # to jnp. The pool is created ON-device via out_shardings (zeros
+        # never round-trip the host, and on a multi-process mesh each host
+        # materializes only its shards — device_put cannot span processes).
+        cshape = (
+            model_config.num_layers, self.num_blocks,
+            engine_config.kv_block_size, model_config.num_kv_heads,
+            model_config.head_dim,
+        )
+        cdtype = cache_dtype or model_config.dtype
         if mesh is not None:
             from dynamo_tpu.parallel.mesh import kv_cache_sharding
 
             sh = kv_cache_sharding(mesh)
-            cache = {k: jax.device_put(v, sh) for k, v in cache.items()}
-        self.cache = cache
+            make = jax.jit(
+                lambda: {"k": jnp.zeros(cshape, cdtype), "v": jnp.zeros(cshape, cdtype)},
+                out_shardings={"k": sh, "v": sh},
+            )
+            self.cache = make()
+        else:
+            self.cache = make_kv_cache(
+                model_config, self.num_blocks, engine_config.kv_block_size,
+                dtype=cdtype,
+            )
 
         S = engine_config.max_slots
         MB = engine_config.max_blocks_per_seq
@@ -284,11 +340,22 @@ class JaxServingEngine(AsyncEngine):
         # each row's contents belong to (identity), so admissions into a
         # slot reset + rebuild only the rows that changed.
         self._counts: Optional[jax.Array] = None
-        self._dummy_counts = jnp.zeros((S, 1), jnp.int32)
+        if self._multihost:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(mesh, PartitionSpec())
+            self._dummy_counts = jax.jit(
+                lambda: jnp.zeros((S, 1), jnp.int32), out_shardings=rep
+            )()
+        else:
+            self._dummy_counts = jnp.zeros((S, 1), jnp.int32)
+        # upload caches for the per-dispatch host arrays (see _DevMirror)
+        self._m_tables = _DevMirror(self._put)
+        self._m_ipack = _DevMirror(self._put)
+        self._m_fpack = _DevMirror(self._put)
         self._counts_lanes: List[Optional[_Seq]] = [None] * S
         self._counts_sync_fns: Dict[Tuple[int, int], Any] = {}
 
-        self._base_key = jax.random.PRNGKey(0)
         self._step_counter = 0
 
         self._pending: Deque[_Seq] = deque()
@@ -390,6 +457,21 @@ class JaxServingEngine(AsyncEngine):
             if self._pp > 1:
                 raise ValueError("pp and sp cannot be combined yet")
 
+    def _put(self, host_arr) -> jax.Array:
+        """Host array → device array usable by the step fns. On a
+        process-spanning mesh this builds a REPLICATED global array (every
+        process holds the full value — the multihost lockstep contract);
+        otherwise a plain transfer."""
+        a = np.asarray(host_arr)
+        if not self._multihost:
+            return jnp.asarray(a)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.make_array_from_callback(
+            a.shape, NamedSharding(self.mesh, PartitionSpec()),
+            lambda idx: a[idx],
+        )
+
     # -- jitted step functions ----------------------------------------------
 
     def _build_decode_fn(self, with_lp: bool = False, with_pen: bool = False,
@@ -400,8 +482,17 @@ class JaxServingEngine(AsyncEngine):
         n_top = self.config.top_logprobs
         dense = self._decode_dense
 
-        def decode(params, cache, counts, tokens, positions, tables, step_key,
-                   seeds, temp, topk, topp, freqp, presp):
+        def decode(params, cache, counts, tokens, positions, tables, step_ctr,
+                   ipack, fpack):
+            # ipack [2,S] int32 = (seeds, topk); fpack [4,S] f32 =
+            # (temp, topp, freqp, presp). Packed so a dispatch uploads at
+            # most two small host arrays (each upload is a fixed-latency
+            # transfer on a tunneled chip), cached by _DevMirror.
+            # step_ctr: replicated int32 scalar; the step key derives from it
+            # IN-JIT so multihost lockstep needs only a number on the wire.
+            step_key = jax.random.fold_in(jax.random.PRNGKey(0), step_ctr)
+            seeds, topk = ipack[0], ipack[1]
+            temp, topp, freqp, presp = fpack[0], fpack[1], fpack[2], fpack[3]
             # tokens/positions: [S]; tables: [S, MB]. Scans k_steps forward+
             # sample iterations, feeding each sampled token back in — one
             # dispatch yields [S, k_steps] tokens. The final carry (tokens,
@@ -522,7 +613,21 @@ class JaxServingEngine(AsyncEngine):
                 )
             return out.T, toks, pos, cache, counts
 
+        if self._multihost:
+            # leader must device_get sampled tokens/carries: pin every output
+            # except the cache to a replicated sharding (tiny all-gathers)
+            rep, cache_sh = self._io_shardings()
+            n_extra = 6 if with_lp else 3
+            out_sh = (rep,) * n_extra + ({"k": cache_sh, "v": cache_sh}, rep)
+            return jax.jit(decode, donate_argnums=(1, 2), out_shardings=out_sh)
         return jax.jit(decode, donate_argnums=(1, 2))
+
+    def _io_shardings(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from dynamo_tpu.parallel.mesh import kv_cache_sharding
+
+        return NamedSharding(self.mesh, PartitionSpec()), kv_cache_sharding(self.mesh)
 
     def _decode(self, want_lp: bool, want_pen: bool = False,
                 want_sample: bool = True):
@@ -539,23 +644,28 @@ class JaxServingEngine(AsyncEngine):
         return fn
 
     def _chunk(self, want_lp: bool, want_pen: bool = False,
-               want_sample: bool = True):
-        key = (want_lp, want_pen, want_sample)
+               want_sample: bool = True, want_history: bool = True):
+        if self._pp > 1 or self._sp > 1:
+            want_history = True  # pp/sp forwards have no history-free variant
+        key = (want_lp, want_pen, want_sample, want_history)
         fn = self._chunk_fns.get(key)
         if fn is None:
             fn = self._chunk_fns[key] = self._build_chunk_fn(
-                want_lp, want_pen, want_sample
+                want_lp, want_pen, want_sample, want_history
             )
         return fn
 
     def _build_chunk_fn(self, with_lp: bool = False, with_pen: bool = False,
-                        with_sample: bool = True):
+                        with_sample: bool = True, with_history: bool = True):
         cfg = self.model_config
         S = self.config.max_slots
         n_top = self.config.top_logprobs
 
         def chunk(params, cache, counts, tokens, positions, tables, sample_at,
-                  step_key, seeds, temp, topk, topp, freqp, presp):
+                  step_ctr, ipack, fpack):
+            step_key = jax.random.fold_in(jax.random.PRNGKey(0), step_ctr)
+            seeds, topk = ipack[0], ipack[1]
+            temp, topp, freqp, presp = fpack[0], fpack[1], fpack[2], fpack[3]
             # tokens/positions: [S, C] (−1 positions = padding); sample_at: [S]
             # index of the token whose logits to sample, −1 → output unused.
             # One shape serves any mix of prefilling and decoding lanes.
@@ -583,7 +693,7 @@ class JaxServingEngine(AsyncEngine):
                 # of serializing scatter -> gather -> einsum per layer
                 h, cache = forward_chunk(
                     params, cfg, tokens, positions, cache, tables,
-                    hidden_only=True,
+                    hidden_only=True, with_history=with_history,
                 )
             hs = h[jnp.arange(S), jnp.clip(sample_at, 0)]  # [S, E]
             sel = lm_head(params, cfg, hs)  # [S, V]
@@ -603,6 +713,11 @@ class JaxServingEngine(AsyncEngine):
                 return nxt, lp, tids, tlps, cache, counts
             return nxt, cache, counts
 
+        if self._multihost:
+            rep, cache_sh = self._io_shardings()
+            n_extra = 4 if with_lp else 1
+            out_sh = (rep,) * n_extra + ({"k": cache_sh, "v": cache_sh}, rep)
+            return jax.jit(chunk, donate_argnums=(1, 2), out_shardings=out_sh)
         return jax.jit(chunk, donate_argnums=(1, 2))
 
     # -- penalty-count buffer -------------------------------------------------
@@ -686,7 +801,6 @@ class JaxServingEngine(AsyncEngine):
         both dispatches no-ops on the cache (scatters drop every index)."""
         cfg = self.config
         S, C, MB = cfg.max_slots, cfg.prefill_chunk, cfg.max_blocks_per_seq
-        key = jax.random.PRNGKey(0)
         neg = np.full((S, C), -1, np.int32)
         zeros_sc = np.zeros((S, C), np.int32)
         tables = np.zeros((S, MB), np.int32)
@@ -695,21 +809,27 @@ class JaxServingEngine(AsyncEngine):
         ones_f = np.ones((S,), np.float32)
 
         # both sampling variants of both step fns: a first non-greedy (or
-        # first all-greedy) request must never eat a mid-serving compile
+        # first all-greedy) request must never eat a mid-serving compile.
+        # The chunk fn also compiles its history-free variant — the first
+        # dispatch every fresh admission wave takes.
+        ctr = self._put(np.int32(0))
+        ipack = self._put(np.stack([svec_i, svec_i]))
+        fpack = self._put(np.stack([svec_f, ones_f, svec_f, svec_f]))
         for want_sample in (False, True):
-            out, self.cache, self._dummy_counts = self._chunk(False, False, want_sample)(
-                self.params, self.cache, self._dummy_counts, jnp.asarray(zeros_sc),
-                jnp.asarray(neg), jnp.asarray(tables),
-                jnp.asarray(np.full((S,), -1, np.int32)), key,
-                jnp.asarray(svec_i), jnp.asarray(svec_f), jnp.asarray(svec_i),
-                jnp.asarray(ones_f), jnp.asarray(svec_f), jnp.asarray(svec_f),
-            )
-            jax.device_get(out)
+            for want_history in (False, True):
+                out, self.cache, self._dummy_counts = self._chunk(
+                    False, False, want_sample, want_history
+                )(
+                    self.params, self.cache, self._dummy_counts, self._put(zeros_sc),
+                    self._put(neg), self._put(tables),
+                    self._put(np.full((S,), -1, np.int32)), ctr,
+                    ipack, fpack,
+                )
+                jax.device_get(out)
             out, _, _, self.cache, self._dummy_counts = self._decode(False, False, want_sample)(
-                self.params, self.cache, self._dummy_counts, jnp.asarray(svec_i),
-                jnp.asarray(np.full((S,), -1, np.int32)), jnp.asarray(tables), key,
-                jnp.asarray(svec_i), jnp.asarray(svec_f), jnp.asarray(svec_i),
-                jnp.asarray(ones_f), jnp.asarray(svec_f), jnp.asarray(svec_f),
+                self.params, self.cache, self._dummy_counts, self._put(svec_i),
+                self._put(np.full((S,), -1, np.int32)), self._put(tables), ctr,
+                ipack, fpack,
             )
             jax.device_get(out)
 
@@ -727,6 +847,22 @@ class JaxServingEngine(AsyncEngine):
                 f"is {self.config.max_model_len}"
             )
             return
+        if self._dispatch_hook is not None:
+            # multihost lockstep serves greedy/temperature sampling only:
+            # reject here at admission — raising deep in the step loop would
+            # take down every in-flight request AND strand the followers
+            # mid-broadcast (parallel/multihost_serving.py)
+            so = req.sampling_options
+            if so is not None and (
+                so.logprobs is not None
+                or (so.frequency_penalty or 0.0) != 0.0
+                or (so.presence_penalty or 0.0) != 0.0
+            ):
+                yield Annotated.from_error(
+                    "multihost serving does not support logprobs or "
+                    "frequency/presence penalties yet"
+                )
+                return
 
         self._ensure_thread()
         seq = _Seq(request, req, asyncio.get_running_loop())
@@ -806,6 +942,15 @@ class JaxServingEngine(AsyncEngine):
                 self._coalesce_admission_wave()
                 self._admit()
                 self._dispatch_step()
+                if (
+                    not any(self._slots) and self._inflight is None
+                    and self._pending and self._awaiting
+                ):
+                    # every pending request is parked (capacity or shared
+                    # in-flight prefix) behind remote prefills: poll gently
+                    # instead of spinning the GIL against the transfer plane
+                    with self._cond:
+                        self._cond.wait(timeout=0.005)
         except Exception:
             logger.exception("engine step loop crashed")
             # fail every in-flight request rather than hanging clients
@@ -871,6 +1016,16 @@ class JaxServingEngine(AsyncEngine):
 
     def _admit(self) -> None:
         """Move pending requests into free slots; run their prefill."""
+        deferred: List[_Seq] = []  # waiting on another lane's in-flight prefix
+        try:
+            self._admit_inner(deferred)
+        finally:
+            if deferred:
+                with self._cond:
+                    for s in reversed(deferred):
+                        self._pending.appendleft(s)
+
+    def _admit_inner(self, deferred: List["_Seq"]) -> None:
         while True:
             with self._cond:
                 if not self._pending:
@@ -899,11 +1054,33 @@ class JaxServingEngine(AsyncEngine):
                 self._slots[seq.slot] = seq
                 seq.prefill_pos = min(seq.alloc.cached_tokens, len(seq.prompt) - 1)
                 continue
+            if seq.wait_hash is not None:
+                if self.allocator.inflight_pending(seq.wait_hash):
+                    # still parked on another lane's in-flight prefix: skip
+                    # the full re-probe (an O(prompt) hash walk per loop
+                    # iteration that would also inflate probe metrics)
+                    deferred.append(seq)
+                    continue
+                seq.wait_hash = None
             alloc = self.allocator.allocate_sequence(seq.prompt)
+            if isinstance(alloc, InflightPrefix):
+                # another lane is prefilling this prompt's prefix right now:
+                # park until it seals (then these become ordinary prefix
+                # hits) instead of computing the same blocks twice. Other
+                # pending requests keep admitting past this one.
+                seq.joined_inflight = True
+                seq.wait_hash = alloc.seq_hash
+                deferred.append(seq)
+                continue
             if alloc is None and (self._inflight is not None or self._zombie_allocs):
                 # blocks may be parked behind the in-flight speculative chunk
                 self._drain_inflight()
                 alloc = self.allocator.allocate_sequence(seq.prompt)
+                if isinstance(alloc, InflightPrefix):
+                    seq.joined_inflight = True
+                    seq.wait_hash = alloc.seq_hash
+                    deferred.append(seq)
+                    continue
             if alloc is None:
                 if not any(self._slots) and not self._awaiting:
                     # nothing running (or awaiting remote prefill) will ever
@@ -918,6 +1095,11 @@ class JaxServingEngine(AsyncEngine):
                     self._pending.appendleft(seq)  # retry when blocks free up
                 return
             seq.alloc = alloc
+            if seq.joined_inflight:
+                # telemetry: tokens this request got for free by waiting for
+                # a concurrent identical prefix instead of recomputing it
+                self.allocator.shared_prefill_tokens += alloc.cached_tokens
+                seq.joined_inflight = False
             if alloc.host_hits:
                 # must land before ANY path uses the allocation: both local
                 # prefill and remote-prefill submission treat cached_tokens
@@ -1029,7 +1211,6 @@ class JaxServingEngine(AsyncEngine):
                 consumed[i] = [fed]
 
         self._step_counter += 1
-        step_key = jax.random.fold_in(self._base_key, self._step_counter)
         want_lp = any(
             s is not None and s.logprobs is not None for s in self._slots
         )
@@ -1037,16 +1218,34 @@ class JaxServingEngine(AsyncEngine):
         want_sample = any(
             s is not None and s.temperature > 0.0 for s in self._slots
         )
+        # a fresh admission wave's first chunk (every lane starting at
+        # position 0) attends nothing in the pool: compile out the history
+        # gather + partial — this is THE TTFT-critical dispatch
+        want_history = any(
+            s is not None and (s.prefill_pos is None or s.prefill_pos > 0)
+            for s in self._slots
+        )
         if want_pen:
             self._sync_counts(list(self._slots))
         counts_in = self._counts if want_pen else self._dummy_counts
+        ipack_np = np.stack([self._seeds, self._topk])
+        fpack_np = np.stack([self._temp, self._topp, self._freqp, self._presp])
+        if self._dispatch_hook is not None:
+            # multihost leader: followers run the SAME dispatch in lockstep
+            self._dispatch_hook(
+                "chunk",
+                dict(lp=want_lp, pen=want_pen, sample=want_sample,
+                     history=want_history, step=self._step_counter),
+                dict(tokens=tokens, positions=positions, tables=self._tables,
+                     sample_at=sample_at, ipack=ipack_np, fpack=fpack_np),
+            )
         args = (
-            self.params, self.cache, counts_in, jnp.asarray(tokens),
-            jnp.asarray(positions),
-            jnp.asarray(self._tables), jnp.asarray(sample_at), step_key,
-            jnp.asarray(self._seeds), jnp.asarray(self._temp),
-            jnp.asarray(self._topk), jnp.asarray(self._topp),
-            jnp.asarray(self._freqp), jnp.asarray(self._presp),
+            self.params, self.cache, counts_in, self._put(tokens),
+            self._put(positions),
+            self._m_tables.get(self._tables), self._put(sample_at),
+            self._put(np.int32(self._step_counter)),
+            self._m_ipack.get(ipack_np),
+            self._m_fpack.get(fpack_np),
         )
         # copy_to_host_async right after dispatch: the host-fetch path has a
         # ~100 ms fixed latency on a tunneled chip when started cold at get
@@ -1054,7 +1253,7 @@ class JaxServingEngine(AsyncEngine):
         # 120 ms -> <1 ms residual get)
         if want_lp:
             sampled, lp, tids, tlps, self.cache, counts_out = self._chunk(
-                True, want_pen, want_sample
+                True, want_pen, want_sample, want_history
             )(*args)
             for arr in (sampled, lp, tids, tlps):
                 arr.copy_to_host_async()
@@ -1063,7 +1262,7 @@ class JaxServingEngine(AsyncEngine):
             )
         else:
             sampled, self.cache, counts_out = self._chunk(
-                False, want_pen, want_sample
+                False, want_pen, want_sample, want_history
             )(*args)
             sampled.copy_to_host_async()
             sampled_np = jax.device_get(sampled)
@@ -1185,25 +1384,36 @@ class JaxServingEngine(AsyncEngine):
             self._freqp[i] = seq.freq_pen
             self._presp[i] = seq.pres_pen
 
-        if self._inflight is None:
-            toks_in = jnp.asarray(self._last_tokens)
-            pos_in = jnp.asarray(self._positions)
-        else:
+        use_carry = self._inflight is not None
+        if use_carry:
             toks_in, pos_in = self._inflight.tokens, self._inflight.positions
+        else:
+            toks_in = self._put(self._last_tokens)
+            pos_in = self._put(self._positions)
 
         self._step_counter += 1
-        step_key = jax.random.fold_in(self._base_key, self._step_counter)
         want_lp = any(s is not None and s.logprobs is not None for s in lanes)
         want_pen = any(s is not None and s.penalized for s in lanes)
         want_sample = any(s is not None and s.temperature > 0.0 for s in lanes)
         if want_pen:
             self._sync_counts(lanes)
         counts_in = self._counts if want_pen else self._dummy_counts
+        ipack_np = np.stack([self._seeds, self._topk])
+        fpack_np = np.stack([self._temp, self._topp, self._freqp, self._presp])
+        if self._dispatch_hook is not None:
+            self._dispatch_hook(
+                "decode",
+                dict(lp=want_lp, pen=want_pen, sample=want_sample,
+                     use_carry=use_carry, step=self._step_counter),
+                dict(tokens=self._last_tokens, positions=self._positions,
+                     tables=self._tables, ipack=ipack_np, fpack=fpack_np),
+            )
         args = (
             self.params, self.cache, counts_in, toks_in, pos_in,
-            jnp.asarray(self._tables), step_key, jnp.asarray(self._seeds),
-            jnp.asarray(self._temp), jnp.asarray(self._topk), jnp.asarray(self._topp),
-            jnp.asarray(self._freqp), jnp.asarray(self._presp),
+            self._m_tables.get(self._tables),
+            self._put(np.int32(self._step_counter)),
+            self._m_ipack.get(ipack_np),
+            self._m_fpack.get(fpack_np),
         )
         if want_lp:
             out, lps, tids, tlps, toks2, pos2, self.cache, counts_out = (
@@ -1243,23 +1453,63 @@ class JaxServingEngine(AsyncEngine):
         for i, seq in enumerate(chunk.lanes):
             if seq is None or seq.slot != i:
                 continue  # empty lane, or finished in an earlier chunk
-            # fed tokens this chunk: last accepted token, then each output fed
-            # back. KV is registered only for fed tokens on the accepted path.
-            fed = seq.generated[-1] if seq.generated else seq.prompt[-1]
-            for j in range(out.shape[1]):
-                self.allocator.note_tokens_computed(seq.alloc, [fed])
-                tok = int(out[i, j])
-                self._emit_token(
-                    seq, tok, defer_free=defer_free,
-                    lpinfo=(
-                        (float(lps[i, j]), tids[i, j], tlps[i, j])
-                        if lps is not None
-                        else None
-                    ),
-                )
-                if seq.slot != i:  # finished mid-chunk
-                    break
-                fed = tok
+            # accepted run for this lane: cut at max_tokens / max_model_len /
+            # first EOS, then emit ONE multi-token item. Per-token emission
+            # costs a dict build + a call_soon_threadsafe wakeup each — at
+            # 32 lanes × 64-step chunks that Python overhead (~1 ms/step,
+            # measured) rivals the decode step's own device time.
+            row = out[i]
+            k = row.shape[0]
+            n_take = min(
+                k,
+                seq.max_tokens - seq.emitted,
+                self.config.max_model_len - seq.total_len,
+            )
+            finish: Optional[FinishReason] = None
+            if n_take < k:
+                finish = FinishReason.LENGTH
+            toks = [int(t) for t in row[:n_take]]
+            if seq.eos_ids and not seq.ignore_eos:
+                for j, t in enumerate(toks):
+                    if t in seq.eos_ids:
+                        toks = toks[: j + 1]
+                        finish = FinishReason.EOS
+                        break
+            if not toks:
+                if finish is not None:
+                    self._finish(seq, finish, defer_free=defer_free)
+                continue
+            if finish is None and seq.emitted + len(toks) >= seq.max_tokens:
+                finish = FinishReason.LENGTH
+            elif finish is None and seq.total_len + len(toks) >= self.config.max_model_len:
+                finish = FinishReason.LENGTH
+            # fed tokens this chunk: last accepted token, then each accepted
+            # output fed back. KV is registered only for fed tokens.
+            fed0 = seq.generated[-1] if seq.generated else seq.prompt[-1]
+            self.allocator.note_tokens_computed(seq.alloc, [fed0] + toks[:-1])
+
+            log_probs = top_logprobs = None
+            if lps is not None and seq.logprobs is not None:
+                n = len(toks)
+                log_probs = [float(x) for x in lps[i, :n]]
+                if seq.logprobs > 0:
+                    kk = min(seq.logprobs, tids.shape[2])
+                    top_logprobs = [
+                        {int(tids[i, j, p]): float(tlps[i, j, p]) for p in range(kk)}
+                        for j in range(n)
+                    ]
+            seq.generated.extend(toks)
+            seq.out_tokens.extend(toks)
+            seq.emitted += len(toks)
+            self.total_generated_tokens += len(toks)
+            seq.emit(Annotated.from_data(
+                LLMEngineOutput(
+                    token_ids=toks, log_probs=log_probs, top_logprobs=top_logprobs
+                ).to_dict(),
+                id=seq.ctx.id,
+            ))
+            if finish is not None:
+                self._finish(seq, finish, defer_free=defer_free)
 
     def _drain_inflight(self) -> None:
         """Fetch + process any in-flight chunk, then release zombie blocks
@@ -1629,6 +1879,10 @@ class JaxServingEngine(AsyncEngine):
             "num_requests_waiting": len(self._pending) + len(self._awaiting),
             "gpu_cache_usage_perc": self.allocator.usage(),
             "gpu_prefix_cache_hit_rate": self.allocator.hit_tokens / probe,
+            # shared in-flight prefill registry (reserved.rs parity):
+            # deferrals onto a concurrent identical prefix + tokens saved
+            "inflight_prefill_waits": self.allocator.inflight_waits,
+            "shared_prefill_tokens": self.allocator.shared_prefill_tokens,
         }
         if self.host_pool is not None:
             m["host_cache_blocks"] = len(self.host_pool)
@@ -1666,7 +1920,14 @@ def build_jax_serving_engine(
     )
     if mesh_cfg.size > 1:
         mesh = make_mesh(mesh_cfg)
-        params = jax.device_put(params, param_shardings(model_config, mesh))
+        if jax.process_count() > 1:
+            # process-spanning mesh: every host loaded the same full params;
+            # each materializes only its device shards
+            from dynamo_tpu.parallel.multihost_serving import shard_params_global
+
+            params = shard_params_global(params, model_config, mesh)
+        else:
+            params = jax.device_put(params, param_shardings(model_config, mesh))
 
     engine_config = EngineConfig(
         max_slots=max_batch_size,

@@ -206,6 +206,65 @@ def make_kv_cache(
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
+# -- int8 weight-only quantization -------------------------------------------
+
+def matw(x: jax.Array, w) -> jax.Array:
+    """``x @ w`` where ``w`` is a plain array or an int8 pair {"q", "s"}.
+
+    Weight-only per-output-channel absmax quantization: the int8 tensor is
+    converted inline and the dot's operand load fuses the convert, so the
+    HBM read halves (weights ARE the decode roofline — a bf16 1B model
+    streams 2.5 GB/step). Scales stay in float32 and multiply the output."""
+    if isinstance(w, dict):
+        y = x @ w["q"].astype(x.dtype)
+        # scales multiply in f32 (they carry the quantization precision;
+        # rounding them to bf16 first would compound the int8 error), then
+        # the product drops back to the activation dtype — XLA fuses the
+        # convert/mul/convert chain into the matmul epilogue
+        return (y.astype(jnp.float32) * w["s"]).astype(x.dtype)
+    return x @ w
+
+
+def embed_lookup(params: Params, tokens: jax.Array, dtype: Any = jnp.bfloat16) -> jax.Array:
+    """Embedding-table gather, transparent to int8 quantization (per-row)."""
+    e = params["embed"]
+    if isinstance(e, dict):
+        rows = jnp.clip(tokens, 0)
+        deq = e["q"][rows].astype(jnp.float32) * e["s"][rows][..., None]
+        return deq.astype(dtype)
+    return e[jnp.clip(tokens, 0)]
+
+
+def quantize_params_int8(params: Params, config: LlamaConfig) -> Params:
+    """Quantize every dense weight matrix to int8 with per-output-channel
+    (absmax/127) scales; norms, biases and the MoE router stay as they are.
+    The embedding table quantizes per ROW so both its gather use and its
+    tied lm-head use (scale per vocab column of ``embed.T``) stay cheap.
+
+    Single-chip serving path: mesh-sharded (tp/pp/sp/ep) params keep bf16 —
+    the sharding specs describe the unquantized tree."""
+    if config.num_experts > 1:
+        raise NotImplementedError("int8 path does not cover MoE experts yet")
+
+    def quant(w: jax.Array, contract_axis: int) -> dict:
+        wf = w.astype(jnp.float32)
+        s = jnp.max(jnp.abs(wf), axis=contract_axis) / 127.0  # per out-channel
+        s = jnp.maximum(s, 1e-12)
+        q = jnp.round(wf / jnp.expand_dims(s, contract_axis))
+        return {"q": jnp.clip(q, -127, 127).astype(jnp.int8), "s": s}
+
+    out = dict(params)
+    out["embed"] = quant(params["embed"], 1)  # per-row: [V, E] → s [V]
+    if "lm_head" in params:
+        out["lm_head"] = quant(params["lm_head"], 0)
+    lp = dict(params["layers"])
+    for name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+        if name in lp:
+            lp[name] = quant(lp[name], 1)  # stacked [L, in, out] → s [L, out]
+    out["layers"] = lp
+    return out
+
+
 # -- math --------------------------------------------------------------------
 
 def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
@@ -237,7 +296,7 @@ def project_qkv(
     cannot drift."""
     b, t = positions.shape
     x = rms_norm(hidden, lp["attn_norm"], c.rms_norm_eps)
-    q, k, v = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
+    q, k, v = matw(x, lp["wq"]), matw(x, lp["wk"]), matw(x, lp["wv"])
     if c.qkv_bias:
         q = q + lp["bq"].astype(q.dtype)
         k = k + lp["bk"].astype(k.dtype)
@@ -277,8 +336,8 @@ def mlp_block(
         }
         out, _aux = moe_mlp(moe_params, mcfg, x, token_valid=positions >= 0)
         return hidden + out.astype(hidden.dtype)
-    gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-    return hidden + (gate * (x @ lp["w_up"])) @ lp["w_down"]
+    gate = jax.nn.silu(matw(x, lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+    return hidden + matw(gate * matw(x, lp["w_up"]), lp["w_down"])
 
 
 # -- forward -----------------------------------------------------------------
@@ -311,13 +370,22 @@ def decoder_layer(
         q, k_page, v_page, block_tables, positions, soft_cap=soft_cap,
         use_pallas=use_pallas, mesh=mesh,
     )
-    hidden = hidden + attn.reshape(b, t, c.q_dim) @ lp["wo"]
+    hidden = hidden + matw(attn.reshape(b, t, c.q_dim), lp["wo"])
     return mlp_block(lp, c, hidden, positions), k_page, v_page
 
 
 def lm_head(params: Params, config: LlamaConfig, h: jax.Array) -> jax.Array:
     """Project final hidden states to vocabulary logits (float32)."""
-    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    head = params["embed"] if config.tie_embeddings else params["lm_head"]
+    if isinstance(head, dict):
+        q, s = head["q"], head["s"]
+        if config.tie_embeddings:
+            # embed is quantized per ROW ([V] scales) = per vocab column of
+            # embed.T, so the scale applies to the logit axis either way
+            return (h @ q.T.astype(h.dtype)).astype(jnp.float32) * s[None, :]
+        return (h @ q.astype(h.dtype)).astype(jnp.float32) * s[None, :]
+    if config.tie_embeddings:
+        head = head.T
     return (h @ head).astype(jnp.float32)
 
 
@@ -332,7 +400,7 @@ def _window_attention(
     wslot: jax.Array,  # scalar: current window slot (q's own position)
     soft_cap: Optional[float],
 ) -> jax.Array:
-    """Attention over (dense history ‖ decode window) with one softmax.
+    """Attention over (dense history, decode window) as two flash partials.
 
     The history is gathered from the paged pool ONCE per decode dispatch (the
     pool is immutable inside a dispatch): a per-step page gather is the
@@ -340,28 +408,47 @@ def _window_attention(
     serialized page slices (~17 ms of a 17 ms step measured on v5e) — while
     attending a dense buffer is a pair of einsums. Fresh K/V live in the
     per-lane window buffer, flushed to pages once per dispatch by
-    :func:`flush_window`."""
+    :func:`flush_window`.
+
+    The two segments are NOT concatenated: at serving scale the concat
+    materializes a history-sized copy per layer per step (~700 MB/step of
+    pure HBM traffic at 32 lanes × 2k ctx on a 1B model — measured ~1.4
+    ms/step of the ~7 ms step on v5e). Instead each segment computes an
+    unnormalized softmax partial and the two are merged flash-decoding
+    style, reading the history exactly once."""
     b, _, h_, d = q.shape
     kvh = c.num_kv_heads
-    smax, w = gk.shape[1], wk.shape[1]
-    ck = jnp.concatenate([gk, wk], axis=1)  # [B, Smax+W, KVH, D]
-    cv = jnp.concatenate([gv, wv], axis=1)
-
-    pool_valid = jnp.arange(smax)[None, :] < base[:, None]  # [B, Smax]
-    win_valid = (jnp.arange(w)[None, :] <= wslot) & (base[:, None] >= 0)
-    mask = jnp.concatenate([pool_valid, win_valid], axis=1)  # [B, Smax+W]
-
     g = h_ // kvh
+    smax = gk.shape[1]
     qg = q.reshape(b, kvh, g, d)
+
+    # history partial
     scores = jnp.einsum(
-        "bngd,bsnd->bngs", qg, ck, preferred_element_type=jnp.float32
+        "bngd,bsnd->bngs", qg, gk, preferred_element_type=jnp.float32
     ) * (d ** -0.5)
     if soft_cap is not None:
         scores = jnp.tanh(scores / soft_cap) * soft_cap
-    scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1)
-    probs = jnp.where(mask.any(axis=1)[:, None, None, None], probs, 0.0)
-    out = jnp.einsum("bngs,bsnd->bngd", probs.astype(cv.dtype), cv)
+    pool_valid = jnp.arange(smax)[None, :] < base[:, None]  # [B, Smax]
+    scores = jnp.where(pool_valid[:, None, None, :], scores, -jnp.inf)
+    m_p = jnp.maximum(scores.max(axis=-1), -1e30)  # [B, KVH, G]
+    p = jnp.exp(scores - m_p[..., None])
+    l_p = p.sum(axis=-1)
+    num_p = jnp.einsum(
+        "bngs,bsnd->bngd", p.astype(gv.dtype), gv
+    ).astype(jnp.float32)
+
+    # window partial + flash combine
+    num_w, m_w, l_w = _window_only_attention(c, q, base, wk, wv, wslot, soft_cap)
+    m_p = m_p.reshape(b, h_)
+    l_p = l_p.reshape(b, h_)
+    num_p = num_p.reshape(b, h_, d)
+    m_t = jnp.maximum(m_p, m_w)
+    a_p = jnp.exp(m_p - m_t)
+    a_w = jnp.exp(m_w - m_t)
+    denom = a_p * l_p + a_w * l_w
+    num = num_p * a_p[..., None] + num_w * a_w[..., None]
+    out = num / jnp.maximum(denom, 1e-30)[..., None]
+    out = jnp.where((denom > 0.0)[..., None], out, 0.0)
     return out.reshape(b, 1, h_, d).astype(q.dtype)
 
 
@@ -504,7 +591,7 @@ def forward_window(
     """
     c = config
     mode = history[0]
-    h = params["embed"][jnp.clip(tokens, 0)][:, None]  # [B, 1, E]
+    h = embed_lookup(params, tokens, c.dtype)[:, None]  # [B, 1, E]
     pos2 = positions[:, None]  # [B, 1]
     if mode == "dense":
         _, hist_k, hist_v = history
@@ -530,7 +617,7 @@ def forward_window(
                 c, q, hk, hv, block_tables, base, wk, wv, wslot, soft_cap,
                 mesh, interpret,
             )
-        hidden = hidden + attn.reshape(b, 1, c.q_dim) @ lp["wo"]
+        hidden = hidden + matw(attn.reshape(b, 1, c.q_dim), lp["wo"])
         return mlp_block(lp, c, hidden, pos2), (wk, wv)
 
     h, (new_wk, new_wv) = jax.lax.scan(
@@ -615,6 +702,7 @@ def forward_chunk(
     block_tables: jax.Array,  # [B, MB]
     *,
     hidden_only: bool = False,
+    with_history: bool = True,
 ) -> Tuple[jax.Array, KVCache]:
     """Prefill-chunk forward with the history/fresh attention split — the
     same contract as :func:`forward`, restructured for the TPU scheduler.
@@ -625,12 +713,18 @@ def forward_chunk(
     partial (pages < each lane's chunk start — by construction everything
     already flushed) with an in-chunk causal partial over the fresh K/V in
     hand, so the page scatter (still needed for later chunks/decode) runs
-    OFF the critical path, concurrent with the attention math."""
+    OFF the critical path, concurrent with the attention math.
+
+    ``with_history=False`` compiles out the pool gather + history partial
+    entirely — the caller guarantees every lane starts at position 0 (a
+    fresh admission wave's first chunk, THE TTFT-critical dispatch; the
+    masked-out history partial still materializes layer-sized f32 score
+    buffers, ~20 ms of a ~100 ms chunk at serving scale on v5e)."""
     from dynamo_tpu.ops.attention import gather_pages, write_kv_to_pages
 
     c = config
     scale = c.head_dim ** -0.5
-    h = params["embed"][jnp.clip(tokens, 0)]  # [B, C, E]
+    h = embed_lookup(params, tokens, c.dtype)  # [B, C, E]
     chunk_start = jnp.where(positions[:, 0] >= 0, positions[:, 0], 0)  # [B]
 
     def layer_body(carry, xs):
@@ -642,31 +736,34 @@ def forward_chunk(
         new_k, new_v = write_kv_to_pages(
             k_page, v_page, k, v, positions, block_tables
         )
-        # history partial reads the PRE-SCATTER pool: masked to
-        # < chunk_start, those pages are identical either way, and using
-        # the old buffers keeps the gather independent of the scatter
-        gk = gather_pages(k_page, block_tables)
-        gv = gather_pages(v_page, block_tables)
-        num_h, m_h, l_h = _history_partial(
-            c, q, gk, gv, chunk_start, positions, scale
-        )
         num_s, m_s, l_s = _chunk_self_partial(c, q, k, v, positions, scale)
-
-        m_t = jnp.maximum(m_h, m_s)
-        a_h = jnp.exp(m_h - m_t)
-        a_s = jnp.exp(m_s - m_t)
-        den = a_h * l_h + a_s * l_s
-        num = (
-            num_h * a_h.transpose(0, 2, 1)[..., None]
-            + num_s * a_s.transpose(0, 2, 1)[..., None]
-        )
+        if with_history:
+            # history partial reads the PRE-SCATTER pool: masked to
+            # < chunk_start, those pages are identical either way, and using
+            # the old buffers keeps the gather independent of the scatter
+            gk = gather_pages(k_page, block_tables)
+            gv = gather_pages(v_page, block_tables)
+            num_h, m_h, l_h = _history_partial(
+                c, q, gk, gv, chunk_start, positions, scale
+            )
+            m_t = jnp.maximum(m_h, m_s)
+            a_h = jnp.exp(m_h - m_t)
+            a_s = jnp.exp(m_s - m_t)
+            den = a_h * l_h + a_s * l_s
+            num = (
+                num_h * a_h.transpose(0, 2, 1)[..., None]
+                + num_s * a_s.transpose(0, 2, 1)[..., None]
+            )
+        else:
+            den = l_s
+            num = num_s
         attn = jnp.where(
             (den > 0.0).transpose(0, 2, 1)[..., None],
             num / jnp.maximum(den, 1e-30).transpose(0, 2, 1)[..., None],
             0.0,
         ).astype(hidden.dtype)
 
-        hidden = hidden + attn.reshape(b, t, c.q_dim) @ lp["wo"]
+        hidden = hidden + matw(attn.reshape(b, t, c.q_dim), lp["wo"])
         return mlp_block(lp, c, hidden, positions), (new_k, new_v)
 
     h, (new_k, new_v) = jax.lax.scan(
@@ -710,7 +807,7 @@ def forward_chunk_sp(
     c = config
     d = c.head_dim
     scale = d ** -0.5
-    h = params["embed"][jnp.clip(tokens, 0)]  # [B, C, E]
+    h = embed_lookup(params, tokens, c.dtype)  # [B, C, E]
     h = jax.lax.with_sharding_constraint(
         h, NamedSharding(mesh, P(None, AXIS_SP, None))
     )
@@ -753,7 +850,7 @@ def forward_chunk_sp(
             0.0,
         ).astype(hidden.dtype)
 
-        hidden = hidden + attn.reshape(b, t, c.q_dim) @ lp["wo"]
+        hidden = hidden + matw(attn.reshape(b, t, c.q_dim), lp["wo"])
         return mlp_block(lp, c, hidden, positions), (k_page, v_page)
 
     h, (new_k, new_v) = jax.lax.scan(
@@ -822,7 +919,7 @@ def forward(
     LM-head columns and the [B, T, vocab] float32 materialization.
     """
     c = config
-    h = params["embed"][jnp.clip(tokens, 0)]  # [B, T, E]
+    h = embed_lookup(params, tokens, c.dtype)  # [B, T, E]
 
     def layer_body(carry, xs):
         lp, k_page, v_page = xs  # layer params + this layer's page pool

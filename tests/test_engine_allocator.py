@@ -115,3 +115,68 @@ def test_decode_sealing_registers_blocks():
     assert len(sink.stored) == 1
     a.note_tokens_computed(alloc, [5])
     assert len(sink.stored) == 1  # second block still partial
+
+
+# -- shared in-flight prefill registry (reference kv/reserved.rs parity) ------
+
+
+def test_inflight_concurrent_identical_prefix_defers():
+    """Second request for a prefix another live sequence is mid-prefill on
+    gets an InflightPrefix sentinel instead of duplicate pages."""
+    from dynamo_tpu.engine_jax.allocator import InflightPrefix
+
+    a = BlockAllocator(num_blocks=16, block_size=4)
+    al1 = a.allocate_sequence(list(range(12)))  # will compute blocks 0..2
+    assert al1.pending_hashes, "full prompt blocks advertised as in-flight"
+
+    res = a.allocate_sequence(list(range(12)))
+    assert isinstance(res, InflightPrefix)
+    assert a.inflight_waits == 1
+
+    # owner seals its blocks → the retry becomes ordinary prefix hits
+    a.note_tokens_computed(al1, list(range(12)))
+    al2 = a.allocate_sequence(list(range(12)))
+    assert not isinstance(al2, InflightPrefix)
+    assert al2.cached_tokens == 8  # 2 full blocks shared (last token computed)
+    assert al2.block_ids[:2] == al1.block_ids[:2]
+    a.free_sequence(al1)
+    a.free_sequence(al2)
+
+
+def test_inflight_divergent_prompt_not_deferred():
+    """A prompt sharing no prefix with the in-flight sequence allocates
+    immediately."""
+    from dynamo_tpu.engine_jax.allocator import InflightPrefix
+
+    a = BlockAllocator(num_blocks=16, block_size=4)
+    al1 = a.allocate_sequence(list(range(12)))
+    al2 = a.allocate_sequence([90, 91, 92, 93, 94, 95])
+    assert not isinstance(al2, InflightPrefix)
+    a.free_sequence(al1)
+    a.free_sequence(al2)
+
+
+def test_inflight_promise_withdrawn_on_free():
+    """Owner dies before sealing: the waiter's next probe allocates and
+    computes the prefix itself (no deadlock)."""
+    from dynamo_tpu.engine_jax.allocator import InflightPrefix
+
+    a = BlockAllocator(num_blocks=16, block_size=4)
+    al1 = a.allocate_sequence(list(range(12)))
+    assert isinstance(a.allocate_sequence(list(range(12))), InflightPrefix)
+    a.free_sequence(al1)  # cancelled before any compute
+    al2 = a.allocate_sequence(list(range(12)))
+    assert not isinstance(al2, InflightPrefix)
+    assert al2.cached_tokens == 0  # nothing was sealed; it computes itself
+    a.free_sequence(al2)
+
+
+def test_inflight_wait_disabled():
+    from dynamo_tpu.engine_jax.allocator import InflightPrefix
+
+    a = BlockAllocator(num_blocks=16, block_size=4)
+    al1 = a.allocate_sequence(list(range(12)))
+    al2 = a.allocate_sequence(list(range(12)), wait_inflight=False)
+    assert not isinstance(al2, InflightPrefix)
+    a.free_sequence(al1)
+    a.free_sequence(al2)

@@ -316,3 +316,94 @@ def test_consumer_break_frees_slot(engine, run):
 
     assert run(go()), "slot not released after consumer closed the stream"
     assert engine.total_generated_tokens < 1000
+
+
+def test_concurrent_identical_prefix_single_prefill(params, run):
+    """Two simultaneous requests with the same prompt: the second joins the
+    first's in-flight prefill (reserved-registry parity) instead of
+    computing the same blocks twice — and both match the reference."""
+    cfg = EngineConfig(max_slots=4, kv_block_size=8, max_model_len=128)
+    eng = JaxServingEngine(CFG, params, cfg)
+    try:
+        prompt = list(range(40))
+
+        class Sink:
+            def __init__(self):
+                self.stored_hashes = []
+
+            def blocks_stored(self, parent, blocks):
+                self.stored_hashes.extend(h for h, _ in blocks)
+
+            def blocks_removed(self, hashes):
+                pass
+
+        sink = Sink()
+        eng.set_event_sink(sink)
+
+        async def go():
+            return await asyncio.gather(
+                *[collect_tokens(eng, prompt, max_tokens=4) for _ in range(3)]
+            )
+
+        results = run(go())
+        ref = reference_greedy(params, prompt, 4)
+        for toks, _ in results:
+            assert toks == ref
+
+        m = eng.metrics_snapshot()
+        assert m["inflight_prefill_waits"] >= 1, "joiners should have deferred"
+        assert m["shared_prefill_tokens"] > 0, "joiners should reuse the prefill"
+        # single prefill compute: every prompt block hash stored exactly once
+        assert len(sink.stored_hashes) == len(set(sink.stored_hashes))
+    finally:
+        eng.close()
+
+
+def test_int8_quantized_engine(params, run):
+    """Weight-only int8: reconstruction is tight and the engine serves
+    sane greedy output end-to-end through the quantized path."""
+    import numpy as np
+
+    from dynamo_tpu.models.llama import quantize_params_int8
+
+    qp = quantize_params_int8(params, CFG)
+    # per-channel absmax reconstruction: error bounded by scale/2
+    w = np.asarray(params["layers"]["wq"], np.float32)
+    deq = np.asarray(qp["layers"]["wq"]["q"], np.float32) * np.asarray(
+        qp["layers"]["wq"]["s"], np.float32
+    )[:, None, :]
+    err = np.abs(w - deq)
+    bound = np.asarray(qp["layers"]["wq"]["s"], np.float32)[:, None, :] * 0.51
+    assert (err <= bound).all()
+
+    cfg = dataclasses.replace(ENGINE_CFG, quantize="int8")
+    eng = JaxServingEngine(CFG, params, cfg)
+    try:
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        toks, finish = run(collect_tokens(eng, prompt, max_tokens=6))
+        assert finish == "length" and len(toks) == 6
+        assert all(0 <= t < CFG.vocab_size for t in toks)
+        # the int8 engine must match a reference loop run with the SAME
+        # dequantized weights (x @ q*s ≡ (x @ q) * s up to float assoc)
+        def dq(leaf):
+            return jnp.asarray(
+                np.asarray(leaf["q"], np.float32)
+                * np.expand_dims(np.asarray(leaf["s"], np.float32), -2)
+            )
+
+        deq = {
+            "embed": jnp.asarray(
+                np.asarray(qp["embed"]["q"], np.float32)
+                * np.asarray(qp["embed"]["s"], np.float32)[:, None]
+            ),
+            "final_norm": params["final_norm"],
+            "lm_head": dq(qp["lm_head"]),
+            "layers": {
+                name: (dq(leaf) if isinstance(leaf, dict) else leaf)
+                for name, leaf in qp["layers"].items()
+            },
+        }
+        ref = reference_greedy(deq, prompt, 6)
+        assert toks == ref
+    finally:
+        eng.close()

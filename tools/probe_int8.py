@@ -1,0 +1,80 @@
+"""Does an int8-weight matmul with inline dequant stream weights at ~2x bf16?
+
+Times chained [B, IN] @ [IN, OUT] matmuls inside one jit:
+  (a) bf16 weights
+  (b) int8 weights, dequantized inline (convert + per-channel scale)
+  (c) int8 weights fed to dot_general directly with bf16 activations
+
+If (b)/(c) approach half of (a)'s time, weight-only int8 is a win for the
+HBM-bound decode: XLA fuses the convert into the dot's operand load instead
+of materializing a bf16 copy.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, IN, OUT = 32, 2048, 8192
+STEPS = 32
+
+
+def fetch(x):
+    return jax.device_get(jnp.ravel(x)[:4])
+
+
+def bench(name, w, matmul):
+    x = jnp.ones((B, IN), jnp.bfloat16)
+
+    @jax.jit
+    def chain(x, w):
+        def body(c, _):
+            y = matmul(c, w)
+            # fold back to [B, IN] so the loop chains (cheap reduce)
+            return y[:, :IN].astype(jnp.bfloat16), ()
+        out, _ = jax.lax.scan(body, x, None, length=STEPS)
+        return out
+
+    y = chain(x, w)
+    fetch(y)
+    t0 = time.perf_counter()
+    y = chain(y, w)
+    fetch(y)
+    dt = (time.perf_counter() - t0) / STEPS
+    wbytes = w.size * w.dtype.itemsize if hasattr(w, "size") else sum(
+        p.size * p.dtype.itemsize for p in jax.tree.leaves(w)
+    )
+    print(f"{name}: {dt*1e6:.0f} us/matmul  ({wbytes/dt/1e9:.0f} GB/s weight stream)")
+    return dt
+
+
+def main():
+    rng = np.random.default_rng(0)
+    wf = rng.standard_normal((IN, OUT)).astype(np.float32)
+    w_bf16 = jnp.asarray(wf, jnp.bfloat16)
+    scale = jnp.asarray(np.abs(wf).max(axis=0) / 127.0, jnp.float32)  # [OUT]
+    w_int8 = jnp.asarray(
+        np.clip(np.round(wf / np.asarray(scale)[None, :]), -127, 127), jnp.int8
+    )
+
+    t_bf16 = bench("bf16", w_bf16, lambda x, w: x @ w)
+
+    def mm_dequant(x, w):
+        return (x @ w.astype(jnp.bfloat16)) * scale.astype(jnp.bfloat16)[None, :]
+
+    t_dq = bench("int8 inline-dequant", w_int8, mm_dequant)
+
+    def mm_mixed(x, w):
+        y = jax.lax.dot_general(
+            x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return y * scale[None, :]
+
+    t_mx = bench("int8 mixed dot_general", w_int8, mm_mixed)
+
+    print(f"speedups vs bf16: dequant {t_bf16/t_dq:.2f}x, mixed {t_bf16/t_mx:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

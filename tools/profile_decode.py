@@ -1,18 +1,24 @@
 """Decode-step ablation profile on real TPU: localize the roofline gap.
 
-Times, with block_until_ready and donation matching the engine:
-  0. HBM bandwidth microbench (achievable, not nominal)
-  1. full decode fn (engine's own, k=decode_steps)
-  2. forward_window-only scan (no sampling, no lm_head)
-  3. lm_head + argmax alone per step
-  4. XLA cost analysis (bytes accessed) for the decode fn
+NOTE on the tunneled axon platform:
+- ``block_until_ready`` does NOT block → every measurement chains
+  computations via data dependencies and fences with a small ``device_get``.
+- per-dispatch latency is large → bandwidth microbenches must chain INSIDE
+  one jit (lax.scan), not across dispatches.
+- closing over params embeds 2.47 GB of constants in the MLIR (hour-long
+  lowering) → every jitted fn takes params as an argument.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import faulthandler
+import functools
 import os
+import sys
 import time
+
+faulthandler.dump_traceback_later(240, repeat=True, file=sys.stderr)
 
 import jax
 import jax.numpy as jnp
@@ -22,10 +28,9 @@ from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
 from dynamo_tpu.models.llama import (
     LLAMA_PRESETS,
     forward_window,
-    flush_window,
     gather_history,
     init_params,
-    lm_head,
+    make_kv_cache,
 )
 
 PRESET = os.environ.get("PROF_PRESET", "llama3.2-1b")
@@ -33,46 +38,59 @@ SLOTS = int(os.environ.get("PROF_SLOTS", "32"))
 K = int(os.environ.get("PROF_DECODE_STEPS", "64"))
 CTX = int(os.environ.get("PROF_CTX", "192"))  # mid-decode history length
 MAX_LEN = int(os.environ.get("PROF_MAX_LEN", "264"))
+N_ITER = int(os.environ.get("PROF_ITERS", "4"))
 
 
-def timeit(fn, *args, n=5, warm=2):
-    for _ in range(warm):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    outs = []
-    for _ in range(n):
-        outs.append(fn(*args))
-    jax.block_until_ready(outs)
-    return (time.perf_counter() - t0) / n
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def fetch(x):
+    """Force completion: device_get of a small dependent slice."""
+    return jax.device_get(jnp.ravel(x)[:4])
 
 
 def hbm_bw():
+    """Achievable HBM BW: 16 chained 1-GiB copies inside ONE dispatch."""
     x = jnp.zeros((1 << 28,), jnp.float32)  # 1 GiB
 
     @jax.jit
-    def copy(a):
-        return a + 1.0
+    def chain(a):
+        def body(c, _):
+            return c + 1.0, ()
+        out, _ = jax.lax.scan(body, a, None, length=16)
+        return out
 
-    dt = timeit(copy, x)
-    return 2 * x.nbytes / dt / 1e9  # rd + wr
+    y = chain(x)
+    fetch(y)  # compile + settle
+    t0 = time.perf_counter()
+    y = chain(y)
+    fetch(y)
+    dt = (time.perf_counter() - t0) / 16
+    return 2 * x.nbytes / dt / 1e9  # rd + wr per step
 
 
 def main():
+    from dynamo_tpu.engine_jax.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    log("init params...")
     cfg = dataclasses.replace(LLAMA_PRESETS[PRESET], dtype=jnp.bfloat16)
     params = init_params(jax.random.PRNGKey(0), cfg)
     pbytes = sum(int(np.prod(p.shape)) * p.dtype.itemsize for p in jax.tree.leaves(params))
     print(f"model={PRESET} params_bytes={pbytes/1e9:.3f} GB")
+    log("hbm bw microbench...")
     bw = hbm_bw()
-    print(f"achievable HBM BW: {bw:.0f} GB/s (nominal 819)")
+    print(f"achievable HBM BW (in-jit chain): {bw:.0f} GB/s (nominal 819)")
     ideal_step = pbytes / (bw * 1e9)
-    print(f"weight-stream step time at achievable BW: {ideal_step*1e3:.2f} ms "
+    print(f"weight-stream step at achievable BW: {ideal_step*1e3:.2f} ms "
           f"-> {SLOTS/ideal_step:.0f} tok/s")
 
     ec = EngineConfig(
         max_slots=SLOTS, kv_block_size=16, max_model_len=MAX_LEN,
         decode_steps=K, prefill_chunk=128,
     )
+    log("build engine...")
     engine = JaxServingEngine(cfg, params, ec)
 
     S = SLOTS
@@ -86,59 +104,44 @@ def main():
             ec.resolve_num_blocks() - 1
         ) + 1
     tables = jnp.asarray(tables)
-    step_key = jax.random.PRNGKey(1)
-    seeds = jnp.zeros((S,), jnp.int32)
-    temp = jnp.zeros((S,), jnp.float32)
-    topk = jnp.zeros((S,), jnp.int32)
-    topp = jnp.ones((S,), jnp.float32)
-    freqp = jnp.zeros((S,), jnp.float32)
-    presp = jnp.zeros((S,), jnp.float32)
+    step_ctr = jnp.asarray(1, jnp.int32)
+    ipack = jnp.zeros((2, S), jnp.int32)
+    fpack = jnp.asarray(
+        np.stack([np.zeros(S), np.ones(S), np.zeros(S), np.zeros(S)]), jnp.float32
+    )
 
     # 1. full decode fn, engine's own (greedy path: no lp/pen/sample)
     fn = engine._decode(False, False, False)
     cache = engine.cache
     counts = engine._dummy_counts
 
-    def call(cache, counts):
+    def call(cache, counts, toks, pos):
         out, t2, p2, cache, counts = fn(
-            params, cache, counts, tokens, positions, tables, step_key,
-            seeds, temp, topk, topp, freqp, presp,
+            params, cache, counts, toks, pos, tables, step_ctr, ipack, fpack,
         )
-        return out, cache, counts
+        return out, t2, p2, cache, counts
 
-    # donation: re-thread cache/counts
-    for _ in range(2):
-        out, cache, counts = call(cache, counts)
-    jax.block_until_ready(out)
+    log("compile + warm decode fn...")
+    out, t2, p2, cache, counts = call(cache, counts, tokens, positions)
+    fetch(out)
+    log("timing full decode fn...")
     t0 = time.perf_counter()
-    n = 5
-    for _ in range(n):
-        out, cache, counts = call(cache, counts)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / n
+    for _ in range(N_ITER):
+        out, t2, p2, cache, counts = call(cache, counts, t2, p2)
+    fetch(out)
+    dt = (time.perf_counter() - t0) / N_ITER
     print(f"[1] full decode dispatch k={K}: {dt*1e3:.1f} ms "
           f"({dt/K*1e3:.2f} ms/step, {S*K/dt:.0f} tok/s, "
-          f"{ideal_step*K/dt*100:.0f}% of achievable roofline)")
-
-    lowered = fn.lower(
-        params, cache, counts, tokens, positions, tables, step_key,
-        seeds, temp, topk, topp, freqp, presp,
-    )
-    compiled = lowered.compile()
-    ca = compiled.cost_analysis()
-    if ca:
-        ba = ca.get("bytes accessed", None)
-        print(f"[4] XLA cost analysis bytes accessed: "
-              f"{ba/1e9 if ba else '?'} GB for k={K} "
-              f"(per step {ba/K/1e9 if ba else '?'} GB; weights {pbytes/1e9:.2f})")
-
+          f"{ideal_step*K/dt*100:.0f}% of achievable-BW weight roofline)")
     engine.close()
+    del engine, cache, counts
 
-    # 2. forward-only scan (window decode, dense history, no lm_head/sampling)
+    # 2. ablation scans (params passed as args — no giant constants)
     wshape = (cfg.num_layers, S, K, cfg.num_kv_heads, cfg.head_dim)
+    cache2 = make_kv_cache(cfg, ec.resolve_num_blocks(), 16)
 
     @jax.jit
-    def fwd_only(cache, tokens, positions, tables):
+    def fwd_only(params, cache, tokens, positions, tables):
         base = positions
         hist_k, hist_v = gather_history(cache, tables)
         history = ("dense", hist_k, hist_v)
@@ -153,42 +156,125 @@ def main():
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return (nxt, pos + 1, wk, wv), nxt
 
-        (toks, pos, wk, wv), out = jax.lax.scan(
+        (toks, pos, wk, wv), outs = jax.lax.scan(
             body, (tokens, positions, wk0, wv0), jnp.arange(K))
-        return out
+        return outs, toks
 
-    cache2 = engine_cache = None
-    # fresh cache (engine's was donated away)
-    from dynamo_tpu.models.llama import make_kv_cache
-    cache2 = make_kv_cache(cfg, ec.resolve_num_blocks(), 16)
-    dt2 = timeit(fwd_only, cache2, tokens, positions, tables, n=3)
-    print(f"[2] fwd+argmax-only scan k={K}: {dt2*1e3:.1f} ms ({dt2/K*1e3:.2f} ms/step)")
+    log("compile fwd-only scan...")
+    outs, toks = fwd_only(params, cache2, tokens, positions, tables)
+    fetch(outs)
+    log("timing fwd-only scan...")
+    t0 = time.perf_counter()
+    for _ in range(N_ITER):
+        outs, toks = fwd_only(params, cache2, toks, positions, tables)
+    fetch(outs)
+    dt2 = (time.perf_counter() - t0) / N_ITER
+    print(f"[2] fwd+argmax scan (no window flush, no sampling machinery) "
+          f"k={K}: {dt2*1e3:.1f} ms ({dt2/K*1e3:.2f} ms/step)")
 
-    # 3. forward WITHOUT lm_head (hidden only): measure lm_head share
-    @jax.jit
-    def fwd_no_head(cache, tokens, positions, tables):
-        base = positions
-        hist_k, hist_v = gather_history(cache, tables)
-        history = ("dense", hist_k, hist_v)
-        wk0 = jnp.zeros(wshape, cache["k"].dtype)
-        wv0 = jnp.zeros(wshape, cache["v"].dtype)
+    # 3. k sweep on the raw scan: exposes fixed per-dispatch cost
+    for ksweep in (16, 32):
+        wshape_k = (cfg.num_layers, S, ksweep, cfg.num_kv_heads, cfg.head_dim)
 
-        def body(carry, k):
-            toks, pos, wk, wv = carry
-            logits, wk, wv = forward_window(
-                params, cfg, toks, pos, history, base, wk, wv, k,
+        @jax.jit
+        def fwd_k(params, cache, tokens, positions, tables, _ks=ksweep, _ws=wshape_k):
+            base = positions
+            hist_k, hist_v = gather_history(cache, tables)
+            history = ("dense", hist_k, hist_v)
+            wk0 = jnp.zeros(_ws, cache["k"].dtype)
+            wv0 = jnp.zeros(_ws, cache["v"].dtype)
+
+            def body(carry, k):
+                toks, pos, wk, wv = carry
+                logits, wk, wv = forward_window(
+                    params, cfg, toks, pos, history, base, wk, wv, k,
+                )
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (nxt, pos + 1, wk, wv), nxt
+
+            (toks, pos, wk, wv), outs = jax.lax.scan(
+                body, (tokens, positions, wk0, wv0), jnp.arange(_ks))
+            return outs, toks
+
+        outs, toks = fwd_k(params, cache2, tokens, positions, tables)
+        fetch(outs)
+        t0 = time.perf_counter()
+        for _ in range(N_ITER):
+            outs, toks = fwd_k(params, cache2, toks, positions, tables)
+        fetch(outs)
+        dtk = (time.perf_counter() - t0) / N_ITER
+        print(f"[3] fwd scan k={ksweep}: {dtk*1e3:.1f} ms ({dtk/ksweep*1e3:.2f} ms/step)")
+
+    # 4. chunk-prefill dispatch: [S, C] fresh prompt, the TTFT critical path
+    ec2 = EngineConfig(
+        max_slots=SLOTS, kv_block_size=16, max_model_len=MAX_LEN,
+        decode_steps=K, prefill_chunk=128,
+    )
+    log("build engine for chunk timing...")
+    engine2 = JaxServingEngine(cfg, params, ec2)
+    C = ec2.prefill_chunk
+    ptoks = jnp.asarray(rng.integers(0, cfg.vocab_size, (S, C)), jnp.int32)
+    ppos = jnp.tile(jnp.arange(C)[None], (S, 1))
+    sample_at = jnp.full((S,), C - 1, jnp.int32)
+    flops = 2.0 * (pbytes / 2) * S * C  # params(count) ≈ bytes/2 for bf16
+
+    for hist in (True, False):
+        cfn = engine2._chunk(False, False, False, hist)
+        cache3 = engine2.cache
+        counts3 = engine2._dummy_counts
+
+        def ccall(cache, counts):
+            nxt, cache, counts = cfn(
+                params, cache, counts, ptoks, ppos, tables, sample_at,
+                step_ctr, ipack, fpack,
             )
-            # feed a constant token: skip argmax + lm_head dependency? lm_head
-            # already ran inside forward_window; instead just don't use it.
-            return (toks, pos + 1, wk, wv), logits[:, 0]
+            return nxt, cache, counts
 
-        (toks, pos, wk, wv), out = jax.lax.scan(
-            body, (tokens, positions, wk0, wv0), jnp.arange(K))
-        return out
+        nxt, cache3, counts3 = ccall(cache3, counts3)
+        fetch(nxt)
+        t0 = time.perf_counter()
+        for _ in range(N_ITER):
+            nxt, cache3, counts3 = ccall(cache3, counts3)
+        fetch(nxt)
+        # donation: hand the live buffers back to the engine
+        engine2.cache = cache3
+        engine2._dummy_counts = counts3
+        dtc = (time.perf_counter() - t0) / N_ITER
+        print(f"[4] chunk prefill dispatch [S={S}, C={C}] history={hist}: "
+              f"{dtc*1e3:.1f} ms ({flops/dtc/1e12:.1f} TFLOP/s, "
+              f"{flops/dtc/197e12*100:.0f}% MFU)")
 
-    dt3 = timeit(fwd_no_head, cache2, tokens, positions, tables, n=3)
-    print(f"[3] fwd scan, constant feed (no argmax dep): {dt3*1e3:.1f} ms "
-          f"({dt3/K*1e3:.2f} ms/step)")
+    # 5. end-to-end single-request TTFT through the engine (host path incl.)
+    import asyncio
+
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    async def one_ttft():
+        req = PreprocessedRequest(
+            token_ids=rng.integers(0, cfg.vocab_size, 128).tolist(),
+            stop_conditions=StopConditions(max_tokens=2, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+        )
+        t0 = time.perf_counter()
+        async for item in engine2.generate(Context(req)):
+            if (item.data or {}).get("token_ids"):
+                return time.perf_counter() - t0
+        return None
+
+    # warm the serving path once, then measure
+    asyncio.run(one_ttft())
+    ts = [asyncio.run(one_ttft()) for _ in range(3)]
+    print(f"[5] single-request TTFT (prompt 128, engine path): "
+          f"{', '.join(f'{t*1e3:.0f}' for t in ts)} ms "
+          f"(device chunk alone: {dtc*1e3:.0f} ms)")
+    engine2.close()
+
+    log("done")
 
 
 if __name__ == "__main__":
