@@ -123,6 +123,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="decode: enqueue long prefills to remote prefill workers")
     p.add_argument("--max-local-prefill-length", type=int, default=1000)
     p.add_argument("--max-prefill-queue-size", type=int, default=2)
+    p.add_argument(
+        "--engine-isolation", choices=["subprocess", "inprocess"],
+        default="subprocess",
+        help="pystr:/pytok: engines run as a crash-isolated child process "
+             "(default) or imported in-process",
+    )
     return p
 
 
@@ -167,38 +173,25 @@ def _token_pipelines(card: ModelDeploymentCard, make_core):
     return build(True), build(False)
 
 
-def _load_user_engine(path: str):
-    """Load a bring-your-own-engine python file.
+def _load_user_engine(path: str, isolation: str = "subprocess"):
+    """Build a bring-your-own-engine from a user python file.
 
-    The file must expose either an AsyncEngine instance named ``engine`` or
-    a factory ``make_engine()`` returning one, or a module-level async
-    generator function ``generate(request)`` (wrapped automatically).
-    Reference: `lib/engines/python/src/lib.rs:78-382` (pystr:/pytok:).
+    ``isolation="subprocess"`` (default, reference parity: engines run as
+    crash-isolated children — lib/engines/sglang/src/worker.rs:784) hosts it
+    in a child process behind :class:`SubprocessEngine`: a segfaulting or
+    leaking engine cannot take the worker down, its logs are scraped, and it
+    restarts on crash. ``isolation="inprocess"`` imports it directly.
     """
-    import importlib.util
+    if isolation == "subprocess":
+        from ..llm.subprocess_engine import SubprocessEngine
 
-    spec = importlib.util.spec_from_file_location("dyn_user_engine", path)
-    if spec is None or spec.loader is None:
-        raise SystemExit(f"cannot load user engine file {path!r}")
-    module = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(module)
+        return SubprocessEngine(path)
+    from ..llm.subprocess_engine import load_user_engine
 
-    if hasattr(module, "engine"):
-        return module.engine
-    if hasattr(module, "make_engine"):
-        return module.make_engine()
-    if hasattr(module, "generate"):
-        from ..runtime.engine import AsyncEngine
-
-        class _FnEngine(AsyncEngine):
-            async def generate(self, request):
-                async for item in module.generate(request):
-                    yield item
-
-        return _FnEngine()
-    raise SystemExit(
-        f"user engine {path!r} must define `engine`, `make_engine()`, or `generate()`"
-    )
+    try:
+        return load_user_engine(path)
+    except RuntimeError as e:
+        raise SystemExit(str(e))
 
 
 def build_engine(out_spec: str, flags: argparse.Namespace):
@@ -221,7 +214,9 @@ def build_engine(out_spec: str, flags: argparse.Namespace):
         # bring-your-own-engine: a user python file provides the engine
         # (reference lib/engines/python: same two integration levels)
         scheme, _, path = out_spec.partition(":")
-        user_engine = _load_user_engine(path)
+        user_engine = _load_user_engine(
+            path, getattr(flags, "engine_isolation", "subprocess")
+        )
         if scheme == "pystr":
             # OpenAI-request level: the user engine sees plain request dicts
             # (the reference hands its python engines JSON, not typed models)
@@ -576,6 +571,44 @@ async def amain(argv: list[str]) -> None:
     else:
         chat_engine, completions_engine, model_name, core_engine = build_engine(out_spec, flags)
 
+    # multi-host serving: after the lockstep warmup, followers execute the
+    # leader's broadcast dispatch stream; only the leader serves a frontend
+    # (parallel/multihost_serving.py; flags: --num-nodes N --node-rank R
+    # --coordinator-addr host:port, same on every host)
+    if flags.num_nodes > 1 and core_engine is not None and getattr(core_engine, "mesh", None) is not None:
+        import jax as _jax
+
+        from ..parallel.multihost_serving import LeaderBroadcaster, follower_serve
+
+        if _jax.process_index() != 0:
+            logger.info("node %d: following the leader's dispatch stream", flags.node_rank)
+            await asyncio.to_thread(
+                follower_serve,
+                core_engine.model_config, core_engine.params,
+                core_engine.config, core_engine.mesh, engine=core_engine,
+            )
+            return
+        hook = LeaderBroadcaster(core_engine)
+        core_engine._dispatch_hook = hook
+        try:
+            await _serve_frontend(
+                in_spec, chat_engine, completions_engine, model_name, flags,
+                core_engine,
+            )
+        finally:
+            # release the followers: without the shutdown opcode every
+            # non-zero rank blocks forever in broadcast_one_to_all
+            core_engine.close()
+            hook.shutdown()
+        return
+
+    await _serve_frontend(
+        in_spec, chat_engine, completions_engine, model_name, flags, core_engine
+    )
+
+
+async def _serve_frontend(in_spec, chat_engine, completions_engine, model_name,
+                          flags, core_engine) -> None:
     if in_spec == "http":
         await run_http(chat_engine, completions_engine, model_name, flags)
     elif in_spec == "text":

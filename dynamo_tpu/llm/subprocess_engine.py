@@ -1,0 +1,411 @@
+"""Crash-isolated bring-your-own-engine host.
+
+The reference runs external engines as subprocess children: a ZMQ ipc socket
+pair with msgpack framing, a ready handshake over a passed fd, stdout/stderr
+scraped into the host's logs, and crash isolation so a dying engine never
+takes the worker down (lib/engines/sglang/src/{worker.rs:784,subprocess.rs},
+lib/engines/vllm0_7/src/worker.rs:797). TPU-build equivalent, re-designed on
+asyncio: a fork/exec child speaking the framed two-part codec
+(runtime/codec.py) over an inherited unix socketpair.
+
+- **ready handshake**: the child loads the user engine, then sends a
+  ``{"ready": true}`` frame; the parent won't serve until it arrives.
+- **log scraping**: child stdout/stderr lines re-emit through the parent's
+  ``logging`` under ``user-engine`` (stderr at WARNING).
+- **crash isolation**: an EOF on the pair fails every in-flight request with
+  a clean error item; with ``restart_on_crash`` the child respawns with
+  backoff and NEW requests proceed (in-flight ones are failed, not replayed).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import socket
+import sys
+from typing import Any, AsyncIterator, Dict, Optional
+
+from dynamo_tpu.runtime.annotated import Annotated
+from dynamo_tpu.runtime.codec import TwoPartMessage, read_frame, write_frame
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+logger = logging.getLogger(__name__)
+_ENGINE_FD_ENV = "DYN_TPU_ENGINE_FD"
+
+
+def load_user_engine(path: str):
+    """Load a bring-your-own-engine python file.
+
+    The file must expose an AsyncEngine instance named ``engine``, a factory
+    ``make_engine()`` returning one, or a module-level async generator
+    function ``generate(request)`` (wrapped automatically).
+    Reference: `lib/engines/python/src/lib.rs:78-382` (pystr:/pytok:).
+    """
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("dyn_user_engine", path)
+    if spec is None or spec.loader is None:
+        raise RuntimeError(f"cannot load user engine file {path!r}")
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+
+    if hasattr(module, "engine"):
+        return module.engine
+    if hasattr(module, "make_engine"):
+        return module.make_engine()
+    if hasattr(module, "generate"):
+
+        class _FnEngine(AsyncEngine):
+            async def generate(self, request):
+                async for item in module.generate(request):
+                    yield item
+
+        return _FnEngine()
+    raise RuntimeError(
+        f"user engine {path!r} must define `engine`, `make_engine()`, or `generate()`"
+    )
+
+
+def _serialize_request(data: Any) -> tuple:
+    """(kind, json-able payload) for the wire."""
+    if hasattr(data, "to_dict"):
+        return type(data).__name__, data.to_dict()
+    if hasattr(data, "model_dump"):
+        return "dict", data.model_dump(exclude_none=True)
+    return "dict", data
+
+
+def _deserialize_request(kind: str, payload: Any):
+    if kind == "PreprocessedRequest":
+        from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+
+        return PreprocessedRequest.from_dict(payload)
+    return payload
+
+
+class SubprocessEngine(AsyncEngine):
+    """AsyncEngine proxy around a user engine running in a child process."""
+
+    def __init__(
+        self,
+        user_path: str,
+        restart_on_crash: bool = True,
+        ready_timeout: float = 60.0,
+        restart_backoff: float = 0.5,
+    ):
+        self.user_path = user_path
+        self.restart_on_crash = restart_on_crash
+        self.ready_timeout = ready_timeout
+        self.restart_backoff = restart_backoff
+        self._proc: Optional[asyncio.subprocess.Process] = None
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._sock: Optional[socket.socket] = None
+        self._streams: Dict[str, asyncio.Queue] = {}
+        self._send_lock = asyncio.Lock()
+        self._closing = False
+        self._tasks: list = []
+        self._ready = asyncio.Event()
+        self._restart_task: Optional[asyncio.Task] = None
+        self._start_lock: Optional[asyncio.Lock] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        parent_sock, child_sock = socket.socketpair()
+        parent_sock.setblocking(False)
+        self._sock = parent_sock
+        env = dict(os.environ)
+        env[_ENGINE_FD_ENV] = str(child_sock.fileno())
+        self._proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-u", "-m", "dynamo_tpu.llm.subprocess_engine",
+            self.user_path,
+            pass_fds=(child_sock.fileno(),),
+            env=env,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        child_sock.close()
+        self._reader, self._writer = await asyncio.open_connection(sock=parent_sock)
+        self._tasks = [
+            asyncio.create_task(self._scrape(self._proc.stdout, logging.INFO)),
+            asyncio.create_task(self._scrape(self._proc.stderr, logging.WARNING)),
+        ]
+        # ready handshake before the read loop takes over the stream
+        try:
+            frame = await asyncio.wait_for(
+                read_frame(self._reader), self.ready_timeout
+            )
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError, ConnectionError) as e:
+            await self._kill_child()
+            raise RuntimeError(
+                f"user engine {self.user_path!r} failed to become ready: {e}"
+            ) from e
+        header = json.loads(frame.header)
+        if not header.get("ready"):
+            await self._kill_child()
+            raise RuntimeError(
+                f"user engine {self.user_path!r} handshake error: "
+                f"{header.get('error', 'unknown')}"
+            )
+        self._ready.set()
+        self._tasks.append(asyncio.create_task(self._read_loop()))
+        logger.info(
+            "user engine %s running in subprocess pid=%d",
+            self.user_path, self._proc.pid,
+        )
+
+    async def _kill_child(self) -> None:
+        if self._proc is not None and self._proc.returncode is None:
+            try:
+                self._proc.kill()
+            except ProcessLookupError:
+                pass
+            await self._proc.wait()
+        if self._writer is not None:
+            self._writer.close()
+
+    async def close(self) -> None:
+        self._closing = True
+        if self._restart_task is not None:
+            self._restart_task.cancel()
+        try:
+            if self._writer is not None:
+                async with self._send_lock:
+                    await write_frame(
+                        self._writer,
+                        TwoPartMessage(json.dumps({"op": "shutdown"}).encode(), b""),
+                    )
+                if self._proc is not None:
+                    await asyncio.wait_for(self._proc.wait(), 5.0)
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+        await self._kill_child()
+        for t in self._tasks:
+            t.cancel()
+
+    async def _scrape(self, stream, level: int) -> None:
+        """Re-emit child output through the framework's logging."""
+        if stream is None:
+            return
+        try:
+            while True:
+                line = await stream.readline()
+                if not line:
+                    return
+                logger.log(level, "[user-engine] %s", line.decode(errors="replace").rstrip())
+        except asyncio.CancelledError:
+            pass
+
+    # -- wire ----------------------------------------------------------------
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = await read_frame(self._reader)
+                h = json.loads(frame.header)
+                q = self._streams.get(h.get("id"))
+                if q is None:
+                    continue
+                kind = h.get("kind")
+                if kind == "item":
+                    q.put_nowait(("item", json.loads(frame.body)))
+                elif kind == "end":
+                    q.put_nowait(("end", None))
+                elif kind == "error":
+                    q.put_nowait(("error", h.get("message", "engine error")))
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        # child gone (crash or shutdown): fail every in-flight request
+        exit_code = self._proc.returncode if self._proc else None
+        for q in self._streams.values():
+            q.put_nowait(
+                ("error", f"engine subprocess died (exit={exit_code})")
+            )
+        self._ready.clear()
+        if not self._closing and self.restart_on_crash:
+            logger.warning(
+                "user engine subprocess died (exit=%s); restarting", exit_code
+            )
+            self._restart_task = asyncio.create_task(self._restart())
+
+    async def _restart(self) -> None:
+        delay = self.restart_backoff
+        while not self._closing:
+            await asyncio.sleep(delay)
+            try:
+                await self.start()
+                return
+            except (RuntimeError, OSError) as e:
+                logger.error("user engine restart failed: %s", e)
+                delay = min(delay * 2, 10.0)
+
+    # -- AsyncEngine ---------------------------------------------------------
+
+    async def generate(self, request: Context) -> AsyncIterator[Annotated]:
+        if self._start_lock is None:
+            self._start_lock = asyncio.Lock()
+        async with self._start_lock:
+            if self._proc is None and not self._closing:
+                # lazy spawn on first use (build paths are synchronous)
+                await self.start()
+        if not self._ready.is_set():
+            try:
+                await asyncio.wait_for(self._ready.wait(), self.ready_timeout)
+            except asyncio.TimeoutError:
+                yield Annotated.from_error("engine subprocess unavailable")
+                return
+        rid = request.id
+        kind, payload = _serialize_request(request.data)
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[rid] = q
+        try:
+            try:
+                async with self._send_lock:
+                    await write_frame(
+                        self._writer,
+                        TwoPartMessage(
+                            json.dumps(
+                                {"op": "generate", "id": rid, "type": kind}
+                            ).encode(),
+                            json.dumps(payload).encode(),
+                        ),
+                    )
+            except (ConnectionError, OSError) as e:
+                yield Annotated.from_error(f"engine subprocess unreachable: {e}")
+                return
+            while True:
+                if request.context.is_stopped:
+                    try:
+                        async with self._send_lock:
+                            await write_frame(
+                                self._writer,
+                                TwoPartMessage(
+                                    json.dumps({"op": "cancel", "id": rid}).encode(),
+                                    b"",
+                                ),
+                            )
+                    except (ConnectionError, OSError):
+                        pass
+                    return
+                try:
+                    what, value = await asyncio.wait_for(q.get(), 0.5)
+                except asyncio.TimeoutError:
+                    continue  # poll is_stopped
+                if what == "item":
+                    yield Annotated.from_dict(value)
+                elif what == "error":
+                    yield Annotated.from_error(value)
+                    return
+                else:  # end
+                    return
+        finally:
+            self._streams.pop(rid, None)
+
+
+# =========================================================================
+# child entrypoint: python -m dynamo_tpu.llm.subprocess_engine <user_file>
+# =========================================================================
+
+
+async def _child_main(user_path: str) -> None:
+    fd = int(os.environ[_ENGINE_FD_ENV])
+    sock = socket.socket(fileno=fd)
+    sock.setblocking(False)
+    reader, writer = await asyncio.open_connection(sock=sock)
+
+    try:
+        engine = load_user_engine(user_path)
+    except Exception as e:  # report over the pair, then exit nonzero
+        await write_frame(
+            writer,
+            TwoPartMessage(
+                json.dumps({"ready": False, "error": str(e)}).encode(), b""
+            ),
+        )
+        raise SystemExit(1)
+    await write_frame(
+        writer, TwoPartMessage(json.dumps({"ready": True}).encode(), b"")
+    )
+
+    send_lock = asyncio.Lock()
+    contexts: Dict[str, Context] = {}
+
+    async def run_request(rid: str, req: Context) -> None:
+        try:
+            async for item in engine.generate(req):
+                if isinstance(item, Annotated):
+                    wire = item.to_dict()
+                elif isinstance(item, dict):
+                    wire = {"data": item}
+                else:
+                    wire = {"data": item}
+                async with send_lock:
+                    await write_frame(
+                        writer,
+                        TwoPartMessage(
+                            json.dumps({"id": rid, "kind": "item"}).encode(),
+                            json.dumps(wire).encode(),
+                        ),
+                    )
+            async with send_lock:
+                await write_frame(
+                    writer,
+                    TwoPartMessage(
+                        json.dumps({"id": rid, "kind": "end"}).encode(), b""
+                    ),
+                )
+        except Exception as e:
+            logging.getLogger("dyn_user_engine").exception("generate failed")
+            try:
+                async with send_lock:
+                    await write_frame(
+                        writer,
+                        TwoPartMessage(
+                            json.dumps(
+                                {"id": rid, "kind": "error", "message": str(e)}
+                            ).encode(),
+                            b"",
+                        ),
+                    )
+            except (ConnectionError, OSError):
+                pass
+        finally:
+            contexts.pop(rid, None)
+
+    tasks = set()
+    while True:
+        try:
+            frame = await read_frame(reader)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return  # parent gone
+        h = json.loads(frame.header)
+        op = h.get("op")
+        if op == "shutdown":
+            return
+        if op == "cancel":
+            ctx = contexts.get(h.get("id"))
+            if ctx is not None:
+                ctx.context.stop_generating()
+            continue
+        if op == "generate":
+            rid = h["id"]
+            payload = _deserialize_request(
+                h.get("type", "dict"), json.loads(frame.body)
+            )
+            req = Context(payload, request_id=rid)
+            contexts[rid] = req
+            t = asyncio.create_task(run_request(rid, req))
+            tasks.add(t)
+            t.add_done_callback(tasks.discard)
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(_child_main(sys.argv[1]))
+
+
+if __name__ == "__main__":
+    main()

@@ -23,7 +23,7 @@ class TestUserEngine:
             "async def generate(request):\n"
             "    yield Annotated.from_data({'echo': request.data.get('x')})\n"
         )
-        eng = _load_user_engine(str(f))
+        eng = _load_user_engine(str(f), isolation="inprocess")
 
         async def go():
             return [i async for i in eng.generate(Context({"x": 42}))]
@@ -39,7 +39,7 @@ class TestUserEngine:
             "from dynamo_tpu.llm.engines import EchoEngineFull\n"
             "engine = EchoEngineFull()\n"
         )
-        eng = _load_user_engine(str(f))
+        eng = _load_user_engine(str(f), isolation="inprocess")
         assert type(eng).__name__ == "EchoEngineFull"
 
     def test_missing_entrypoints_rejected(self, tmp_path):
@@ -48,7 +48,7 @@ class TestUserEngine:
         f = tmp_path / "empty.py"
         f.write_text("x = 1\n")
         with pytest.raises(SystemExit):
-            _load_user_engine(str(f))
+            _load_user_engine(str(f), isolation="inprocess")
 
 
 class TestStandaloneRouter:

@@ -1,0 +1,152 @@
+"""Subprocess-isolated BYO engine (VERDICT r3 missing item 2).
+
+Reference behavior being matched: engines run as crash-isolated children
+with an IPC pair, framed messages, a ready handshake and log scraping
+(lib/engines/sglang/src/worker.rs:784, subprocess.rs). The key contract:
+a dying engine fails its in-flight requests cleanly and the worker process
+survives — and with restart-on-crash, later requests succeed again.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.llm.subprocess_engine import SubprocessEngine
+from dynamo_tpu.runtime.engine import Context
+
+GOOD_ENGINE = '''
+import asyncio
+from dynamo_tpu.runtime.annotated import Annotated
+
+async def generate(request):
+    data = request.data
+    n = int(data.get("n", 3))
+    for i in range(n):
+        await asyncio.sleep(0.01)
+        yield Annotated.from_data({"i": i, "echo": data.get("text", "")})
+'''
+
+CRASH_ENGINE = '''
+import asyncio, os, sys
+from dynamo_tpu.runtime.annotated import Annotated
+
+async def generate(request):
+    yield Annotated.from_data({"i": 0})
+    await asyncio.sleep(0.05)
+    print("about to crash", file=sys.stderr, flush=True)
+    os._exit(42)  # simulated segfault: no cleanup, no goodbye
+'''
+
+BROKEN_ENGINE = '''
+raise ImportError("this engine cannot even import")
+'''
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def collect(engine, payload):
+    items = []
+    async for item in engine.generate(Context(payload)):
+        items.append(item)
+    return items
+
+
+class TestSubprocessEngine:
+    def test_round_trip(self, tmp_path):
+        f = tmp_path / "eng.py"
+        f.write_text(GOOD_ENGINE)
+
+        async def go():
+            eng = SubprocessEngine(str(f))
+            try:
+                items = await collect(eng, {"n": 4, "text": "hi"})
+                assert [i.data["i"] for i in items] == [0, 1, 2, 3]
+                assert items[0].data["echo"] == "hi"
+                # concurrent requests multiplex over the one pair
+                r = await asyncio.gather(
+                    collect(eng, {"n": 2}), collect(eng, {"n": 3})
+                )
+                assert [len(x) for x in r] == [2, 3]
+            finally:
+                await eng.close()
+
+        run(go())
+
+    def test_crash_mid_stream_fails_cleanly_and_restarts(self, tmp_path):
+        f = tmp_path / "eng.py"
+        f.write_text(CRASH_ENGINE)
+
+        async def go():
+            eng = SubprocessEngine(str(f), restart_backoff=0.1)
+            try:
+                items = await collect(eng, {})
+                # first item arrived, then a clean error — no hang, no
+                # exception escaping into the worker
+                assert items[0].data == {"i": 0}
+                assert items[-1].is_error
+                assert "died" in items[-1].error_message()
+
+                # the child restarts; the next request reaches the fresh one
+                await asyncio.sleep(0.5)
+                items2 = await collect(eng, {})
+                assert items2[0].data == {"i": 0}
+            finally:
+                await eng.close()
+
+        run(go())
+
+    def test_failed_handshake_reports_error(self, tmp_path):
+        f = tmp_path / "eng.py"
+        f.write_text(BROKEN_ENGINE)
+
+        async def go():
+            eng = SubprocessEngine(str(f))
+            with pytest.raises(RuntimeError, match="cannot even import"):
+                await eng.start()
+
+        run(go())
+
+    def test_log_scraping(self, tmp_path, caplog):
+        f = tmp_path / "eng.py"
+        f.write_text(
+            GOOD_ENGINE.replace(
+                "async def generate",
+                'print("engine booted ok", flush=True)\n\nasync def generate',
+            )
+        )
+
+        async def go():
+            eng = SubprocessEngine(str(f))
+            try:
+                await eng.start()
+                await collect(eng, {"n": 1})
+                await asyncio.sleep(0.1)
+            finally:
+                await eng.close()
+
+        import logging
+
+        with caplog.at_level(logging.INFO, logger="dynamo_tpu.llm.subprocess_engine"):
+            run(go())
+        assert any("engine booted ok" in r.getMessage() for r in caplog.records)
+
+    def test_cancellation_propagates(self, tmp_path):
+        f = tmp_path / "eng.py"
+        f.write_text(GOOD_ENGINE)
+
+        async def go():
+            eng = SubprocessEngine(str(f))
+            try:
+                ctx = Context({"n": 1000})
+                got = 0
+                async for _ in eng.generate(ctx):
+                    got += 1
+                    if got == 2:
+                        ctx.context.stop_generating()
+                assert got < 50  # stopped early, not after 1000 items
+            finally:
+                await eng.close()
+
+        run(go())
