@@ -1,0 +1,157 @@
+"""In-memory mock transport with latency models for tests.
+
+Reference parity: `lib/runtime/tests/common/mock.rs:31-496` — a complete fake
+network transport (`MockNetworkTransport::new_egress_ingress`) with
+`LatencyModel::{NoDelay, ConstantDelayInNanos, NormalDistribution}` so
+multi-node pipelines and routing policies are unit-testable without a
+cluster, and latency-sensitivity regressions are visible in CI.
+
+TPU-build shape: the seam is :class:`AsyncEngine` (every network hop proxies
+one), so the mock is an engine wrapper pair —
+
+- :class:`MockNetwork` — a registry standing in for discovery: register
+  engines under endpoint names, get back latency-injected clients.
+- :class:`MockChannel` — the egress↔ingress pair for ONE endpoint: applies
+  the request-path latency before dispatch, the response-path latency per
+  item, counts in-flight requests, and injects faults (connection errors,
+  drops) on demand.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass
+from typing import AsyncIterator, Dict, Optional
+
+from dynamo_tpu.runtime.annotated import Annotated
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+
+# -- latency models ----------------------------------------------------------
+
+
+class LatencyModel:
+    """Base: no delay (reference LatencyModel::NoDelay)."""
+
+    async def delay(self) -> None:
+        return None
+
+
+class NoDelay(LatencyModel):
+    pass
+
+
+@dataclass
+class ConstantDelay(LatencyModel):
+    """Fixed delay per hop (reference ConstantDelayInNanos)."""
+
+    seconds: float
+
+    async def delay(self) -> None:
+        if self.seconds > 0:
+            await asyncio.sleep(self.seconds)
+
+
+@dataclass
+class NormalDistribution(LatencyModel):
+    """Gaussian delay, clamped at ``floor`` (reference NormalDistribution)."""
+
+    mean: float
+    std: float
+    floor: float = 0.0
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+
+    async def delay(self) -> None:
+        d = max(self.floor, self._rng.gauss(self.mean, self.std))
+        if d > 0:
+            await asyncio.sleep(d)
+
+
+# -- the egress/ingress pair -------------------------------------------------
+
+
+class MockChannel(AsyncEngine):
+    """Latency-injecting in-memory proxy in front of one engine.
+
+    The request path sleeps ``request_latency`` once (the NATS push + TCP
+    connect-back of the real plane); the response path sleeps
+    ``response_latency`` before each item (per-frame transit). Faults:
+    ``fail_next(n)`` makes the next n requests surface a connection error
+    (as an error item, exactly like the real egress does)."""
+
+    def __init__(
+        self,
+        engine: AsyncEngine,
+        request_latency: Optional[LatencyModel] = None,
+        response_latency: Optional[LatencyModel] = None,
+    ):
+        self.engine = engine
+        self.request_latency = request_latency or NoDelay()
+        self.response_latency = response_latency or NoDelay()
+        self.inflight = 0
+        self.total_requests = 0
+        self._fail_budget = 0
+
+    def fail_next(self, n: int = 1) -> None:
+        self._fail_budget += n
+
+    async def generate(self, request: Context) -> AsyncIterator[Annotated]:
+        self.total_requests += 1
+        if self._fail_budget > 0:
+            self._fail_budget -= 1
+            yield Annotated.from_error("mock transport: connection refused")
+            return
+        await self.request_latency.delay()
+        self.inflight += 1
+        try:
+            async for item in self.engine.generate(request):
+                await self.response_latency.delay()
+                if request.context.is_stopped:
+                    return  # egress stops reading when the caller cancels
+                yield item
+        finally:
+            self.inflight -= 1
+
+
+class MockNetwork:
+    """Stand-in for the discovery plane: endpoint name → engine, with a
+    network-wide default latency model and per-endpoint overrides."""
+
+    def __init__(
+        self,
+        request_latency: Optional[LatencyModel] = None,
+        response_latency: Optional[LatencyModel] = None,
+    ):
+        self.request_latency = request_latency or NoDelay()
+        self.response_latency = response_latency or NoDelay()
+        self._endpoints: Dict[str, AsyncEngine] = {}
+        self._channels: Dict[str, MockChannel] = {}
+
+    def register(self, name: str, engine: AsyncEngine) -> None:
+        self._endpoints[name] = engine
+
+    def endpoints(self) -> list:
+        return sorted(self._endpoints)
+
+    def client(
+        self,
+        name: str,
+        request_latency: Optional[LatencyModel] = None,
+        response_latency: Optional[LatencyModel] = None,
+    ) -> MockChannel:
+        """An egress client for an endpoint (one channel per endpoint,
+        reused — its counters accumulate like a real connection's)."""
+        if name not in self._endpoints:
+            raise KeyError(f"unknown mock endpoint {name!r}")
+        ch = self._channels.get(name)
+        if ch is None:
+            ch = self._channels[name] = MockChannel(
+                self._endpoints[name],
+                request_latency or self.request_latency,
+                response_latency or self.response_latency,
+            )
+        return ch
