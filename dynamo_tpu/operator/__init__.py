@@ -1,0 +1,19 @@
+"""Declarative deploy tier: DynamoGraph CRD + reconciling operator.
+
+`kubectl apply` one DynamoGraph object and the controller materializes the
+whole serving graph (statestore, bus, frontend, worker pools); edit it to
+scale or reconfigure; delete it and ownerReferences tear everything down.
+Reference: the K8s operator (deploy/dynamo/operator, Go/kubebuilder) —
+re-built as a Python watch-loop on a minimal REST client.
+"""
+
+from dynamo_tpu.operator.controller import GraphController, desired_children
+from dynamo_tpu.operator.kube import FakeKube, KubeApi, RealKube
+
+__all__ = [
+    "GraphController",
+    "desired_children",
+    "FakeKube",
+    "KubeApi",
+    "RealKube",
+]

@@ -1,0 +1,335 @@
+"""DynamoGraph controller: declarative graph deployment.
+
+Reconciles ``DynamoGraph`` custom resources into the child objects that run
+an inference graph — statestore, bus, frontend, decode workers, prefill
+workers (each a Deployment + Service) — creating, updating, scaling and
+tearing down to match the spec, with ownerReferences so deleting the CR
+garbage-collects everything.
+
+Reference parity: the K8s operator's reconcile loop
+(deploy/dynamo/operator/internal/controller/dynamodeployment_controller.go:74,
+dynamonimdeployment_controller.go:134 — CRD → Deployments/Services/ingress).
+Re-designed for this runtime's topology: one CR describes the WHOLE graph
+(frontend + planes + worker pools), matching the self-hosted statestore/bus
+architecture instead of NATS/etcd operator charts.
+
+Example CR::
+
+    apiVersion: dynamo.tpu/v1
+    kind: DynamoGraph
+    metadata: {name: llama-serve}
+    spec:
+      image: dynamo-tpu:latest
+      model: {path: /models/llama3-1b, name: llama}
+      frontend: {replicas: 1, port: 8080}
+      workers:
+        decode: {replicas: 2, args: ["--max-batch-size", "16"]}
+        prefill: {replicas: 1}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+from typing import Dict, List, Optional
+
+from dynamo_tpu.operator.kube import KubeApi
+
+logger = logging.getLogger(__name__)
+
+GROUP_API = "apis/dynamo.tpu/v1"
+GRAPH_PLURAL = "dynamographs"
+APPS_API = "apis/apps/v1"
+CORE_API = "api/v1"
+
+SPEC_HASH_ANNOTATION = "dynamo.tpu/spec-hash"
+MANAGED_LABEL = "dynamo.tpu/graph"
+
+
+def _spec_hash(obj: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(obj, sort_keys=True).encode()
+    ).hexdigest()[:16]
+
+
+def _owner_ref(cr: dict) -> dict:
+    return {
+        "apiVersion": "dynamo.tpu/v1",
+        "kind": "DynamoGraph",
+        "name": cr["metadata"]["name"],
+        "uid": cr["metadata"].get("uid", ""),
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }
+
+
+def desired_children(cr: dict) -> List[dict]:
+    """Expand a DynamoGraph spec into its child Deployments + Services."""
+    spec = cr.get("spec", {})
+    graph = cr["metadata"]["name"]
+    ns = cr["metadata"].get("namespace", "default")
+    image = spec.get("image", "dynamo-tpu:latest")
+    model = spec.get("model", {})
+    owner = _owner_ref(cr)
+
+    ss_host = f"{graph}-statestore"
+    bus_host = f"{graph}-bus"
+    common_flags = [
+        "--statestore", f"{ss_host}:37901",
+        "--bus", f"{bus_host}:37902",
+        "--namespace", spec.get("namespace", "dynamo"),
+    ]
+
+    def deployment(name: str, command: List[str], replicas: int,
+                   port: Optional[int] = None, component: str = "",
+                   resources: Optional[dict] = None) -> dict:
+        labels = {MANAGED_LABEL: graph, "app": name}
+        container = {
+            "name": "main",
+            "image": image,
+            "command": command,
+            "env": [{"name": "PYTHONUNBUFFERED", "value": "1"}],
+        }
+        if port is not None:
+            container["ports"] = [{"containerPort": port}]
+        if resources:
+            container["resources"] = resources
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {
+                "name": name,
+                "namespace": ns,
+                "labels": labels,
+                "ownerReferences": [owner],
+            },
+            "spec": {
+                "replicas": replicas,
+                "selector": {"matchLabels": {"app": name}},
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": {"containers": [container]},
+                },
+            },
+        }
+
+    def service(name: str, port: int) -> dict:
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": name,
+                "namespace": ns,
+                "labels": {MANAGED_LABEL: graph},
+                "ownerReferences": [owner],
+            },
+            "spec": {
+                "selector": {"app": name},
+                "ports": [{"port": port, "targetPort": port}],
+            },
+        }
+
+    children: List[dict] = [
+        deployment(
+            ss_host,
+            ["python", "-m", "dynamo_tpu.runtime.statestore",
+             "--port", "37901", "--data-dir", "/data"],
+            1, port=37901,
+        ),
+        service(ss_host, 37901),
+        deployment(
+            bus_host,
+            ["python", "-m", "dynamo_tpu.runtime.bus", "--port", "37902"],
+            1, port=37902,
+        ),
+        service(bus_host, 37902),
+    ]
+
+    fe = spec.get("frontend", {})
+    fe_port = int(fe.get("port", 8080))
+    children.append(deployment(
+        f"{graph}-frontend",
+        ["python", "-m", "dynamo_tpu.cli.run",
+         "in=http", "out=discover", "--port", str(fe_port), *common_flags,
+         *fe.get("args", [])],
+        int(fe.get("replicas", 1)), port=fe_port,
+        resources=fe.get("resources"),
+    ))
+    children.append(service(f"{graph}-frontend", fe_port))
+
+    workers = spec.get("workers", {})
+    model_flags = []
+    if model.get("path"):
+        model_flags += ["--model-path", model["path"]]
+    if model.get("name"):
+        model_flags += ["--model-name", model["name"]]
+
+    decode = workers.get("decode", {})
+    if decode:
+        children.append(deployment(
+            f"{graph}-decode",
+            ["python", "-m", "dynamo_tpu.cli.run",
+             "in=dyn://worker", "out=jax", *model_flags, *common_flags,
+             *decode.get("args", [])],
+            int(decode.get("replicas", 1)),
+            resources=decode.get("resources"),
+        ))
+    prefill = workers.get("prefill", {})
+    if prefill:
+        children.append(deployment(
+            f"{graph}-prefill",
+            ["python", "-m", "dynamo_tpu.disagg.prefill_worker",
+             *model_flags, *common_flags, *prefill.get("args", [])],
+            int(prefill.get("replicas", 1)),
+            resources=prefill.get("resources"),
+        ))
+    return children
+
+
+class GraphController:
+    """Level-triggered reconcile loop over DynamoGraph CRs."""
+
+    def __init__(self, kube: KubeApi, namespace: str = "default",
+                 resync_interval: float = 30.0):
+        self.kube = kube
+        self.namespace = namespace
+        self.resync_interval = resync_interval
+        self._dirty = asyncio.Event()
+        self._stop = False
+        self._tasks: list = []
+
+    async def run(self) -> None:
+        """Watch CRs + children; reconcile on any change (and periodically)."""
+        self._tasks = [
+            asyncio.create_task(self._watch(GROUP_API, GRAPH_PLURAL)),
+            asyncio.create_task(self._watch(APPS_API, "deployments")),
+        ]
+        try:
+            while not self._stop:
+                self._dirty.clear()
+                try:
+                    await self.reconcile_all()
+                except Exception:
+                    logger.exception("reconcile pass failed")
+                try:
+                    await asyncio.wait_for(self._dirty.wait(), self.resync_interval)
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            for t in self._tasks:
+                t.cancel()
+
+    def stop(self) -> None:
+        self._stop = True
+        self._dirty.set()
+
+    async def _watch(self, api: str, plural: str) -> None:
+        try:
+            async for _ in self.kube.watch(api, plural, self.namespace):
+                self._dirty.set()
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            logger.exception("watch %s/%s failed", api, plural)
+            self._dirty.set()
+
+    # -- reconcile -----------------------------------------------------------
+
+    async def reconcile_all(self) -> None:
+        crs = await self.kube.list(GROUP_API, GRAPH_PLURAL, self.namespace)
+        live_graphs = set()
+        for cr in crs:
+            live_graphs.add(cr["metadata"]["name"])
+            await self.reconcile(cr)
+        # orphans: children labeled for a graph whose CR is gone. With a real
+        # apiserver ownerReference GC handles this; done here too so the
+        # controller converges even where GC lags.
+        for api, plural in ((APPS_API, "deployments"), (CORE_API, "services")):
+            for obj in await self.kube.list(api, plural, self.namespace):
+                g = obj["metadata"].get("labels", {}).get(MANAGED_LABEL)
+                if g is not None and g not in live_graphs:
+                    logger.info("GC orphan %s/%s", plural, obj["metadata"]["name"])
+                    await self.kube.delete(
+                        api, plural, self.namespace, obj["metadata"]["name"]
+                    )
+
+    async def reconcile(self, cr: dict) -> None:
+        children = desired_children(cr)
+        ready = 0
+        total_deployments = 0
+        desired_names = {
+            (c["kind"], c["metadata"]["name"]) for c in children
+        }
+        for child in children:
+            api, plural = (
+                (APPS_API, "deployments") if child["kind"] == "Deployment"
+                else (CORE_API, "services")
+            )
+            name = child["metadata"]["name"]
+            h = _spec_hash(child["spec"])
+            child["metadata"].setdefault("annotations", {})[SPEC_HASH_ANNOTATION] = h
+            live = await self.kube.get(api, plural, self.namespace, name)
+            if live is None:
+                logger.info("create %s/%s", plural, name)
+                live = await self.kube.create(api, plural, self.namespace, child)
+            elif (
+                live["metadata"].get("annotations", {}).get(SPEC_HASH_ANNOTATION) != h
+            ):
+                logger.info("update %s/%s (spec changed)", plural, name)
+                child["metadata"]["uid"] = live["metadata"].get("uid")
+                live = await self.kube.replace(api, plural, self.namespace, name, child)
+            if child["kind"] == "Deployment":
+                total_deployments += 1
+                want = child["spec"].get("replicas", 1)
+                if (live.get("status") or {}).get("readyReplicas", 0) >= want:
+                    ready += 1
+        # prune children of THIS graph that the spec no longer wants
+        # (e.g. prefill pool removed from the CR)
+        graph = cr["metadata"]["name"]
+        for api, plural, kind in (
+            (APPS_API, "deployments", "Deployment"),
+            (CORE_API, "services", "Service"),
+        ):
+            for obj in await self.kube.list(api, plural, self.namespace):
+                meta = obj["metadata"]
+                if meta.get("labels", {}).get(MANAGED_LABEL) != graph:
+                    continue
+                if (kind, meta["name"]) not in desired_names:
+                    logger.info("prune %s/%s", plural, meta["name"])
+                    await self.kube.delete(api, plural, self.namespace, meta["name"])
+
+        await self.kube.patch_status(
+            GROUP_API, GRAPH_PLURAL, self.namespace, cr["metadata"]["name"],
+            {
+                "observedGeneration": cr["metadata"].get("generation", 0),
+                "readyDeployments": ready,
+                "totalDeployments": total_deployments,
+                "phase": "Ready" if ready == total_deployments else "Progressing",
+            },
+        )
+
+
+def main() -> None:
+    import argparse
+
+    from dynamo_tpu.operator.kube import RealKube
+
+    p = argparse.ArgumentParser(description="dynamo_tpu graph operator")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--resync-interval", type=float, default=30.0)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        ctrl = GraphController(
+            RealKube(), args.namespace, args.resync_interval
+        )
+        await ctrl.run()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()
