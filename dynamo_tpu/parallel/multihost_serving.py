@@ -1,0 +1,189 @@
+"""Multi-host serving: one engine, a process-spanning mesh, lockstep SPMD.
+
+The serving engine is a single-controller design (one host owns admission,
+the allocator and streaming), but a model sharded over a MULTI-PROCESS mesh
+requires every process to execute the same XLA program in the same order.
+This module closes that gap with a lockstep protocol:
+
+- the **leader** (process 0) runs the full engine; its ``_dispatch_hook``
+  broadcasts a descriptor of every jitted dispatch — opcode, variant flags,
+  and the host input arrays — via ``multihost_utils.broadcast_one_to_all``;
+- every **follower** runs :func:`follower_serve`: it builds the same engine
+  object (same params, same mesh, no step thread), receives descriptors,
+  and invokes the identical jitted fns with identical replicated inputs —
+  its shards participate in the program's collectives over ICI/DCN.
+
+Reference parity: MultiNodeConfig engines (lib/llm/src/engines.rs:41-59) and
+the vLLM0.7 Ray leader/follower bring-up (lib/engines/vllm0_7/src/ray.rs:
+66-170) — re-designed for XLA's SPMD model: instead of an engine-internal
+NCCL world driven by RPC, the *dispatch stream itself* is the coordination
+channel, and XLA inserts the cross-host collectives.
+
+Limits (explicit): logprobs/penalty sampling paths are leader-only features
+not yet wired into the lockstep descriptors — multihost serving is greedy/
+temperature sampling (the common serving configuration).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# opcodes on the broadcast channel
+OP_SHUTDOWN = 0
+OP_CHUNK = 1
+OP_DECODE = 2
+
+_HDR = 8  # int32 header slots
+
+
+def _broadcast(tree):
+    from jax.experimental import multihost_utils as mhu
+
+    return mhu.broadcast_one_to_all(tree)
+
+
+class LeaderBroadcaster:
+    """The engine-side dispatch hook: ships each dispatch to the followers.
+
+    Install with ``engine._dispatch_hook = LeaderBroadcaster(engine)``; call
+    :meth:`shutdown` when serving ends so followers exit their loop."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._ec = engine.config
+
+    def __call__(self, kind: str, flags: dict, arrays: dict) -> None:
+        if flags.get("lp") or flags.get("pen"):
+            raise NotImplementedError(
+                "multihost lockstep serves greedy/temperature sampling; "
+                "logprobs/penalties are not in the descriptor protocol yet"
+            )
+        hdr = np.zeros((_HDR,), np.int32)
+        hdr[0] = OP_CHUNK if kind == "chunk" else OP_DECODE
+        hdr[1] = int(flags.get("sample", False))
+        hdr[2] = int(flags.get("history", True))
+        hdr[3] = int(flags.get("use_carry", False))
+        hdr[4] = int(flags["step"])
+        _broadcast(hdr)
+        if kind == "chunk":
+            payload = (
+                arrays["tokens"].astype(np.int32),
+                arrays["positions"].astype(np.int32),
+                arrays["tables"].astype(np.int32),
+                arrays["sample_at"].astype(np.int32),
+                arrays["ipack"].astype(np.int32),
+                arrays["fpack"].astype(np.float32),
+            )
+        else:
+            payload = (
+                arrays["tokens"].astype(np.int32),
+                arrays["positions"].astype(np.int32),
+                arrays["tables"].astype(np.int32),
+                arrays["ipack"].astype(np.int32),
+                arrays["fpack"].astype(np.float32),
+            )
+        _broadcast(payload)
+
+    def shutdown(self) -> None:
+        hdr = np.zeros((_HDR,), np.int32)
+        hdr[0] = OP_SHUTDOWN
+        _broadcast(hdr)
+
+
+def follower_serve(model_config, params, engine_config, mesh, engine=None) -> None:
+    """Run a follower: execute the leader's dispatch stream until shutdown.
+
+    Must be called with the SAME model config, params and engine config the
+    leader built its engine from, on every non-zero process of the
+    ``jax.distributed`` world, after the global mesh exists. Pass ``engine``
+    when an (already-warmed) engine exists — the CLI builds + warms one on
+    every rank so the warmup dispatches themselves run in lockstep."""
+    from dynamo_tpu.engine_jax.engine import JaxServingEngine
+
+    if engine is not None:
+        eng = engine
+    else:
+        eng = JaxServingEngine(model_config, params, engine_config, mesh=mesh)
+        # warmup is itself a sequence of global dispatches: the leader runs
+        # the same calls before serving (contract: leader warms up, THEN
+        # installs the broadcast hook), so both sides run it in lockstep here
+        eng.warmup()
+    S, C = engine_config.max_slots, engine_config.prefill_chunk
+    MB = engine_config.max_blocks_per_seq
+    carry = None  # (tokens, positions) device arrays from the last decode
+    counts = eng._dummy_counts
+    z_i = np.zeros((S,), np.int32)
+
+    logger.info("multihost follower serving (process %d)", _process_index())
+    while True:
+        hdr = _broadcast(np.zeros((_HDR,), np.int32))
+        op = int(hdr[0])
+        if op == OP_SHUTDOWN:
+            logger.info("follower shutdown")
+            return
+        want_sample = bool(hdr[1])
+        want_history = bool(hdr[2])
+        use_carry = bool(hdr[3])
+        step = int(hdr[4])
+        if op == OP_CHUNK:
+            tokens, positions, tables, sample_at, ipack, fpack = _broadcast((
+                np.zeros((S, C), np.int32), np.zeros((S, C), np.int32),
+                np.zeros((S, MB), np.int32), z_i,
+                np.zeros((2, S), np.int32), np.zeros((4, S), np.float32),
+            ))
+            fn = eng._chunk(False, False, want_sample, want_history)
+            out, eng.cache, counts = fn(
+                eng.params, eng.cache, counts, eng._put(tokens),
+                eng._put(positions), eng._m_tables.get(tables),
+                eng._put(sample_at), eng._put(np.int32(step)),
+                eng._m_ipack.get(ipack), eng._m_fpack.get(fpack),
+            )
+            carry = None  # leader also drains its pipeline around chunks
+        else:
+            tokens, positions, tables, ipack, fpack = _broadcast((
+                z_i, z_i, np.zeros((S, MB), np.int32),
+                np.zeros((2, S), np.int32), np.zeros((4, S), np.float32),
+            ))
+            if use_carry and carry is not None:
+                toks_in, pos_in = carry
+            else:
+                toks_in, pos_in = eng._put(tokens), eng._put(positions)
+            fn = eng._decode(False, False, want_sample)
+            out, toks2, pos2, eng.cache, counts = fn(
+                eng.params, eng.cache, counts, toks_in, pos_in,
+                eng._m_tables.get(tables), eng._put(np.int32(step)),
+                eng._m_ipack.get(ipack), eng._m_fpack.get(fpack),
+            )
+            carry = (toks2, pos2)
+
+
+def _process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def shard_params_global(params, model_config, mesh):
+    """Shard a (host-replicated) param pytree over a process-spanning mesh.
+
+    Every process holds the same full host values (e.g. identical
+    init/checkpoint load); each materializes only its device shards via
+    ``make_array_from_callback``. Works for single-process meshes too."""
+    import jax
+
+    from dynamo_tpu.models.llama import param_shardings
+
+    sh = param_shardings(model_config, mesh)
+
+    def put(leaf, sharding):
+        a = np.asarray(leaf)
+        return jax.make_array_from_callback(
+            a.shape, sharding, lambda idx: a[idx]
+        )
+
+    return jax.tree.map(put, params, sh)
