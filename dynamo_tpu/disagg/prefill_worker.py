@@ -221,7 +221,9 @@ async def run_prefill_worker(runtime, namespace: str, engine: PrefillEngine) -> 
     concurrently (they batch into shared chunk dispatches)."""
     if runtime.bus is None:
         raise RuntimeError("prefill worker needs the message bus")
-    client = KvTransferClient()
+    from dynamo_tpu.disagg.device_transfer import make_device_plane
+
+    client = KvTransferClient(device_plane=make_device_plane())
     addr_cache: Dict[str, str] = {}
     queue = f"{namespace}.{PREFILL_QUEUE}"
     sem = asyncio.Semaphore(engine.engine.config.max_slots)

@@ -41,7 +41,11 @@ async def enable_disagg_decode(
         raise RuntimeError("disagg decode needs the message bus")
     loop = asyncio.get_running_loop()
 
-    server = KvTransferServer(engine, host="0.0.0.0", port=0)
+    from dynamo_tpu.disagg.device_transfer import make_device_plane
+
+    server = KvTransferServer(
+        engine, host="0.0.0.0", port=0, device_plane=make_device_plane()
+    )
     await server.start()
     # rendezvous key: use the STABLE worker id (not the lease-scoped instance
     # id) so in-flight prefills still resolve across a lease loss; registered

@@ -23,6 +23,10 @@ from dynamo_tpu.runtime.codec import TwoPartMessage, read_frame, write_frame
 logger = logging.getLogger(__name__)
 
 
+class _NoDevicePeer(Exception):
+    """Peer has no device plane: fall back to the host-staged path."""
+
+
 def _engine_call(engine, fn):
     """Run ``fn`` on the engine thread, await the result from asyncio."""
     loop = asyncio.get_running_loop()
@@ -53,12 +57,20 @@ def _unpack(raw: bytes, dtype: str, shape) -> np.ndarray:
 
 
 class KvTransferServer:
-    """Decode-worker side: receives KV pages and completes waiting requests."""
+    """Decode-worker side: receives KV pages and completes waiting requests.
 
-    def __init__(self, engine, host: str = "0.0.0.0", port: int = 0):
+    With a :class:`~dynamo_tpu.disagg.device_transfer.DevicePlane` attached
+    (platforms whose PJRT backend implements the transfer-server API), the
+    BULK bytes ride the device fabric instead of this TCP channel — the
+    channel then carries only control: stage/pull descriptors and hash
+    validation (``read_blocks_dev`` / ``kv_blocks_dev`` ops)."""
+
+    def __init__(self, engine, host: str = "0.0.0.0", port: int = 0,
+                 device_plane=None):
         self.engine = engine
         self.host = host
         self.port = port
+        self.device_plane = device_plane
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> None:
@@ -112,6 +124,49 @@ class KvTransferServer:
                         ),
                     )
                     continue
+                elif h.get("op") == "read_blocks_dev":
+                    # device path: stage the pages on the device plane and
+                    # return a pull descriptor instead of the bytes
+                    if self.device_plane is None:
+                        await write_frame(writer, TwoPartMessage(
+                            json.dumps({"id": h.get("id"), "ok": False,
+                                        "error": "no device plane"}).encode(), b""))
+                        continue
+
+                    def _extract_dev(ids=h["block_ids"]):
+                        k, v = self.engine.extract_blocks(ids, as_device=True)
+                        return k, v, self.engine.block_hashes_of(ids)
+
+                    k, v, hashes = await _engine_call(self.engine, _extract_dev)
+                    uid, specs = self.device_plane.stage([k, v])
+                    await write_frame(writer, TwoPartMessage(
+                        json.dumps({
+                            "id": h.get("id"), "ok": True, "uuid": uid,
+                            "specs": specs, "hashes": hashes,
+                            "dev_addr": self.device_plane.address(),
+                        }).encode(), b""))
+                    continue
+                elif h.get("op") == "kv_blocks_dev":
+                    # prefill staged its computed pages; pull them into our
+                    # device memory, then inject
+                    if self.device_plane is None:
+                        await write_frame(writer, TwoPartMessage(
+                            json.dumps({"id": h.get("id"), "ok": False,
+                                        "error": "no device plane"}).encode(), b""))
+                        continue
+                    pulled = await asyncio.to_thread(
+                        self.device_plane.pull,
+                        h["dev_addr"], h["uuid"], h["specs"],
+                    )
+                    k, v = pulled[0], pulled[1]
+                    self.engine.complete_remote_prefill(
+                        h["request_id"], h["first_token"], h["block_ids"], k, v
+                    )
+                elif h.get("op") == "release_dev":
+                    # client pulled: free the staged device arrays now
+                    # instead of pinning HBM pages until the TTL sweep
+                    if self.device_plane is not None:
+                        self.device_plane.release(h["uuid"])
                 elif h.get("op") == "prefill_failed":
                     self.engine.fail_remote_prefill(h["request_id"], h.get("message", ""))
                 await write_frame(
@@ -163,9 +218,16 @@ class LocalKvTransfer:
 
 
 class KvTransferClient:
-    """Prefill-worker side: pooled connections to decode workers' servers."""
+    """Prefill-worker side: pooled connections to decode workers' servers.
 
-    def __init__(self):
+    With a device plane, bulk KV rides the device fabric: ``send_blocks``
+    stages locally + ships a pull descriptor; ``read_blocks`` asks the peer
+    to stage + pulls. Peers without a plane answer ``ok=False`` and the
+    call falls back to host-staged TCP — mixed fleets just work."""
+
+    def __init__(self, device_plane=None):
+        self.device_plane = device_plane
+        self._dev_peers: Dict[str, bool] = {}  # addr → peer has a plane
         self._conns: Dict[str, tuple] = {}
         self._locks: Dict[str, asyncio.Lock] = {}
 
@@ -179,15 +241,27 @@ class KvTransferClient:
             self._locks[address] = asyncio.Lock()
         return c
 
+    def _use_dev(self, address: str) -> bool:
+        return self.device_plane is not None and self._dev_peers.get(address, True)
+
     async def send_blocks(
         self,
         address: str,
         request_id: str,
         first_token: int,
         block_ids,
-        k: np.ndarray,
-        v: np.ndarray,
+        k,
+        v,
     ) -> None:
+        if self._use_dev(address):
+            try:
+                await self._send_blocks_dev(
+                    address, request_id, first_token, block_ids, k, v
+                )
+                return
+            except _NoDevicePeer:
+                self._dev_peers[address] = False  # fall through to TCP
+        k, v = np.asarray(k), np.asarray(v)
         reader, writer = await self._conn(address)
         k_raw, v_raw = _pack(k), _pack(v)
         header = {
@@ -205,10 +279,43 @@ class KvTransferClient:
             )
             await read_frame(reader)  # ack
 
+    async def _send_blocks_dev(
+        self, address, request_id, first_token, block_ids, k, v
+    ) -> None:
+        import jax.numpy as jnp
+
+        uid, specs = self.device_plane.stage([jnp.asarray(k), jnp.asarray(v)])
+        try:
+            reader, writer = await self._conn(address)
+            header = {
+                "op": "kv_blocks_dev",
+                "request_id": request_id,
+                "first_token": int(first_token),
+                "block_ids": list(map(int, block_ids)),
+                "uuid": uid,
+                "specs": specs,
+                "dev_addr": self.device_plane.address(),
+            }
+            async with self._locks[address]:
+                await write_frame(
+                    writer, TwoPartMessage(json.dumps(header).encode(), b"")
+                )
+                frame = await read_frame(reader)  # ack AFTER the peer pulled
+            if not json.loads(frame.header).get("ok"):
+                raise _NoDevicePeer()
+        finally:
+            self.device_plane.release(uid)
+
     async def read_blocks(self, address: str, block_ids) -> tuple:
         """Pull KV pages from a decode worker's pool by physical id.
-        Returns (k, v, hashes): numpy [L, n, bs, KVH, D] pages plus each
-        page's registered content hash (-1 = no longer registered)."""
+        Returns (k, v, hashes): [L, n, bs, KVH, D] pages plus each page's
+        registered content hash (-1 = no longer registered). Device-path
+        when both ends have a plane, host-staged TCP otherwise."""
+        if self._use_dev(address):
+            try:
+                return await self._read_blocks_dev(address, block_ids)
+            except _NoDevicePeer:
+                self._dev_peers[address] = False
         reader, writer = await self._conn(address)
         async with self._locks[address]:
             await write_frame(
@@ -226,6 +333,37 @@ class KvTransferClient:
         k = _unpack(frame.body[:k_len], h["dtype"], h["shape"])
         v = _unpack(frame.body[k_len:], h["dtype"], h["shape"])
         return k, v, h.get("hashes") or [-1] * k.shape[1]
+
+    async def _read_blocks_dev(self, address: str, block_ids) -> tuple:
+        reader, writer = await self._conn(address)
+        async with self._locks[address]:
+            await write_frame(
+                writer,
+                TwoPartMessage(
+                    json.dumps(
+                        {"op": "read_blocks_dev", "block_ids": list(map(int, block_ids))}
+                    ).encode(),
+                    b"",
+                ),
+            )
+            frame = await read_frame(reader)
+        h = json.loads(frame.header)
+        if not h.get("ok"):
+            raise _NoDevicePeer()
+        try:
+            pulled = await asyncio.to_thread(
+                self.device_plane.pull, h["dev_addr"], h["uuid"], h["specs"]
+            )
+        finally:
+            # tell the peer to drop its staged copy (success or failure —
+            # a failed pull must not pin its HBM pages until the TTL)
+            async with self._locks[address]:
+                await write_frame(writer, TwoPartMessage(
+                    json.dumps({"op": "release_dev", "uuid": h["uuid"]}).encode(),
+                    b"",
+                ))
+                await read_frame(reader)
+        return pulled[0], pulled[1], h.get("hashes") or [-1] * len(block_ids)
 
     async def send_failure(self, address: str, request_id: str, message: str) -> None:
         reader, writer = await self._conn(address)
