@@ -45,6 +45,17 @@ class TrackedServer:
     async def stop(self) -> None:
         if self._server:
             self._server.close()
-            for w in list(self._conns):
-                w.close()
-            await self._server.wait_closed()
+            # a reconnecting client can race the listener close and land a
+            # fresh connection AFTER the first force-close sweep — keep
+            # sweeping until the set drains, and never block stop() forever
+            # on a wedged handler
+            for _ in range(100):
+                for w in list(self._conns):
+                    w.close()
+                if not self._conns:
+                    break
+                await asyncio.sleep(0.02)
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5.0)
+            except asyncio.TimeoutError:
+                pass

@@ -226,7 +226,15 @@ class StateStoreServer:
             # rotate on-loop (cheap rename), serialize+fsync in a thread —
             # a big store must not stall calls/keepalives for the dump
             self._wal.close()
-            os.replace(self._wal_path, self._wal_old_path)
+            if os.path.exists(self._wal_old_path):
+                # a previous async snapshot failed and retained wal.old:
+                # APPEND the current WAL to it (replay order preserved)
+                # rather than clobbering those records
+                with open(self._wal_old_path, "a") as dst, open(self._wal_path) as src:
+                    dst.write(src.read())
+                os.remove(self._wal_path)
+            else:
+                os.replace(self._wal_path, self._wal_old_path)
             self._wal = open(self._wal_path, "w")
             self._wal_records = 0
             snap = self._state_copy()
@@ -257,7 +265,7 @@ class StateStoreServer:
             },
             "leases": snap["leases"],
         }
-        tmp = f"{self._snap_path}.tmp.{os.getpid()}"
+        tmp = f"{self._snap_path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
         with open(tmp, "w") as f:
             json.dump(out, f)
             f.flush()
@@ -294,7 +302,13 @@ class StateStoreServer:
         if self._server:
             await self._server.stop()
         if self._snapshot_task is not None and not self._snapshot_task.done():
-            self._snapshot_task.cancel()  # the sync compact below covers it
+            # AWAIT, don't cancel: cancellation cannot stop an already-running
+            # to_thread dump, which would finish later and overwrite the
+            # fresh compacted snapshot below with its older state copy
+            try:
+                await self._snapshot_task
+            except Exception:
+                pass
         if self._wal is not None:
             self._compact()  # graceful stop leaves a snapshot, empty WAL
             self._wal.close()
