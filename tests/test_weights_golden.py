@@ -1,0 +1,190 @@
+"""Golden-weight fixtures for the HF weight mappings (VERDICT r3 item 9).
+
+For each family (llama, qwen2, mixtral) a tiny REAL checkpoint is generated
+deterministically with the HF reference implementation, saved as
+safetensors, loaded through the framework's real path
+(config_from_card → params_from_hf), and the JAX forward's logits are
+asserted against the HF model's own — catching transpose, bias, expert-
+stacking and naming regressions that random-init e2e tests cannot see.
+
+Reference analogue: golden-fixture style of lib/llm/tests/preprocessor.rs +
+tests/data.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine_jax.weights import config_from_card, params_from_hf
+from dynamo_tpu.models.llama import forward, make_kv_cache
+
+PROMPT = [3, 17, 91, 5, 44, 101, 7, 63]
+
+
+class _CardShim:
+    """Just enough card for config_from_card."""
+
+    def __init__(self, cfg: dict):
+        self.model_config = cfg
+
+
+def _hf_logits(model, prompt):
+    with torch.no_grad():
+        out = model(torch.tensor([prompt]))
+    return out.logits[0].float().numpy()
+
+
+def _our_logits(hf_config: dict, tensors, prompt):
+    cfg = config_from_card(_CardShim(hf_config), dtype=jnp.float32)
+    params = params_from_hf(tensors, cfg)
+    cache = make_kv_cache(cfg, 8, 16, dtype=jnp.float32)
+    tables = jnp.arange(8, dtype=jnp.int32)[None]
+    toks = jnp.asarray([prompt], jnp.int32)
+    pos = jnp.arange(len(prompt))[None]
+    logits, _ = forward(params, cfg, toks, pos, cache, tables)
+    return np.asarray(logits[0], np.float32)
+
+
+def _state_tensors(model):
+    return {k: v.float().numpy() for k, v in model.state_dict().items()}
+
+
+def _assert_close(ours, theirs, family):
+    # float32 on both sides; rope/softmax association differences stay tiny
+    err = np.abs(ours - theirs).max()
+    scale = np.abs(theirs).max()
+    assert err <= 2e-3 * max(scale, 1.0), (
+        f"{family}: logits diverge (max err {err:.5f}, scale {scale:.2f}) — "
+        "weight mapping bug (transpose/bias/stacking)?"
+    )
+    # argmax agreement across all positions (the serving-visible contract)
+    assert (ours.argmax(-1) == theirs.argmax(-1)).all(), f"{family}: argmax flip"
+
+
+def test_llama_golden():
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=1,
+        head_dim=16, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, attention_bias=False,
+    )).eval()
+    cfg = {
+        "architectures": ["LlamaForCausalLM"], "model_type": "llama",
+        "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+        "num_hidden_layers": 2, "num_attention_heads": 2,
+        "num_key_value_heads": 1, "head_dim": 16, "rope_theta": 10000.0,
+        "rms_norm_eps": 1e-5, "tie_word_embeddings": False,
+    }
+    _assert_close(
+        _our_logits(cfg, _state_tensors(hf), PROMPT),
+        _hf_logits(hf, PROMPT),
+        "llama",
+    )
+
+
+def test_qwen2_golden():
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    torch.manual_seed(1)
+    hf = Qwen2ForCausalLM(Qwen2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=1,
+        rope_theta=10000.0, rms_norm_eps=1e-6, tie_word_embeddings=False,
+    )).eval()
+    # qwen2 ships NONZERO attention biases — the exact thing the random-init
+    # e2e tests can't validate
+    with torch.no_grad():
+        for layer in hf.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.uniform_(-0.5, 0.5)
+    cfg = {
+        "architectures": ["Qwen2ForCausalLM"], "model_type": "qwen2",
+        "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+        "num_hidden_layers": 2, "num_attention_heads": 2,
+        "num_key_value_heads": 1, "rope_theta": 10000.0,
+        "rms_norm_eps": 1e-6, "tie_word_embeddings": False,
+    }
+    _assert_close(
+        _our_logits(cfg, _state_tensors(hf), PROMPT),
+        _hf_logits(hf, PROMPT),
+        "qwen2",
+    )
+
+
+def test_mixtral_golden():
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    torch.manual_seed(2)
+    hf = MixtralForCausalLM(MixtralConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=1,
+        num_local_experts=4, num_experts_per_tok=2,
+        rope_theta=10000.0, rms_norm_eps=1e-5, tie_word_embeddings=False,
+    )).eval()
+    cfg = {
+        "architectures": ["MixtralForCausalLM"], "model_type": "mixtral",
+        "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+        "num_hidden_layers": 2, "num_attention_heads": 2,
+        "num_key_value_heads": 1, "num_local_experts": 4,
+        "num_experts_per_tok": 2, "rope_theta": 10000.0,
+        "rms_norm_eps": 1e-5, "tie_word_embeddings": False,
+    }
+    _assert_close(
+        _our_logits(cfg, _state_tensors(hf), PROMPT),
+        _hf_logits(hf, PROMPT),
+        "mixtral",
+    )
+
+
+def test_safetensors_roundtrip_through_load_params(tmp_path):
+    """The on-disk path: save HF llama → safetensors file, load via the
+    engine's load_params (card with model_path), logits must still match."""
+    from safetensors.numpy import save_file
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from dynamo_tpu.engine_jax.weights import load_params
+
+    torch.manual_seed(3)
+    hf = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=1,
+        head_dim=16, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )).eval()
+    save_file(_state_tensors(hf), str(tmp_path / "model.safetensors"))
+
+    class Card:
+        model_path = str(tmp_path)
+        gguf_path = None
+        display_name = "tiny-golden"
+        model_config = {
+            "model_type": "llama", "vocab_size": 128, "hidden_size": 32,
+            "intermediate_size": 64, "num_hidden_layers": 2,
+            "num_attention_heads": 2, "num_key_value_heads": 1,
+            "head_dim": 16, "rope_theta": 10000.0,
+            "tie_word_embeddings": False,
+        }
+
+    cfg = config_from_card(Card(), dtype=jnp.float32)
+    params = load_params(Card(), cfg)
+    cache = make_kv_cache(cfg, 8, 16, dtype=jnp.float32)
+    tables = jnp.arange(8, dtype=jnp.int32)[None]
+    logits, _ = forward(
+        params, cfg, jnp.asarray([PROMPT], jnp.int32),
+        jnp.arange(len(PROMPT))[None], cache, tables,
+    )
+    _assert_close(
+        np.asarray(logits[0], np.float32), _hf_logits(hf, PROMPT), "llama-disk"
+    )
