@@ -16,13 +16,14 @@ from dynamo_tpu.ops.pallas.paged_attention import (
 )
 
 
-def _setup(seed, s, h, kvh, d, bs, mb, n_blocks, lengths):
+def _setup(seed, s, h, kvh, d, bs, mb, n_blocks, lengths, tables=None):
     rng = np.random.default_rng(seed)
     k_cache = jnp.asarray(rng.normal(size=(n_blocks, bs, kvh, d)), jnp.float32)
     v_cache = jnp.asarray(rng.normal(size=(n_blocks, bs, kvh, d)), jnp.float32)
     q = jnp.asarray(rng.normal(size=(s, 1, h, d)), jnp.float32)
-    # distinct random pages per lane
-    tables = rng.permutation(n_blocks)[: s * mb].reshape(s, mb).astype(np.int32)
+    if tables is None:
+        # distinct random pages per lane
+        tables = rng.permutation(n_blocks)[: s * mb].reshape(s, mb).astype(np.int32)
     return q, k_cache, v_cache, jnp.asarray(tables), jnp.asarray(lengths, jnp.int32)
 
 
@@ -226,3 +227,32 @@ def test_decode_return_stats_merge_contract():
     np.testing.assert_allclose(
         np.asarray(merged), np.asarray(ref[:, 0]).astype(np.float32), atol=2e-5
     )
+
+
+@pytest.mark.parametrize(
+    "lengths,pages_per_chunk",
+    [
+        ([32, 32, 32, 32], 2),  # every chunk fully live + consecutive
+        ([32, 17, 32, 9], 4),  # mix: run-DMA chunks and ragged tails
+    ],
+)
+def test_decode_kernel_v2_consecutive_run_dma(lengths, pages_per_chunk):
+    """Consecutive physical pages take the single-run DMA fast path (the
+    steady-serving layout — fresh allocations pop ascending free-list ids);
+    results must be identical to the scattered-table path."""
+    s, h, kvh, d, bs, mb = 4, 8, 2, 32, 8, 4
+    # consecutive runs: lane i gets pages [i*mb .. i*mb+mb)
+    consec = np.stack(
+        [np.arange(i * mb, (i + 1) * mb) for i in range(s)]
+    ).astype(np.int32)
+    q, k_cache, v_cache, tables, lens = _setup(
+        11, s, h, kvh, d, bs, mb, 64, lengths, tables=consec
+    )
+
+    q_positions = (lens - 1)[:, None].astype(jnp.int32)
+    ref = paged_attention(q, k_cache, v_cache, tables, q_positions)
+    got = paged_attention_decode_v2(
+        q[:, 0], k_cache, v_cache, tables, lens,
+        pages_per_chunk=pages_per_chunk, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref[:, 0]), atol=1e-5)
