@@ -269,9 +269,15 @@ class JaxServingEngine(AsyncEngine):
                 )
             from dynamo_tpu.models.llama import quantize_params_int8
 
-            params = quantize_params_int8(params, model_config)
+            # hybrid: DECODE reads the int8 copy (weights are the decode
+            # bandwidth roofline — the stream halves), PREFILL keeps bf16
+            # (it is FLOPs-bound and per-tile dequant converts starve the
+            # MXU — measured 13x slower chunks). Costs 1.5x param residency.
+            self.params_decode = quantize_params_int8(params, model_config)
         elif engine_config.quantize:
             raise ValueError(f"unknown quantize mode {engine_config.quantize!r}")
+        else:
+            self.params_decode = params
         self.params = params
         self.mesh = mesh
         # multihost lockstep: every host array entering a global-mesh jit is
@@ -827,7 +833,7 @@ class JaxServingEngine(AsyncEngine):
                 )
                 jax.device_get(out)
             out, _, _, self.cache, self._dummy_counts = self._decode(False, False, want_sample)(
-                self.params, self.cache, self._dummy_counts, self._put(svec_i),
+                self.params_decode, self.cache, self._dummy_counts, self._put(svec_i),
                 self._put(np.full((S,), -1, np.int32)), self._put(tables), ctr,
                 ipack, fpack,
             )
@@ -1409,7 +1415,7 @@ class JaxServingEngine(AsyncEngine):
                      tables=self._tables, ipack=ipack_np, fpack=fpack_np),
             )
         args = (
-            self.params, self.cache, counts_in, toks_in, pos_in,
+            self.params_decode, self.cache, counts_in, toks_in, pos_in,
             self._m_tables.get(self._tables),
             self._put(np.int32(self._step_counter)),
             self._m_ipack.get(ipack_np),

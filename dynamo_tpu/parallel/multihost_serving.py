@@ -155,7 +155,7 @@ def follower_serve(model_config, params, engine_config, mesh, engine=None) -> No
                 toks_in, pos_in = eng._put(tokens), eng._put(positions)
             fn = eng._decode(False, False, want_sample)
             out, toks2, pos2, eng.cache, counts = fn(
-                eng.params, eng.cache, counts, toks_in, pos_in,
+                eng.params_decode, eng.cache, counts, toks_in, pos_in,
                 eng._m_tables.get(tables), eng._put(np.int32(step)),
                 eng._m_ipack.get(ipack), eng._m_fpack.get(fpack),
             )
