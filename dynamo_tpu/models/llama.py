@@ -523,15 +523,26 @@ def _paged_window_attention(
         paged_attention_decode,
         paged_attention_decode_sharded,
         paged_attention_decode_v2,
+        paged_attention_decode_v4,
+        v4_plan,
     )
 
     b, _, h_, d = q.shape
     lengths = jnp.maximum(base, 0)
     q1 = q[:, 0]
+    plan = v4_plan(
+        b, k_page.shape[1], c.num_kv_heads, d, k_page.dtype.itemsize,
+        block_tables.shape[1],
+    )
     if mesh is not None:
         o_p, m_p, l_p = paged_attention_decode_sharded(
             q1, k_page, v_page, block_tables, lengths, mesh=mesh,
             interpret=interpret, return_stats=True,
+        )
+    elif _v2_supported(d) and plan is not None:
+        o_p, m_p, l_p = paged_attention_decode_v4(
+            q1, k_page, v_page, block_tables, lengths,
+            pages_per_chunk=plan, interpret=interpret, return_stats=True,
         )
     elif _v2_supported(d):
         o_p, m_p, l_p = paged_attention_decode_v2(

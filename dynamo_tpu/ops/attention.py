@@ -204,15 +204,28 @@ def paged_attention(
             paged_attention_decode,
             paged_attention_decode_sharded,
             paged_attention_decode_v2,
+            paged_attention_decode_v4,
+            v4_plan,
         )
 
         lengths = jnp.maximum(q_positions[:, 0] + 1, 0)  # padding (pos<0) → 0
         interpret = jax.devices()[0].platform == "cpu"
+        plan = v4_plan(
+            q.shape[0], k_cache.shape[1], kvh, d, k_cache.dtype.itemsize,
+            block_tables.shape[1],
+        )
         if mesh is not None:
             # sharded cache: run the kernel per tp shard under shard_map
             out = paged_attention_decode_sharded(
                 q[:, 0], k_cache, v_cache, block_tables, lengths, mesh=mesh,
                 scale=scale, interpret=interpret,
+            )
+        elif _v2_supported(d) and plan is not None:
+            # lane-batched single-program schedule: one loop drives every
+            # lane's DMA+compute (the per-lane grid's fixed cost / n_lanes)
+            out = paged_attention_decode_v4(
+                q[:, 0], k_cache, v_cache, block_tables, lengths, scale=scale,
+                pages_per_chunk=plan, interpret=interpret,
             )
         elif _v2_supported(d):
             out = paged_attention_decode_v2(

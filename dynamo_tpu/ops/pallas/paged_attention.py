@@ -312,6 +312,240 @@ def paged_attention_decode_v2(
     return res
 
 
+def v4_plan(
+    n_lanes: int, bs: int, kvh: int, d: int, itemsize: int, mb: int,
+    vmem_budget: int = 8 << 20,
+) -> Optional[int]:
+    """Largest pages_per_chunk whose lane-batched double buffers fit the
+    VMEM budget, or None when even the smallest chunk doesn't (huge lane
+    counts: fall back to the per-lane v2 schedule)."""
+    for p in (16, 8, 4, 2):
+        if p > mb:
+            continue
+        if 2 * 2 * n_lanes * p * bs * kvh * d * itemsize <= vmem_budget:
+            return p
+    return None
+
+
+def _decode_kernel_v4(
+    # scalar prefetch
+    tables_ref,  # [S, MB]
+    lengths_ref,  # [S]
+    # blocks
+    q_ref,  # [S, H, D] (VMEM — every lane)
+    k_hbm,  # [N, bs, KVH, D]
+    v_hbm,
+    o_ref,  # [S, H, D]
+    *rest,
+    scale: float,
+    kvh: int,
+    pages_per_chunk: int,
+    n_lanes: int,
+    with_stats: bool = False,
+):
+    """Lane-batched single-program schedule: ONE fori_loop over context
+    chunks drives every lane's DMA + compute together. vs the per-lane grid
+    of v2/v3 this divides the fixed per-iteration cost (DMA bookkeeping,
+    loop control, flash rescale) by the lane count and feeds the MXU a
+    batched [S·KVH] stack of small matmuls per chunk — the regime where the
+    kernel must compete with one big dense einsum."""
+    if with_stats:
+        ms_ref, ls_ref, k_buf, v_buf, sem = rest
+    else:
+        ms_ref = ls_ref = None
+        k_buf, v_buf, sem = rest
+    S = n_lanes
+    P = pages_per_chunk
+    bs = k_hbm.shape[1]
+    h, d = q_ref.shape[1], q_ref.shape[2]
+    g = h // kvh
+    T = P * bs
+
+    # scalar-prefetch refs live in SMEM: only scalar loads — assemble the
+    # per-lane length vector from S scalar reads (S is static)
+    lengths = jnp.stack([lengths_ref[i] for i in range(S)])  # [S]
+    max_len = jnp.max(lengths)
+    n_chunks = lax.div(max_len + T - 1, T)
+
+    def lane_last_live(s):
+        n_pages = lax.div(lengths_ref[s] + bs - 1, bs)
+        return jnp.maximum(n_pages - 1, 0)
+
+    def lane_consecutive(s, chunk):
+        last = lane_last_live(s)
+        first = tables_ref[s, jnp.minimum(chunk * P, last)]
+        ok = (chunk + 1) * P - 1 <= last
+        for i in range(1, P):
+            idx = jnp.minimum(chunk * P + i, last)
+            ok = jnp.logical_and(ok, tables_ref[s, idx] == first + i)
+        return ok, first
+
+    def run_dma(slot, s, first, which):
+        src, dst = (k_hbm, k_buf) if which == 0 else (v_hbm, v_buf)
+        return pltpu.make_async_copy(
+            src.at[pl.ds(first, P)], dst.at[slot, s], sem.at[slot, s, 0, which]
+        )
+
+    def page_dma(slot, s, chunk, i, which):
+        last = lane_last_live(s)
+        pid = tables_ref[s, jnp.minimum(chunk * P + i, last)]
+        src, dst = (k_hbm, k_buf) if which == 0 else (v_hbm, v_buf)
+        return pltpu.make_async_copy(
+            src.at[pid], dst.at[slot, s, i], sem.at[slot, s, i, which]
+        )
+
+    def start_chunk(slot, chunk):
+        for s in range(S):  # static unroll over lanes
+            consec, first = lane_consecutive(s, chunk)
+
+            @pl.when(consec)
+            def _(s=s, first=first):
+                run_dma(slot, s, first, 0).start()
+                run_dma(slot, s, first, 1).start()
+
+            @pl.when(jnp.logical_not(consec))
+            def _(s=s, chunk=chunk):
+                for i in range(P):
+                    page_dma(slot, s, chunk, i, 0).start()
+                    page_dma(slot, s, chunk, i, 1).start()
+
+    def wait_chunk(slot, chunk):
+        for s in range(S):
+            consec, first = lane_consecutive(s, chunk)
+
+            @pl.when(consec)
+            def _(s=s, first=first):
+                run_dma(slot, s, first, 0).wait()
+                run_dma(slot, s, first, 1).wait()
+
+            @pl.when(jnp.logical_not(consec))
+            def _(s=s, chunk=chunk):
+                for i in range(P):
+                    page_dma(slot, s, chunk, i, 0).wait()
+                    page_dma(slot, s, chunk, i, 1).wait()
+
+    @pl.when(n_chunks > 0)
+    def _():
+        start_chunk(0, 0)
+
+    # per-kv-head query slices (kvh is static): Mosaic's tpu.matmul takes
+    # ONE batch dim, and per-head slicing avoids vector-layout shape casts
+    q_all = q_ref[...].astype(jnp.float32)  # [S, H, D]
+    q_heads = [q_all[:, n * g:(n + 1) * g, :] for n in range(kvh)]  # [S,G,D]
+
+    def chunk_body(chunk, carry):
+        m, l, acc = carry  # [S,H], [S,H], [S,H,D] f32
+        slot = lax.rem(chunk, 2)
+
+        @pl.when(chunk + 1 < n_chunks)
+        def _():
+            start_chunk(lax.rem(chunk + 1, 2), chunk + 1)
+
+        wait_chunk(slot, chunk)
+        # merge (P, bs) → T by static concat: Mosaic's layout inference
+        # rejects the equivalent 5D→4D shape cast
+        kc = jnp.concatenate([k_buf[slot, :, i] for i in range(P)], axis=1)
+        vc = jnp.concatenate([v_buf[slot, :, i] for i in range(P)], axis=1)
+        pos = chunk * T + lax.broadcasted_iota(jnp.int32, (S, g, T), 2)
+        live = pos < lengths[:, None, None]  # [S, G, T]
+
+        outs = []
+        for n in range(kvh):
+            kn = kc[:, :, n, :].astype(jnp.float32)  # [S, T, D]
+            vn = vc[:, :, n, :].astype(jnp.float32)
+            scores = lax.dot_general(  # [S, G, T]
+                q_heads[n], kn, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            outs.append(jnp.where(live, scores, -jnp.inf))
+        flat = jnp.concatenate(outs, axis=1)  # [S, H, T] (kvh-major like q)
+
+        m_new = jnp.maximum(m, flat.max(axis=2))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(flat - m_new[:, :, None])
+        l = l * alpha + p.sum(axis=2)
+        pvs = []
+        for n in range(kvh):
+            vn = vc[:, :, n, :].astype(jnp.float32)
+            pvs.append(lax.dot_general(  # [S, G, D]
+                p[:, n * g:(n + 1) * g, :], vn,
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            ))
+        pv = jnp.concatenate(pvs, axis=1)  # [S, H, D]
+        acc = acc * alpha[:, :, None] + pv
+        return m_new, l, acc
+
+    m0 = jnp.full((S, h), -1e30, jnp.float32)
+    l0 = jnp.zeros((S, h), jnp.float32)
+    acc0 = jnp.zeros((S, h, d), jnp.float32)
+    m, l, acc = lax.fori_loop(0, n_chunks, chunk_body, (m0, l0, acc0))
+    denom = jnp.where(l > 0.0, l, 1.0)
+    o_ref[...] = (acc / denom[:, :, None]).astype(o_ref.dtype)
+    if with_stats:
+        ms_ref[...] = m[:, None]
+        ls_ref[...] = l[:, None]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "pages_per_chunk", "interpret", "return_stats")
+)
+def paged_attention_decode_v4(
+    q: jax.Array,  # [S, H, D]
+    k_cache: jax.Array,  # [N, bs, KVH, D]
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [S, MB] int32
+    lengths: jax.Array,  # [S] int32; 0 = padding lane
+    *,
+    scale: Optional[float] = None,
+    pages_per_chunk: int = 8,
+    interpret: bool = False,
+    return_stats: bool = False,
+):
+    """Lane-batched flash decode over paged KV (see _decode_kernel_v4)."""
+    s, h, d = q.shape
+    _, bs, kvh, _ = k_cache.shape
+    if scale is None:
+        scale = d ** -0.5
+    P = min(pages_per_chunk, block_tables.shape[1])
+
+    out_shape = [jax.ShapeDtypeStruct((s, h, d), q.dtype)]
+    if return_stats:
+        out_shape += [jax.ShapeDtypeStruct((s, 1, h), jnp.float32)] * 2
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+        ],
+        out_specs=(
+            [pl.BlockSpec(memory_space=pltpu.VMEM)] * 3
+            if return_stats else pl.BlockSpec(memory_space=pltpu.VMEM)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, s, P, bs, kvh, d), k_cache.dtype),
+            pltpu.VMEM((2, s, P, bs, kvh, d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, s, P, 2)),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel_v4, scale=scale, kvh=kvh, pages_per_chunk=P,
+        n_lanes=s, with_stats=return_stats,
+    )
+    res = pl.pallas_call(
+        kernel,
+        out_shape=out_shape if return_stats else out_shape[0],
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32), q, k_cache, v_cache)
+    if return_stats:
+        out, m, l = res
+        return out, m[:, 0], l[:, 0]
+    return res
+
+
 def paged_attention_decode_sharded(
     q: jax.Array,  # [S, H, D] — H sharded over tp
     k_cache: jax.Array,  # [N, bs, KVH, D] — KVH sharded over tp

@@ -256,3 +256,43 @@ def test_decode_kernel_v2_consecutive_run_dma(lengths, pages_per_chunk):
         pages_per_chunk=pages_per_chunk, interpret=True,
     )
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref[:, 0]), atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "lengths,pages_per_chunk",
+    [
+        ([16, 16, 16, 16], 2),
+        ([1, 7, 17, 31], 2),
+        ([0, 5, 32, 12], 4),
+        ([31, 3, 9, 2], 8),
+        ([31, 25, 17, 32], 1),
+    ],
+)
+def test_decode_kernel_v4_matches_reference(lengths, pages_per_chunk):
+    """The lane-batched single-program schedule must match the jnp
+    reference (same contract as v2, one fori_loop drives every lane)."""
+    from dynamo_tpu.ops.pallas.paged_attention import paged_attention_decode_v4
+
+    s, h, kvh, d, bs, mb = 4, 8, 2, 32, 8, 4
+    q, k_cache, v_cache, tables, lens = _setup(5, s, h, kvh, d, bs, mb, 64, lengths)
+
+    q_positions = (lens - 1)[:, None].astype(jnp.int32)
+    ref = paged_attention(q, k_cache, v_cache, tables, q_positions)
+    got = paged_attention_decode_v4(
+        q[:, 0], k_cache, v_cache, tables, lens,
+        pages_per_chunk=pages_per_chunk, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref[:, 0]), atol=1e-5)
+    # stats contract matches v2's
+    from dynamo_tpu.ops.pallas.paged_attention import paged_attention_decode_v2
+
+    _, m2, l2 = paged_attention_decode_v2(
+        q[:, 0], k_cache, v_cache, tables, lens,
+        pages_per_chunk=pages_per_chunk, interpret=True, return_stats=True,
+    )
+    _, m4, l4 = paged_attention_decode_v4(
+        q[:, 0], k_cache, v_cache, tables, lens,
+        pages_per_chunk=pages_per_chunk, interpret=True, return_stats=True,
+    )
+    np.testing.assert_allclose(np.asarray(m4), np.asarray(m2), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(l4), np.asarray(l2), atol=1e-5)
