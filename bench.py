@@ -27,6 +27,7 @@ import asyncio
 import dataclasses
 import json
 import os
+import sys
 import time
 
 def _tree_bytes(tree) -> int:
@@ -145,6 +146,49 @@ def bench_multiturn() -> None:
     print(json.dumps(out))
 
 
+def _retry(fn, attempts=3, delay=5.0):
+    """Run ``fn`` with retries: the tunneled compile helper can 500
+    transiently (it erased round 4's kernel evidence); an infra hiccup must
+    not erase a round's measurement again. Deterministic errors (bad shape,
+    missing module) fail straight through — retrying those only burns
+    minutes of bench budget."""
+    last = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except (ValueError, TypeError, ImportError, KeyError):
+            raise
+        except Exception as e:  # noqa: BLE001 — transient infra errors
+            last = e
+            time.sleep(delay * (i + 1))
+    raise last
+
+
+def bench_pallas_kernel() -> dict:
+    """On-chip kernel microbench: lane-batched Pallas decode (v4) vs the
+    dense jnp tier at the llama-8B serving geometry (S=8, H=32, KVH=8,
+    D=128), ctx 2k/4k/8k. Uses the N-differenced chained harness
+    (tools/bench_pallas.py) — the only timing method that reports physical
+    device time through the tunnel. The auto-policy crossover
+    (dense under ``dense_history_max_bytes``, kernel above) is grounded in
+    these numbers: dense wins while its buffer is VMEM/HBM-affordable, the
+    kernel streams at the practical HBM ceiling and reads only live bytes."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from bench_pallas import sweep_row
+
+    S, H, KVH, D, BS = 8, 32, 8, 128, 128
+    rows = [
+        sweep_row(S, H, KVH, D, BS, ctx, ("jnp", "v4"), retry=_retry)
+        for ctx in (2048, 4096, 8192)
+    ]
+    return {
+        "shape": {"lanes": S, "heads": H, "kv_heads": KVH, "head_dim": D},
+        "sweep": rows,
+        # longest-ctx row = the kernel-tier regime
+        "pallas_speedup": rows[-1].get("v4_speedup"),
+    }
+
+
 def bench_pallas_d128() -> dict:
     """Kernel-tier proof point on a D=128 model (qwen2.5-1.5b), long context.
 
@@ -156,8 +200,7 @@ def bench_pallas_d128() -> dict:
     dense_history_max_bytes, ops/attention.py decode_uses_pallas) picks the
     dense tier at this scale — the kernel's regime is histories too large to
     materialize densely (70B/long-context), which a 16 GB single chip cannot
-    hold; ``pallas_speedup`` < 1 here is the measured reason for that
-    policy, not a defect."""
+    hold; the kernel-level crossover is measured by bench_pallas_kernel."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -419,9 +462,6 @@ def main() -> None:
 
     n_chips = len(jax.devices())
     cfg = dataclasses.replace(LLAMA_PRESETS[PRESET], dtype=jnp.bfloat16)
-    global QUANTIZE
-    if cfg.num_experts > 1 and QUANTIZE == "int8":
-        QUANTIZE = ""  # int8 does not cover MoE experts yet: bench bf16
     params = init_params(jax.random.PRNGKey(0), cfg)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
 
@@ -532,6 +572,11 @@ def main() -> None:
             out["alt_mode"] = bench_alt_mode(alt)
         except Exception as e:  # secondary measurement must never kill the bench
             out["alt_mode"] = {"error": str(e)[:200]}
+    if os.environ.get("BENCH_PALLAS_KERNEL", "1") == "1":
+        try:
+            out["pallas_kernel"] = bench_pallas_kernel()
+        except Exception as e:  # secondary measurement must never kill the bench
+            out["pallas_kernel"] = {"error": str(e)[:200]}
     if os.environ.get("BENCH_PALLAS_D128", "1") == "1":
         try:
             out["pallas_d128"] = bench_pallas_d128()

@@ -262,18 +262,27 @@ class JaxServingEngine(AsyncEngine):
         self.model_config = model_config
         self.config = engine_config
         if engine_config.quantize == "int8":
-            if mesh is not None:
-                raise ValueError(
-                    "int8 weight quantization is single-chip only: the "
-                    "sharding specs describe the unquantized param tree"
-                )
             from dynamo_tpu.models.llama import quantize_params_int8
 
             # hybrid: DECODE reads the int8 copy (weights are the decode
             # bandwidth roofline — the stream halves), PREFILL keeps bf16
             # (it is FLOPs-bound and per-tile dequant converts starve the
             # MXU — measured 13x slower chunks). Costs 1.5x param residency.
-            self.params_decode = quantize_params_int8(params, model_config)
+            if mesh is not None:
+                # sharded serving: quantize under jit with out_shardings so
+                # each {q, s} leaf lands sharded like its parent weight
+                # (scales keep every non-contracted axis) — the 70B north
+                # star serves int8 on the dp×tp mesh. Works on a process-
+                # spanning mesh too: every host runs this jit in lockstep.
+                from dynamo_tpu.models.llama import quantized_param_shardings
+
+                quant = jax.jit(
+                    lambda p: quantize_params_int8(p, model_config),
+                    out_shardings=quantized_param_shardings(model_config, mesh),
+                )
+                self.params_decode = quant(params)
+            else:
+                self.params_decode = quantize_params_int8(params, model_config)
         elif engine_config.quantize:
             raise ValueError(f"unknown quantize mode {engine_config.quantize!r}")
         else:

@@ -241,10 +241,10 @@ def quantize_params_int8(params: Params, config: LlamaConfig) -> Params:
     The embedding table quantizes per ROW so both its gather use and its
     tied lm-head use (scale per vocab column of ``embed.T``) stay cheap.
 
-    Single-chip serving path: mesh-sharded (tp/pp/sp/ep) params keep bf16 —
-    the sharding specs describe the unquantized tree."""
-    if config.num_experts > 1:
-        raise NotImplementedError("int8 path does not cover MoE experts yet")
+    Dense mats contract over the second-to-last axis, both plain stacked
+    ([L, in, out]) and MoE expert stacks ([L, X, in, out]) — so one rule
+    quantizes every family. Mesh-sharded serving uses this tree with
+    :func:`quantized_param_shardings`."""
 
     def quant(w: jax.Array, contract_axis: int) -> dict:
         wf = w.astype(jnp.float32)
@@ -260,9 +260,44 @@ def quantize_params_int8(params: Params, config: LlamaConfig) -> Params:
     lp = dict(params["layers"])
     for name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
         if name in lp:
-            lp[name] = quant(lp[name], 1)  # stacked [L, in, out] → s [L, out]
+            lp[name] = quant(lp[name], lp[name].ndim - 2)
     out["layers"] = lp
     return out
+
+
+def quantized_logical_axes(config: LlamaConfig) -> Params:
+    """Logical sharding axes for :func:`quantize_params_int8`'s tree: ``q``
+    shards exactly like its parent weight; ``s`` (per-out-channel scales)
+    keeps every parent axis except the contracted one. This is what lets
+    int8 decode run on a dp×tp×ep mesh — the 70B north-star config — with
+    each shard holding its own slice of both tensors."""
+    axes = param_logical_axes(config)
+
+    def q_axes(ax, contract_idx):
+        return {
+            "q": ax,
+            "s": tuple(a for i, a in enumerate(ax) if i != contract_idx),
+        }
+
+    axes["embed"] = q_axes(axes["embed"], 1)
+    if "lm_head" in axes:
+        axes["lm_head"] = q_axes(axes["lm_head"], 0)
+    for name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+        if name in axes["layers"]:
+            ax = axes["layers"][name]
+            axes["layers"][name] = q_axes(ax, len(ax) - 2)
+    return axes
+
+
+def quantized_param_shardings(config: LlamaConfig, mesh) -> Params:
+    """NamedSharding pytree matching quantize_params_int8's structure."""
+    from dynamo_tpu.parallel.mesh import logical_to_sharding
+
+    return jax.tree.map(
+        lambda ax: logical_to_sharding(mesh, *ax),
+        quantized_logical_axes(config),
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
 
 
 # -- math --------------------------------------------------------------------

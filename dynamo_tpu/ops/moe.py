@@ -67,6 +67,20 @@ def moe_param_logical_axes() -> Dict[str, Tuple[Optional[str], ...]]:
     }
 
 
+def _expert_mat(x: jax.Array, w, pattern: str) -> jax.Array:
+    """Expert-batched einsum against a plain or int8 ``{"q","s"}`` weight.
+
+    Scales are per (expert, out-channel) — ``[X, out]`` — and the batched
+    patterns here all produce ``[X, C, out]``, so one broadcast rule
+    (``s[:, None, :]``) covers gate/up/down. Same quantization contract as
+    models/llama.py ``matw``: int8 load converts inline (the decode weight
+    stream halves), scales multiply in f32."""
+    if isinstance(w, dict):
+        y = jnp.einsum(pattern, x, w["q"].astype(x.dtype))
+        return (y.astype(jnp.float32) * w["s"][:, None, :]).astype(x.dtype)
+    return jnp.einsum(pattern, x, w)
+
+
 def moe_mlp(
     params: Dict[str, Any],
     cfg: MoeConfig,
@@ -122,10 +136,10 @@ def moe_mlp(
     # expert batches; the X axis is sharded over ep (GSPMD all-to-all)
     expert_in = jnp.einsum("nxc,ne->xce", dispatch.astype(x.dtype), xt)
     gate = jax.nn.silu(
-        jnp.einsum("xce,xef->xcf", expert_in, params["w_gate"]).astype(jnp.float32)
+        _expert_mat(expert_in, params["w_gate"], "xce,xef->xcf").astype(jnp.float32)
     ).astype(x.dtype)
-    up = jnp.einsum("xce,xef->xcf", expert_in, params["w_up"])
-    expert_out = jnp.einsum("xcf,xfe->xce", gate * up, params["w_down"])
+    up = _expert_mat(expert_in, params["w_up"], "xce,xef->xcf")
+    expert_out = _expert_mat(gate * up, params["w_down"], "xcf,xfe->xce")
 
     out = jnp.einsum("nxc,xce->ne", combine.astype(x.dtype), expert_out)
 

@@ -199,7 +199,11 @@ def _decode_kernel_v2(
     def _():
         start_chunk(0, 0)
 
-    q = q_ref[0].reshape(kvh, g, d).astype(jnp.float32)  # [KVH, G, D]
+    # q joins the cache dtype: K/V stream uncast into the MXU (casting THEM
+    # is what blew the scoped-VMEM budget), and q is tiny — this also keeps
+    # the engine's cache_dtype-differs-from-model-dtype configs compiling
+    # (Mosaic has no mixed-operand matmul)
+    q = q_ref[0].reshape(kvh, g, d).astype(k_buf.dtype)  # [KVH, G, D]
 
     def chunk_body(chunk, carry):
         m, l, acc = carry  # [H], [H], [H, D] f32
@@ -212,8 +216,10 @@ def _decode_kernel_v2(
         wait_chunk(slot, chunk)
         k = k_buf[slot].reshape(P * bs, kvh, d)  # [T, KVH, D]
         v = v_buf[slot].reshape(P * bs, kvh, d)
-        kt = k.transpose(1, 0, 2).astype(jnp.float32)  # [KVH, T, D]
-        vt = v.transpose(1, 0, 2).astype(jnp.float32)
+        # cache dtype straight into the MXU (f32 accumulate via
+        # preferred_element_type); f32 copies here double VMEM pressure
+        kt = k.transpose(1, 0, 2)  # [KVH, T, D]
+        vt = v.transpose(1, 0, 2)
 
         scores = lax.dot_general(  # [KVH, G, T]
             q, kt, (((2,), (2,)), ((0,), (0,))),
@@ -228,7 +234,7 @@ def _decode_kernel_v2(
         p = jnp.exp(flat - m_new[:, None])
         l = l * alpha + p.sum(axis=1)
         pv = lax.dot_general(  # [KVH, G, D]
-            p.reshape(kvh, g, P * bs), vt,
+            p.reshape(kvh, g, P * bs).astype(vt.dtype), vt,
             (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
@@ -274,7 +280,13 @@ def paged_attention_decode_v2(
     _, bs, kvh, _ = k_cache.shape
     if scale is None:
         scale = d ** -0.5
+    # clamp the double buffers to the scoped-VMEM budget. The in-kernel
+    # transposes/casts cost roughly another buffer's worth of stack, so the
+    # buffers themselves get at most 4 MB of the 16 MB scoped limit.
     P = min(pages_per_chunk, block_tables.shape[1])
+    per_p = 2 * 2 * bs * kvh * d * k_cache.dtype.itemsize
+    while P > 1 and P * per_p > (4 << 20):
+        P //= 2
 
     out_specs = [pl.BlockSpec((1, h, d), lambda si, *_: (si, 0, 0))]
     out_shape = [jax.ShapeDtypeStruct((s, h, d), q.dtype)]
@@ -319,7 +331,7 @@ def v4_plan(
     """Largest pages_per_chunk whose lane-batched double buffers fit the
     VMEM budget, or None when even the smallest chunk doesn't (huge lane
     counts: fall back to the per-lane v2 schedule)."""
-    for p in (16, 8, 4, 2):
+    for p in (16, 8, 4, 2, 1):
         if p > mb:
             continue
         if 2 * 2 * n_lanes * p * bs * kvh * d * itemsize <= vmem_budget:
@@ -333,7 +345,7 @@ def _decode_kernel_v4(
     lengths_ref,  # [S]
     # blocks
     q_ref,  # [S, H, D] (VMEM — every lane)
-    k_hbm,  # [N, bs, KVH, D]
+    k_hbm,  # [N, bs, KVH*D] — kv-head and head-dim fused into the lane dim
     v_hbm,
     o_ref,  # [S, H, D]
     *rest,
@@ -348,7 +360,16 @@ def _decode_kernel_v4(
     of v2/v3 this divides the fixed per-iteration cost (DMA bookkeeping,
     loop control, flash rescale) by the lane count and feeds the MXU a
     batched [S·KVH] stack of small matmuls per chunk — the regime where the
-    kernel must compete with one big dense einsum."""
+    kernel must compete with one big dense einsum.
+
+    The cache arrives with (kvh, d) FUSED into one lane dimension: a page
+    is [bs, kvh*d], so one DMA moves every head's slice of a page and the
+    per-head operand inside the kernel is a STATIC LANE SLICE
+    (``[..., n*d:(n+1)*d]``) — the one indexing pattern Mosaic lowers
+    without relayout. Any unfused layout either puts kvh in the sublane dim
+    (padded 2→8: 4× VMEM inflation) or needs a middle-dim gather (full
+    buffer relayout on every read); both blow the 16 MB scoped-VMEM budget
+    at serving shapes."""
     if with_stats:
         ms_ref, ls_ref, k_buf, v_buf, sem = rest
     else:
@@ -359,12 +380,15 @@ def _decode_kernel_v4(
     bs = k_hbm.shape[1]
     h, d = q_ref.shape[1], q_ref.shape[2]
     g = h // kvh
-    T = P * bs
+    T = P * bs  # context tokens per chunk
 
-    # scalar-prefetch refs live in SMEM: only scalar loads — assemble the
-    # per-lane length vector from S scalar reads (S is static)
-    lengths = jnp.stack([lengths_ref[i] for i in range(S)])  # [S]
-    max_len = jnp.max(lengths)
+    # scalar-prefetch refs live in SMEM: only scalar loads — keep the
+    # reduction scalar (Mosaic rejects 1-D→3-D vector reshapes, so the
+    # mask-side broadcast below goes scalar→3-D directly, never via a
+    # stacked [S] vector)
+    max_len = lengths_ref[0]
+    for i in range(1, S):
+        max_len = jnp.maximum(max_len, lengths_ref[i])
     n_chunks = lax.div(max_len + T - 1, T)
 
     def lane_last_live(s):
@@ -394,16 +418,27 @@ def _decode_kernel_v4(
             src.at[pid], dst.at[slot, s, i], sem.at[slot, s, i, which]
         )
 
+    def lane_fetches(s, chunk):
+        """Lanes whose context ended before this chunk skip their DMAs
+        entirely — with ragged lengths (the serving norm: n_chunks is the
+        BATCH max) a finished lane would otherwise re-stream its last page
+        once per remaining chunk, pure wasted HBM bandwidth. Chunks 0 and 1
+        always fetch so BOTH double-buffer slots hold finite data (compute
+        masks the values off, but 0·NaN from uninitialized scratch would
+        survive the mask through the value contraction)."""
+        return jnp.logical_or(chunk <= 1, chunk * (P * bs) < lengths_ref[s])
+
     def start_chunk(slot, chunk):
         for s in range(S):  # static unroll over lanes
             consec, first = lane_consecutive(s, chunk)
+            fetch = lane_fetches(s, chunk)
 
-            @pl.when(consec)
+            @pl.when(jnp.logical_and(fetch, consec))
             def _(s=s, first=first):
                 run_dma(slot, s, first, 0).start()
                 run_dma(slot, s, first, 1).start()
 
-            @pl.when(jnp.logical_not(consec))
+            @pl.when(jnp.logical_and(fetch, jnp.logical_not(consec)))
             def _(s=s, chunk=chunk):
                 for i in range(P):
                     page_dma(slot, s, chunk, i, 0).start()
@@ -412,13 +447,14 @@ def _decode_kernel_v4(
     def wait_chunk(slot, chunk):
         for s in range(S):
             consec, first = lane_consecutive(s, chunk)
+            fetch = lane_fetches(s, chunk)
 
-            @pl.when(consec)
+            @pl.when(jnp.logical_and(fetch, consec))
             def _(s=s, first=first):
                 run_dma(slot, s, first, 0).wait()
                 run_dma(slot, s, first, 1).wait()
 
-            @pl.when(jnp.logical_not(consec))
+            @pl.when(jnp.logical_and(fetch, jnp.logical_not(consec)))
             def _(s=s, chunk=chunk):
                 for i in range(P):
                     page_dma(slot, s, chunk, i, 0).wait()
@@ -429,9 +465,15 @@ def _decode_kernel_v4(
         start_chunk(0, 0)
 
     # per-kv-head query slices (kvh is static): Mosaic's tpu.matmul takes
-    # ONE batch dim, and per-head slicing avoids vector-layout shape casts
-    q_all = q_ref[...].astype(jnp.float32)  # [S, H, D]
+    # ONE batch dim, and per-head slicing avoids vector-layout shape casts.
+    # q joins the cache dtype (tiny cast; K/V stream uncast — see v2 note).
+    q_all = q_ref[...].astype(k_buf.dtype)  # [S, H, D]
     q_heads = [q_all[:, n * g:(n + 1) * g, :] for n in range(kvh)]  # [S,G,D]
+
+    # per-lane live mask operand, scalar→3-D broadcast per lane (see above)
+    len3 = jnp.concatenate(
+        [jnp.full((1, g, T), lengths_ref[i], jnp.int32) for i in range(S)], axis=0
+    )  # [S, g, T]
 
     def chunk_body(chunk, carry):
         m, l, acc = carry  # [S,H], [S,H], [S,H,D] f32
@@ -442,17 +484,22 @@ def _decode_kernel_v4(
             start_chunk(lax.rem(chunk + 1, 2), chunk + 1)
 
         wait_chunk(slot, chunk)
-        # merge (P, bs) → T by static concat: Mosaic's layout inference
-        # rejects the equivalent 5D→4D shape cast
-        kc = jnp.concatenate([k_buf[slot, :, i] for i in range(P)], axis=1)
-        vc = jnp.concatenate([v_buf[slot, :, i] for i in range(P)], axis=1)
+        # Per-kv-head [S, T, D] operands via static LANE slices of the
+        # fused buffer — no relayout, dense (T, D) tiling, MXU dtype.
         pos = chunk * T + lax.broadcasted_iota(jnp.int32, (S, g, T), 2)
-        live = pos < lengths[:, None, None]  # [S, G, T]
+        live = pos < len3  # [S, G, T]
 
         outs = []
+        vns = []
         for n in range(kvh):
-            kn = kc[:, :, n, :].astype(jnp.float32)  # [S, T, D]
-            vn = vc[:, :, n, :].astype(jnp.float32)
+            kn = jnp.concatenate(
+                [k_buf[slot, :, i, :, n * d:(n + 1) * d] for i in range(P)],
+                axis=1,
+            )  # [S, T, D]
+            vns.append(jnp.concatenate(
+                [v_buf[slot, :, i, :, n * d:(n + 1) * d] for i in range(P)],
+                axis=1,
+            ))
             scores = lax.dot_general(  # [S, G, T]
                 q_heads[n], kn, (((2,), (2,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32,
@@ -464,11 +511,11 @@ def _decode_kernel_v4(
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(flat - m_new[:, :, None])
         l = l * alpha + p.sum(axis=2)
+        pb = p.astype(k_buf.dtype)  # back to the MXU operand dtype
         pvs = []
         for n in range(kvh):
-            vn = vc[:, :, n, :].astype(jnp.float32)
             pvs.append(lax.dot_general(  # [S, G, D]
-                p[:, n * g:(n + 1) * g, :], vn,
+                pb[:, n * g:(n + 1) * g, :], vns[n],
                 (((2,), (1,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32,
             ))
@@ -507,7 +554,16 @@ def paged_attention_decode_v4(
     _, bs, kvh, _ = k_cache.shape
     if scale is None:
         scale = d ** -0.5
-    P = min(pages_per_chunk, block_tables.shape[1])
+    # self-clamp to the VMEM budget: the scoped-vmem limit is ~16 MB and the
+    # double buffers are the dominant allocation — a caller-passed P that
+    # blows it is a compile error on chip, so clamp rather than trust
+    plan = v4_plan(s, bs, kvh, d, k_cache.dtype.itemsize, block_tables.shape[1])
+    if plan is None:
+        raise ValueError(
+            "v4 double buffers exceed the VMEM budget at every chunk size; "
+            "use paged_attention_decode_v2 (per-lane grid) for this shape"
+        )
+    P = min(pages_per_chunk, block_tables.shape[1], plan)
 
     out_shape = [jax.ShapeDtypeStruct((s, h, d), q.dtype)]
     if return_stats:
@@ -525,8 +581,8 @@ def paged_attention_decode_v4(
             if return_stats else pl.BlockSpec(memory_space=pltpu.VMEM)
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, s, P, bs, kvh, d), k_cache.dtype),
-            pltpu.VMEM((2, s, P, bs, kvh, d), v_cache.dtype),
+            pltpu.VMEM((2, s, P, bs, kvh * d), k_cache.dtype),
+            pltpu.VMEM((2, s, P, bs, kvh * d), v_cache.dtype),
             pltpu.SemaphoreType.DMA((2, s, P, 2)),
         ],
     )
@@ -534,12 +590,19 @@ def paged_attention_decode_v4(
         _decode_kernel_v4, scale=scale, kvh=kvh, pages_per_chunk=P,
         n_lanes=s, with_stats=return_stats,
     )
+    n_pages = k_cache.shape[0]
     res = pl.pallas_call(
         kernel,
         out_shape=out_shape if return_stats else out_shape[0],
         grid_spec=grid_spec,
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32), q, k_cache, v_cache)
+    )(
+        block_tables.astype(jnp.int32), lengths.astype(jnp.int32), q,
+        # fuse (kvh, d) into the lane dim: layout-free reshape (contiguous
+        # minor dims), one DMA per page covers every head's slice
+        k_cache.reshape(n_pages, bs, kvh * d),
+        v_cache.reshape(n_pages, bs, kvh * d),
+    )
     if return_stats:
         out, m, l = res
         return out, m[:, 0], l[:, 0]

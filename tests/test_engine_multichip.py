@@ -10,6 +10,7 @@ import asyncio
 import dataclasses
 
 import jax
+import jax.numpy as jnp
 import pytest
 
 from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
@@ -79,3 +80,45 @@ def test_driver_dryrun_multichip_in_process():
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
+
+
+def test_engine_int8_sharded_parity(params, run):
+    """Sharded int8 (VERDICT r4 item 2): the hybrid int8 serving mode must
+    run on a dp×tp mesh — quantized {q, s} leaves shard like their parent
+    weights — and produce exactly the tokens of the single-chip int8 engine
+    (float32 model: greedy parity is bitwise-stable)."""
+    cfg8 = dataclasses.replace(ENGINE_CFG, quantize="int8")
+
+    single = JaxServingEngine(CFG, params, cfg8)
+    try:
+
+        async def go_single():
+            return await asyncio.gather(
+                *[collect_tokens(single, p, max_tokens=5) for p in PROMPTS]
+            )
+
+        expected = {
+            tuple(p): toks
+            for p, (toks, _) in zip(PROMPTS, run(go_single()))
+        }
+    finally:
+        single.close()
+
+    mesh = make_mesh(MeshConfig(dp=2, tp=2))
+    sharded = jax.device_put(params, param_shardings(CFG, mesh))
+    eng = JaxServingEngine(CFG, sharded, cfg8, mesh=mesh)
+    try:
+        # decode params really are the quantized tree, sharded on the mesh
+        q_leaf = eng.params_decode["layers"]["wq"]
+        assert set(q_leaf) == {"q", "s"} and q_leaf["q"].dtype == jnp.int8
+        assert len(q_leaf["q"].sharding.device_set) == 4
+
+        async def go():
+            return await asyncio.gather(
+                *[collect_tokens(eng, p, max_tokens=5) for p in PROMPTS]
+            )
+
+        for p, (toks, _) in zip(PROMPTS, run(go())):
+            assert toks == expected[tuple(p)], f"prompt {p} int8-on-mesh"
+    finally:
+        eng.close()

@@ -156,3 +156,64 @@ def test_padding_tokens_cannot_steal_expert_capacity(params):
     assert float(aux["dropped_fraction"]) == 0.0
     # padding rows contribute nothing
     np.testing.assert_array_equal(np.asarray(got[1:]), 0.0)
+
+
+def test_moe_int8_expert_quantization(params):
+    """int8 expert weights (VERDICT r4 item 2): _expert_mat dequantizes per
+    (expert, out-channel); the quantized MoE output must track the bf16 one
+    within the absmax/127 reconstruction error, with identical routing."""
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 6, CFG.hidden_size), jnp.float32)
+
+    def quant(w):
+        wf = np.asarray(w, np.float32)
+        s = np.maximum(np.abs(wf).max(axis=-2) / 127.0, 1e-12)
+        q = np.clip(np.round(wf / s[..., None, :]), -127, 127).astype(np.int8)
+        return {"q": jnp.asarray(q), "s": jnp.asarray(s)}
+
+    qp = dict(params)
+    for name in ("w_gate", "w_up", "w_down"):
+        qp[name] = quant(params[name])
+
+    got, _ = moe_mlp(qp, CFG, x)
+    want, _ = moe_mlp(params, CFG, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=0.05)
+
+
+def test_llama_moe_int8_family_quantizes():
+    """quantize_params_int8 covers the MoE family (the r4 guard is gone):
+    expert stacks [L, X, in, out] quantize over the in axis, the router
+    stays float, and the quantized forward runs."""
+    import dataclasses as _dc
+
+    from dynamo_tpu.models.llama import (
+        LLAMA_PRESETS,
+        forward,
+        init_params,
+        make_kv_cache,
+        quantize_params_int8,
+        quantized_logical_axes,
+    )
+
+    cfg = _dc.replace(LLAMA_PRESETS["tiny-moe"], dtype=jnp.float32)
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    qp = quantize_params_int8(p, cfg)
+    wg = qp["layers"]["w_gate"]
+    assert wg["q"].dtype == jnp.int8
+    assert wg["q"].shape == p["layers"]["w_gate"].shape
+    assert wg["s"].shape == p["layers"]["w_gate"].shape[:2] + (
+        p["layers"]["w_gate"].shape[-1],
+    )
+    assert not isinstance(qp["layers"]["moe_router"], dict)  # router unquantized
+    # logical axes for scales drop the contracted axis, keep experts/mlp
+    ax = quantized_logical_axes(cfg)["layers"]["w_gate"]
+    assert ax["s"] == ("layers", "experts", "mlp")
+
+    cache = make_kv_cache(cfg, 8, 8, dtype=jnp.float32)
+    tokens = jnp.asarray([[5, 3, 7, 1]], jnp.int32)
+    positions = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
+    tables = jnp.asarray([[0, 1]], jnp.int32)
+    logits, _ = forward(qp, cfg, tokens, positions, cache, tables)
+    ref, _ = forward(p, cfg, tokens, positions, cache, tables)
+    assert not np.isnan(np.asarray(logits)).any()
+    # same argmax as the unquantized model on a tiny model
+    assert (np.asarray(logits[0, -1]).argmax() == np.asarray(ref[0, -1]).argmax())
