@@ -756,10 +756,16 @@ class JaxServingEngine(AsyncEngine):
     def _release_counts(self) -> None:
         """No penalized lane is running: free the [S, V] device buffer and
         the strong _Seq references held by the row tracking. Rebuilt from
-        out_tokens on the next penalized admission."""
+        out_tokens on the next penalized admission. The multihost leader
+        broadcasts the release — followers drop theirs on non-penalized
+        dispatches, but an IDLE engine sends no dispatches, and without the
+        marker each follower would hold the buffer until unrelated traffic
+        arrived."""
         if self._counts is not None:
             self._counts = None
             self._counts_lanes = [None] * self.config.max_slots
+            if self._dispatch_hook is not None:
+                self._dispatch_hook("counts_release", {}, {})
 
     def _sync_counts(self, lanes: List[Optional["_Seq"]]) -> None:
         """Bring the device count buffer in line with the current lane set:
@@ -773,8 +779,9 @@ class JaxServingEngine(AsyncEngine):
         stall every lane the moment the first penalized request lands."""
         S = self.config.max_slots
         if self._counts is None:
-            self._counts = jnp.zeros(
-                (S, self.model_config.vocab_size), jnp.int32
+            # _put: replicated global array on a process-spanning mesh
+            self._counts = self._put(
+                np.zeros((S, self.model_config.vocab_size), np.int32)
             )
         changed = [
             i for i in range(S)
@@ -801,9 +808,16 @@ class JaxServingEngine(AsyncEngine):
         for j, (r, t) in enumerate(pairs):
             add_rows[j] = r
             add_toks[j] = t
+        if self._dispatch_hook is not None:
+            # the sync is itself a device program: followers must run it in
+            # the same order as every other dispatch
+            self._dispatch_hook(
+                "counts", dict(rb=rb, pb=pb),
+                dict(reset=reset, add_rows=add_rows, add_toks=add_toks),
+            )
         self._counts = self._counts_sync_fn(rb, pb)(
-            self._counts, jnp.asarray(reset), jnp.asarray(add_rows),
-            jnp.asarray(add_toks),
+            self._counts, self._put(reset), self._put(add_rows),
+            self._put(add_toks),
         )
         self._counts_lanes = list(lanes)
 
@@ -862,23 +876,6 @@ class JaxServingEngine(AsyncEngine):
                 f"is {self.config.max_model_len}"
             )
             return
-        if self._dispatch_hook is not None:
-            # multihost lockstep serves greedy/temperature sampling only:
-            # reject here at admission — raising deep in the step loop would
-            # take down every in-flight request AND strand the followers
-            # mid-broadcast (parallel/multihost_serving.py)
-            so = req.sampling_options
-            if so is not None and (
-                so.logprobs is not None
-                or (so.frequency_penalty or 0.0) != 0.0
-                or (so.presence_penalty or 0.0) != 0.0
-            ):
-                yield Annotated.from_error(
-                    "multihost serving does not support logprobs or "
-                    "frequency/presence penalties yet"
-                )
-                return
-
         self._ensure_thread()
         seq = _Seq(request, req, asyncio.get_running_loop())
         with self._cond:

@@ -553,7 +553,7 @@ def _paged_window_attention(
     partial is merged with the standard flash-decoding combine. The pool
     stays read-only inside the dispatch — the kernel tier gets the same
     no-per-step-scatter decode structure as the jnp path."""
-    from dynamo_tpu.ops.attention import _v2_supported
+    from dynamo_tpu.ops.attention import _v2_supported, _v4_supported
     from dynamo_tpu.ops.pallas.paged_attention import (
         paged_attention_decode,
         paged_attention_decode_sharded,
@@ -574,7 +574,7 @@ def _paged_window_attention(
             q1, k_page, v_page, block_tables, lengths, mesh=mesh,
             interpret=interpret, return_stats=True,
         )
-    elif _v2_supported(d) and plan is not None:
+    elif _v4_supported(c.num_kv_heads, d) and plan is not None:
         o_p, m_p, l_p = paged_attention_decode_v4(
             q1, k_page, v_page, block_tables, lengths,
             pages_per_chunk=plan, interpret=interpret, return_stats=True,

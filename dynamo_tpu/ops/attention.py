@@ -36,10 +36,11 @@ def _select_pallas(head_dim: int) -> bool:
     DYN_TPU_ATTENTION=pallas|jnp forces the choice; auto uses the
     multi-page double-buffered kernel (paged_attention_decode_v2) on TPU
     whenever the head dim is lane-aligned (D % 128 == 0 — Mosaic DMA slices
-    must align to the 128-lane tiling). Measured on v5e at D=128: never
-    slower than XLA's gather+einsum, ~2× total (10× on attention compute)
-    by an 8k context. D=64 models (llama3.2-1b) keep the jnp path, which
-    wins there anyway. Env vars are read at trace time, so tests and
+    must align to the 128-lane tiling); the lane-batched v4 schedule widens
+    this to kvh*d % 128 == 0 where callers know kvh (see _v4_supported —
+    d=64 GQA models like llama3.2-1b qualify). Measured on v5e at D=128:
+    v4 streams at the practical HBM ceiling and beats the dense tier at 8k
+    context. Env vars are read at trace time, so tests and
     operators can flip them live. Callers with a cache sharded over a mesh
     pass ``mesh=`` so the kernel runs under shard_map (Mosaic kernels have
     no GSPMD partitioning rule; shard_map sidesteps auto-partitioning).
@@ -49,6 +50,8 @@ def _select_pallas(head_dim: int) -> bool:
         return True
     if mode == "jnp":
         return False
+    # note: callers with kvh in hand get the wider fused-lane rule via
+    # _v4_supported below (d=64 GQA models qualify through kvh*d % 128)
     return _platform_is_tpu() and _v2_supported(head_dim)
 
 
@@ -56,6 +59,14 @@ def _v2_supported(head_dim: int) -> bool:
     """Single home for the Mosaic DMA-slice alignment constraint (128-lane
     tiling): both auto-selection and the v2-vs-v1 dispatch consult it."""
     return head_dim % 128 == 0
+
+
+def _v4_supported(num_kv_heads: int, head_dim: int) -> bool:
+    """The lane-batched v4 kernel fuses (kvh, d) into ONE lane dimension
+    (its pages move as [bs, kvh*d] slabs), so its alignment constraint is
+    on the fused width — d=64 GQA models (llama-1b: 8×64=512) qualify even
+    though the per-lane v2 schedule's d%128 rule excludes them."""
+    return (num_kv_heads * head_dim) % 128 == 0
 
 
 def decode_uses_pallas(
@@ -87,8 +98,10 @@ def decode_uses_pallas(
       regime with zero extra HBM.
 
     Usability: TPU platform, and on a sharded mesh the head axes must split
-    evenly over tp (shard_map divisibility). D % 128 != 0 falls back to the
-    per-page-grid v1 kernel schedule, which has no DMA-slice alignment
+    evenly over tp (shard_map divisibility). Shapes where kvh*d % 128 == 0
+    take the lane-batched v4 schedule (fused-lane pages — includes the
+    d=64 GQA families); d % 128 == 0 takes v2; anything else falls back to
+    the per-page-grid v1 schedule, which has no DMA-slice alignment
     constraint.
     """
     mode = os.environ.get("DYN_TPU_ATTENTION", "auto")
@@ -220,7 +233,7 @@ def paged_attention(
                 q[:, 0], k_cache, v_cache, block_tables, lengths, mesh=mesh,
                 scale=scale, interpret=interpret,
             )
-        elif _v2_supported(d) and plan is not None:
+        elif _v4_supported(kvh, d) and plan is not None:
             # lane-batched single-program schedule: one loop drives every
             # lane's DMA+compute (the per-lane grid's fixed cost / n_lanes)
             out = paged_attention_decode_v4(

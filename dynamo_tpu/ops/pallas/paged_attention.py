@@ -326,7 +326,7 @@ def paged_attention_decode_v2(
 
 def v4_plan(
     n_lanes: int, bs: int, kvh: int, d: int, itemsize: int, mb: int,
-    vmem_budget: int = 8 << 20,
+    vmem_budget: int = 6 << 20,
 ) -> Optional[int]:
     """Largest pages_per_chunk whose lane-batched double buffers fit the
     VMEM budget, or None when even the smallest chunk doesn't (huge lane
@@ -404,10 +404,14 @@ def _decode_kernel_v4(
             ok = jnp.logical_and(ok, tables_ref[s, idx] == first + i)
         return ok, first
 
+    # one semaphore per (slot, lane, k/v), SHARED by that lane's page
+    # copies: each copy increments it once and each wait decrements once,
+    # so counts balance. A per-page semaphore array ([2, S, P, 2]) blows
+    # the chip's sflag space (2 KB) at serving lane counts.
     def run_dma(slot, s, first, which):
         src, dst = (k_hbm, k_buf) if which == 0 else (v_hbm, v_buf)
         return pltpu.make_async_copy(
-            src.at[pl.ds(first, P)], dst.at[slot, s], sem.at[slot, s, 0, which]
+            src.at[pl.ds(first, P)], dst.at[slot, s], sem.at[slot, s, which]
         )
 
     def page_dma(slot, s, chunk, i, which):
@@ -415,7 +419,7 @@ def _decode_kernel_v4(
         pid = tables_ref[s, jnp.minimum(chunk * P + i, last)]
         src, dst = (k_hbm, k_buf) if which == 0 else (v_hbm, v_buf)
         return pltpu.make_async_copy(
-            src.at[pid], dst.at[slot, s, i], sem.at[slot, s, i, which]
+            src.at[pid], dst.at[slot, s, i], sem.at[slot, s, which]
         )
 
     def lane_fetches(s, chunk):
@@ -583,7 +587,7 @@ def paged_attention_decode_v4(
         scratch_shapes=[
             pltpu.VMEM((2, s, P, bs, kvh * d), k_cache.dtype),
             pltpu.VMEM((2, s, P, bs, kvh * d), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, s, P, 2)),
+            pltpu.SemaphoreType.DMA((2, s, 2)),
         ],
     )
     kernel = functools.partial(

@@ -19,9 +19,12 @@ the vLLM0.7 Ray leader/follower bring-up (lib/engines/vllm0_7/src/ray.rs:
 NCCL world driven by RPC, the *dispatch stream itself* is the coordination
 channel, and XLA inserts the cross-host collectives.
 
-Limits (explicit): logprobs/penalty sampling paths are leader-only features
-not yet wired into the lockstep descriptors — multihost serving is greedy/
-temperature sampling (the common serving configuration).
+The full sampling surface rides the descriptors (reference parity:
+multinode engines serve logprobs/penalties like any other request,
+lib/engines/vllm0_7/src/ray.rs:66-170): ``lp``/``pen`` variant bits select
+the same jitted fn on both sides, and the penalty-count sync — itself a
+device program — is broadcast as its own opcode so followers execute the
+identical program sequence.
 """
 
 from __future__ import annotations
@@ -37,6 +40,8 @@ logger = logging.getLogger(__name__)
 OP_SHUTDOWN = 0
 OP_CHUNK = 1
 OP_DECODE = 2
+OP_COUNTS = 3  # penalty-count row sync (reset + rebuild scatters)
+OP_COUNTS_RELEASE = 4  # idle engine dropped the count buffer: followers too
 
 _HDR = 8  # int32 header slots
 
@@ -58,17 +63,30 @@ class LeaderBroadcaster:
         self._ec = engine.config
 
     def __call__(self, kind: str, flags: dict, arrays: dict) -> None:
-        if flags.get("lp") or flags.get("pen"):
-            raise NotImplementedError(
-                "multihost lockstep serves greedy/temperature sampling; "
-                "logprobs/penalties are not in the descriptor protocol yet"
-            )
         hdr = np.zeros((_HDR,), np.int32)
+        if kind == "counts_release":
+            hdr[0] = OP_COUNTS_RELEASE
+            _broadcast(hdr)
+            return
+        if kind == "counts":
+            # variable-size scatter payload: sizes ride the header
+            hdr[0] = OP_COUNTS
+            hdr[1] = int(flags["rb"])
+            hdr[2] = int(flags["pb"])
+            _broadcast(hdr)
+            _broadcast((
+                arrays["reset"].astype(np.int32),
+                arrays["add_rows"].astype(np.int32),
+                arrays["add_toks"].astype(np.int32),
+            ))
+            return
         hdr[0] = OP_CHUNK if kind == "chunk" else OP_DECODE
         hdr[1] = int(flags.get("sample", False))
         hdr[2] = int(flags.get("history", True))
         hdr[3] = int(flags.get("use_carry", False))
         hdr[4] = int(flags["step"])
+        hdr[5] = int(flags.get("lp", False))
+        hdr[6] = int(flags.get("pen", False))
         _broadcast(hdr)
         if kind == "chunk":
             payload = (
@@ -126,23 +144,46 @@ def follower_serve(model_config, params, engine_config, mesh, engine=None) -> No
         if op == OP_SHUTDOWN:
             logger.info("follower shutdown")
             return
+        if op == OP_COUNTS_RELEASE:
+            eng._counts = None
+            continue
+        if op == OP_COUNTS:
+            rb, pb = int(hdr[1]), int(hdr[2])
+            reset, add_rows, add_toks = _broadcast((
+                np.zeros((rb,), np.int32), np.zeros((pb,), np.int32),
+                np.zeros((pb,), np.int32),
+            ))
+            if eng._counts is None:
+                eng._counts = eng._put(
+                    np.zeros((S, model_config.vocab_size), np.int32)
+                )
+            eng._counts = eng._counts_sync_fn(rb, pb)(
+                eng._counts, eng._put(reset), eng._put(add_rows),
+                eng._put(add_toks),
+            )
+            continue
         want_sample = bool(hdr[1])
         want_history = bool(hdr[2])
         use_carry = bool(hdr[3])
         step = int(hdr[4])
+        want_lp = bool(hdr[5])
+        want_pen = bool(hdr[6])
+        counts_in = eng._counts if want_pen else counts
         if op == OP_CHUNK:
             tokens, positions, tables, sample_at, ipack, fpack = _broadcast((
                 np.zeros((S, C), np.int32), np.zeros((S, C), np.int32),
                 np.zeros((S, MB), np.int32), z_i,
                 np.zeros((2, S), np.int32), np.zeros((4, S), np.float32),
             ))
-            fn = eng._chunk(False, False, want_sample, want_history)
-            out, eng.cache, counts = fn(
-                eng.params, eng.cache, counts, eng._put(tokens),
+            fn = eng._chunk(want_lp, want_pen, want_sample, want_history)
+            res = fn(
+                eng.params, eng.cache, counts_in, eng._put(tokens),
                 eng._put(positions), eng._m_tables.get(tables),
                 eng._put(sample_at), eng._put(np.int32(step)),
                 eng._m_ipack.get(ipack), eng._m_fpack.get(fpack),
             )
+            # lp variants return (sampled, lp, ids, lps, cache, counts)
+            eng.cache, counts_out = res[-2], res[-1]
             carry = None  # leader also drains its pipeline around chunks
         else:
             tokens, positions, tables, ipack, fpack = _broadcast((
@@ -153,13 +194,22 @@ def follower_serve(model_config, params, engine_config, mesh, engine=None) -> No
                 toks_in, pos_in = carry
             else:
                 toks_in, pos_in = eng._put(tokens), eng._put(positions)
-            fn = eng._decode(False, False, want_sample)
-            out, toks2, pos2, eng.cache, counts = fn(
-                eng.params_decode, eng.cache, counts, toks_in, pos_in,
+            fn = eng._decode(want_lp, want_pen, want_sample)
+            res = fn(
+                eng.params_decode, eng.cache, counts_in, toks_in, pos_in,
                 eng._m_tables.get(tables), eng._put(np.int32(step)),
                 eng._m_ipack.get(ipack), eng._m_fpack.get(fpack),
             )
-            carry = (toks2, pos2)
+            # (out[, lps, ids, lps], tokens, positions, cache, counts)
+            eng.cache, counts_out = res[-2], res[-1]
+            carry = (res[-4], res[-3])
+        # mirror the leader's counts bookkeeping: penalized dispatches carry
+        # the real buffer forward; others update the dummy and release
+        if want_pen:
+            eng._counts = counts_out
+        else:
+            counts = counts_out
+            eng._counts = None
 
 
 def _process_index() -> int:

@@ -1,0 +1,105 @@
+"""Ablation: per-step cost of ONLY the weight matmuls (no attention, no
+norms, no sampling) at several batch sizes, int8 and bf16.
+
+The decode step's cost model is (weight stream ~ fixed) + (per-lane ~
+linear). probe_decode_scaling.py measures the full step; this isolates the
+matmul tier so the per-lane residue can be attributed between the GEMMs
+themselves and everything else (attention, window flush, sampling).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dynamo_tpu.models.llama import (
+    LLAMA_PRESETS, init_params, matw, quantize_params_int8, embed_lookup, lm_head,
+)
+
+PRESET = os.environ.get("PROBE_PRESET", "llama3.2-1b")
+SLOTS = [int(s) for s in os.environ.get("PROBE_SLOTS", "32,64,128").split(",")]
+K = 16
+
+
+def fetch(x):
+    jax.block_until_ready(x)
+    return np.asarray(jax.device_get(jnp.ravel(x)[:4]))
+
+
+def main():
+    from dynamo_tpu.engine_jax.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    cfg = dataclasses.replace(LLAMA_PRESETS[PRESET], dtype=jnp.bfloat16)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    for quant in ("int8", "bf16"):
+        p = quantize_params_int8(params, cfg) if quant == "int8" else params
+        pbytes = sum(
+            int(np.prod(x.shape)) * x.dtype.itemsize for x in jax.tree.leaves(p)
+        )
+
+        @partial(jax.jit, static_argnames="n")
+        def scan_mats(p, x0, n):
+            lp = p["layers"]
+
+            # every product is consumed through tanh before reduction: a bare
+            # .sum() lets XLA push the reduction into the (loop-invariant)
+            # weights and skip the read; a sliced use lets it slice the
+            # weight load. tanh blocks both rewrites.
+            def use(y):
+                return jnp.tanh(y.astype(jnp.float32)).sum().astype(jnp.bfloat16)
+
+            def one_layer(x, i):
+                li = jax.tree.map(lambda a: a[i], lp)
+                q = matw(x, li["wq"])
+                k = matw(x, li["wk"])
+                v = matw(x, li["wv"])
+                x = x + matw(q, li["wo"]) * 1e-6 + (use(k) + use(v)) * 1e-9
+                g = matw(x, li["w_gate"])
+                u = matw(x, li["w_up"])
+                return x + matw(g * u, li["w_down"]) * 1e-6, ()
+
+            def step(x, _):
+                x, _ = lax.scan(one_layer, x, jnp.arange(cfg.num_layers))
+                logits = lm_head(p, cfg, x)
+                return x + use(logits) * 1e-9, ()
+
+            out, _ = lax.scan(step, x0, None, length=n)
+            return out
+
+        for S in SLOTS:
+            x0 = jax.random.normal(
+                jax.random.PRNGKey(1), (S, cfg.hidden_size), jnp.bfloat16
+            )
+            fetch(scan_mats(p, x0, 2))
+
+            def timed(n, reps=3):
+                best = float("inf")
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    fetch(scan_mats(p, x0, n))
+                    best = min(best, time.perf_counter() - t0)
+                return best
+
+            n_lo, n_hi = 4, 44
+            fetch(scan_mats(p, x0, n_lo)); fetch(scan_mats(p, x0, n_hi))
+            dt = (timed(n_hi) - timed(n_lo)) / (n_hi - n_lo)
+            print(
+                f"{quant} S={S:4d}: {dt*1e3:.2f} ms/step  "
+                f"stream={pbytes/dt/1e9:.0f} GB/s",
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,8 @@ import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 faulthandler.dump_traceback_later(240, repeat=True, file=sys.stderr)
 
 import jax
