@@ -332,16 +332,32 @@ async def run_prefill_worker(runtime, namespace: str, engine: PrefillEngine) -> 
 
     try:
         while True:
-            raw = await runtime.bus.queue_pop(queue, block=True)
-            if raw is None:
+            # ack-mode pop (at-least-once): the item stays in-flight on the
+            # bus until this worker finishes handling it — a worker crash or
+            # a bus bounce mid-prefill redelivers instead of dropping the
+            # request (NATS JetStream work-queue semantics,
+            # examples/llm/utils/nats_queue.py:155)
+            popped = await runtime.bus.queue_pop_acked(queue, block=True)
+            if popped is None:
                 continue
+            raw, msg_id = popped
             req = RemotePrefillRequest.from_dict(json.loads(raw))
             await sem.acquire()
 
-            async def run_one(r=req):
+            async def run_one(r=req, mid=msg_id):
                 try:
                     await handle(r)
                 finally:
+                    # ack on every handled outcome — handle() reports its
+                    # own failures to the requesting engine, which also has
+                    # a remote-prefill timeout sweep. Only worker/bus DEATH
+                    # leaves the item unacked, and that is exactly the case
+                    # redelivery is for (a poison request must not redeliver
+                    # forever).
+                    try:
+                        await runtime.bus.queue_ack(mid)
+                    except (ConnectionError, RuntimeError, OSError):
+                        pass  # bus gone: the item redelivers, by design
                     sem.release()
 
             t = asyncio.create_task(run_one())

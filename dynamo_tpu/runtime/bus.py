@@ -2,25 +2,38 @@
 
 Capability parity with the reference's NATS usage (SURVEY.md §1):
 - **pub/sub subjects** carry KV cache events (`kv_events`), hit-rate events
-  and other scoped notifications (traits/events.rs:31-96);
+  and other scoped notifications (traits/events.rs:31-96) — fire-and-forget,
+  ephemeral, exactly like NATS core;
 - **work queues** back the disaggregated prefill queue (JetStream work-queue
-  stream, examples/llm/utils/nats_queue.py) — at-most-once pop with blocking
-  waiters.
+  stream, examples/llm/utils/nats_queue.py:155) with JetStream's durability
+  semantics: queued items and unacked in-flight deliveries survive a server
+  bounce via a WAL + snapshot (same structure as statestore.py), ack-mode
+  pops (``queue_pop_acked``/``queue_ack``) are at-least-once — an item whose
+  consumer or server dies before the ack is redelivered — and the plain
+  ``queue_pop`` keeps its original at-most-once contract.
+
+The client reconnects transparently: on connection loss it redials with
+backoff, re-subscribes, and re-sends still-pending requests (qpush retries
+make delivery at-least-once across a bounce — consumers must tolerate
+duplicates, which the disagg prefill path does: a duplicate prefill lands as
+a stale completion).
 
 One asyncio TCP service speaking the framed codec; the request/response RPC
 plane does NOT go through here (workers are dialed directly — see rpc.py —
 which removes a broker hop the reference pays on every request).
 
-Run standalone: ``python -m dynamo_tpu.runtime.bus --port 37902``.
+Run standalone: ``python -m dynamo_tpu.runtime.bus --port 37902 --data-dir ...``.
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import base64
 import itertools
 import json
 import logging
+import os
 import uuid
 from collections import deque
 from typing import AsyncIterator, Deque, Dict, List, Optional, Tuple
@@ -114,15 +127,204 @@ class _Conn:
 
 
 class MessageBusServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = DEFAULT_PORT):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = DEFAULT_PORT,
+        data_dir: Optional[str] = None,
+        snapshot_every: int = 10_000,
+    ):
         self.host = host
         self.port = port
+        self.data_dir = data_dir
+        self.snapshot_every = snapshot_every
         # subject → {sub_id → conn}
         self._subs: Dict[str, Dict[str, _Conn]] = {}
-        self._queues: Dict[str, Deque[bytes]] = {}
-        # queue → waiters (conn, req_id)
-        self._queue_waiters: Dict[str, Deque[Tuple[_Conn, int]]] = {}
+        # queue → deque of (msg_id, body)
+        self._queues: Dict[str, Deque[Tuple[str, bytes]]] = {}
+        # queue → waiters (conn, req_id, wants_ack)
+        self._queue_waiters: Dict[str, Deque[Tuple[_Conn, int, bool]]] = {}
+        # msg_id → (queue, body, conn): delivered in ack mode, not yet acked
+        self._inflight: Dict[str, Tuple[str, bytes, _Conn]] = {}
+        # recently seen push msg_ids (bounded): reconnect-replay dedup
+        self._push_ids: Dict[str, None] = {}
         self._server: Optional[asyncio.AbstractServer] = None
+        self._wal = None
+        self._wal_records = 0
+        self._snapshot_task: Optional[asyncio.Task] = None
+        if data_dir is not None:
+            os.makedirs(data_dir, exist_ok=True)
+            self._restore()
+            self._wal = open(self._wal_path, "a")
+
+    # -- durability (WAL + snapshot; same shape as statestore.py) ------------
+
+    @property
+    def _snap_path(self) -> str:
+        return os.path.join(self.data_dir, "bus-snapshot.json")
+
+    @property
+    def _wal_path(self) -> str:
+        return os.path.join(self.data_dir, "bus-wal.jsonl")
+
+    @property
+    def _wal_old_path(self) -> str:
+        return os.path.join(self.data_dir, "bus-wal.old.jsonl")
+
+    def _restore(self) -> None:
+        """Load snapshot + replay WAL. In-flight (delivered, unacked) items
+        are REDELIVERED: they go back to the FRONT of their queue — the
+        consumer may have died with the server, and at-least-once means the
+        work must not vanish with the ack."""
+        inflight: Dict[str, Tuple[str, bytes]] = {}
+        if os.path.exists(self._snap_path):
+            try:
+                with open(self._snap_path) as f:
+                    snap = json.load(f)
+            except (json.JSONDecodeError, OSError):
+                logger.exception("corrupt bus snapshot; starting empty")
+                snap = {"queues": {}, "inflight": []}
+            for q, items in snap.get("queues", {}).items():
+                self._queues[q] = deque(
+                    (it["id"], base64.b64decode(it["v"])) for it in items
+                )
+            for it in snap.get("inflight", []):
+                inflight[it["id"]] = (it["q"], base64.b64decode(it["v"]))
+        n = 0
+        for path in (self._wal_old_path, self._wal_path):
+            if not os.path.exists(path):
+                continue
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        logger.warning("truncated bus WAL tail dropped")
+                        break
+                    self._replay(rec, inflight)
+                    n += 1
+        self._wal_records = n
+        # unacked in-flight at crash time → front of the queue
+        for msg_id, (q, body) in inflight.items():
+            self._queues.setdefault(q, deque()).appendleft((msg_id, body))
+        # seed the push-id dedup window with every restored id: a client
+        # replaying a pre-crash push must not double-enqueue
+        for items in self._queues.values():
+            for mid, _ in items:
+                self._note_push_id(mid)
+        total = sum(len(q) for q in self._queues.values())
+        if total:
+            logger.info(
+                "bus restored %d queued items (%d were unacked in-flight, "
+                "%d WAL records)", total, len(inflight), n,
+            )
+
+    def _note_push_id(self, msg_id: str, cap: int = 8192) -> None:
+        self._push_ids[msg_id] = None
+        while len(self._push_ids) > cap:
+            self._push_ids.pop(next(iter(self._push_ids)))
+
+    def _replay(self, rec: dict, inflight: Dict[str, Tuple[str, bytes]]) -> None:
+        op = rec.get("op")
+        if op == "push":
+            self._note_push_id(rec["id"])
+            self._queues.setdefault(rec["q"], deque()).append(
+                (rec["id"], base64.b64decode(rec["v"]))
+            )
+        elif op == "deliver":
+            q = self._queues.get(rec["q"])
+            if q:
+                for i, (mid, body) in enumerate(q):
+                    if mid == rec["id"]:
+                        del q[i]
+                        inflight[mid] = (rec["q"], body)
+                        break
+        elif op == "ack":
+            if rec["id"] not in inflight:
+                # acked a non-inflight id: it was a plain (at-most-once) pop
+                q = self._queues.get(rec["q"])
+                if q:
+                    for i, (mid, _) in enumerate(q):
+                        if mid == rec["id"]:
+                            del q[i]
+                            break
+            inflight.pop(rec["id"], None)
+        elif op == "requeue":
+            item = inflight.pop(rec["id"], None)
+            if item is not None:
+                self._queues.setdefault(item[0], deque()).appendleft(
+                    (rec["id"], item[1])
+                )
+
+    def _log(self, rec: dict) -> None:
+        if self._wal is None:
+            return
+        self._wal.write(json.dumps(rec) + "\n")
+        self._wal.flush()
+        self._wal_records += 1
+        if (
+            self._wal_records >= self.snapshot_every
+            and (self._snapshot_task is None or self._snapshot_task.done())
+        ):
+            self._wal.close()
+            if os.path.exists(self._wal_old_path):
+                # rare (only after a failed snapshot): chunked append so a
+                # large WAL never sits in memory; the file is closed and no
+                # longer written, so the copy is race-free
+                import shutil
+
+                with open(self._wal_old_path, "ab") as dst, \
+                        open(self._wal_path, "rb") as src:
+                    shutil.copyfileobj(src, dst)
+                os.remove(self._wal_path)
+            else:
+                os.replace(self._wal_path, self._wal_old_path)
+            self._wal = open(self._wal_path, "w")
+            self._wal_records = 0
+            snap = self._state_copy()
+            self._snapshot_task = asyncio.get_running_loop().create_task(
+                self._write_snapshot_async(snap)
+            )
+
+    def _state_copy(self) -> dict:
+        return {
+            "queues": {q: list(items) for q, items in self._queues.items()},
+            "inflight": [
+                (mid, q, body) for mid, (q, body, _) in self._inflight.items()
+            ],
+        }
+
+    async def _write_snapshot_async(self, snap: dict) -> None:
+        try:
+            await asyncio.to_thread(self._dump_snapshot, snap)
+            if os.path.exists(self._wal_old_path):
+                os.remove(self._wal_old_path)
+        except Exception:
+            logger.exception("bus snapshot failed; wal.old retained")
+
+    def _dump_snapshot(self, snap: dict) -> None:
+        out = {
+            "queues": {
+                q: [
+                    {"id": mid, "v": base64.b64encode(body).decode()}
+                    for mid, body in items
+                ]
+                for q, items in snap["queues"].items()
+            },
+            "inflight": [
+                {"id": mid, "q": q, "v": base64.b64encode(body).decode()}
+                for mid, q, body in snap["inflight"]
+            ],
+        }
+        tmp = f"{self._snap_path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path)
 
     async def start(self) -> None:
         from dynamo_tpu.runtime.netutil import TrackedServer
@@ -134,6 +336,20 @@ class MessageBusServer:
     async def stop(self) -> None:
         if self._server:
             await self._server.stop()
+        if self._snapshot_task is not None and not self._snapshot_task.done():
+            try:
+                await self._snapshot_task
+            except Exception:
+                pass
+        if self._wal is not None:
+            # graceful stop: compact so restart replays a snapshot, not a log
+            self._dump_snapshot(self._state_copy())
+            self._wal.close()
+            self._wal = open(self._wal_path, "w")
+            self._wal.close()
+            self._wal = None
+            if os.path.exists(self._wal_old_path):
+                os.remove(self._wal_old_path)
 
     @property
     def url(self) -> str:
@@ -161,9 +377,22 @@ class MessageBusServer:
                 if subs:
                     subs.pop(sub_id, None)
             for waiters in self._queue_waiters.values():
-                remaining = deque((c, rid) for c, rid in waiters if c is not conn)
+                remaining = deque(
+                    (c, rid, a) for c, rid, a in waiters if c is not conn
+                )
                 waiters.clear()
                 waiters.extend(remaining)
+            # ack-mode deliveries owned by this connection die with it:
+            # redeliver — to a blocked waiter if one exists, else to the
+            # front of the queue (they were next in line)
+            owned = [
+                mid for mid, (_, _, c) in self._inflight.items() if c is conn
+            ]
+            for mid in owned:
+                q, body, _ = self._inflight.pop(mid)
+                self._log({"op": "requeue", "id": mid})
+                if not await self._deliver(q, mid, body):
+                    self._queues.setdefault(q, deque()).appendleft((mid, body))
             conn.close()
             writer.close()
 
@@ -198,46 +427,68 @@ class MessageBusServer:
             return {"ok": True}
         if op == "qpush":
             queue = req["queue"]
-            waiters = self._queue_waiters.get(queue)
-            while waiters:  # try every live waiter before enqueueing
-                c, req_id = waiters.popleft()
-                delivered = await c.send_reliable(
-                    TwoPartMessage(
-                        json.dumps({"id": req_id, "ok": True, "found": True}).encode(),
-                        body,
-                    )
-                )
-                if delivered:
-                    return {"ok": True}
-                # waiter connection died: try the next one
-            self._queues.setdefault(queue, deque()).append(body)
+            msg_id = req.get("msg_id") or uuid.uuid4().hex
+            # idempotent under reconnect replay: a push the server applied
+            # right before dying comes again with the same msg_id — applying
+            # it twice would put two items under ONE id and corrupt the
+            # id-keyed inflight tracking
+            if msg_id in self._push_ids:
+                return {"ok": True}
+            self._note_push_id(msg_id)
+            self._log({
+                "op": "push", "q": queue, "id": msg_id,
+                "v": base64.b64encode(body).decode(),
+            })
+            if not await self._deliver(queue, msg_id, body):
+                self._queues.setdefault(queue, deque()).append((msg_id, body))
             return {"ok": True}
         if op == "qpop":
             queue = req["queue"]
+            wants_ack = bool(req.get("ack"))
             q = self._queues.get(queue)
             if q:
-                return_body = q.popleft()
+                msg_id, return_body = q.popleft()
+                if wants_ack:
+                    # logged BEFORE the send: a crash after delivery but
+                    # before the consumer's ack must redeliver (at-least-once)
+                    self._log({"op": "deliver", "q": queue, "id": msg_id})
+                    self._inflight[msg_id] = (queue, return_body, conn)
                 sent = await conn.send_reliable(
                     TwoPartMessage(
-                        json.dumps({"id": req.get("id"), "ok": True, "found": True}).encode(),
+                        json.dumps({
+                            "id": req.get("id"), "ok": True, "found": True,
+                            "msg_id": msg_id,
+                        }).encode(),
                         return_body,
                     )
                 )
                 if not sent:  # popper died: don't lose the item
-                    q.appendleft(return_body)
+                    if wants_ack:
+                        self._inflight.pop(msg_id, None)
+                        self._log({"op": "requeue", "id": msg_id})
+                    q.appendleft((msg_id, return_body))
+                elif not wants_ack:
+                    # at-most-once: consumed at delivery — logged only after
+                    # the send succeeded, else a crash would drop the item
+                    self._log({"op": "ack", "q": queue, "id": msg_id})
                 return None  # reply already sent (with body)
             if req.get("block"):
                 self._queue_waiters.setdefault(queue, deque()).append(
-                    (conn, req.get("id"))
+                    (conn, req.get("id"), wants_ack)
                 )
                 return None  # reply deferred until a push arrives
             return {"ok": True, "found": False}
+        if op == "qack":
+            item = self._inflight.pop(req["msg_id"], None)
+            if item is not None:
+                self._log({"op": "ack", "q": item[0], "id": req["msg_id"]})
+            return {"ok": True, "known": item is not None}
         if op == "qcancel":
             # remove this connection's blocked pop (client-side cancellation)
             waiters = self._queue_waiters.get(req["queue"])
             if waiters:
                 remaining = deque(
-                    (c, rid) for c, rid in waiters
+                    (c, rid, a) for c, rid, a in waiters
                     if not (c is conn and rid == req.get("cancel_id"))
                 )
                 waiters.clear()
@@ -246,6 +497,33 @@ class MessageBusServer:
         if op == "qlen":
             return {"ok": True, "len": len(self._queues.get(req["queue"], ()))}
         return {"ok": False, "error": f"unknown op {op!r}"}
+
+    async def _deliver(self, queue: str, msg_id: str, body: bytes) -> bool:
+        """Offer an item to blocked waiters; True if one took delivery."""
+        waiters = self._queue_waiters.get(queue)
+        while waiters:
+            c, req_id, wants_ack = waiters.popleft()
+            if wants_ack:
+                self._log({"op": "deliver", "q": queue, "id": msg_id})
+                self._inflight[msg_id] = (queue, body, c)
+            delivered = await c.send_reliable(
+                TwoPartMessage(
+                    json.dumps({
+                        "id": req_id, "ok": True, "found": True,
+                        "msg_id": msg_id,
+                    }).encode(),
+                    body,
+                )
+            )
+            if delivered:
+                if not wants_ack:
+                    self._log({"op": "ack", "q": queue, "id": msg_id})
+                return True
+            # waiter connection died mid-delivery: roll back, try the next
+            if wants_ack:
+                self._inflight.pop(msg_id, None)
+                self._log({"op": "requeue", "id": msg_id})
+        return False
 
 
 class Subscription:
@@ -277,26 +555,42 @@ class Subscription:
 
 
 class MessageBusClient:
-    def __init__(self, host: str, port: int):
+    """Framed-codec bus client with transparent reconnection.
+
+    On connection loss the read loop redials with backoff, re-subscribes
+    every live subscription (same sub_id), and re-sends every still-pending
+    request — a server bounce looks like latency, not an error. qpush
+    retries carry a client msg_id, so delivery across a bounce is
+    at-least-once (a push the old server processed right before dying can
+    be duplicated; work-queue consumers are expected to tolerate that,
+    matching JetStream semantics). Set ``reconnect=False`` for the old
+    fail-fast behavior."""
+
+    def __init__(self, host: str, port: int, reconnect: bool = True):
         self.host = host
         self.port = port
+        self.reconnect = reconnect
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._ids = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
+        # req_id → (request dict, body): replayed verbatim on reconnect
+        self._pending_reqs: Dict[int, Tuple[dict, bytes]] = {}
         self._subs: Dict[str, Subscription] = {}
         self._reader_task: Optional[asyncio.Task] = None
         self._send_lock = asyncio.Lock()
+        self._closed = False
 
     @classmethod
-    async def connect(cls, url: str) -> "MessageBusClient":
+    async def connect(cls, url: str, reconnect: bool = True) -> "MessageBusClient":
         host, _, port = url.rpartition(":")
-        c = cls(host or "127.0.0.1", int(port))
+        c = cls(host or "127.0.0.1", int(port), reconnect=reconnect)
         c._reader, c._writer = await asyncio.open_connection(c.host, c.port)
         c._reader_task = asyncio.create_task(c._read_loop())
         return c
 
     async def close(self) -> None:
+        self._closed = True
         if self._reader_task:
             self._reader_task.cancel()
         if self._writer:
@@ -304,33 +598,95 @@ class MessageBusClient:
         for s in self._subs.values():
             s.queue.put_nowait(None)
 
+    def _fail_all(self) -> None:
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("bus connection lost"))
+        self._pending_reqs.clear()
+        for s in self._subs.values():
+            s.queue.put_nowait(None)
+
+    async def _reconnect(self) -> bool:
+        delay = 0.05
+        while not self._closed:
+            try:
+                self._reader, self._writer = await asyncio.open_connection(
+                    self.host, self.port
+                )
+            except OSError:
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 2.0)
+                continue
+            # restore server-side state: subscriptions first, then replay
+            # every request still awaiting a reply (incl. blocked qpops)
+            try:
+                for sub in list(self._subs.values()):
+                    await write_frame(self._writer, TwoPartMessage(
+                        json.dumps({
+                            "op": "sub", "subject": sub.subject,
+                            "sub_id": sub.sub_id, "id": next(self._ids),
+                        }).encode(), b"",
+                    ))
+                for req_id, (req, body) in list(self._pending_reqs.items()):
+                    await write_frame(self._writer, TwoPartMessage(
+                        json.dumps(req).encode(), body
+                    ))
+            except (ConnectionError, OSError):
+                continue  # server bounced again mid-replay: redial
+            logger.info("bus client reconnected to %s:%d", self.host, self.port)
+            return True
+        return False
+
     async def _read_loop(self) -> None:
-        try:
-            while True:
-                frame = await read_frame(self._reader)
-                h = json.loads(frame.header)
-                if h.get("push") == "msg":
-                    sub = self._subs.get(h["sub_id"])
-                    if sub is not None:
-                        sub.queue.put_nowait(frame.body)
-                    continue
-                fut = self._pending.pop(h.get("id"), None)
-                if fut is not None and not fut.done():
-                    fut.set_result((h, frame.body))
-        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
-            for fut in self._pending.values():
-                if not fut.done():
-                    fut.set_exception(ConnectionError("bus connection lost"))
-            for s in self._subs.values():
-                s.queue.put_nowait(None)
+        while True:
+            try:
+                while True:
+                    frame = await read_frame(self._reader)
+                    h = json.loads(frame.header)
+                    if h.get("push") == "msg":
+                        sub = self._subs.get(h["sub_id"])
+                        if sub is not None:
+                            sub.queue.put_nowait(frame.body)
+                        continue
+                    rid = h.get("id")
+                    fut = self._pending.pop(rid, None)
+                    self._pending_reqs.pop(rid, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result((h, frame.body))
+            except asyncio.CancelledError:
+                self._fail_all()
+                return
+            except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                if self._closed or not self.reconnect:
+                    self._fail_all()
+                    return
+                try:
+                    ok = await self._reconnect()
+                except asyncio.CancelledError:
+                    # close() landed while redialing: callers must not hang
+                    self._fail_all()
+                    return
+                if not ok:
+                    self._fail_all()
+                    return
 
     async def _call(self, req: dict, body: bytes = b"") -> Tuple[dict, bytes]:
         req_id = next(self._ids)
         req["id"] = req_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
-        async with self._send_lock:
-            await write_frame(self._writer, TwoPartMessage(json.dumps(req).encode(), body))
+        self._pending_reqs[req_id] = (req, body)
+        try:
+            async with self._send_lock:
+                await write_frame(
+                    self._writer, TwoPartMessage(json.dumps(req).encode(), body)
+                )
+        except (ConnectionError, OSError):
+            if not self.reconnect or self._closed:
+                self._pending.pop(req_id, None)
+                self._pending_reqs.pop(req_id, None)
+                raise
+            # the read loop is redialing; the request replays on reconnect
         reply, rbody = await fut
         if not reply.get("ok"):
             raise RuntimeError(f"bus error: {reply.get('error')}")
@@ -349,18 +705,38 @@ class MessageBusClient:
         return sub
 
     async def queue_push(self, queue: str, payload: bytes) -> None:
-        await self._call({"op": "qpush", "queue": queue}, payload)
+        await self._call(
+            {"op": "qpush", "queue": queue, "msg_id": uuid.uuid4().hex}, payload
+        )
 
-    async def queue_pop(self, queue: str, block: bool = False) -> Optional[bytes]:
+    async def queue_pop(
+        self, queue: str, block: bool = False, ack: bool = False,
+        _want_msg_id: bool = False,
+    ):
         """Pop one item; with block=True waits for a push. Cancellation-safe:
         a cancelled blocking pop withdraws its server-side waiter, and an item
-        that raced the cancellation is re-pushed rather than lost."""
+        that raced the cancellation is re-pushed rather than lost. With
+        ``ack=True`` the server keeps the item in-flight until
+        :meth:`queue_ack` — at-least-once across consumer AND server death."""
         req_id = next(self._ids)
-        req = {"op": "qpop", "queue": queue, "block": block, "id": req_id}
+        req = {
+            "op": "qpop", "queue": queue, "block": block, "id": req_id,
+            "ack": ack,
+        }
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
-        async with self._send_lock:
-            await write_frame(self._writer, TwoPartMessage(json.dumps(req).encode(), b""))
+        self._pending_reqs[req_id] = (req, b"")
+        try:
+            async with self._send_lock:
+                await write_frame(
+                    self._writer, TwoPartMessage(json.dumps(req).encode(), b"")
+                )
+        except (ConnectionError, OSError):
+            if not self.reconnect or self._closed:
+                self._pending.pop(req_id, None)
+                self._pending_reqs.pop(req_id, None)
+                raise
+            # the read loop is redialing; the request replays on reconnect
         try:
             reply, body = await fut
         except asyncio.CancelledError:
@@ -375,9 +751,16 @@ class MessageBusClient:
                 try:
                     await self._call({"op": "qcancel", "queue": queue, "cancel_id": req_id})
                     self._pending.pop(req_id, None)
+                    self._pending_reqs.pop(req_id, None)
                     if tomb.done():
                         r, b = tomb.result()
                         if r.get("found"):
+                            if ack and r.get("msg_id"):
+                                # withdraw cleanly: the ack-mode item is
+                                # in-flight under our name — requeue it
+                                await self._call(
+                                    {"op": "qack", "msg_id": r["msg_id"]}
+                                )
                             await self.queue_push(queue, b)
                 except (ConnectionError, RuntimeError):
                     pass
@@ -386,7 +769,27 @@ class MessageBusClient:
             raise
         if not reply.get("ok"):
             raise RuntimeError(f"bus error: {reply.get('error')}")
-        return body if reply.get("found") else None
+        if not reply.get("found"):
+            return (None, None) if _want_msg_id else None
+        if _want_msg_id:
+            return body, reply.get("msg_id")
+        return body
+
+    async def queue_pop_acked(
+        self, queue: str, block: bool = False
+    ) -> Optional[Tuple[bytes, str]]:
+        """At-least-once pop: returns (body, msg_id); the item stays
+        in-flight server-side until :meth:`queue_ack`(msg_id). Consumer or
+        server death before the ack redelivers it (JetStream work-queue
+        semantics, examples/llm/utils/nats_queue.py:155)."""
+        res = await self.queue_pop(queue, block=block, ack=True, _want_msg_id=True)
+        body, msg_id = res
+        if body is None:
+            return None
+        return body, msg_id
+
+    async def queue_ack(self, msg_id: str) -> None:
+        await self._call({"op": "qack", "msg_id": msg_id})
 
     async def queue_len(self, queue: str) -> int:
         reply, _ = await self._call({"op": "qlen", "queue": queue})
@@ -397,11 +800,15 @@ def main() -> None:
     p = argparse.ArgumentParser(description="dynamo_tpu message bus server")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=DEFAULT_PORT)
+    p.add_argument(
+        "--data-dir", default=None,
+        help="enable work-queue durability (WAL + snapshot) in this directory",
+    )
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
     async def run():
-        server = MessageBusServer(args.host, args.port)
+        server = MessageBusServer(args.host, args.port, data_dir=args.data_dir)
         await server.start()
         await asyncio.Event().wait()
 

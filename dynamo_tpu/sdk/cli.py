@@ -76,7 +76,12 @@ async def serve_cmd(args) -> None:
         from dynamo_tpu.runtime.statestore import StateStoreServer
 
         ss_server = StateStoreServer(host="127.0.0.1", port=args.statestore_port)
-        bus_server = MessageBusServer(host="127.0.0.1", port=args.bus_port)
+        bus_server = MessageBusServer(
+            host="127.0.0.1", port=args.bus_port,
+            # durable work queues when a data dir is configured (the
+            # statestore reads the equivalent env in its own entrypoint)
+            data_dir=os.environ.get("DYN_TPU_BUS_DATA_DIR") or None,
+        )
         await ss_server.start()
         await bus_server.start()
         statestore = ss_server.url
