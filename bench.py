@@ -59,6 +59,8 @@ MODE = os.environ.get("BENCH_MODE", "serve")
 # against BOTH rooflines — the bf16 (unquantized-ceiling) one and the int8
 # stream's own — explicitly labeled.
 QUANTIZE = os.environ.get("BENCH_QUANTIZE", "int8")
+# >1: serve over a tp mesh spanning the local chips (real multi-chip runs)
+BENCH_TP = int(os.environ.get("BENCH_TP", "1"))
 
 
 def bench_multiturn() -> None:
@@ -658,6 +660,15 @@ def main() -> None:
     n_chips = len(jax.devices())
     cfg = dataclasses.replace(LLAMA_PRESETS[PRESET], dtype=jnp.bfloat16)
     params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = None
+    if BENCH_TP > 1:
+        # sharded serving bench (the first-real-multi-chip runbook,
+        # docs/multihost_serving.md): tp mesh over the local chips
+        from dynamo_tpu.models.llama import param_shardings
+        from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(tp=BENCH_TP))
+        params = jax.device_put(params, param_shardings(cfg, mesh))
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
 
     engine_cfg = EngineConfig(
@@ -668,7 +679,7 @@ def main() -> None:
         prefill_chunk=min(256, PROMPT_LEN),
         quantize=QUANTIZE or None,
     )
-    engine = JaxServingEngine(cfg, params, engine_cfg)
+    engine = JaxServingEngine(cfg, params, engine_cfg, mesh=mesh)
     # bf16 bytes = the UNQUANTIZED decode ceiling (the classical roofline a
     # bf16 engine can never beat); stream bytes = what this engine's decode
     # actually re-reads per step (the int8 copy under quantize="int8")

@@ -1,0 +1,6 @@
+"""``python -m dynamo_tpu.operator`` — same entry as the ``dynamo-operator``
+console script (pyproject.toml)."""
+
+from dynamo_tpu.operator.controller import main
+
+main()

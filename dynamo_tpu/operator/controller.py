@@ -43,6 +43,16 @@ GROUP_API = "apis/dynamo.tpu/v1"
 GRAPH_PLURAL = "dynamographs"
 APPS_API = "apis/apps/v1"
 CORE_API = "api/v1"
+NETWORKING_API = "apis/networking.k8s.io/v1"
+AUTOSCALING_API = "apis/autoscaling/v2"
+
+# kind → (api, plural) for every child type the controller manages
+KIND_MAP = {
+    "Deployment": (APPS_API, "deployments"),
+    "Service": (CORE_API, "services"),
+    "Ingress": (NETWORKING_API, "ingresses"),
+    "HorizontalPodAutoscaler": (AUTOSCALING_API, "horizontalpodautoscalers"),
+}
 
 SPEC_HASH_ANNOTATION = "dynamo.tpu/spec-hash"
 MANAGED_LABEL = "dynamo.tpu/graph"
@@ -147,17 +157,98 @@ def desired_children(cr: dict) -> List[dict]:
         service(bus_host, 37902),
     ]
 
+    def hpa(name: str, conf: dict) -> dict:
+        """Replicas-from-metric: an autoscaling/v2 HPA per component that
+        asks for it (reference parity: the operator's autoscaling tier,
+        dynamonimdeployment_controller.go:134)."""
+        return {
+            "apiVersion": "autoscaling/v2",
+            "kind": "HorizontalPodAutoscaler",
+            "metadata": {
+                "name": name,
+                "namespace": ns,
+                "labels": {MANAGED_LABEL: graph},
+                "ownerReferences": [owner],
+            },
+            "spec": {
+                "scaleTargetRef": {
+                    "apiVersion": "apps/v1", "kind": "Deployment", "name": name,
+                },
+                "minReplicas": int(conf.get("minReplicas", 1)),
+                # never emit min > max (the apiserver 422s the create and
+                # the whole reconcile pass would abort on every loop)
+                "maxReplicas": max(
+                    int(conf.get("maxReplicas", 4)),
+                    int(conf.get("minReplicas", 1)),
+                ),
+                "metrics": [{
+                    "type": "Resource",
+                    "resource": {
+                        "name": conf.get("metric", "cpu"),
+                        "target": {
+                            "type": "Utilization",
+                            "averageUtilization": int(
+                                conf.get("targetUtilization", 80)
+                            ),
+                        },
+                    },
+                }],
+            },
+        }
+
     fe = spec.get("frontend", {})
     fe_port = int(fe.get("port", 8080))
+    fe_name = f"{graph}-frontend"
     children.append(deployment(
-        f"{graph}-frontend",
+        fe_name,
         ["python", "-m", "dynamo_tpu.cli.run",
          "in=http", "out=discover", "--port", str(fe_port), *common_flags,
          *fe.get("args", [])],
         int(fe.get("replicas", 1)), port=fe_port,
         resources=fe.get("resources"),
     ))
-    children.append(service(f"{graph}-frontend", fe_port))
+    children.append(service(fe_name, fe_port))
+    if fe.get("autoscale"):
+        children.append(hpa(fe_name, fe["autoscale"]))
+
+    ing = spec.get("ingress", {})
+    if ing:
+        # HTTP entry to the frontend Service (reference: the operator's
+        # ingress/Envoy config generation, internal/envoy/envoy.go)
+        rule_http = {
+            "paths": [{
+                "path": ing.get("path", "/"),
+                "pathType": ing.get("pathType", "Prefix"),
+                "backend": {
+                    "service": {
+                        "name": fe_name,
+                        "port": {"number": fe_port},
+                    },
+                },
+            }],
+        }
+        rule = {"http": rule_http}
+        if ing.get("host"):
+            rule["host"] = ing["host"]
+        ingress_spec: dict = {"rules": [rule]}
+        if ing.get("className"):
+            ingress_spec["ingressClassName"] = ing["className"]
+        if ing.get("tlsSecret"):
+            ingress_spec["tls"] = [{
+                "hosts": [ing["host"]] if ing.get("host") else [],
+                "secretName": ing["tlsSecret"],
+            }]
+        children.append({
+            "apiVersion": "networking.k8s.io/v1",
+            "kind": "Ingress",
+            "metadata": {
+                "name": fe_name,
+                "namespace": ns,
+                "labels": {MANAGED_LABEL: graph},
+                "ownerReferences": [owner],
+            },
+            "spec": ingress_spec,
+        })
 
     workers = spec.get("workers", {})
     model_flags = []
@@ -176,6 +267,8 @@ def desired_children(cr: dict) -> List[dict]:
             int(decode.get("replicas", 1)),
             resources=decode.get("resources"),
         ))
+        if decode.get("autoscale"):
+            children.append(hpa(f"{graph}-decode", decode["autoscale"]))
     prefill = workers.get("prefill", {})
     if prefill:
         children.append(deployment(
@@ -185,7 +278,24 @@ def desired_children(cr: dict) -> List[dict]:
             int(prefill.get("replicas", 1)),
             resources=prefill.get("resources"),
         ))
+        if prefill.get("autoscale"):
+            children.append(hpa(f"{graph}-prefill", prefill["autoscale"]))
     return children
+
+
+def _autoscaled_names(cr: dict) -> set:
+    """Deployment names whose replica counts an HPA owns (the controller
+    must not fight the autoscaler over them)."""
+    spec = cr.get("spec", {})
+    graph = cr["metadata"]["name"]
+    names = set()
+    if (spec.get("frontend") or {}).get("autoscale"):
+        names.add(f"{graph}-frontend")
+    workers = spec.get("workers", {})
+    for comp in ("decode", "prefill"):
+        if (workers.get(comp) or {}).get("autoscale"):
+            names.add(f"{graph}-{comp}")
+    return names
 
 
 class GraphController:
@@ -246,7 +356,7 @@ class GraphController:
         # orphans: children labeled for a graph whose CR is gone. With a real
         # apiserver ownerReference GC handles this; done here too so the
         # controller converges even where GC lags.
-        for api, plural in ((APPS_API, "deployments"), (CORE_API, "services")):
+        for api, plural in KIND_MAP.values():
             for obj in await self.kube.list(api, plural, self.namespace):
                 g = obj["metadata"].get("labels", {}).get(MANAGED_LABEL)
                 if g is not None and g not in live_graphs:
@@ -257,20 +367,31 @@ class GraphController:
 
     async def reconcile(self, cr: dict) -> None:
         children = desired_children(cr)
+        autoscaled = _autoscaled_names(cr)
         ready = 0
         total_deployments = 0
         desired_names = {
             (c["kind"], c["metadata"]["name"]) for c in children
         }
         for child in children:
-            api, plural = (
-                (APPS_API, "deployments") if child["kind"] == "Deployment"
-                else (CORE_API, "services")
-            )
+            api, plural = KIND_MAP[child["kind"]]
             name = child["metadata"]["name"]
-            h = _spec_hash(child["spec"])
-            child["metadata"].setdefault("annotations", {})[SPEC_HASH_ANNOTATION] = h
             live = await self.kube.get(api, plural, self.namespace, name)
+            hpa_owned = child["kind"] == "Deployment" and name in autoscaled
+            if hpa_owned:
+                # the HPA owns the replica count: hash the spec WITHOUT it
+                # (scale events must not look like drift) and carry the live
+                # count through our replaces instead of resetting it
+                spec_for_hash = dict(child["spec"])
+                spec_for_hash.pop("replicas", None)
+                h = _spec_hash(spec_for_hash)
+                if live is not None:
+                    child["spec"]["replicas"] = (live.get("spec") or {}).get(
+                        "replicas", child["spec"].get("replicas", 1)
+                    )
+            else:
+                h = _spec_hash(child["spec"])
+            child["metadata"].setdefault("annotations", {})[SPEC_HASH_ANNOTATION] = h
             if live is None:
                 logger.info("create %s/%s", plural, name)
                 live = await self.kube.create(api, plural, self.namespace, child)
@@ -288,10 +409,7 @@ class GraphController:
         # prune children of THIS graph that the spec no longer wants
         # (e.g. prefill pool removed from the CR)
         graph = cr["metadata"]["name"]
-        for api, plural, kind in (
-            (APPS_API, "deployments", "Deployment"),
-            (CORE_API, "services", "Service"),
-        ):
+        for kind, (api, plural) in KIND_MAP.items():
             for obj in await self.kube.list(api, plural, self.namespace):
                 meta = obj["metadata"]
                 if meta.get("labels", {}).get(MANAGED_LABEL) != graph:

@@ -98,6 +98,10 @@ class RealKube(KubeApi):
         self._session = None
 
     def _ssl(self):
+        if self.server.startswith("http://"):
+            # plain HTTP: `kubectl proxy` endpoints and the envtest-style
+            # apiserver stub (tests/kubestub.py) speak unencrypted localhost
+            return None
         if self.ca_cert:
             return ssl.create_default_context(cafile=self.ca_cert)
         ctx = ssl.create_default_context()

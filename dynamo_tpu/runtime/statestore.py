@@ -116,6 +116,10 @@ class StateStoreServer:
         self._kv: Dict[str, Tuple[bytes, Optional[str]]] = {}  # key → (value, lease)
         self._leases: Dict[str, _Lease] = {}
         self._watches: Dict[str, _Watch] = {}
+        # wal_tail subscribers (warm standbys): receive a state snapshot on
+        # attach, then every WAL record live — regardless of whether this
+        # server persists locally
+        self._wal_tails: Dict[str, _Watch] = {}
         self._server = None  # TrackedServer
         self._expiry_task: Optional[asyncio.Task] = None
         self.data_dir = data_dir
@@ -123,6 +127,9 @@ class StateStoreServer:
         self._wal = None  # append handle, open while serving
         self._wal_records = 0
         self._snapshot_task: Optional[asyncio.Task] = None
+        # a promoting standby already holds replicated state + an open WAL;
+        # start() must not clobber it with whatever is on disk
+        self._skip_restore = False
 
     # -- persistence ---------------------------------------------------------
 
@@ -214,6 +221,30 @@ class StateStoreServer:
                     self._kv.pop(key, None)
 
     def _log(self, rec: dict) -> None:
+        if self._wal_tails:
+            frame = TwoPartMessage(
+                json.dumps({"push": "wal", "rec": rec}).encode(), b""
+            )
+            dead = []
+            for tid, w in self._wal_tails.items():
+                if w.dead:
+                    dead.append(tid)
+                    continue
+                w.offer(frame)
+                if w.dead:  # offer overflowed: it missed this record
+                    dead.append(tid)
+            for tid in dead:
+                w = self._wal_tails.pop(tid, None)
+                if w:
+                    w.close()
+                    # close the CONNECTION too: a silently-dropped tail
+                    # would leave the standby blocked in read_frame
+                    # believing it is replicating — it must see the break
+                    # and re-attach for a fresh snapshot
+                    try:
+                        w.writer.close()
+                    except Exception:
+                        pass
         if self._wal is None:
             return
         self._wal.write(json.dumps(rec) + "\n")
@@ -287,7 +318,7 @@ class StateStoreServer:
     async def start(self) -> None:
         from dynamo_tpu.runtime.netutil import TrackedServer
 
-        if self.data_dir is not None:
+        if self.data_dir is not None and not self._skip_restore:
             os.makedirs(self.data_dir, exist_ok=True)
             self._restore()
             self._wal = open(self._wal_path, "a")
@@ -402,6 +433,8 @@ class StateStoreServer:
                 # handler unwound — popping by id alone would kill the live one
                 if self._watches.get(w.watch_id) is w:
                     self._watches.pop(w.watch_id)
+                if self._wal_tails.get(w.watch_id) is w:
+                    self._wal_tails.pop(w.watch_id)
                 w.close()
             writer.close()
 
@@ -479,6 +512,28 @@ class StateStoreServer:
             if w:
                 w.close()
             return {"ok": True}, b""
+        if op == "wal_tail":
+            # warm-standby attach: full state snapshot now, every WAL record
+            # from here on (the raft-replication stand-in: one follower
+            # tailing the leader's log — StandbyStateStore below)
+            tail_id = req.get("tail_id") or uuid.uuid4().hex
+            w = _Watch(tail_id, "", writer)
+            self._wal_tails[tail_id] = w
+            conn_watches.append(w)
+            snap = {
+                "kv": {
+                    k: {"v": base64.b64encode(v).decode(), "lease": lid}
+                    for k, (v, lid) in self._kv.items()
+                },
+                "leases": {l.lease_id: l.ttl for l in self._leases.values()},
+            }
+            w.offer(
+                TwoPartMessage(
+                    json.dumps({"push": "wal_snapshot"}).encode(),
+                    json.dumps(snap).encode(),
+                )
+            )
+            return {"ok": True, "tail_id": tail_id}, b""
         if op == "lease_grant":
             ttl = float(req.get("ttl", DEFAULT_LEASE_TTL))
             lease_id = uuid.uuid4().hex[:16]
@@ -833,6 +888,141 @@ class StateStoreClient:
         return w
 
 
+class StandbyStateStore:
+    """Warm standby: tails the primary's WAL stream and takes over its
+    address on primary loss.
+
+    The raft stand-in for the self-hosted store (reference: etcd,
+    lib/runtime/src/transports/etcd.rs:40-500): ONE follower replicates the
+    leader's log (snapshot on attach + live records), and on leader death
+    binds the leader's host:port and serves. Clients already reconnect with
+    backoff to the same address and resync watches, so the failover is
+    transparent to them; promoted leases get a fresh TTL (same grace as a
+    restart — live owners resume keep-alives within ttl/3, dead ones expire
+    one TTL later).
+
+    Split-brain note (documented blast radius): there is no quorum — the
+    operator must not run the old primary again after a promotion without
+    wiping its data dir. The standby only promotes once its primary
+    CONNECTION breaks, and binding the primary's port fails fast if the
+    primary is actually still alive.
+    """
+
+    def __init__(
+        self,
+        primary_url: str,
+        host: str,
+        port: int,
+        data_dir: Optional[str] = None,
+        promote_after: float = 3.0,
+    ):
+        self.primary_url = primary_url
+        # grace window: a broken tail first RE-ATTACHES (fresh snapshot) if
+        # the primary is still reachable — a transient TCP reset or a
+        # primary upgrade-restart must not trigger an irreversible
+        # promotion (split brain if the standby is on another machine)
+        self.promote_after = promote_after
+        # the server we will become; not listening until promotion
+        self.server = StateStoreServer(host, port, data_dir=data_dir)
+        if data_dir is not None:
+            # persistence is owned HERE: open the WAL now so replicated
+            # records land on disk, and keep start() from re-reading stale
+            # disk state over the replica
+            os.makedirs(data_dir, exist_ok=True)
+            self.server._wal = open(self.server._wal_path, "a")
+        self.server._skip_restore = True
+        self.promoted = asyncio.Event()
+        self._synced = False
+
+    async def run(self) -> None:
+        """Replicate until the primary dies, then promote and serve.
+
+        Returns once promoted (the server keeps serving; stop via
+        ``self.server.stop()``)."""
+        host, _, port = self.primary_url.rpartition(":")
+        host = host or "127.0.0.1"
+        port = int(port)
+        down_since: Optional[float] = None
+        while not self.promoted.is_set():
+            try:
+                reader, writer = await asyncio.open_connection(host, port)
+            except OSError:
+                now = time.monotonic()
+                if down_since is None:
+                    down_since = now
+                if self._synced and now - down_since >= self.promote_after:
+                    # primary unreachable beyond the grace window: take over
+                    await self._promote()
+                    return
+                await asyncio.sleep(0.2)
+                continue
+            down_since = None  # reachable again: replication resumes
+            try:
+                await write_frame(
+                    writer,
+                    TwoPartMessage(
+                        json.dumps({"op": "wal_tail", "id": 1}).encode(), b""
+                    ),
+                )
+                while True:
+                    frame = await read_frame(reader)
+                    h = json.loads(frame.header)
+                    if h.get("push") == "wal_snapshot":
+                        self._apply_snapshot(json.loads(frame.body))
+                        self._synced = True
+                    elif h.get("push") == "wal":
+                        self.server._replay(h["rec"], time.monotonic())
+                        self.server._log(h["rec"])  # local durability
+            except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                # tail broke: try to RE-ATTACH (the primary may be alive —
+                # slow-tail drop, rolling restart, network blip); promotion
+                # happens only after promote_after seconds of unreachability
+                writer.close()
+                down_since = time.monotonic()
+                await asyncio.sleep(0.1)
+
+    def _apply_snapshot(self, snap: dict) -> None:
+        now = time.monotonic()
+        self.server._kv.clear()
+        self.server._leases.clear()
+        for lid, ttl in snap.get("leases", {}).items():
+            self.server._leases[lid] = _Lease(lid, float(ttl), now + float(ttl))
+        for key, ent in snap.get("kv", {}).items():
+            value = base64.b64decode(ent["v"])
+            lease_id = ent.get("lease")
+            if lease_id and lease_id not in self.server._leases:
+                continue
+            self.server._kv[key] = (value, lease_id)
+            if lease_id:
+                self.server._leases[lease_id].keys.add(key)
+        if self.server._wal is not None:
+            # local disk now mirrors the attach point: snapshot + empty WAL
+            self.server._compact()
+
+    async def _promote(self) -> None:
+        now = time.monotonic()
+        for lease in self.server._leases.values():
+            # fresh TTL: live owners resume keep-alives, dead ones expire
+            lease.deadline = now + lease.ttl
+        # the primary's port may linger in TIME_WAIT or the primary may be
+        # mid-death: retry the bind briefly
+        last: Optional[Exception] = None
+        for _ in range(50):
+            try:
+                await self.server.start()
+                break
+            except OSError as e:
+                last = e
+                await asyncio.sleep(0.1)
+        else:
+            raise RuntimeError(f"standby could not bind primary address: {last}")
+        self.promoted.set()
+        logger.warning(
+            "standby PROMOTED: serving %d keys on %s",
+            len(self.server._kv), self.server.url,
+        )
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description="dynamo_tpu statestore server")
     p.add_argument("--host", default="0.0.0.0")
@@ -841,12 +1031,23 @@ def main() -> None:
         "--data-dir", default=None,
         help="persist state (snapshot + WAL) here; restart restores it",
     )
+    p.add_argument(
+        "--standby-of", default=None, metavar="HOST:PORT",
+        help="run as a warm standby of this primary: replicate its WAL "
+             "stream, take over --host:--port on primary loss",
+    )
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
     async def run():
-        server = StateStoreServer(args.host, args.port, data_dir=args.data_dir)
-        await server.start()
+        if args.standby_of:
+            standby = StandbyStateStore(
+                args.standby_of, args.host, args.port, data_dir=args.data_dir
+            )
+            await standby.run()
+        else:
+            server = StateStoreServer(args.host, args.port, data_dir=args.data_dir)
+            await server.start()
         await asyncio.Event().wait()
 
     asyncio.run(run())

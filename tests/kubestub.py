@@ -1,0 +1,119 @@
+"""Envtest-style minimal kube apiserver: a REAL aiohttp server speaking the
+k8s REST subset the operator uses, backed by FakeKube's store.
+
+Purpose (VERDICT r4 item 5): RealKube had zero coverage — a typo in its
+HTTP paths would pass every FakeKube test and fail on first contact with a
+cluster. Running the controller through RealKube against this stub
+exercises the full wire: URL construction, JSON bodies, merge-patch status,
+chunked watch streams, 404 semantics. Reference analogue: envtest
+(operator/internal/controller/suite_test.go:149) — a real apiserver without
+a cluster.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+from aiohttp import web
+
+from dynamo_tpu.operator.kube import FakeKube
+
+
+class KubeApiStub:
+    """HTTP façade over FakeKube. Paths mirror the real apiserver:
+
+    - ``/{api...}/namespaces/{ns}/{plural}``            list / create
+    - ``/{api...}/namespaces/{ns}/{plural}?watch=true`` chunked watch stream
+    - ``/{api...}/namespaces/{ns}/{plural}/{name}``     get / replace / delete
+    - ``/{api...}/namespaces/{ns}/{plural}/{name}/status`` merge-patch
+    """
+
+    def __init__(self, fake: Optional[FakeKube] = None):
+        self.fake = fake or FakeKube()
+        self._runner: Optional[web.AppRunner] = None
+        self.port: Optional[int] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    async def start(self) -> None:
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._route)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    def _parse(self, tail: str):
+        """Split '{api...}/namespaces/{ns}/{plural}[/{name}[/status]]'."""
+        parts = tail.strip("/").split("/")
+        if "namespaces" not in parts:
+            return None
+        i = parts.index("namespaces")
+        api = "/".join(parts[:i])
+        ns = parts[i + 1]
+        plural = parts[i + 2]
+        name = parts[i + 3] if len(parts) > i + 3 else None
+        sub = parts[i + 4] if len(parts) > i + 4 else None
+        return api, ns, plural, name, sub
+
+    async def _route(self, request: web.Request) -> web.StreamResponse:
+        parsed = self._parse(request.match_info["tail"])
+        if parsed is None:
+            return web.json_response({"message": "bad path"}, status=400)
+        api, ns, plural, name, sub = parsed
+
+        if request.method == "GET" and name is None:
+            if request.query.get("watch") == "true":
+                return await self._watch(request, api, plural, ns)
+            items = await self.fake.list(api, plural, ns)
+            return web.json_response({"items": items})
+        if request.method == "GET":
+            obj = await self.fake.get(api, plural, ns, name)
+            if obj is None:
+                return web.json_response({"message": "not found"}, status=404)
+            return web.json_response(obj)
+        if request.method == "POST":
+            obj = json.loads(await request.text())
+            try:
+                created = await self.fake.create(api, plural, ns, obj)
+            except RuntimeError as e:
+                return web.json_response({"message": str(e)}, status=409)
+            return web.json_response(created, status=201)
+        if request.method == "PUT":
+            obj = json.loads(await request.text())
+            try:
+                replaced = await self.fake.replace(api, plural, ns, name, obj)
+            except RuntimeError as e:
+                return web.json_response({"message": str(e)}, status=404)
+            return web.json_response(replaced)
+        if request.method == "PATCH" and sub == "status":
+            body = json.loads(await request.text())
+            await self.fake.patch_status(
+                api, plural, ns, name, body.get("status", {})
+            )
+            return web.json_response({"ok": True})
+        if request.method == "DELETE":
+            await self.fake.delete(api, plural, ns, name)
+            return web.json_response({"status": "Success"})
+        return web.json_response({"message": "unsupported"}, status=405)
+
+    async def _watch(self, request, api, plural, ns) -> web.StreamResponse:
+        resp = web.StreamResponse()
+        resp.content_type = "application/json"
+        await resp.prepare(request)
+        try:
+            async for ev in self.fake.watch(api, plural, ns):
+                line = json.dumps({"type": ev.type, "object": ev.obj}) + "\n"
+                await resp.write(line.encode())
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        return resp
