@@ -227,6 +227,7 @@ class HttpService:
             async for i in it:
                 yield i
 
+        tmpl = _SseTemplate()
         try:
             async for item in _rest():
                 if isinstance(item, Annotated):
@@ -244,7 +245,11 @@ class HttpService:
                 if _chunk_has_content(payload):
                     guard.mark_first_token()
                     guard.count_tokens()
-                await resp.write((f"data: {json.dumps(payload)}\n\n").encode())
+                fast = tmpl.encode(payload)
+                if fast is not None:
+                    await resp.write(fast)
+                else:
+                    await resp.write((f"data: {json.dumps(payload)}\n\n").encode())
             else:
                 guard.mark_ok()
             await resp.write(f"data: {DONE_SENTINEL}\n\n".encode())
@@ -352,6 +357,84 @@ def _chunk_has_content(payload) -> bool:
         if choice.get("text"):
             return True
     return False
+
+
+class _SseTemplate:
+    """Per-request fast path for the dominant SSE frame shape.
+
+    Every streamed chat/completions chunk in a request differs ONLY in the
+    token text: id/object/created/model repeat verbatim. json.dumps of the
+    nested dict is the measured frontend hot spot (VERDICT r4 item 6 —
+    24.5 µs/token at saturation, one frontend per ~7 chips); splicing the
+    escaped token into a pre-encoded prefix/suffix removes the per-token
+    tree walk. Any chunk that doesn't match the plain content-delta shape
+    (logprobs, finish frames, tool calls, n>1) falls back to json.dumps —
+    byte-identical output either way (templates are built FROM a dumps of
+    the first matching chunk)."""
+
+    __slots__ = ("prefix", "suffix", "key")
+
+    def __init__(self):
+        self.prefix: Optional[bytes] = None
+        self.suffix: Optional[bytes] = None
+        self.key = None
+
+    _MARK = "@DYN_TPU_TOK@"
+
+    def encode(self, payload) -> Optional[bytes]:
+        try:
+            # unknown top-level fields (usage from a custom engine, ...)
+            # would be frozen into the template: fall back on anything
+            # beyond the standard chunk envelope
+            if set(payload) - {"id", "object", "created", "model", "choices"}:
+                return None
+            choices = payload["choices"]
+            if len(choices) != 1:
+                return None
+            ch = choices[0]
+            if ch.get("finish_reason") is not None or ch.get("logprobs"):
+                return None
+            delta = ch.get("delta")
+            if delta is not None:
+                if set(ch) - {"index", "delta", "finish_reason", "logprobs"}:
+                    return None
+                if set(delta) != {"content"} or not isinstance(
+                    delta["content"], str
+                ):
+                    return None
+                tok = delta["content"]
+            else:
+                if set(ch) - {"index", "text", "finish_reason", "logprobs"} \
+                        or not isinstance(ch.get("text"), str):
+                    return None
+                tok = ch["text"]
+            # the choice index is IN the key: n>1 requests stream as
+            # interleaved single-choice chunks with identical id/created —
+            # without it, choice 1's tokens would reuse choice 0's template
+            key = (
+                payload.get("id"), payload.get("created"),
+                ch.get("index"), delta is None,
+            )
+        except (TypeError, KeyError, AttributeError):
+            return None
+        if key != self.key or self.prefix is None:
+            # build the template from a real dumps of THIS chunk with a
+            # marker token — output stays byte-identical to the slow path
+            probe = json.loads(json.dumps(payload))
+            if delta is not None:
+                probe["choices"][0]["delta"]["content"] = self._MARK
+            else:
+                probe["choices"][0]["text"] = self._MARK
+            enc = json.dumps(probe)
+            mark = json.dumps(self._MARK)[1:-1]
+            i = enc.find(mark)
+            if i < 0:
+                return None
+            self.prefix = ("data: " + enc[:i]).encode()
+            self.suffix = (enc[i + len(mark):] + "\n\n").encode()
+            self.key = key
+        # token text goes through the same escaping rules as dumps
+        return self.prefix + json.dumps(tok)[1:-1].encode() + self.suffix
 
 
 def _error_response(status: int, message: str) -> web.Response:
