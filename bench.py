@@ -148,6 +148,23 @@ def bench_multiturn() -> None:
     print(json.dumps(out))
 
 
+def _release_device_memory():
+    """Drop every droppable device buffer between bench sections: each
+    section builds its own engine + params, and without this the leftovers
+    accumulate until the later sections die with RESOURCE_EXHAUSTED on a
+    16 GB chip (the dress-rehearsal failure mode for concurrency/model_8b)."""
+    import gc
+
+    import jax
+
+    gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+    gc.collect()
+
+
 def _retry(fn, attempts=3, delay=5.0):
     """Run ``fn`` with retries: the tunneled compile helper can 500
     transiently (it erased round 4's kernel evidence); an infra hiccup must
@@ -745,6 +762,9 @@ def main() -> None:
         out, elapsed, ttfts, decode_tok_s = drive_wave(engine, wave, GEN_TOKENS)
         per_wave.append((out / elapsed, elapsed, out, ttfts, decode_tok_s))
     engine.close()
+    del engine  # free the primary engine's HBM before the sections
+    params = None
+    _release_device_memory()
 
     # median wave by throughput; its own TTFT distribution rides along
     per_wave.sort(key=lambda w: w[0])
@@ -808,31 +828,37 @@ def main() -> None:
             out["alt_mode"] = bench_alt_mode(alt)
         except Exception as e:  # secondary measurement must never kill the bench
             out["alt_mode"] = {"error": str(e)[:200]}
+        _release_device_memory()
     if os.environ.get("BENCH_PALLAS_KERNEL", "1") == "1":
         try:
             out["pallas_kernel"] = bench_pallas_kernel()
         except Exception as e:  # secondary measurement must never kill the bench
             out["pallas_kernel"] = {"error": str(e)[:200]}
+        _release_device_memory()
     if os.environ.get("BENCH_PALLAS_D128", "1") == "1":
         try:
             out["pallas_d128"] = bench_pallas_d128()
         except Exception as e:  # secondary measurement must never kill the bench
             out["pallas_d128"] = {"error": str(e)[:200]}
+        _release_device_memory()
     if os.environ.get("BENCH_FRONTEND", "1") == "1":
         try:
             out["frontend"] = bench_frontend()
         except Exception as e:
             out["frontend"] = {"error": str(e)[:200]}
+        _release_device_memory()
     if os.environ.get("BENCH_ISL_SWEEP", "1") == "1":
         try:
             out["isl_sweep"] = bench_isl_sweep()
         except Exception as e:
             out["isl_sweep"] = {"error": str(e)[:200]}
+        _release_device_memory()
     if os.environ.get("BENCH_CONCURRENCY", "1") == "1":
         try:
             out["concurrency"] = bench_concurrency()
         except Exception as e:
             out["concurrency"] = {"error": str(e)[:200]}
+        _release_device_memory()
     # LAST: pays minutes of first-boot remote compilation on the tunneled
     # runtime — must not eat the other sections' budget if it times out
     if os.environ.get("BENCH_MODEL_8B", "1") == "1":
@@ -840,6 +866,7 @@ def main() -> None:
             out["model_8b"] = bench_model_8b()
         except Exception as e:
             out["model_8b"] = {"error": str(e)[:200]}
+        _release_device_memory()
     print(json.dumps(out))
 
 

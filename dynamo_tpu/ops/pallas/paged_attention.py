@@ -326,11 +326,21 @@ def paged_attention_decode_v2(
 
 def v4_plan(
     n_lanes: int, bs: int, kvh: int, d: int, itemsize: int, mb: int,
-    vmem_budget: int = 6 << 20,
+    vmem_budget: Optional[int] = None,
 ) -> Optional[int]:
     """Largest pages_per_chunk whose lane-batched double buffers fit the
     VMEM budget, or None when even the smallest chunk doesn't (huge lane
-    counts: fall back to the per-lane v2 schedule)."""
+    counts: fall back to the per-lane v2 schedule).
+
+    The chip's scoped-VMEM limit is 16 MB, shared between the double
+    buffers and the kernel's stack temporaries; the stack grows with the
+    lane count (per-lane q/acc/score rows — measured ~9 MB at 64 lanes,
+    ~4 MB at 8), so the buffer budget is 16 MB minus an affine
+    lane-scaled margin that sits ABOVE both measured points (a constant
+    would overshoot small-lane shapes or undershoot mid-lane ones)."""
+    if vmem_budget is None:
+        margin = max(6 << 20, (4 << 20) + n_lanes * 100 * 1024)
+        vmem_budget = (16 << 20) - margin
     for p in (16, 8, 4, 2, 1):
         if p > mb:
             continue
