@@ -94,11 +94,17 @@ class SubprocessEngine(AsyncEngine):
         restart_on_crash: bool = True,
         ready_timeout: float = 60.0,
         restart_backoff: float = 0.5,
+        env: Optional[Dict[str, str]] = None,
     ):
         self.user_path = user_path
         self.restart_on_crash = restart_on_crash
         self.ready_timeout = ready_timeout
         self.restart_backoff = restart_backoff
+        # extra environment for the child (merged over the parent's): how a
+        # host passes engine config (model paths, device selection) without
+        # polluting its own process env — the reference passes env to its
+        # child engines the same way
+        self.extra_env = dict(env) if env else {}
         self._proc: Optional[asyncio.subprocess.Process] = None
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
@@ -118,6 +124,7 @@ class SubprocessEngine(AsyncEngine):
         parent_sock.setblocking(False)
         self._sock = parent_sock
         env = dict(os.environ)
+        env.update(self.extra_env)
         env[_ENGINE_FD_ENV] = str(child_sock.fileno())
         self._proc = await asyncio.create_subprocess_exec(
             sys.executable, "-u", "-m", "dynamo_tpu.llm.subprocess_engine",

@@ -1,0 +1,138 @@
+"""A REAL external engine behind the BYO subprocess host: HuggingFace
+``transformers`` serving OpenAI chat requests.
+
+This is the proof that the bring-your-own-engine contract holds for
+engines this framework does not control (reference: six engine-adapter
+crates, ``lib/engines/{mistralrs,llamacpp,sglang,...}``; the python-file
+level is ``lib/engines/python``). Run it crash-isolated exactly like any
+other user engine::
+
+    python -m dynamo_tpu.cli.run in=http out=pystr:examples/hf_transformers_engine.py \
+        --model-name hf
+
+Environment:
+- ``DYN_HF_MODEL_PATH``: local HF model directory (config.json +
+  tokenizer.json [+ weights]). Weights are optional — without
+  safetensors the model initializes from config (random weights; fine
+  for integration demos, which is also how the zero-egress CI exercises
+  this file).
+- ``DYN_HF_DEVICE``: torch device (default "cpu").
+
+The engine speaks the pystr contract: ``generate(request)`` receives the
+OpenAI request as a plain dict and yields OpenAI chat-completion chunk
+dicts (wrapped in Annotated), one per generated token, ending with a
+finish chunk — the same stream shape the native engines produce, so the
+HTTP frontend (incl. its SSE fast path) serves it unchanged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+import uuid
+
+from dynamo_tpu.runtime.annotated import Annotated
+
+_model = None
+_tokenizer = None
+
+
+def _load():
+    global _model, _tokenizer
+    if _model is not None:
+        return
+    import torch
+    from transformers import AutoConfig, AutoModelForCausalLM, AutoTokenizer
+
+    path = os.environ.get("DYN_HF_MODEL_PATH")
+    if not path:
+        raise RuntimeError("set DYN_HF_MODEL_PATH to a local HF model dir")
+    device = os.environ.get("DYN_HF_DEVICE", "cpu")
+    _tokenizer = AutoTokenizer.from_pretrained(path)
+    try:
+        _model = AutoModelForCausalLM.from_pretrained(
+            path, torch_dtype=torch.float32
+        )
+    except (OSError, ValueError):
+        # no weight files in the dir: config-initialized (random) weights —
+        # the integration surface is identical
+        cfg = AutoConfig.from_pretrained(path)
+        torch.manual_seed(0)
+        _model = AutoModelForCausalLM.from_config(cfg)
+    _model.to(device)
+    _model.eval()
+    print(f"hf engine ready: {path} on {device}", flush=True)
+
+
+def _chat_prompt(messages) -> str:
+    parts = []
+    for m in messages or []:
+        parts.append(f"{m.get('role', 'user')}: {m.get('content', '')}")
+    parts.append("assistant:")
+    return "\n".join(parts)
+
+
+async def generate(request):
+    """pystr contract: OpenAI request dict in, Annotated chunk dicts out."""
+    import torch
+
+    _load()
+    data = request.data
+    model_name = data.get("model", "hf")
+    messages = data.get("messages")
+    prompt = (
+        _chat_prompt(messages) if messages is not None
+        else str(data.get("prompt", ""))
+    )
+    max_tokens = int(data.get("max_tokens") or 16)
+    temperature = float(data.get("temperature") or 0.0)
+
+    enc = _tokenizer(prompt, return_tensors="pt")
+    ids = enc["input_ids"].to(_model.device)
+    rid = f"chatcmpl-{uuid.uuid4().hex}"
+    created = int(time.time())
+
+    def chunk(delta, finish=None):
+        return {
+            "id": rid,
+            "object": "chat.completion.chunk",
+            "created": created,
+            "model": model_name,
+            "choices": [
+                {"index": 0, "delta": delta, "finish_reason": finish}
+                if finish is not None
+                else {"index": 0, "delta": delta}
+            ],
+        }
+
+    yield Annotated.from_data(chunk({"role": "assistant", "content": ""}))
+
+    eos_id = _tokenizer.eos_token_id
+    past = None
+    cur = ids
+    finish = "length"
+    gen = torch.Generator(device="cpu").manual_seed(int(data.get("seed") or 0))
+    for _ in range(max_tokens):
+        # one real transformers decode step (KV-cached); run in a thread so
+        # the subprocess host's event loop keeps heartbeating
+        def step(cur=cur, past=past):
+            with torch.no_grad():
+                out = _model(cur, past_key_values=past, use_cache=True)
+            return out.logits[:, -1], out.past_key_values
+
+        logits, past = await asyncio.to_thread(step)
+        if temperature > 0.0:
+            probs = torch.softmax(logits / temperature, dim=-1)
+            nxt = torch.multinomial(probs, 1, generator=gen)
+        else:
+            nxt = logits.argmax(dim=-1, keepdim=True)
+        tok = int(nxt[0, 0])
+        if eos_id is not None and tok == eos_id:
+            finish = "stop"
+            break
+        text = _tokenizer.decode([tok], skip_special_tokens=True)
+        yield Annotated.from_data(chunk({"content": text}))
+        cur = nxt
+
+    yield Annotated.from_data(chunk({}, finish=finish))

@@ -1,0 +1,75 @@
+"""The HF transformers example engine through the crash-isolated
+subprocess host (the last r4 'missing' item: one REAL external engine
+proving the BYO contract holds for engines this framework doesn't
+control — reference: lib/engines/python + the six adapter crates).
+
+Runs fully offline: the model initializes from the fixture dir's
+config.json (real transformers LlamaForCausalLM, random weights); the
+tokenizer is the fixture's real tokenizers file.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from dynamo_tpu.llm.subprocess_engine import SubprocessEngine
+from dynamo_tpu.runtime.engine import Context
+
+ENGINE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "examples", "hf_transformers_engine.py",
+)
+
+REQ = {
+    "model": "hf",
+    "messages": [{"role": "user", "content": "hello there"}],
+    "max_tokens": 4,
+    "temperature": 0.0,
+}
+
+
+@pytest.fixture(scope="module")
+def hf_model_dir(tmp_path_factory):
+    from .fixtures import build_model_dir
+
+    return build_model_dir(str(tmp_path_factory.mktemp("hf-model")))
+
+
+def _serve_once(model_dir):
+    async def go():
+        eng = SubprocessEngine(
+            ENGINE_PATH, env={"DYN_HF_MODEL_PATH": model_dir}
+        )
+        try:
+            items = []
+            async for item in eng.generate(Context(dict(REQ))):
+                items.append(item.data)
+            return items
+        finally:
+            await eng.close()
+
+    return asyncio.run(go())
+
+
+def test_hf_engine_serves_openai_chunks_in_subprocess(hf_model_dir):
+    """Real transformers decode steps, streamed as OpenAI chunks, through
+    the same subprocess isolation every BYO engine gets."""
+    items = _serve_once(hf_model_dir)
+
+    assert len(items) >= 3  # role chunk + >=1 token + finish chunk
+    first, last = items[0], items[-1]
+    assert first["object"] == "chat.completion.chunk"
+    assert first["choices"][0]["delta"].get("role") == "assistant"
+    assert last["choices"][0].get("finish_reason") in ("length", "stop")
+    contents = [
+        it["choices"][0]["delta"].get("content") for it in items[1:-1]
+    ]
+    assert all(isinstance(c, str) for c in contents)
+
+    # determinism across engine restarts: seeded config-init weights +
+    # greedy decode → identical tokens from a fresh subprocess
+    items2 = _serve_once(hf_model_dir)
+    assert contents == [
+        it["choices"][0]["delta"].get("content") for it in items2[1:-1]
+    ]
