@@ -12,6 +12,14 @@ wire-compatible. Content-addressed KV hashing still uses xxh3 — different
 concern, different hash.)
 
 Max-size enforcement guards both sides against corrupt/hostile frames.
+
+Native-path note (r5 determination): the per-frame cost here is crc32
+(zlib, C) + one struct.pack + bytes concat — already C-dominated, so
+swapping in native/codec_core.so for in-process framing has no measurable
+headroom. codec_core.so exists for NON-Python engines/components speaking
+this wire format (its layout is differential-tested against this file);
+the measured Python frontend hot spot was SSE chunk serialization, fixed
+by the template fast path in llm/http/service.py.
 """
 
 from __future__ import annotations
