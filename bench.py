@@ -80,11 +80,11 @@ def bench_multiturn() -> None:
         SamplingOptions,
         StopConditions,
     )
-    from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+    from dynamo_tpu.models.llama import LLAMA_PRESETS
     from dynamo_tpu.runtime.engine import Context
 
     cfg = dataclasses.replace(LLAMA_PRESETS[PRESET], dtype=jnp.bfloat16)
-    params = init_params(jax.random.PRNGKey(0), cfg)
+    params = _init_params_fast(cfg)
     n_convs = int(os.environ.get("BENCH_CONVS", "8"))
     turn_len = int(os.environ.get("BENCH_TURN_LEN", "512"))
     # pool holds ~2.5 conversations: revisits force eviction
@@ -147,6 +147,19 @@ def bench_multiturn() -> None:
     }
     print(json.dumps(out))
 
+
+
+def _init_params_fast(cfg, seed: int = 0):
+    """init_params under ONE jit program. The eager version dispatches ~30
+    separate device ops; through a degraded tunnel each dispatch can take
+    seconds (measured 461 s for a 1B init vs ~10 s healthy). One compiled
+    program costs one dispatch and the persistent compile cache makes the
+    compile itself a one-time cost. Bitwise-identical to the eager init."""
+    import jax
+
+    from dynamo_tpu.models.llama import init_params
+
+    return jax.jit(init_params, static_argnums=1)(jax.random.PRNGKey(seed), cfg)
 
 def _release_device_memory():
     """Drop every droppable device buffer between bench sections: each
@@ -230,13 +243,13 @@ def bench_pallas_d128() -> dict:
         SamplingOptions,
         StopConditions,
     )
-    from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+    from dynamo_tpu.models.llama import LLAMA_PRESETS
     from dynamo_tpu.runtime.engine import Context
 
     preset = "qwen2.5-1.5b"
     n_req, prompt_len, gen = 8, 2048, 48
     cfg = dataclasses.replace(LLAMA_PRESETS[preset], dtype=jnp.bfloat16)
-    params = init_params(jax.random.PRNGKey(1), cfg)
+    params = _init_params_fast(cfg, seed=1)
     rng = np.random.default_rng(1)
     prompts = [
         rng.integers(0, cfg.vocab_size, prompt_len).tolist() for _ in range(n_req)
@@ -336,10 +349,10 @@ def bench_isl_sweep() -> dict:
     import numpy as np
 
     from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
-    from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+    from dynamo_tpu.models.llama import LLAMA_PRESETS
 
     cfg = dataclasses.replace(LLAMA_PRESETS[PRESET], dtype=jnp.bfloat16)
-    params = init_params(jax.random.PRNGKey(0), cfg)
+    params = _init_params_fast(cfg)
     rows = []
     rng = np.random.default_rng(7)
     for isl in (128, 1024, 2048, 4096):
@@ -481,10 +494,10 @@ def bench_concurrency() -> dict:
     import numpy as np
 
     from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
-    from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+    from dynamo_tpu.models.llama import LLAMA_PRESETS
 
     cfg = dataclasses.replace(LLAMA_PRESETS[PRESET], dtype=jnp.bfloat16)
-    params = init_params(jax.random.PRNGKey(0), cfg)
+    params = _init_params_fast(cfg)
     rng = np.random.default_rng(5)
     rows = []
     for slots in (16, 32, 64):
@@ -568,11 +581,11 @@ def bench_alt_mode(quantize: str) -> dict:
         SamplingOptions,
         StopConditions,
     )
-    from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+    from dynamo_tpu.models.llama import LLAMA_PRESETS
     from dynamo_tpu.runtime.engine import Context
 
     cfg = dataclasses.replace(LLAMA_PRESETS[PRESET], dtype=jnp.bfloat16)
-    params = init_params(jax.random.PRNGKey(0), cfg)
+    params = _init_params_fast(cfg)
     engine = JaxServingEngine(
         cfg, params,
         EngineConfig(
@@ -697,12 +710,12 @@ def main() -> None:
         SamplingOptions,
         StopConditions,
     )
-    from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+    from dynamo_tpu.models.llama import LLAMA_PRESETS
     from dynamo_tpu.runtime.engine import Context
 
     n_chips = len(jax.devices())
     cfg = dataclasses.replace(LLAMA_PRESETS[PRESET], dtype=jnp.bfloat16)
-    params = init_params(jax.random.PRNGKey(0), cfg)
+    params = _init_params_fast(cfg)
     mesh = None
     if BENCH_TP > 1:
         # sharded serving bench (the first-real-multi-chip runbook,
