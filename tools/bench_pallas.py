@@ -9,7 +9,7 @@ force completion, and differences two N values to cancel the fixed cost.
 Calibration on known ops lands at 601 GB/s / 156 bf16 TFLOPs — 73-79% of
 v5e peak — so the method reports physical device time.
 
-Usage: python tools/bench_pallas.py [--ctx 2048,4096,8192] [--lanes 8]
+Usage: python tools/bench_pallas.py [--ctx 2048,4096,8192,16384] [--lanes 8]
        [--heads 32] [--kv-heads 8] [--head-dim 128] [--json]
 
 Counterpart of the reference's kernel benches (components/benchmarks; the
@@ -148,7 +148,7 @@ def sweep_row(S, H, KVH, D, BS, ctx, impls, retry=None):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--ctx", default="2048,4096,8192")
+    ap.add_argument("--ctx", default="2048,4096,8192,16384")
     ap.add_argument("--lanes", type=int, default=8)
     ap.add_argument("--heads", type=int, default=32)
     ap.add_argument("--kv-heads", type=int, default=8)
