@@ -183,3 +183,31 @@ def test_sse_template_n2_choice_indices():
         {"index": 0, "delta": {"content": "x"}}]}) is None
     assert t.encode({**base, "choices": [
         {"index": 0, "delta": {}, "finish_reason": "stop"}]}) is None
+
+
+def test_sse_template_completions_text_chunks():
+    """The template fast path covers /v1/completions 'text' chunks too,
+    with the same byte-identical guarantee and fallback rules."""
+    from dynamo_tpu.llm.http.service import _SseTemplate
+
+    t = _SseTemplate()
+    base = {"id": "cmpl-1", "object": "text_completion", "created": 9,
+            "model": "m"}
+
+    def chunk(tok, finish=None):
+        ch = {"index": 0, "text": tok}
+        if finish is not None:
+            ch["finish_reason"] = finish
+        return {**base, "choices": [ch]}
+
+    for tok in ("hello", " wor\"ld", "\n", "€"):  # incl. escaping cases
+        enc = t.encode(chunk(tok))
+        assert enc is not None, tok
+        assert enc.startswith(b"data: ") and enc.endswith(b"\n\n")
+        parsed = json.loads(enc.decode()[len("data: "):])
+        assert parsed == chunk(tok), tok
+        # byte-identical to the slow path
+        assert enc == (f"data: {json.dumps(chunk(tok))}\n\n").encode()
+
+    # finish frames fall back
+    assert t.encode(chunk("", finish="stop")) is None
