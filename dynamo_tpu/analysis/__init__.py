@@ -1,0 +1,52 @@
+"""dynlint — project-native static analysis for dynamo_tpu.
+
+The serving stack mixes two worlds with opposite hazard profiles: an
+asyncio control plane (one blocking call or swallowed ``CancelledError``
+stalls every in-flight stream) and a JIT-compiled JAX data plane (one
+stray host sync inside a traced function serializes the TPU pipeline).
+The reference Dynamo leans on Rust's compiler for these invariants; this
+package is the Python reproduction's own checker — an AST rule engine
+with per-rule suppressions, a checked-in baseline for grandfathered
+findings, and a CLI that exits nonzero on anything new.
+
+Usage::
+
+    python -m dynamo_tpu.analysis dynamo_tpu/          # whole package
+    python tools/lint.py --changed                      # files vs main
+
+Suppress one finding::
+
+    time.sleep(0.1)  # dynlint: disable=blocking-call-in-async
+
+Mark an intentional host sync in the engine hot path::
+
+    out = jax.device_get(x)  # dynlint: allow-host-sync(leader sync)
+
+See docs/static_analysis.md for the rule catalogue and baseline workflow.
+"""
+
+from dynamo_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Module,
+    Project,
+    all_rules,
+    analyze_paths,
+    analyze_project,
+)
+from dynamo_tpu.analysis.baseline import (  # noqa: F401
+    filter_baselined,
+    load_baseline,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "Module",
+    "Project",
+    "all_rules",
+    "analyze_paths",
+    "analyze_project",
+    "filter_baselined",
+    "load_baseline",
+    "write_baseline",
+]

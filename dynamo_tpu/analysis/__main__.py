@@ -1,0 +1,7 @@
+"""``python -m dynamo_tpu.analysis`` → the dynlint CLI."""
+
+import sys
+
+from dynamo_tpu.analysis.cli import main
+
+sys.exit(main())

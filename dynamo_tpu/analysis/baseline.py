@@ -1,0 +1,70 @@
+"""Baseline file handling: grandfathered findings that don't fail the build.
+
+The baseline is a JSON list of findings, deterministically ordered
+(sorted by path, line, rule, message; repo-relative POSIX paths only) so
+regenerating it on any machine produces byte-identical output. Matching
+against the baseline ignores line numbers — unrelated edits move code —
+and uses multiset semantics on (path, rule, message): if a file had two
+grandfathered findings with the same identity and now has three, one is
+new and the run fails.
+
+Workflow (see docs/static_analysis.md): the baseline only ever shrinks.
+Fix a finding → regenerate with ``--write-baseline`` (the entry drops
+out). Never hand-add entries to silence a new finding — suppress with a
+``# dynlint: disable=rule`` comment carrying a justification instead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import Dict, List, Sequence, Tuple
+
+from dynamo_tpu.analysis.core import Finding
+
+DEFAULT_BASELINE_PATH = os.path.join("tools", "dynlint_baseline.json")
+
+
+def load_baseline(path: str) -> Counter:
+    """Load a baseline into a multiset of (path, rule, message) keys.
+    A missing file is an empty baseline."""
+    if not os.path.exists(path):
+        return Counter()
+    with open(path, encoding="utf-8") as f:
+        entries = json.load(f)
+    counts: Counter = Counter()
+    for e in entries:
+        counts[(e["path"], e["rule"], e["message"])] += 1
+    return counts
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    """Write the deterministic baseline file for ``findings``."""
+    entries = [
+        {"path": f.path, "line": f.line, "rule": f.rule, "message": f.message}
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))
+    ]
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(entries, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def filter_baselined(
+    findings: Sequence[Finding], baseline: Counter
+) -> Tuple[List[Finding], List[Finding]]:
+    """Split findings into (new, baselined) under multiset matching."""
+    budget: Dict[Tuple[str, str, str], int] = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old

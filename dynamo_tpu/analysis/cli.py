@@ -1,0 +1,162 @@
+"""dynlint CLI.
+
+Exit codes: 0 = clean (or only baselined findings), 1 = new violations,
+2 = usage error. The default invocation from the repo root checks the
+whole package against the checked-in baseline::
+
+    python -m dynamo_tpu.analysis dynamo_tpu/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from dynamo_tpu.analysis.baseline import (
+    DEFAULT_BASELINE_PATH,
+    filter_baselined,
+    load_baseline,
+    write_baseline,
+)
+from dynamo_tpu.analysis.core import (
+    all_rules,
+    analyze_paths,
+    find_project_root,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dynlint",
+        description="project-native static analysis for dynamo_tpu "
+        "(async-safety, JAX-dispatch, exception-hygiene invariants)",
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to check (default: the dynamo_tpu package "
+        "next to the current directory's pyproject.toml)",
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        metavar="FILE",
+        help=f"baseline file (default: <root>/{DEFAULT_BASELINE_PATH})",
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite the baseline from the current findings and exit 0 "
+        "(use after FIXING findings, so the baseline shrinks)",
+    )
+    p.add_argument(
+        "--context",
+        action="append",
+        default=[],
+        metavar="PATH",
+        help="extra modules loaded for cross-file rules but not reported on "
+        "(used by tools/lint.py --changed)",
+    )
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalogue"
+    )
+    return p
+
+
+def _default_paths(root: str) -> List[str]:
+    pkg = os.path.join(root, "dynamo_tpu")
+    if os.path.isdir(pkg):
+        return [pkg]
+    return [root]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.name}\n    {rule.description}")
+        return 0
+
+    root = find_project_root(args.paths[0] if args.paths else os.getcwd())
+    paths = [os.path.abspath(p) for p in args.paths] or _default_paths(root)
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"dynlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    # partial invocations (a file or subdirectory) still need the whole
+    # package as context, or cross-file rules (jit reachability, endpoint
+    # registries) see only the targets and report spurious drift / silently
+    # miss jit roots. build_project dedupes, so this is free when the
+    # targets already cover the package.
+    context = list(args.context) or _default_paths(root)
+
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE_PATH)
+    if args.write_baseline:
+        # refuse a subset: a baseline written from partial findings would
+        # erase every grandfathered entry outside the targets
+        pkg = os.path.abspath(_default_paths(root)[0])
+        covers_pkg = any(
+            os.path.commonpath([os.path.abspath(p), pkg]) == os.path.abspath(p)
+            for p in paths
+            if os.path.isdir(p)
+        )
+        if not covers_pkg:
+            print(
+                f"dynlint: --write-baseline must cover the whole package "
+                f"({os.path.relpath(pkg, root)}); got a subset",
+                file=sys.stderr,
+            )
+            return 2
+
+    findings = analyze_paths(paths, root=root, context_paths=context)
+
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(
+            f"dynlint: wrote {len(findings)} finding(s) to "
+            f"{os.path.relpath(baseline_path, root)}"
+        )
+        return 0
+
+    if args.no_baseline:
+        new, old = list(findings), []
+    else:
+        new, old = filter_baselined(findings, load_baseline(baseline_path))
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "new": [f.__dict__ for f in new],
+                    "baselined": [f.__dict__ for f in old],
+                },
+                indent=2,
+                sort_keys=True,
+            )
+        )
+    else:
+        for f in new:
+            print(f.render())
+        if new or old:
+            print(
+                f"dynlint: {len(new)} new violation(s), "
+                f"{len(old)} baselined (grandfathered)"
+            )
+        else:
+            print("dynlint: clean")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,417 @@
+"""dynlint core: findings, modules, suppressions, and the analysis driver.
+
+A :class:`Project` is the unit of analysis — every rule gets the full
+project so cross-file rules (jit reachability, endpoint/protocol drift)
+can see imports and registries, while per-file rules just walk one
+module's AST. Findings carry repo-relative POSIX paths so baselines and
+output never differ across machines.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+# line comments understood by the analyzer:
+#   # dynlint: disable=rule-a,rule-b     suppress those rules on this line
+#   # dynlint: disable=*                 suppress every rule on this line
+#   # dynlint: allow-host-sync(reason)   allowlist marker for intentional
+#                                        host syncs in engine hot paths
+_DISABLE_RE = re.compile(r"#\s*dynlint:\s*disable=([\w\-*]+(?:\s*,\s*[\w\-*]+)*)")
+_ALLOW_HOST_SYNC_RE = re.compile(r"#\s*dynlint:\s*allow-host-sync\b")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    path: str  # repo-relative, POSIX separators
+    line: int
+    rule: str
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line numbers shift on unrelated edits, so a
+        grandfathered finding is matched by (path, rule, message) only."""
+        return (self.path, self.rule, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Module:
+    """A parsed source file plus its suppression comments."""
+
+    abspath: str
+    relpath: str  # POSIX, relative to the project root
+    source: str
+    tree: ast.Module
+    # line → set of suppressed rule names ("*" = all)
+    suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+    # lines carrying the allow-host-sync marker
+    host_sync_allowed: Set[int] = field(default_factory=set)
+
+    @property
+    def dotted_name(self) -> str:
+        """Best-effort dotted module name ("dynamo_tpu.runtime.rpc")."""
+        rel = self.relpath[:-3] if self.relpath.endswith(".py") else self.relpath
+        parts = rel.split("/")
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    @property
+    def package(self) -> str:
+        """The package relative imports resolve against: the module itself
+        for ``__init__.py``, its parent otherwise."""
+        if self.relpath.endswith("/__init__.py") or self.relpath == "__init__.py":
+            return self.dotted_name
+        return self.dotted_name.rpartition(".")[0]
+
+    def is_suppressed(self, line: int, rule: str) -> bool:
+        rules = self.suppressions.get(line)
+        return rules is not None and (rule in rules or "*" in rules)
+
+    def allows_host_sync(self, line: int) -> bool:
+        return line in self.host_sync_allowed
+
+
+def _scan_comments(source: str) -> Tuple[Dict[int, Set[str]], Set[int]]:
+    """A trailing directive covers its own line; a directive on a standalone
+    comment line covers the next non-blank, non-comment line (so multi-line
+    annotation comments above a call work naturally).
+
+    Directives are extracted from real COMMENT tokens (tokenize), never
+    from string literals or docstrings — otherwise a string containing
+    '# dynlint: disable=*' would silently switch the enforcement off."""
+    lines = source.splitlines()
+    # (lineno, text, standalone): standalone = nothing but whitespace
+    # precedes the comment on its line
+    comments: List[Tuple[int, str, bool]] = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                row, col = tok.start
+                standalone = not lines[row - 1][:col].strip()
+                comments.append((row, tok.string, standalone))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # ast.parse accepted the file, so this is near-unreachable; err on
+        # the side of enforcement (no suppressions) rather than a bypass
+        return {}, set()
+
+    standalone_rows = {row for row, _, standalone in comments if standalone}
+
+    def effective_line(lineno: int, standalone: bool) -> int:
+        if not standalone:
+            return lineno
+        for nxt in range(lineno + 1, len(lines) + 1):
+            if lines[nxt - 1].strip() and nxt not in standalone_rows:
+                return nxt
+        return lineno
+
+    suppressions: Dict[int, Set[str]] = {}
+    allowed: Set[int] = set()
+    for lineno, text, standalone in comments:
+        if "dynlint" not in text:
+            continue
+        target = effective_line(lineno, standalone)
+        m = _DISABLE_RE.search(text)
+        if m:
+            names = {n.strip() for n in m.group(1).split(",") if n.strip()}
+            suppressions.setdefault(target, set()).update(names)
+        if _ALLOW_HOST_SYNC_RE.search(text):
+            allowed.add(lineno)
+            allowed.add(target)
+    return suppressions, allowed
+
+
+def load_module(abspath: str, root: str) -> Optional[Module]:
+    """Parse one file; returns None for unreadable/unparseable sources
+    (reported separately by the driver as a parse-error finding)."""
+    try:
+        with open(abspath, encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=abspath)
+    except (SyntaxError, UnicodeDecodeError, OSError):
+        return None
+    relpath = os.path.relpath(abspath, root).replace(os.sep, "/")
+    suppressions, allowed = _scan_comments(source)
+    return Module(abspath, relpath, source, tree, suppressions, allowed)
+
+
+@dataclass
+class Project:
+    """All modules visible to the analysis.
+
+    ``targets`` are the modules findings are reported for; ``modules``
+    is the full context (targets plus any extra context modules — e.g.
+    the whole package when linting only changed files, so cross-file
+    rules still resolve imports and registries).
+    """
+
+    root: str
+    modules: List[Module]
+    targets: List[Module]
+
+    _by_dotted: Dict[str, Module] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        self._by_dotted = {m.dotted_name: m for m in self.modules}
+
+    def module_named(self, dotted: str) -> Optional[Module]:
+        return self._by_dotted.get(dotted)
+
+
+class Rule:
+    """Base class: subclasses set ``name``/``description`` and implement
+    ``check``. One instance is created per run (rules may cache
+    project-wide state on self between modules).
+
+    ``project_wide`` rules are checked against every loaded module, not
+    just the targets: their findings can land on files the caller didn't
+    touch (a host sync in an unchanged helper newly reachable from a
+    changed jit root; a usage left dangling by a registry edit), and a
+    ``--changed`` run must not silently drop those."""
+
+    name: str = ""
+    description: str = ""
+    project_wide: bool = False
+
+    def prepare(self, project: Project) -> None:
+        """Called once before any module is checked."""
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+def all_rules() -> List[Rule]:
+    from dynamo_tpu.analysis.rules_async import (
+        BlockingCallInAsyncRule,
+        CancelledSwallowRule,
+        DanglingTaskRule,
+        UnawaitedCoroutineRule,
+    )
+    from dynamo_tpu.analysis.rules_jax import (
+        ImportTimeJaxComputeRule,
+        JitHostSyncRule,
+        UnmarkedHostSyncRule,
+    )
+    from dynamo_tpu.analysis.rules_protocol import EndpointProtocolDriftRule
+
+    return [
+        BlockingCallInAsyncRule(),
+        UnawaitedCoroutineRule(),
+        DanglingTaskRule(),
+        CancelledSwallowRule(),
+        JitHostSyncRule(),
+        UnmarkedHostSyncRule(),
+        ImportTimeJaxComputeRule(),
+        EndpointProtocolDriftRule(),
+    ]
+
+
+def _iter_py_files(path: str) -> Iterator[str]:
+    if os.path.isfile(path):
+        if path.endswith(".py"):
+            yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if not d.startswith(".") and d != "__pycache__" and d != "node_modules"
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def find_project_root(start: str) -> str:
+    """Walk up from ``start`` to the repo root (pyproject.toml / .git)."""
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    while True:
+        if os.path.exists(os.path.join(cur, "pyproject.toml")) or os.path.isdir(
+            os.path.join(cur, ".git")
+        ):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start)
+        cur = parent
+
+
+def build_project(
+    paths: Sequence[str],
+    root: Optional[str] = None,
+    context_paths: Sequence[str] = (),
+) -> Tuple[Project, List[Finding]]:
+    """Load targets + context; returns the project and parse-error findings."""
+    root = os.path.abspath(root or find_project_root(paths[0] if paths else "."))
+    parse_errors: List[Finding] = []
+    targets: List[Module] = []
+    seen: Dict[str, Module] = {}
+
+    def load_all(pths: Iterable[str], as_target: bool) -> None:
+        for p in pths:
+            for f in _iter_py_files(os.path.abspath(p)):
+                if f in seen:
+                    if as_target and seen[f] not in targets:
+                        targets.append(seen[f])
+                    continue
+                mod = load_module(f, root)
+                if mod is None:
+                    rel = os.path.relpath(f, root).replace(os.sep, "/")
+                    if as_target:
+                        parse_errors.append(
+                            Finding(rel, 1, "parse-error", "file could not be parsed")
+                        )
+                    continue
+                seen[f] = mod
+                if as_target:
+                    targets.append(mod)
+
+    load_all(paths, as_target=True)
+    load_all(context_paths, as_target=False)
+    project = Project(root=root, modules=list(seen.values()), targets=targets)
+    return project, parse_errors
+
+
+def analyze_project(
+    project: Project, rules: Optional[Sequence[Rule]] = None
+) -> List[Finding]:
+    """Run rules over the project targets; suppressed findings dropped."""
+    rules = list(rules) if rules is not None else all_rules()
+    for rule in rules:
+        rule.prepare(project)
+    findings: List[Finding] = []
+    for rule in rules:
+        modules = project.modules if rule.project_wide else project.targets
+        for module in modules:
+            for finding in rule.check(module, project):
+                if not module.is_suppressed(finding.line, finding.rule):
+                    findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    root: Optional[str] = None,
+    context_paths: Sequence[str] = (),
+    rules: Optional[Sequence[Rule]] = None,
+) -> List[Finding]:
+    project, parse_errors = build_project(paths, root, context_paths)
+    findings = parse_errors + analyze_project(project, rules)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers used by the rule modules
+# --------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` → "a.b.c"; None for non-name expressions."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def collect_imports(
+    stmts: Iterable[ast.stmt], package: str = ""
+) -> Dict[str, str]:
+    """Map local names to the qualified thing they import.
+
+    ``import a.b as c`` → {"c": "a.b"}; ``from a.b import f`` → {"f": "a.b.f"};
+    ``import a.b`` → {"a": "a"} (usage goes through the ``a.`` attribute chain).
+
+    Relative imports resolve against ``package`` (the importing module's
+    package, :attr:`Module.package`): in ``a/b/c.py``, ``from .x import f``
+    → {"f": "a.b.x.f"} and ``from ..x import f`` → {"a.x.f"} — without this
+    the jit call graph would silently miss edges behind relative imports.
+    """
+    out: Dict[str, str] = {}
+    for stmt in stmts:
+        if isinstance(stmt, ast.Import):
+            for alias in stmt.names:
+                if alias.asname:
+                    out[alias.asname] = alias.name
+                else:
+                    out[alias.name.split(".")[0]] = alias.name.split(".")[0]
+        elif isinstance(stmt, ast.ImportFrom):
+            if stmt.level == 0:
+                base = stmt.module or ""
+            else:
+                parts = package.split(".") if package else []
+                if stmt.level - 1 > len(parts):
+                    continue  # escapes the known tree; nothing to resolve
+                parts = parts[: len(parts) - (stmt.level - 1)]
+                if stmt.module:
+                    parts.append(stmt.module)
+                base = ".".join(parts)
+            if not base:
+                continue
+            for alias in stmt.names:
+                out[alias.asname or alias.name] = f"{base}.{alias.name}"
+    return out
+
+
+def resolve_call(node: ast.AST, imports: Dict[str, str]) -> Optional[str]:
+    """Qualified name of a call target with import aliases expanded.
+
+    ``sleep(...)`` with ``from time import sleep`` → "time.sleep";
+    ``rq.get(...)`` with ``import requests as rq`` → "requests.get".
+    """
+    name = dotted_name(node)
+    if name is None:
+        return None
+    head, _, rest = name.partition(".")
+    mapped = imports.get(head)
+    if mapped is None:
+        return name
+    return f"{mapped}.{rest}" if rest else mapped
+
+
+def walk_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that does NOT descend into nested (async) function or class
+    definitions — yields only nodes executed in ``node``'s own scope.
+    Lambda bodies ARE yielded (they share the enclosing trace/loop context
+    for the hazards dynlint cares about)."""
+    stack: List[ast.AST] = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            stack.append(child)
+
+
+def iter_functions(
+    tree: ast.Module,
+) -> Iterator[Tuple[ast.AST, List[ast.AST]]]:
+    """Yield every (async) function def with its ancestor chain (outermost
+    first; the chain contains every enclosing AST node, not just defs)."""
+    stack: List[Tuple[ast.AST, List[ast.AST]]] = [(tree, [])]
+    while stack:
+        node, ancestors = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            chain = ancestors + [node]
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, chain
+            stack.append((child, chain))

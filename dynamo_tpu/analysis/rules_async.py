@@ -1,0 +1,347 @@
+"""Async-safety rules: blocking calls, dropped coroutines/tasks, and
+exception hygiene inside the event-loop layers.
+
+Why these are project rules and not generic lints: the runtime/bus/HTTP
+layers multiplex every in-flight stream onto one event loop — a single
+``time.sleep`` stalls all of them, a dropped ``create_task`` handle can
+be garbage-collected mid-flight (asyncio keeps only weak refs), and a
+broad ``except`` in a retry loop that neither logs nor re-raises turns
+worker death into silence (runtime/statestore.py's watch loops are the
+canonical sites).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dynamo_tpu.analysis.core import (
+    Finding,
+    Module,
+    Project,
+    Rule,
+    collect_imports,
+    dotted_name,
+    iter_functions,
+    resolve_call,
+    walk_scope,
+)
+
+# Exact qualified names that block the event loop.
+_BLOCKING_EXACT = {
+    "time.sleep",
+    "os.system",
+    "os.popen",
+    "os.wait",
+    "os.waitpid",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.getoutput",
+    "subprocess.getstatusoutput",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "urllib.request.urlopen",
+    "open",
+    "io.open",
+}
+# Any call into these namespaces blocks (sync HTTP clients).
+_BLOCKING_PREFIXES = ("requests.", "http.client.")
+# Blocking methods flagged by attribute name regardless of receiver type
+# (Path IO; sync-socket/file primitives on an object we can't type).
+_BLOCKING_METHODS = {
+    "read_text",
+    "read_bytes",
+    "write_text",
+    "write_bytes",
+}
+
+
+def _enclosing_function(ancestors: List[ast.AST]) -> Optional[ast.AST]:
+    for node in reversed(ancestors):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return node
+    return None
+
+
+class BlockingCallInAsyncRule(Rule):
+    name = "blocking-call-in-async"
+    description = (
+        "blocking call (time.sleep, requests.*, subprocess, sync file/socket "
+        "IO) directly inside an async def stalls every coroutine on the loop; "
+        "use the asyncio equivalent or asyncio.to_thread"
+    )
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        imports = collect_imports(ast.walk(module.tree), module.package)
+        for func, _ancestors in iter_functions(module.tree):
+            if not isinstance(func, ast.AsyncFunctionDef):
+                continue
+            for node in walk_scope(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                qual = resolve_call(node.func, imports)
+                hit: Optional[str] = None
+                if qual in _BLOCKING_EXACT:
+                    hit = qual
+                elif qual and qual.startswith(_BLOCKING_PREFIXES):
+                    hit = qual
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _BLOCKING_METHODS
+                ):
+                    hit = f".{node.func.attr}"
+                if hit:
+                    yield Finding(
+                        module.relpath,
+                        node.lineno,
+                        self.name,
+                        f"blocking call {hit}() inside async def "
+                        f"{func.name}; it stalls the event loop — use the "
+                        f"async equivalent or asyncio.to_thread",
+                    )
+
+
+class UnawaitedCoroutineRule(Rule):
+    name = "unawaited-coroutine"
+    description = (
+        "calling a local async def without awaiting it creates a coroutine "
+        "that never runs (the call site silently does nothing)"
+    )
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        # module-level async defs: callable by bare name anywhere. Function-
+        # nested async defs are deliberately NOT tracked — they're only in
+        # scope inside their enclosing function, and matching them module-
+        # wide would flag unrelated same-named sync calls.
+        free_async: Set[str] = set()
+        # ClassDef node → its async method names (for self.f() resolution)
+        class_async: Dict[ast.ClassDef, Set[str]] = {}
+        for f, ancestors in iter_functions(module.tree):
+            if not isinstance(f, ast.AsyncFunctionDef):
+                continue
+            owner = ancestors[-1] if ancestors else None
+            if isinstance(owner, ast.ClassDef):
+                class_async.setdefault(owner, set()).add(f.name)
+            elif isinstance(owner, ast.Module):
+                free_async.add(f.name)
+        if not free_async and not class_async:
+            return
+
+        # walk Expr(Call) statements with their enclosing class tracked, so
+        # self.f() only matches async methods of the SAME class — matching
+        # arbitrary obj.f() by name would flag sync calls like
+        # StreamWriter.close() whenever the module defines an async close()
+        stack: List[Tuple[ast.AST, Optional[ast.ClassDef]]] = [(module.tree, None)]
+        while stack:
+            node, cls = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, child if isinstance(child, ast.ClassDef) else cls))
+            if not (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)):
+                continue
+            func = node.value.func
+            hit: Optional[str] = None
+            if isinstance(func, ast.Name) and func.id in free_async:
+                hit = func.id
+            elif (
+                isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+                and cls is not None
+                and func.attr in class_async.get(cls, ())
+            ):
+                hit = func.attr
+            if hit:
+                yield Finding(
+                    module.relpath,
+                    node.lineno,
+                    self.name,
+                    f"result of async function {hit}() is discarded — "
+                    f"missing await (the coroutine never executes)",
+                )
+
+
+class DanglingTaskRule(Rule):
+    name = "dangling-task"
+    description = (
+        "asyncio.create_task result dropped: the event loop holds only a "
+        "weak reference, so the task can be garbage-collected mid-flight; "
+        "store the handle (and cancel it on shutdown)"
+    )
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        # names bound from `async with asyncio.TaskGroup() as tg`: a
+        # TaskGroup holds strong refs and awaits its tasks, so a discarded
+        # tg.create_task() handle is safe
+        taskgroup_names = {
+            item.optional_vars.id
+            for node in ast.walk(module.tree)
+            if isinstance(node, (ast.With, ast.AsyncWith))
+            for item in node.items
+            if isinstance(item.optional_vars, ast.Name)
+            and isinstance(item.context_expr, ast.Call)
+            and (dotted_name(item.context_expr.func) or "").rsplit(".", 1)[-1]
+            == "TaskGroup"
+        }
+        for stmt in ast.walk(module.tree):
+            if not (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)):
+                continue
+            callee = dotted_name(stmt.value.func) or ""
+            head, _, _ = callee.partition(".")
+            simple = callee.rsplit(".", 1)[-1]
+            if "." in callee and head in taskgroup_names:
+                continue
+            if simple in ("create_task", "ensure_future"):
+                yield Finding(
+                    module.relpath,
+                    stmt.lineno,
+                    self.name,
+                    f"{simple}() result discarded; asyncio only weakly "
+                    f"references tasks — keep the handle or the task can be "
+                    f"GC'd mid-flight",
+                )
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> Tuple[bool, bool, str]:
+    """→ (broad, catches_cancelled, label). ``catches_cancelled`` is true for
+    bare except / BaseException (CancelledError subclasses BaseException in
+    py≥3.8, so plain ``except Exception`` does NOT swallow it)."""
+
+    def names(t: ast.AST) -> List[str]:
+        if isinstance(t, ast.Tuple):
+            return [dotted_name(e) or "" for e in t.elts]
+        return [dotted_name(t) or ""]
+
+    if handler.type is None:
+        return True, True, "bare except"
+    got = names(handler.type)
+    for n in got:
+        tail = n.rsplit(".", 1)[-1]
+        if tail == "BaseException":
+            return True, True, f"except {n}"
+        if tail == "Exception":
+            return True, False, f"except {n}"
+    return False, False, ""
+
+
+def _catches_cancelled_explicitly(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    elts = t.elts if isinstance(t, ast.Tuple) else ([t] if t is not None else [])
+    for e in elts:
+        n = dotted_name(e) or ""
+        if n.rsplit(".", 1)[-1] == "CancelledError":
+            return True
+    return False
+
+
+def _body_only_pass(body: List[ast.stmt]) -> bool:
+    return all(
+        isinstance(s, ast.Pass)
+        or (isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant))
+        for s in body
+    )
+
+
+def _has_reraise(body: List[ast.stmt]) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(ast.Module(body, [])))
+
+
+_LOG_HEADS = ("logger", "logging", "log", "warnings")
+
+
+def _has_logging(body: List[ast.stmt]) -> bool:
+    for s in body:
+        for node in ast.walk(s):
+            if isinstance(node, ast.Call):
+                n = dotted_name(node.func) or ""
+                head = n.split(".", 1)[0]
+                tail = n.rsplit(".", 1)[-1]
+                if head in _LOG_HEADS or tail in ("exception", "print"):
+                    return True
+    return False
+
+
+def _in_loop(ancestors: List[ast.AST], func: ast.AST) -> bool:
+    """True if the chain between the enclosing function and the node
+    contains a loop."""
+    seen_func = False
+    for node in ancestors:
+        if node is func:
+            seen_func = True
+            continue
+        if seen_func and isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+            return True
+    return False
+
+
+class CancelledSwallowRule(Rule):
+    name = "cancelled-swallow"
+    description = (
+        "broad exception handler in async code that swallows "
+        "asyncio.CancelledError, or silently hides failures in a retry/"
+        "watch loop (empty body, or no log and no re-raise)"
+    )
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        # walk Try statements with ancestor context
+        stack: List[Tuple[ast.AST, List[ast.AST]]] = [(module.tree, [])]
+        while stack:
+            node, ancestors = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, ancestors + [node]))
+            if not isinstance(node, ast.Try):
+                continue
+            func = _enclosing_function(ancestors)
+            if not isinstance(func, ast.AsyncFunctionDef):
+                continue
+            for idx, handler in enumerate(node.handlers):
+                broad, catches_cancel, label = _handler_is_broad(handler)
+                if not broad:
+                    continue
+                # `except (asyncio.CancelledError, Exception):` names the
+                # cancellation explicitly inside a broad tuple — it catches
+                # it just as surely as bare except does
+                if _catches_cancelled_explicitly(handler):
+                    catches_cancel = True
+                # only handlers BEFORE this one can protect it: Python
+                # matches in order, so a CancelledError re-raise placed
+                # after a broad handler is unreachable
+                earlier_reraises_cancel = any(
+                    _catches_cancelled_explicitly(h) and _has_reraise(h.body)
+                    for h in node.handlers[:idx]
+                )
+                reraises = _has_reraise(handler.body)
+                if catches_cancel and not reraises and not earlier_reraises_cancel:
+                    yield Finding(
+                        module.relpath,
+                        handler.lineno,
+                        self.name,
+                        f"{label} in async def {func.name} swallows "
+                        f"asyncio.CancelledError; add `except asyncio."
+                        f"CancelledError: raise` before it (or re-raise)",
+                    )
+                    continue
+                if _body_only_pass(handler.body):
+                    yield Finding(
+                        module.relpath,
+                        handler.lineno,
+                        self.name,
+                        f"{label} with empty body in async def {func.name} "
+                        f"silently swallows errors; log the failure or "
+                        f"narrow the exception type",
+                    )
+                    continue
+                if (
+                    _in_loop(ancestors + [node], func)
+                    and not reraises
+                    and not _has_logging(handler.body)
+                ):
+                    yield Finding(
+                        module.relpath,
+                        handler.lineno,
+                        self.name,
+                        f"{label} in a loop in async def {func.name} hides "
+                        f"failures (no log, no re-raise); log the error so "
+                        f"retry storms are visible",
+                    )

@@ -1,0 +1,160 @@
+"""RPC/protocol drift rule.
+
+Endpoint names are plain strings on the wire (runtime/rpc.py header
+``{"op": "generate", "endpoint": ...}``); nothing at runtime ties the
+name a component registers to the protocol type the caller serializes.
+The reference's Rust traits close that loop at compile time — here the
+checker does: every endpoint name used as a literal in the package must
+appear in an ``ENDPOINT_PROTOCOLS`` registry (llm/protocols/__init__.py,
+kv_router/protocols.py), and every registry entry must point at a
+protocol class that actually exists, so a renamed endpoint or a deleted
+protocol dataclass fails the lint instead of failing a worker at 3am.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from dynamo_tpu.analysis.core import Finding, Module, Project, Rule
+
+REGISTRY_NAME = "ENDPOINT_PROTOCOLS"
+
+
+def _registry_entries(module: Module) -> List[Tuple[str, str, int]]:
+    """(endpoint_name, "module:Symbol", line) for each ENDPOINT_PROTOCOLS
+    entry declared at module top level."""
+    out: List[Tuple[str, str, int]] = []
+    for stmt in module.tree.body:
+        targets: List[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+            value = stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets = [stmt.target]
+            value = stmt.value
+        else:
+            continue
+        named = any(
+            isinstance(t, ast.Name) and t.id == REGISTRY_NAME for t in targets
+        )
+        if not named or not isinstance(value, ast.Dict):
+            continue
+        for k, v in zip(value.keys, value.values):
+            if (
+                isinstance(k, ast.Constant)
+                and isinstance(k.value, str)
+                and isinstance(v, ast.Constant)
+                and isinstance(v.value, str)
+            ):
+                out.append((k.value, v.value, k.lineno))
+    return out
+
+
+def _module_defines(module: Module, symbol: str) -> bool:
+    for stmt in module.tree.body:
+        if isinstance(stmt, (ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)):
+            if stmt.name == symbol:
+                return True
+        elif isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and t.id == symbol:
+                    return True
+        elif isinstance(stmt, ast.AnnAssign):
+            if isinstance(stmt.target, ast.Name) and stmt.target.id == symbol:
+                return True
+        elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            # re-exports bind the symbol too (`from .impl import Req`)
+            for alias in stmt.names:
+                if (alias.asname or alias.name.split(".")[0]) == symbol:
+                    return True
+    return False
+
+
+class EndpointProtocolDriftRule(Rule):
+    name = "endpoint-protocol-drift"
+    project_wide = True  # a registry edit can strand usages in UNCHANGED files
+    description = (
+        "endpoint name registered/dialed without a matching entry in an "
+        "ENDPOINT_PROTOCOLS registry (llm/protocols, kv_router/protocols), "
+        "or a registry entry pointing at a protocol symbol that no longer "
+        "exists"
+    )
+
+    def prepare(self, project: Project) -> None:
+        self._known: Dict[str, str] = {}
+        self._registry_findings: Dict[str, List[Finding]] = {}
+        self._have_registry = False
+        for module in project.modules:
+            entries = _registry_entries(module)
+            if entries:
+                self._have_registry = True
+            for endpoint, proto, line in entries:
+                self._known[endpoint] = proto
+                finding = self._check_entry(project, module, endpoint, proto, line)
+                if finding is not None:
+                    self._registry_findings.setdefault(module.relpath, []).append(
+                        finding
+                    )
+
+    def _check_entry(
+        self, project: Project, module: Module, endpoint: str, proto: str, line: int
+    ) -> Optional[Finding]:
+        if ":" not in proto:
+            return Finding(
+                module.relpath,
+                line,
+                self.name,
+                f"registry entry for endpoint {endpoint!r} is {proto!r}; "
+                f"expected \"dotted.module:ProtocolSymbol\"",
+            )
+        mod_name, _, symbol = proto.partition(":")
+        target = project.module_named(mod_name)
+        if target is None:
+            # protocol lives outside the analyzed tree: nothing to verify
+            return None
+        if not _module_defines(target, symbol):
+            return Finding(
+                module.relpath,
+                line,
+                self.name,
+                f"registry entry for endpoint {endpoint!r} points at "
+                f"{proto!r}, but {target.relpath} defines no {symbol!r} — "
+                f"protocol drift",
+            )
+        return None
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        yield from self._registry_findings.get(module.relpath, [])
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "endpoint"
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            name = node.args[0].value
+            if not self._have_registry:
+                yield Finding(
+                    module.relpath,
+                    node.lineno,
+                    self.name,
+                    f"endpoint {name!r} used but no ENDPOINT_PROTOCOLS "
+                    f"registry exists in the project (declare one in "
+                    f"llm/protocols/__init__.py)",
+                )
+                continue
+            if name not in self._known:
+                known = ", ".join(sorted(self._known)) or "<empty>"
+                yield Finding(
+                    module.relpath,
+                    node.lineno,
+                    self.name,
+                    f"endpoint {name!r} has no protocol definition in any "
+                    f"ENDPOINT_PROTOCOLS registry (known: {known}); add it "
+                    f"to llm/protocols/__init__.py or kv_router/protocols.py",
+                )

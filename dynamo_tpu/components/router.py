@@ -21,7 +21,12 @@ import asyncio
 import json
 import logging
 
-from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, RouterEvent
+from dynamo_tpu.kv_router.protocols import (
+    ForwardPassMetrics,
+    RouterEvent,
+    ScheduleDecision,
+    ScheduleRequest,
+)
 from dynamo_tpu.kv_router.router import KvRouter
 from dynamo_tpu.runtime.annotated import Annotated
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
@@ -37,21 +42,23 @@ class RouterEngine(AsyncEngine):
 
     async def generate(self, request: Context):
         data = request.data
-        token_ids = data.get("token_ids") if isinstance(data, dict) else None
-        if not token_ids:
+        req = ScheduleRequest.from_dict(data) if isinstance(data, dict) else None
+        if req is None or not req.token_ids:
             yield Annotated.from_error("router request needs token_ids")
             return
-        decision = self.router.schedule(token_ids)
+        decision = self.router.schedule(req.token_ids)
         if decision is None:
             yield Annotated.from_error("no workers registered")
             return
-        blocks = (len(token_ids) + self.router.block_size - 1) // self.router.block_size
+        blocks = (
+            len(req.token_ids) + self.router.block_size - 1
+        ) // self.router.block_size
         yield Annotated.from_data(
-            {
-                "worker_id": decision.worker_id,
-                "overlap_blocks": decision.overlap_blocks,
-                "prefix_hit_rate": decision.overlap_blocks / max(blocks, 1),
-            }
+            ScheduleDecision(
+                worker_id=decision.worker_id,
+                overlap_blocks=decision.overlap_blocks,
+                prefix_hit_rate=decision.overlap_blocks / max(blocks, 1),
+            ).to_dict()
         )
 
 
@@ -96,8 +103,12 @@ async def run_router(drt, namespace: str, block_size: int = 16) -> None:
             if feed_alive[0] < cutoff:
                 try:
                     await drt.bus.queue_len("__router_liveness_probe__")
+                except asyncio.CancelledError:
+                    raise
                 except Exception:
-                    continue  # bus unreachable: feed outage, keep state
+                    # bus unreachable: feed outage, keep state
+                    logger.debug("router liveness probe failed", exc_info=True)
+                    continue
             for wid in stale:
                 logger.info("worker %s silent > %.0fs: purging from router", wid, expiry)
                 router.remove_worker(wid)

@@ -108,6 +108,45 @@ class KVHitRateEvent:
         )
 
 
+@dataclass(frozen=True)
+class ScheduleRequest:
+    """Request to the KV router's ``schedule`` endpoint: pick a worker for
+    this prompt (components/router.py RouterEngine)."""
+
+    token_ids: List[int]
+
+    def to_dict(self) -> dict:
+        return {"token_ids": list(self.token_ids)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ScheduleRequest":
+        return cls(token_ids=list(d.get("token_ids") or []))
+
+
+@dataclass(frozen=True)
+class ScheduleDecision:
+    """Reply from the ``schedule`` endpoint: chosen worker + prefix overlap."""
+
+    worker_id: str
+    overlap_blocks: int
+    prefix_hit_rate: float
+
+    def to_dict(self) -> dict:
+        return {
+            "worker_id": self.worker_id,
+            "overlap_blocks": self.overlap_blocks,
+            "prefix_hit_rate": self.prefix_hit_rate,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ScheduleDecision":
+        return cls(
+            worker_id=d["worker_id"],
+            overlap_blocks=int(d.get("overlap_blocks", 0)),
+            prefix_hit_rate=float(d.get("prefix_hit_rate", 0.0)),
+        )
+
+
 @dataclass
 class ForwardPassMetrics:
     """Worker load snapshot (reference kv_router/protocols.rs:42-54)."""
@@ -130,3 +169,11 @@ class ForwardPassMetrics:
     def from_dict(cls, d: dict) -> "ForwardPassMetrics":
         known = {f for f in cls.__dataclass_fields__}
         return cls(**{k: v for k, v in d.items() if k in known})
+
+
+# endpoint name → "dotted.module:ProtocolSymbol" — the KV-routing side of the
+# project endpoint registry (see dynamo_tpu/llm/protocols/__init__.py and the
+# endpoint-protocol-drift dynlint rule in docs/static_analysis.md)
+ENDPOINT_PROTOCOLS = {
+    "schedule": "dynamo_tpu.kv_router.protocols:ScheduleRequest",
+}

@@ -100,7 +100,13 @@ class ModelWatcher:
                 for key in list(self._entry_model):
                     if key not in snapshot:
                         await self._remove(key)
-            except Exception:
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.warning(
+                    "model registry resync failed (%s); retrying in %.1fs",
+                    e, backoff,
+                )
                 await asyncio.sleep(backoff)
                 backoff = min(backoff * 2, 10.0)
 
@@ -191,8 +197,10 @@ class ModelWatcher:
         if client is not None:
             try:
                 await client.close()
+            except asyncio.CancelledError:
+                raise
             except Exception:
-                pass
+                logger.debug("closing client for %s failed", key, exc_info=True)
         kind, name = parsed
         if kind == "chat":
             self.manager.remove_chat_model(name)

@@ -1,0 +1,25 @@
+"""Wire protocol types for the LLM serving plane.
+
+``ENDPOINT_PROTOCOLS`` is the project's endpoint→protocol registry:
+every endpoint name that appears as a string literal in the package
+(``component.endpoint("...")``) must have an entry here or in
+``dynamo_tpu/kv_router/protocols.py`` naming the endpoint's anchoring
+wire type — the request protocol its workers deserialize, or, for
+poll-style endpoints whose request carries no payload, the reply type
+(noted per entry). The ``endpoint-protocol-drift`` dynlint rule
+cross-checks both directions — an unregistered endpoint name and a
+registry entry pointing at a deleted protocol class both fail the lint
+(docs/static_analysis.md).
+"""
+
+# endpoint name → "dotted.module:ProtocolSymbol" of the request type
+ENDPOINT_PROTOCOLS = {
+    # the serving endpoint every LLM worker registers (cli/run.py
+    # run_endpoint; name comes from the dyn://ns.comp.ep spec, "generate"
+    # by convention); carries a preprocessed token-in/token-out request
+    "generate": "dynamo_tpu.llm.protocols.common:PreprocessedRequest",
+    # pull-based metrics scrape plane (runtime/distributed.py
+    # serve_stats_endpoint): the request carries no payload, so the entry
+    # anchors the REPLY type
+    "stats": "dynamo_tpu.kv_router.protocols:ForwardPassMetrics",
+}

@@ -339,10 +339,13 @@ class MessageBusServer:
         if self._snapshot_task is not None and not self._snapshot_task.done():
             try:
                 await self._snapshot_task
+            except asyncio.CancelledError:
+                raise
             except Exception:
-                pass
+                logger.exception("async snapshot failed during stop")
         if self._wal is not None:
             # graceful stop: compact so restart replays a snapshot, not a log
+            # (sync file IO on the one-shot shutdown path — dynlint baseline)
             self._dump_snapshot(self._state_copy())
             self._wal.close()
             self._wal = open(self._wal_path, "w")
@@ -580,6 +583,9 @@ class MessageBusClient:
         self._reader_task: Optional[asyncio.Task] = None
         self._send_lock = asyncio.Lock()
         self._closed = False
+        # strong refs to fire-and-forget cleanup tasks (asyncio only weakly
+        # references tasks; a GC'd cleanup would strand a queue item)
+        self._bg_tasks: set = set()
 
     @classmethod
     async def connect(cls, url: str, reconnect: bool = True) -> "MessageBusClient":
@@ -765,7 +771,9 @@ class MessageBusClient:
                 except (ConnectionError, RuntimeError):
                     pass
 
-            asyncio.ensure_future(_cleanup())
+            t = asyncio.ensure_future(_cleanup())
+            self._bg_tasks.add(t)
+            t.add_done_callback(self._bg_tasks.discard)
             raise
         if not reply.get("ok"):
             raise RuntimeError(f"bus error: {reply.get('error')}")

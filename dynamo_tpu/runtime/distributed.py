@@ -152,8 +152,10 @@ class DistributedRuntime:
     async def reconnect_store(self) -> None:
         try:
             await self.store.close()
+        except asyncio.CancelledError:
+            raise
         except Exception:
-            pass
+            logger.debug("closing stale statestore client failed", exc_info=True)
         self.store = await StateStoreClient.connect(self._store_url)
         self._primary_lease = None
 
@@ -464,8 +466,12 @@ class EndpointClient(AsyncEngine):
                     for conn in stale_conns:
                         try:
                             await conn.close()
+                        except asyncio.CancelledError:
+                            raise
                         except Exception:
-                            pass
+                            logger.debug(
+                                "closing stale worker conn failed", exc_info=True
+                            )
                     self._ready.clear()
                     backoff = 0.5
                     break
@@ -493,10 +499,23 @@ class EndpointClient(AsyncEngine):
 
     async def wait_for_instances(self, n: int = 1, timeout: float = 30.0) -> None:
         """Reference: Client::wait_for_endpoints (client.rs:205-215)."""
-        async with asyncio.timeout(timeout):
+
+        async def _wait() -> None:
             while len(self._instances) < n:
                 self._ready.clear()
                 await self._ready.wait()
+
+        # asyncio.wait_for, not asyncio.timeout: the latter is py3.11+ and
+        # the supported floor is 3.10. Normalize the timeout type too —
+        # asyncio.TimeoutError is a distinct class from builtin TimeoutError
+        # until 3.11, and callers should not have to catch both.
+        try:
+            await asyncio.wait_for(_wait(), timeout)
+        except asyncio.TimeoutError:
+            raise TimeoutError(
+                f"no {n} live instance(s) for {self.endpoint.path} "
+                f"within {timeout:.0f}s"
+            ) from None
 
     def instance_ids(self) -> List[str]:
         return sorted(self._instances)

@@ -15,10 +15,13 @@ finalizers instead of Drop impls.
 
 from __future__ import annotations
 
+import logging
 import threading
 import weakref
 from collections import deque
 from typing import Any, Callable, Deque, Generic, Optional, TypeVar
+
+logger = logging.getLogger(__name__)
 
 T = TypeVar("T")
 
@@ -155,7 +158,11 @@ class Pool(Generic[T]):
             try:
                 self._reset(value)
             except Exception:
-                # a value that can't reset is dropped, freeing its slot
+                # a value that can't reset is dropped, freeing its slot —
+                # loudly, or a flaky reset silently drains the pool to zero
+                logger.warning(
+                    "pool reset failed; dropping value %r", value, exc_info=True
+                )
                 with self._cond:
                     self._live -= 1
                     self._cond.notify()

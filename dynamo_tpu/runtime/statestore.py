@@ -321,6 +321,8 @@ class StateStoreServer:
         if self.data_dir is not None and not self._skip_restore:
             os.makedirs(self.data_dir, exist_ok=True)
             self._restore()
+            # startup path, runs once before serving; async file IO isn't
+            # worth a dependency here — tracked in the dynlint baseline
             self._wal = open(self._wal_path, "a")
         self._server = TrackedServer(self._handle, self.host, self.port)
         self.port = await self._server.start()
@@ -338,8 +340,10 @@ class StateStoreServer:
             # fresh compacted snapshot below with its older state copy
             try:
                 await self._snapshot_task
+            except asyncio.CancelledError:
+                raise
             except Exception:
-                pass
+                logger.exception("async snapshot failed during stop")
         if self._wal is not None:
             self._compact()  # graceful stop leaves a snapshot, empty WAL
             self._wal.close()

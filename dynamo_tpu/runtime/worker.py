@@ -76,12 +76,17 @@ async def serve_until_shutdown(drt, engine=None) -> None:
         t.cancel()
 
     timeout = graceful_timeout()
+
+    async def _graceful() -> None:
+        await drt.shutdown()  # lease revoke → RPC drain → transports
+        if engine is not None and hasattr(engine, "close"):
+            engine.close()
+
     try:
-        async with asyncio.timeout(timeout):
-            await drt.shutdown()  # lease revoke → RPC drain → transports
-            if engine is not None and hasattr(engine, "close"):
-                engine.close()
-    except TimeoutError:
+        # asyncio.wait_for, not asyncio.timeout: the latter is py3.11+ and
+        # the supported floor is 3.10
+        await asyncio.wait_for(_graceful(), timeout)
+    except (TimeoutError, asyncio.TimeoutError):
         logger.error(
             "graceful shutdown exceeded %.0fs: exiting %d",
             timeout, EXIT_GRACEFUL_TIMEOUT,

@@ -1,0 +1,840 @@
+"""Unit tests for the dynlint rule engine (dynamo_tpu/analysis).
+
+Table-driven: each rule gets known-bad snippets it must fire on,
+known-good snippets it must stay quiet on, and a suppressed variant the
+``# dynlint: disable=`` comment must silence. Snippets are written into a
+temp project so path-scoped rules (engine hot modules, protocol
+registries) and cross-module reachability are exercised for real.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from dynamo_tpu.analysis import (
+    all_rules,
+    analyze_paths,
+    filter_baselined,
+    load_baseline,
+    write_baseline,
+)
+from dynamo_tpu.analysis.cli import main as dynlint_main
+
+
+def lint_tree(tmp_path, files):
+    """Write {relpath: source} into tmp_path and lint the whole tree."""
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return analyze_paths([str(tmp_path)], root=str(tmp_path))
+
+
+def rules_fired(findings):
+    return {f.rule for f in findings}
+
+
+def test_rule_catalogue_has_at_least_six_rules():
+    names = [r.name for r in all_rules()]
+    assert len(names) >= 6
+    assert len(set(names)) == len(names), "duplicate rule names"
+    for r in all_rules():
+        assert r.description, f"rule {r.name} has no description"
+
+
+# -- blocking-call-in-async -------------------------------------------------
+
+BLOCKING_CASES = [
+    ("time_sleep", "import time\nasync def f():\n    time.sleep(1)\n", True),
+    (
+        "from_import_sleep",
+        "from time import sleep\nasync def f():\n    sleep(1)\n",
+        True,
+    ),
+    ("requests", "import requests\nasync def f():\n    requests.get('http://x')\n", True),
+    (
+        "requests_alias",
+        "import requests as rq\nasync def f():\n    rq.post('http://x')\n",
+        True,
+    ),
+    ("subprocess", "import subprocess\nasync def f():\n    subprocess.run(['ls'])\n", True),
+    ("open_call", "async def f(p):\n    open(p).read()\n", True),
+    ("path_read_text", "async def f(p):\n    return p.read_text()\n", True),
+    ("sync_def_ok", "import time\ndef f():\n    time.sleep(1)\n", False),
+    (
+        "asyncio_sleep_ok",
+        "import asyncio\nasync def f():\n    await asyncio.sleep(1)\n",
+        False,
+    ),
+    (
+        "to_thread_ok",
+        "import asyncio, time\nasync def f():\n    await asyncio.to_thread(time.sleep, 1)\n",
+        False,
+    ),
+    (
+        "nested_sync_def_ok",
+        "import time\nasync def f():\n    def inner():\n        time.sleep(1)\n    return inner\n",
+        False,
+    ),
+]
+
+
+@pytest.mark.parametrize("name,src,expect", BLOCKING_CASES, ids=[c[0] for c in BLOCKING_CASES])
+def test_blocking_call_in_async(tmp_path, name, src, expect):
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    fired = "blocking-call-in-async" in rules_fired(findings)
+    assert fired == expect, [f.render() for f in findings]
+
+
+def test_blocking_call_suppressed(tmp_path):
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # dynlint: disable=blocking-call-in-async\n"
+    )
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    assert "blocking-call-in-async" not in rules_fired(findings)
+
+
+def test_directive_inside_string_literal_is_not_a_suppression(tmp_path):
+    """A string containing the disable syntax must not switch enforcement
+    off — only real comment tokens count."""
+    src = (
+        "import time\n"
+        "MSG = \"# dynlint: disable=*\"\n"
+        "async def f():\n"
+        "    time.sleep(1)\n"
+    )
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    assert "blocking-call-in-async" in rules_fired(findings)
+
+
+def test_allow_marker_inside_docstring_is_not_an_allowlist(tmp_path):
+    src = (
+        '"""Docs mention the # dynlint: allow-host-sync(reason) marker."""\n'
+        "import jax\n"
+        "def fetch(x):\n"
+        "    return jax.device_get(x)\n"
+    )
+    findings = lint_tree(tmp_path, {"engine_jax/engine.py": src})
+    assert "unmarked-host-sync" in rules_fired(findings)
+
+
+def test_disable_ignores_trailing_prose_and_unrelated_rules(tmp_path):
+    # prose after the rule list must not become a bogus "rule name", and a
+    # disable naming a different rule must not suppress this one
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # dynlint: disable=blocking-call-in-async  startup only\n"
+        "async def g():\n"
+        "    time.sleep(1)  # dynlint: disable=cancelled-swallow\n"
+    )
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    hits = [f for f in findings if f.rule == "blocking-call-in-async"]
+    assert len(hits) == 1 and hits[0].line == 5, [f.render() for f in findings]
+
+
+def test_suppression_on_standalone_comment_line_covers_next_stmt(tmp_path):
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    # startup-only file read\n"
+        "    # dynlint: disable=blocking-call-in-async\n"
+        "    time.sleep(1)\n"
+    )
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    assert "blocking-call-in-async" not in rules_fired(findings)
+
+
+# -- unawaited-coroutine / dangling-task ------------------------------------
+
+UNAWAITED_CASES = [
+    (
+        "bare_call",
+        "async def work():\n    pass\ndef kick():\n    work()\n",
+        True,
+    ),
+    (
+        "self_method",
+        "class A:\n    async def go(self):\n        pass\n"
+        "    def kick(self):\n        self.go()\n",
+        True,
+    ),
+    (
+        "awaited_ok",
+        "async def work():\n    pass\nasync def kick():\n    await work()\n",
+        False,
+    ),
+    (
+        "assigned_ok",
+        "async def work():\n    pass\ndef kick():\n    c = work()\n    return c\n",
+        False,
+    ),
+    (
+        "other_object_ok",  # writer.close() is sync even if module has async close
+        "async def close():\n    pass\ndef kick(writer):\n    writer.close()\n",
+        False,
+    ),
+    (
+        "function_nested_async_ok",  # nested defs are only in scope inside
+        # their enclosing function; don't match same-named calls module-wide
+        "def setup():\n    async def close():\n        pass\n    return close\n"
+        "def kick(conn):\n    close = conn.closer()\n    close()\n",
+        False,
+    ),
+    (
+        "other_class_ok",
+        "class A:\n    async def go(self):\n        pass\n"
+        "class B:\n    def go(self):\n        pass\n"
+        "    def kick(self):\n        self.go()\n",
+        False,
+    ),
+]
+
+
+@pytest.mark.parametrize("name,src,expect", UNAWAITED_CASES, ids=[c[0] for c in UNAWAITED_CASES])
+def test_unawaited_coroutine(tmp_path, name, src, expect):
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    fired = "unawaited-coroutine" in rules_fired(findings)
+    assert fired == expect, [f.render() for f in findings]
+
+
+def test_dangling_task(tmp_path):
+    bad = "import asyncio\nasync def w():\n    pass\ndef f(loop):\n    asyncio.create_task(w())\n"
+    good = (
+        "import asyncio\nasync def w():\n    pass\n"
+        "def f(tasks):\n    t = asyncio.create_task(w())\n    tasks.add(t)\n"
+    )
+    sup = (
+        "import asyncio\nasync def w():\n    pass\n"
+        "def f():\n    asyncio.create_task(w())  # dynlint: disable=dangling-task\n"
+    )
+    # TaskGroup holds strong refs and awaits its tasks — not dangling
+    tg = (
+        "import asyncio\nasync def w():\n    pass\n"
+        "async def f():\n"
+        "    async with asyncio.TaskGroup() as tg:\n"
+        "        tg.create_task(w())\n"
+    )
+    assert "dangling-task" in rules_fired(lint_tree(tmp_path / "a", {"m.py": bad}))
+    assert "dangling-task" not in rules_fired(lint_tree(tmp_path / "b", {"m.py": good}))
+    assert "dangling-task" not in rules_fired(lint_tree(tmp_path / "c", {"m.py": sup}))
+    assert "dangling-task" not in rules_fired(lint_tree(tmp_path / "d", {"m.py": tg}))
+
+
+# -- cancelled-swallow ------------------------------------------------------
+
+SWALLOW_CASES = [
+    (
+        "bare_except_no_reraise",
+        """
+        async def f(x):
+            try:
+                await x()
+            except:
+                return None
+        """,
+        True,
+    ),
+    (
+        "base_exception_no_reraise",
+        """
+        async def f(x):
+            try:
+                await x()
+            except BaseException:
+                return None
+        """,
+        True,
+    ),
+    (
+        "exception_empty_body",
+        """
+        async def f(x):
+            try:
+                await x()
+            except Exception:
+                pass
+        """,
+        True,
+    ),
+    (
+        "loop_no_log_no_reraise",
+        """
+        import asyncio
+        async def f(x):
+            while True:
+                try:
+                    await x()
+                except Exception:
+                    await asyncio.sleep(1)
+        """,
+        True,
+    ),
+    (
+        "bare_with_reraise_ok",
+        """
+        async def f(x):
+            try:
+                await x()
+            except:
+                raise
+        """,
+        False,
+    ),
+    (
+        "cancel_sibling_and_log_ok",
+        """
+        import asyncio, logging
+        logger = logging.getLogger(__name__)
+        async def f(x):
+            while True:
+                try:
+                    await x()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    logger.exception("retry failed")
+        """,
+        False,
+    ),
+    (
+        "broad_before_cancel_reraise_fires",  # handler order matters: the
+        # trailing CancelledError re-raise is unreachable behind BaseException
+        """
+        import asyncio, logging
+        logger = logging.getLogger(__name__)
+        async def f(x):
+            try:
+                await x()
+            except BaseException:
+                logger.exception("boom")
+            except asyncio.CancelledError:
+                raise
+        """,
+        True,
+    ),
+    (
+        "cancel_in_broad_tuple_fires",  # naming CancelledError inside a
+        # broad tuple catches it just like bare except does
+        """
+        import asyncio, logging
+        logger = logging.getLogger(__name__)
+        async def f(x):
+            while True:
+                try:
+                    await x()
+                except (asyncio.CancelledError, Exception):
+                    logger.warning("retrying")
+                    continue
+        """,
+        True,
+    ),
+    (
+        "sync_function_ok",
+        """
+        def f(x):
+            try:
+                x()
+            except Exception:
+                pass
+        """,
+        False,
+    ),
+    (
+        "narrow_ok",
+        """
+        async def f(x):
+            try:
+                await x()
+            except ConnectionError:
+                pass
+        """,
+        False,
+    ),
+]
+
+
+@pytest.mark.parametrize("name,src,expect", SWALLOW_CASES, ids=[c[0] for c in SWALLOW_CASES])
+def test_cancelled_swallow(tmp_path, name, src, expect):
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    fired = "cancelled-swallow" in rules_fired(findings)
+    assert fired == expect, [f.render() for f in findings]
+
+
+def test_cancelled_swallow_suppressed(tmp_path):
+    src = (
+        "async def f(x):\n"
+        "    try:\n"
+        "        await x()\n"
+        "    except Exception:  # dynlint: disable=cancelled-swallow\n"
+        "        pass\n"
+    )
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    assert "cancelled-swallow" not in rules_fired(findings)
+
+
+# -- jit-host-sync ----------------------------------------------------------
+
+def test_jit_host_sync_direct(tmp_path):
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    def step(x):
+        jax.device_get(x)
+        return x * 2
+
+    step_fn = jax.jit(step)
+    """
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    hits = [f for f in findings if f.rule == "jit-host-sync"]
+    assert hits and "step" in hits[0].message
+
+
+def test_jit_host_sync_transitive_same_module(tmp_path):
+    src = """
+    import jax
+
+    def helper(x):
+        return float(x.item())
+
+    def step(x):
+        return helper(x)
+
+    step_fn = jax.jit(step)
+    """
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    assert "jit-host-sync" in rules_fired(findings)
+
+
+def test_jit_host_sync_cross_module(tmp_path):
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/model.py": """
+        import numpy as np
+
+        def attention(x):
+            return np.asarray(x)
+        """,
+        "pkg/engine.py": """
+        import jax
+        from pkg.model import attention
+
+        def step(x):
+            return attention(x)
+
+        step_fn = jax.jit(step)
+        """,
+    }
+    findings = lint_tree(tmp_path, {k: v for k, v in files.items()})
+    hits = [f for f in findings if f.rule == "jit-host-sync"]
+    assert hits, [f.render() for f in findings]
+    assert hits[0].path == "pkg/model.py"
+
+
+def test_jit_host_sync_lambda_root_and_scan_body(tmp_path):
+    src = """
+    import jax
+
+    def builder():
+        def body(carry, _):
+            jax.device_get(carry)
+            return carry, carry
+
+        def step(x):
+            return jax.lax.scan(body, x, None, length=4)
+
+        return jax.jit(step)
+    """
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    hits = [f for f in findings if f.rule == "jit-host-sync"]
+    assert hits and "body" in hits[0].message
+
+
+def test_jit_host_sync_decorator_root(tmp_path):
+    src = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=(1,))
+    def step(x, n):
+        x.block_until_ready()
+        return x
+    """
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    assert "jit-host-sync" in rules_fired(findings)
+
+
+def test_jit_host_sync_cross_module_relative_import(tmp_path):
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/model.py": """
+        import jax
+
+        def attention(x):
+            return jax.device_get(x)
+        """,
+        "pkg/engine.py": """
+        import jax
+        from .model import attention
+
+        def step(x):
+            return attention(x)
+
+        step_fn = jax.jit(step)
+        """,
+    }
+    findings = lint_tree(tmp_path, files)
+    hits = [f for f in findings if f.rule == "jit-host-sync"]
+    assert hits, [f.render() for f in findings]
+    assert hits[0].path == "pkg/model.py"
+
+
+def test_jit_host_sync_method_root(tmp_path):
+    src = """
+    import jax
+
+    class Engine:
+        def __init__(self):
+            self.step = jax.jit(self._step)
+
+        def _step(self, x):
+            return jax.device_get(x)
+    """
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    hits = [f for f in findings if f.rule == "jit-host-sync"]
+    assert hits and "_step" in hits[0].message, [f.render() for f in findings]
+
+
+def test_jit_host_sync_quiet_outside_jit(tmp_path):
+    src = """
+    import jax
+
+    def host_side(x):
+        return jax.device_get(x)
+    """
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    assert "jit-host-sync" not in rules_fired(findings)
+
+
+def test_jit_host_sync_suppressed(tmp_path):
+    src = """
+    import jax
+
+    def step(x):
+        jax.device_get(x)  # dynlint: disable=jit-host-sync
+        return x
+
+    step_fn = jax.jit(step)
+    """
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    assert "jit-host-sync" not in rules_fired(findings)
+
+
+# -- unmarked-host-sync -----------------------------------------------------
+
+def test_unmarked_host_sync_in_engine_module(tmp_path):
+    src = "import jax\ndef fetch(x):\n    return jax.device_get(x)\n"
+    findings = lint_tree(tmp_path, {"engine_jax/engine.py": src})
+    assert "unmarked-host-sync" in rules_fired(findings)
+
+
+def test_marked_host_sync_is_allowed(tmp_path):
+    src = (
+        "import jax\n"
+        "def fetch(x):\n"
+        "    # dynlint: allow-host-sync(leader sync, once per dispatch)\n"
+        "    return jax.device_get(x)\n"
+    )
+    findings = lint_tree(tmp_path, {"engine_jax/engine.py": src})
+    assert "unmarked-host-sync" not in rules_fired(findings)
+
+
+def test_host_sync_outside_hot_modules_not_flagged(tmp_path):
+    src = "import jax\ndef fetch(x):\n    return jax.device_get(x)\n"
+    findings = lint_tree(tmp_path, {"other/module.py": src})
+    assert "unmarked-host-sync" not in rules_fired(findings)
+
+
+# -- import-time-jax-compute ------------------------------------------------
+
+IMPORT_TIME_CASES = [
+    ("module_level_zeros", "import jax.numpy as jnp\nX = jnp.zeros((4,))\n", True),
+    ("module_level_prng", "import jax\nKEY = jax.random.PRNGKey(0)\n", True),
+    ("module_level_devices", "import jax\nN = len(jax.devices())\n", True),
+    ("inside_def_ok", "import jax.numpy as jnp\ndef f():\n    return jnp.zeros((4,))\n", False),
+    ("lambda_ok", "import jax.numpy as jnp\nmake = lambda: jnp.zeros((4,))\n", False),
+    ("dtype_attr_ok", "import jax.numpy as jnp\nDTYPE = jnp.bfloat16\n", False),
+    (
+        "try_guarded_import_still_flagged",
+        "try:\n    import jax.numpy as jnp\nexcept ImportError:\n    jnp = None\n"
+        "X = jnp.zeros((4,))\n",
+        True,
+    ),
+    (
+        "class_body_flagged",
+        "import jax.numpy as jnp\nclass C:\n    X = jnp.ones((2,))\n",
+        True,
+    ),
+]
+
+
+@pytest.mark.parametrize("name,src,expect", IMPORT_TIME_CASES, ids=[c[0] for c in IMPORT_TIME_CASES])
+def test_import_time_jax_compute(tmp_path, name, src, expect):
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    fired = "import-time-jax-compute" in rules_fired(findings)
+    assert fired == expect, [f.render() for f in findings]
+
+
+def test_import_time_suppressed(tmp_path):
+    src = (
+        "import jax.numpy as jnp\n"
+        "X = jnp.zeros((4,))  # dynlint: disable=import-time-jax-compute\n"
+    )
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    assert "import-time-jax-compute" not in rules_fired(findings)
+
+
+# -- endpoint-protocol-drift ------------------------------------------------
+
+REGISTRY = """
+ENDPOINT_PROTOCOLS = {
+    "generate": "proto.common:Request",
+}
+"""
+PROTO = """
+class Request:
+    pass
+"""
+
+
+def test_registered_endpoint_is_clean(tmp_path):
+    findings = lint_tree(
+        tmp_path,
+        {
+            "proto/__init__.py": REGISTRY,
+            "proto/common.py": PROTO,
+            "user.py": "def f(c):\n    return c.endpoint(\"generate\")\n",
+        },
+    )
+    assert "endpoint-protocol-drift" not in rules_fired(findings)
+
+
+def test_unregistered_endpoint_fires(tmp_path):
+    findings = lint_tree(
+        tmp_path,
+        {
+            "proto/__init__.py": REGISTRY,
+            "proto/common.py": PROTO,
+            "user.py": "def f(c):\n    return c.endpoint(\"mystery\")\n",
+        },
+    )
+    hits = [f for f in findings if f.rule == "endpoint-protocol-drift"]
+    assert hits and "mystery" in hits[0].message and hits[0].path == "user.py"
+
+
+def test_registry_pointing_at_missing_symbol_fires(tmp_path):
+    findings = lint_tree(
+        tmp_path,
+        {
+            "proto/__init__.py": (
+                "ENDPOINT_PROTOCOLS = {\n"
+                "    \"generate\": \"proto.common:Deleted\",\n"
+                "}\n"
+            ),
+            "proto/common.py": PROTO,
+            "user.py": "def f(c):\n    return c.endpoint(\"generate\")\n",
+        },
+    )
+    hits = [f for f in findings if f.rule == "endpoint-protocol-drift"]
+    assert hits and "Deleted" in hits[0].message
+
+
+def test_registry_reexported_symbol_is_clean(tmp_path):
+    """A registry entry pointing at a re-export (`from .impl import Req`)
+    must not be reported as drift — the symbol is bound and deserializes."""
+    findings = lint_tree(
+        tmp_path,
+        {
+            "proto/__init__.py": REGISTRY,
+            "proto/common.py": "from proto.impl import Request\n",
+            "proto/impl.py": PROTO,
+            "user.py": "def f(c):\n    return c.endpoint(\"generate\")\n",
+        },
+    )
+    assert "endpoint-protocol-drift" not in rules_fired(findings)
+
+
+def test_no_registry_at_all_fires(tmp_path):
+    findings = lint_tree(
+        tmp_path,
+        {"user.py": "def f(c):\n    return c.endpoint(\"generate\")\n"},
+    )
+    assert "endpoint-protocol-drift" in rules_fired(findings)
+
+
+def test_dynamic_endpoint_names_ignored(tmp_path):
+    findings = lint_tree(
+        tmp_path,
+        {"user.py": "def f(c, name):\n    return c.endpoint(name)\n"},
+    )
+    assert "endpoint-protocol-drift" not in rules_fired(findings)
+
+
+def test_drift_suppressed(tmp_path):
+    findings = lint_tree(
+        tmp_path,
+        {
+            "user.py": (
+                "def f(c):\n"
+                "    return c.endpoint(\"adhoc\")  # dynlint: disable=endpoint-protocol-drift\n"
+            )
+        },
+    )
+    assert "endpoint-protocol-drift" not in rules_fired(findings)
+
+
+def test_cross_file_findings_survive_changed_mode(tmp_path):
+    """In --changed mode (targets ⊂ context), a finding that lands on an
+    UNCHANGED module must still be reported: here the registry (context)
+    points at a protocol deleted by the changed file."""
+    files = {
+        "proto/__init__.py": (
+            "ENDPOINT_PROTOCOLS = {\n"
+            "    \"generate\": \"proto.common:Request\",\n"
+            "}\n"
+        ),
+        "proto/common.py": "class Renamed:\n    pass\n",  # Request deleted
+        "user.py": "def f(c):\n    return c.endpoint(\"generate\")\n",
+    }
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+    findings = analyze_paths(
+        [str(tmp_path / "proto" / "common.py")],  # the "changed" file
+        root=str(tmp_path),
+        context_paths=[str(tmp_path)],
+    )
+    hits = [f for f in findings if f.rule == "endpoint-protocol-drift"]
+    assert hits, [f.render() for f in findings]
+    assert any(f.path == "proto/__init__.py" for f in hits)
+
+
+def test_cross_file_jit_finding_survives_changed_mode(tmp_path):
+    """A host sync in an UNCHANGED helper reached from a changed jit root
+    must be reported even when only the root module is a target."""
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/helper.py": "import jax\ndef aux(x):\n    return jax.device_get(x)\n",
+        "pkg/engine.py": (
+            "import jax\nfrom pkg.helper import aux\n"
+            "def step(x):\n    return aux(x)\n"
+            "step_fn = jax.jit(step)\n"
+        ),
+    }
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+    findings = analyze_paths(
+        [str(tmp_path / "pkg" / "engine.py")],
+        root=str(tmp_path),
+        context_paths=[str(tmp_path)],
+    )
+    hits = [f for f in findings if f.rule == "jit-host-sync"]
+    assert hits and hits[0].path == "pkg/helper.py", [f.render() for f in findings]
+
+
+# -- baseline mechanics -----------------------------------------------------
+
+def test_baseline_is_deterministic_and_sorted(tmp_path):
+    src = "import time\nasync def f():\n    time.sleep(1)\n    time.sleep(2)\n"
+    findings = lint_tree(tmp_path, {"b.py": src, "a.py": src})
+    p1, p2 = tmp_path / "bl1.json", tmp_path / "bl2.json"
+    write_baseline(str(p1), findings)
+    write_baseline(str(p2), list(reversed(findings)))
+    assert p1.read_text() == p2.read_text(), "baseline must not depend on input order"
+    entries = json.loads(p1.read_text())
+    keys = [(e["path"], e["line"], e["rule"], e["message"]) for e in entries]
+    assert keys == sorted(keys)
+    assert all(not os.path.isabs(e["path"]) and "\\" not in e["path"] for e in entries)
+
+
+def test_baseline_multiset_matching(tmp_path):
+    src = "import time\nasync def f():\n    time.sleep(1)\n"
+    findings = lint_tree(tmp_path, {"m.py": src})
+    bl = tmp_path / "bl.json"
+    write_baseline(str(bl), findings)
+    baseline = load_baseline(str(bl))
+    # same findings → all grandfathered
+    new, old = filter_baselined(findings, baseline)
+    assert not new and len(old) == 1
+    # a SECOND identical violation exceeds the baselined count → new
+    src2 = "import time\nasync def f():\n    time.sleep(1)\n    time.sleep(1)\n"
+    findings2 = lint_tree(tmp_path / "v2", {"m.py": src2})
+    new2, old2 = filter_baselined(findings2, baseline)
+    assert len(old2) == 1 and len(new2) == 1
+
+
+def test_cli_single_file_gets_package_context(capsys):
+    """Linting one file must not produce spurious cross-file findings: the
+    registry lives in another module, so the CLI auto-loads the package as
+    context (reproduces the endpoint-protocol-drift false positive)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    target = os.path.join(repo, "dynamo_tpu", "components", "router.py")
+    assert dynlint_main([target]) == 0, capsys.readouterr().out
+
+
+def test_cli_subdirectory_gets_package_context(capsys):
+    """Same for a subdirectory target: components/ uses endpoint('schedule')
+    whose registry lives in kv_router/protocols.py."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    target = os.path.join(repo, "dynamo_tpu", "components")
+    assert dynlint_main([target]) == 0, capsys.readouterr().out
+
+
+def test_cli_write_baseline_rejects_subset(capsys):
+    """--write-baseline over a subset would erase grandfathered entries for
+    the rest of the package; the CLI must refuse."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    target = os.path.join(repo, "dynamo_tpu", "runtime")
+    baseline = os.path.join(repo, "tools", "dynlint_baseline.json")
+    before = open(baseline).read()
+    assert dynlint_main([target, "--write-baseline"]) == 2
+    assert open(baseline).read() == before, "baseline must be untouched"
+    capsys.readouterr()
+
+
+def test_lint_wrapper_rejects_changed_write_baseline(capsys):
+    """--changed + --write-baseline would truncate the baseline to the
+    changed files' findings, erasing grandfathered entries elsewhere."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "tools_lint", os.path.join(repo, "tools", "lint.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["--changed", "--write-baseline"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    pkg = tmp_path / "clean"
+    pkg.mkdir()
+    (pkg / "ok.py").write_text("def f():\n    return 1\n")
+    assert dynlint_main([str(pkg), "--no-baseline"]) == 0
+    bad = tmp_path / "dirty"
+    bad.mkdir()
+    (bad / "bad.py").write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    assert dynlint_main([str(bad), "--no-baseline"]) == 1
+    assert dynlint_main([str(tmp_path / "missing")]) == 2
+    capsys.readouterr()

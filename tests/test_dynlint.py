@@ -1,0 +1,102 @@
+"""Tier-1 enforcement: the whole package must be dynlint-clean.
+
+Runs the analyzer over ``dynamo_tpu/`` and asserts zero non-baselined
+violations, so the async-safety / JAX-dispatch / exception-hygiene /
+protocol-drift invariants hold on every future PR. Also enforces the
+baseline contract: deterministic ordering, relative paths, and
+shrink-only (an entry that no longer matches a real finding is stale and
+must be removed via ``--write-baseline``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from dynamo_tpu.analysis import (
+    analyze_paths,
+    filter_baselined,
+    load_baseline,
+    write_baseline,
+)
+from dynamo_tpu.analysis.baseline import DEFAULT_BASELINE_PATH
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO_ROOT, "dynamo_tpu")
+BASELINE = os.path.join(REPO_ROOT, DEFAULT_BASELINE_PATH)
+
+
+_CACHE = []
+
+
+def _findings():
+    if not _CACHE:
+        _CACHE.append(analyze_paths([PACKAGE], root=REPO_ROOT))
+    return _CACHE[0]
+
+
+def test_package_has_no_new_violations():
+    findings = _findings()
+    new, _old = filter_baselined(findings, load_baseline(BASELINE))
+    assert not new, (
+        "dynlint found new violations (fix them, add a justified "
+        "`# dynlint: disable=<rule>` comment, or — for genuine hot-path "
+        "syncs — a `# dynlint: allow-host-sync(reason)` marker):\n"
+        + "\n".join(f.render() for f in new)
+    )
+
+
+def test_baseline_has_no_stale_entries():
+    """The baseline only ever shrinks: every grandfathered entry must still
+    correspond to a real finding, so fixed debt can't silently linger as a
+    free pass for future regressions."""
+    findings = _findings()
+    baseline = load_baseline(BASELINE)
+    _new, old = filter_baselined(findings, baseline)
+    stale = sum(baseline.values()) - len(old)
+    assert stale == 0, (
+        f"{stale} baseline entr{'y is' if stale == 1 else 'ies are'} stale — "
+        f"regenerate with `python tools/lint.py --write-baseline`"
+    )
+
+
+def test_baseline_file_is_deterministic():
+    assert os.path.exists(BASELINE), "checked-in baseline missing"
+    with open(BASELINE, encoding="utf-8") as f:
+        on_disk = f.read()
+    entries = json.loads(on_disk)
+    keys = [(e["path"], e["line"], e["rule"], e["message"]) for e in entries]
+    assert keys == sorted(keys), "baseline must be sorted by path/line"
+    for e in entries:
+        assert not os.path.isabs(e["path"]), "baseline paths must be relative"
+        assert "\\" not in e["path"], "baseline paths must be POSIX"
+    # round-trip through the writer must be byte-identical
+    tmp = BASELINE + ".roundtrip"
+    try:
+        from dynamo_tpu.analysis.core import Finding
+
+        write_baseline(
+            tmp,
+            [Finding(e["path"], e["line"], e["rule"], e["message"]) for e in entries],
+        )
+        with open(tmp, encoding="utf-8") as f:
+            assert f.read() == on_disk, (
+                "baseline not in canonical form; regenerate with "
+                "`python tools/lint.py --write-baseline`"
+            )
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def test_endpoint_registries_exist():
+    """The protocol-drift rule needs its registries; make their absence a
+    loud failure rather than a silently weaker rule."""
+    from dynamo_tpu.kv_router.protocols import ENDPOINT_PROTOCOLS as KV
+    from dynamo_tpu.llm.protocols import ENDPOINT_PROTOCOLS as LLM
+
+    assert "generate" in LLM and "stats" in LLM
+    assert "schedule" in KV
+    for reg in (LLM, KV):
+        for name, proto in reg.items():
+            assert ":" in proto, f"registry entry {name!r} malformed: {proto!r}"

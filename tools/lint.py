@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Run dynlint over the repo (or just your changes).
+
+    python tools/lint.py                # whole package vs the baseline
+    python tools/lint.py --changed      # only files differing from main
+    python tools/lint.py --write-baseline
+
+``--changed`` is the fast local loop: it lints only tracked .py files that
+differ from ``main`` (plus untracked ones), while still loading the whole
+package as context so cross-file rules (jit reachability, endpoint
+registries) resolve correctly. Everything else is forwarded to the
+dynlint CLI (see ``python -m dynamo_tpu.analysis --help``).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO_ROOT, "dynamo_tpu")
+
+
+def _git(*args: str) -> str:
+    return subprocess.run(
+        ["git", *args], cwd=REPO_ROOT, capture_output=True, text=True, check=True
+    ).stdout
+
+
+def changed_files(base: str = "main") -> list:
+    """Tracked files differing from ``base`` + untracked files, .py only,
+    existing, inside the package."""
+    out = _git("diff", "--name-only", "--diff-filter=d", base, "--", "*.py")
+    out += _git("ls-files", "--others", "--exclude-standard", "--", "*.py")
+    files = []
+    for rel in sorted(set(out.splitlines())):
+        path = os.path.join(REPO_ROOT, rel)
+        if rel.startswith("dynamo_tpu/") and os.path.exists(path):
+            files.append(path)
+    return files
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    sys.path.insert(0, REPO_ROOT)
+    from dynamo_tpu.analysis.cli import main as dynlint_main
+
+    if "--changed" in argv:
+        argv.remove("--changed")
+        if "--write-baseline" in argv:
+            # a baseline written from only the changed files would erase
+            # every grandfathered entry for unchanged files
+            print(
+                "lint: --write-baseline needs the full package; run "
+                "`python tools/lint.py --write-baseline` without --changed",
+                file=sys.stderr,
+            )
+            return 2
+        base = "main"
+        if "--base" in argv:
+            i = argv.index("--base")
+            if i + 1 >= len(argv):
+                print("lint: --base needs a ref argument", file=sys.stderr)
+                return 2
+            base = argv[i + 1]
+            del argv[i : i + 2]
+        try:
+            files = changed_files(base)
+        except subprocess.CalledProcessError as e:
+            print(f"lint: git failed: {e.stderr.strip()}", file=sys.stderr)
+            return 2
+        if not files:
+            print(f"lint: no package files changed vs {base}")
+            return 0
+        return dynlint_main(files + ["--context", PACKAGE] + argv)
+    if not any(not a.startswith("-") for a in argv):
+        argv = [PACKAGE] + argv
+    return dynlint_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
