@@ -296,10 +296,13 @@ async def build_remote_client(out_spec: str, flags: argparse.Namespace):
         card = _load_card(flags)
         pre = OpenAIPreprocessor(card)
         route_token_fn = pre.route_token_ids
+    from ..runtime.resilience import ResiliencePolicy
+
     client = await drt.namespace(ns).component(comp).endpoint(ep).client(
         flags.router_mode,
         kv_block_size=flags.kv_block_size,
         route_token_fn=route_token_fn,
+        policy=ResiliencePolicy.from_env(),
     )
     await client.wait_for_instances(1, timeout=flags.wait_workers_timeout)
     return client, drt

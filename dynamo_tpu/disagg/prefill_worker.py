@@ -215,14 +215,25 @@ def _validate_pages(req, k) -> None:
         )
 
 
-async def run_prefill_worker(runtime, namespace: str, engine: PrefillEngine) -> None:
+async def run_prefill_worker(
+    runtime, namespace: str, engine: PrefillEngine, policy=None
+) -> None:
     """Pop → prefill → ship, forever. Multiple prefill workers share the
     queue; within one worker, up to the engine's slot count of requests run
-    concurrently (they batch into shared chunk dispatches)."""
+    concurrently (they batch into shared chunk dispatches).
+
+    ``policy`` (a :class:`~dynamo_tpu.runtime.resilience.ResiliencePolicy`,
+    env-derived by default) drives the retry/backoff behavior of the two
+    network interactions on this path: resolving the decode worker's
+    transfer address (which races re-registration after lease loss) and
+    shipping the computed pages (which can hit a decode worker mid-bounce)."""
     if runtime.bus is None:
         raise RuntimeError("prefill worker needs the message bus")
     from dynamo_tpu.disagg.device_transfer import make_device_plane
+    from dynamo_tpu.runtime.resilience import ResiliencePolicy
 
+    policy = policy or ResiliencePolicy.from_env()
+    backoff_rng = policy.rng()
     client = KvTransferClient(device_plane=make_device_plane())
     addr_cache: Dict[str, str] = {}
     queue = f"{namespace}.{PREFILL_QUEUE}"
@@ -245,9 +256,12 @@ async def run_prefill_worker(runtime, namespace: str, engine: PrefillEngine) -> 
             if addr is None:
                 key = f"{namespace}/{TRANSFER_KEY_PREFIX}{req.engine_id}"
                 raw_addr = None
-                for delay in (0, 0.2, 0.5, 1.0):  # brief re-registration races
-                    if delay:
-                        await asyncio.sleep(delay)
+                # re-registration races: exponential backoff, with enough
+                # attempts that the cumulative wait (~3s at defaults) covers
+                # a lease-loss re-registration window
+                for attempt in range(max(policy.max_attempts, 6) + 1):
+                    if attempt:
+                        await asyncio.sleep(policy.backoff(attempt, backoff_rng))
                     raw_addr = await runtime.store.get(key)
                     if raw_addr is not None:
                         break
@@ -308,9 +322,40 @@ async def run_prefill_worker(runtime, namespace: str, engine: PrefillEngine) -> 
                 prefix_kv=prefix_kv, as_device=local_engine is not None,
             )
             _validate_pages(req, k)
-            await transfer.send_blocks(
-                addr, req.request_id, tok, req.block_ids, k, v
-            )
+            # the decode worker can be mid-bounce exactly when the pages are
+            # ready: retry transport failures within the policy budget,
+            # RE-RESOLVING the transfer address each time — a restarted
+            # decode worker re-registers on a fresh ephemeral port, so
+            # redialing the stale address could never succeed
+            for attempt in range(1, policy.max_attempts + 1):
+                try:
+                    await transfer.send_blocks(
+                        addr, req.request_id, tok, req.block_ids, k, v
+                    )
+                    break
+                except (ConnectionError, OSError, asyncio.IncompleteReadError):
+                    # IncompleteReadError too: a decode worker that closes
+                    # gracefully between our write and the ack read raises a
+                    # clean EOF (EOFError, not OSError) — same mid-bounce
+                    # case this retry exists for. send_blocks already
+                    # evicted its own failed conn (identity-guarded); here
+                    # we only invalidate the address mapping so the retry
+                    # can re-resolve it
+                    addr_cache.pop(req.engine_id, None)
+                    if attempt >= policy.max_attempts:
+                        raise
+                    logger.warning(
+                        "send_blocks to %s failed (attempt %d/%d); retrying",
+                        addr, attempt, policy.max_attempts,
+                    )
+                    await asyncio.sleep(policy.backoff(attempt, backoff_rng))
+                    if local_engine is None:
+                        fresh = await runtime.store.get(
+                            f"{namespace}/{TRANSFER_KEY_PREFIX}{req.engine_id}"
+                        )
+                        if fresh is not None:
+                            addr = fresh.decode()
+                            addr_cache[req.engine_id] = addr
             logger.info(
                 "prefilled %s%s (%d tokens, computed %d → %d pages)",
                 req.request_id,

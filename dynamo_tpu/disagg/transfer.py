@@ -235,11 +235,28 @@ class KvTransferClient:
         c = self._conns.get(address)
         if c is None or c[1].is_closing():
             host, _, port = address.rpartition(":")
-            reader, writer = await asyncio.open_connection(host or "127.0.0.1", int(port))
+            from dynamo_tpu.runtime import faults
+
+            reader, writer = await faults.open_connection(
+                host or "127.0.0.1", int(port), plane="transfer"
+            )
             c = (reader, writer)
             self._conns[address] = c
             self._locks[address] = asyncio.Lock()
         return c
+
+    def evict(self, address: str, writer=None) -> None:
+        """Drop the pooled connection to ``address`` (after a transport
+        failure) so the next call dials fresh. With ``writer`` given, only
+        evicts if the pool still holds *that* connection — a late-failing
+        task must not close a fresh conn a concurrent task already dialed.
+        The per-address lock is retained on purpose: swapping it mid-flight
+        would let two tasks interleave frames on one stream."""
+        c = self._conns.get(address)
+        if c is None or (writer is not None and c[1] is not writer):
+            return
+        del self._conns[address]
+        c[1].close()
 
     def _use_dev(self, address: str) -> bool:
         return self.device_plane is not None and self._dev_peers.get(address, True)
@@ -273,11 +290,17 @@ class KvTransferClient:
             "shape": list(k.shape),
             "k_bytes": len(k_raw),
         }
-        async with self._locks[address]:
-            await write_frame(
-                writer, TwoPartMessage(json.dumps(header).encode(), k_raw + v_raw)
-            )
-            await read_frame(reader)  # ack
+        try:
+            async with self._locks[address]:
+                await write_frame(
+                    writer, TwoPartMessage(json.dumps(header).encode(), k_raw + v_raw)
+                )
+                await read_frame(reader)  # ack
+        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            # evict exactly the conn that failed (identity-guarded), so
+            # retries dial fresh without racing concurrent senders
+            self.evict(address, writer)
+            raise
 
     async def _send_blocks_dev(
         self, address, request_id, first_token, block_ids, k, v

@@ -35,12 +35,19 @@ class ModelWatcher:
         manager: ModelManager,
         router_mode: str = "round_robin",
         kv_block_size: int = 16,
+        policy=None,
     ):
+        from dynamo_tpu.runtime.resilience import ResiliencePolicy
+
         self.drt = drt
         self.namespace = namespace
         self.manager = manager
         self.router_mode = router_mode
         self.kv_block_size = kv_block_size
+        # one resilience policy shared by every discovered model's client;
+        # defaults come from the environment so operators can tune the
+        # frontend's failover/deadline behavior without code changes
+        self.policy = policy or ResiliencePolicy.from_env()
         # entries are per-worker-instance ({kind}/{name}:{instance}); a model
         # is served by ONE client per (kind, name) and removed only when its
         # last entry disappears
@@ -162,7 +169,8 @@ class ModelWatcher:
             ns, comp, ep = parse_endpoint_path(endpoint_path)
             client = await (
                 self.drt.namespace(ns).component(comp).endpoint(ep).client(
-                    self.router_mode, kv_block_size=self.kv_block_size
+                    self.router_mode, kv_block_size=self.kv_block_size,
+                    policy=self.policy,
                 )
             )
         except (ValueError, KeyError):

@@ -21,6 +21,12 @@ from aiohttp import web
 
 from ...runtime.annotated import Annotated
 from ...runtime.engine import AsyncEngine, Context
+from ...runtime.resilience import (
+    DEADLINE_ERROR,
+    AllInstancesFailed,
+    DeadlineExceeded,
+    NoHealthyInstances,
+)
 from ..protocols.openai import (
     ChatCompletionRequest,
     CompletionRequest,
@@ -188,7 +194,7 @@ class HttpService:
 
         with guard:
             if streaming:
-                return await self._stream_response(request, engine, ctx, guard)
+                return await self._stream_response(request, engine, ctx, guard, chat)
             return await self._unary_response(engine, ctx, guard, chat)
 
     async def _stream_response(
@@ -197,6 +203,7 @@ class HttpService:
         engine: AsyncEngine,
         ctx: Context,
         guard,
+        chat: bool,
     ) -> web.StreamResponse:
         # pull the first item BEFORE sending headers, so validation errors
         # (e.g. over-length prompts) still surface as proper HTTP status codes
@@ -210,6 +217,19 @@ class HttpService:
             first_item = None
         except HttpError as e:
             return _error_response(e.status, e.message)
+        except DeadlineExceeded as e:
+            return _error_response(504, str(e) or DEADLINE_ERROR)
+        except (NoHealthyInstances, AllInstancesFailed, ConnectionError, OSError) as e:
+            return _error_response(502, f"upstream failure: {e}")
+
+        # an upstream that failed before producing anything is an HTTP error,
+        # not a 200 stream carrying an error payload
+        if (
+            isinstance(first_item, Annotated)
+            and first_item.is_error
+        ):
+            msg = first_item.error_message() or "upstream failure"
+            return _error_response(_upstream_status(msg), msg)
 
         resp = web.StreamResponse(
             status=200,
@@ -228,12 +248,17 @@ class HttpService:
                 yield i
 
         tmpl = _SseTemplate()
+        envelope: Optional[dict] = None  # id/object/created/model of the stream
         try:
             async for item in _rest():
                 if isinstance(item, Annotated):
                     if item.is_error:
+                        # headers already sent: error goes in-band, followed
+                        # by a WELL-FORMED final chunk (finish_reason
+                        # "error") + [DONE] so clients aren't left dangling
                         msg = SseMessage(event="error", data=json.dumps({"message": item.error_message()}))
                         await resp.write((msg.encode() + "\n\n").encode())
+                        await _write_error_finish(resp, envelope, chat)
                         break
                     if item.data is None:
                         # annotation/comment event
@@ -242,6 +267,12 @@ class HttpService:
                     payload = item.data
                 else:
                     payload = item
+                if isinstance(payload, dict) and envelope is None:
+                    envelope = {
+                        k: payload[k]
+                        for k in ("id", "object", "created", "model")
+                        if k in payload
+                    }
                 if _chunk_has_content(payload):
                     guard.mark_first_token()
                     guard.count_tokens()
@@ -264,6 +295,7 @@ class HttpService:
             msg = SseMessage(event="error", data=json.dumps({"message": str(e)}))
             with contextlib.suppress(ConnectionError):
                 await resp.write((msg.encode() + "\n\n").encode())
+                await _write_error_finish(resp, envelope, chat)
                 await resp.write(f"data: {DONE_SENTINEL}\n\n".encode())
         finally:
             with contextlib.suppress(ConnectionError):
@@ -279,7 +311,12 @@ class HttpService:
             async for item in engine.generate(ctx):
                 if isinstance(item, Annotated):
                     if item.is_error:
-                        return _error_response(500, item.error_message() or "engine error")
+                        msg = item.error_message() or "engine error"
+                        if not chunks:
+                            # upstream failed before producing anything:
+                            # 502/504, not a generic server error
+                            return _error_response(_upstream_status(msg), msg)
+                        return _error_response(500, msg)
                     if item.data is None:
                         continue
                     chunks.append(item.data)
@@ -290,6 +327,10 @@ class HttpService:
                     n_tokens += 1
         except HttpError as e:
             return _error_response(e.status, e.message)
+        except DeadlineExceeded as e:
+            return _error_response(504, str(e) or DEADLINE_ERROR)
+        except (NoHealthyInstances, AllInstancesFailed, ConnectionError, OSError) as e:
+            return _error_response(502, f"upstream failure: {e}")
         if not chunks:
             return _error_response(500, "engine produced no response")
         full = aggregate_chat_chunks(chunks) if chat else aggregate_completion_chunks(chunks)
@@ -435,6 +476,27 @@ class _SseTemplate:
             self.key = key
         # token text goes through the same escaping rules as dumps
         return self.prefix + json.dumps(tok)[1:-1].encode() + self.suffix
+
+
+def _upstream_status(message: str) -> int:
+    """Pre-first-token upstream failures: 504 when the request's deadline
+    expired (the canonical message prefix crosses process boundaries in the
+    error envelope), 502 for everything else upstream."""
+    return 504 if message.startswith(DEADLINE_ERROR) else 502
+
+
+async def _write_error_finish(resp: web.StreamResponse, envelope: Optional[dict],
+                              chat: bool) -> None:
+    """Emit a well-formed final SSE chunk with ``finish_reason: "error"`` so
+    streaming clients see a terminated choice instead of a dangling stream."""
+    chunk: dict = dict(envelope or {})
+    choice: dict = {"index": 0, "finish_reason": "error"}
+    if chat:
+        choice["delta"] = {}
+    else:
+        choice["text"] = ""
+    chunk["choices"] = [choice]
+    await resp.write((f"data: {json.dumps(chunk)}\n\n").encode())
 
 
 def _error_response(status: int, message: str) -> web.Response:

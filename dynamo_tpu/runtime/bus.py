@@ -38,6 +38,7 @@ import uuid
 from collections import deque
 from typing import AsyncIterator, Deque, Dict, List, Optional, Tuple
 
+from dynamo_tpu.runtime import faults
 from dynamo_tpu.runtime.codec import TwoPartMessage, read_frame, write_frame
 
 logger = logging.getLogger(__name__)
@@ -591,7 +592,7 @@ class MessageBusClient:
     async def connect(cls, url: str, reconnect: bool = True) -> "MessageBusClient":
         host, _, port = url.rpartition(":")
         c = cls(host or "127.0.0.1", int(port), reconnect=reconnect)
-        c._reader, c._writer = await asyncio.open_connection(c.host, c.port)
+        c._reader, c._writer = await faults.open_connection(c.host, c.port, plane="bus")
         c._reader_task = asyncio.create_task(c._read_loop())
         return c
 
@@ -616,8 +617,8 @@ class MessageBusClient:
         delay = 0.05
         while not self._closed:
             try:
-                self._reader, self._writer = await asyncio.open_connection(
-                    self.host, self.port
+                self._reader, self._writer = await faults.open_connection(
+                    self.host, self.port, plane="bus"
                 )
             except OSError:
                 await asyncio.sleep(delay)

@@ -30,6 +30,17 @@ from typing import Any, AsyncIterator, Callable, Dict, List, Optional
 from dynamo_tpu.runtime.annotated import Annotated
 from dynamo_tpu.runtime.bus import MessageBusClient
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.resilience import (
+    DEADLINE_ERROR,
+    AllInstancesFailed,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    NoHealthyInstances,
+    ResiliencePolicy,
+    RetryableRpcError,
+    WorkerStalled,
+)
 from dynamo_tpu.runtime.rpc import RpcClient, RpcServer
 from dynamo_tpu.runtime.statestore import Lease, StateStoreClient, WatchEvent
 
@@ -362,6 +373,7 @@ class EndpointClient(AsyncEngine):
         mode: str = "random",
         kv_block_size: int = 16,
         route_token_fn: Optional[Callable[[dict], Optional[List[int]]]] = None,
+        policy: Optional[ResiliencePolicy] = None,
     ):
         self.endpoint = endpoint
         self.mode = mode
@@ -369,6 +381,15 @@ class EndpointClient(AsyncEngine):
         # kv mode: derives token_ids from requests that don't carry them
         # (e.g. raw OpenAI dicts at a frontend) so prefix routing still works
         self.route_token_fn = route_token_fn
+        self.policy = policy or ResiliencePolicy()
+        self._breaker = CircuitBreaker(
+            threshold=self.policy.breaker_threshold,
+            cooldown=self.policy.breaker_cooldown,
+            half_open_probes=self.policy.breaker_half_open_probes,
+        )
+        self._retry_rng = self.policy.rng()
+        # observability: how often the resilience layer actually worked
+        self.stats = {"failures": 0, "failovers": 0, "deadline_expired": 0}
         self._instances: Dict[str, InstanceInfo] = {}
         # stable worker_id → live instance_id: KV events/metrics are keyed by
         # worker_id (which survives lease loss), instances come and go
@@ -424,6 +445,7 @@ class EndpointClient(AsyncEngine):
                     self._ready.set()
                 else:
                     gone = self._instances.pop(iid, None)
+                    self._breaker.forget(iid)
                     conn = self._conns.pop(iid, None)
                     if conn is not None:
                         await conn.close()
@@ -456,6 +478,13 @@ class EndpointClient(AsyncEngine):
                     # connections (the delete-event path closes these; without
                     # it they'd leak across outages) — live workers repopulate
                     # from the snapshot + future events and re-dial lazily.
+                    # breaker state survives the resync: instances that are
+                    # still live (and possibly still failing) must not get a
+                    # clean slate from a statestore blip. Slots for instances
+                    # that vanished BEFORE this outage are pruned here;
+                    # current ones linger at most until the next resync
+                    # (delete events handle the common case).
+                    self._breaker.prune(self._instances)
                     self._instances.clear()
                     if self._router is not None:
                         for wid in self._by_worker:
@@ -520,17 +549,29 @@ class EndpointClient(AsyncEngine):
     def instance_ids(self) -> List[str]:
         return sorted(self._instances)
 
-    def _pick(self, request: Any) -> str:
+    def _pick(self, request: Any, exclude: frozenset = frozenset()) -> str:
         ids = sorted(self._instances)
         if not ids:
-            raise RuntimeError(f"no live instances for {self.endpoint.path}")
+            raise NoHealthyInstances(f"no live instances for {self.endpoint.path}")
         if self.mode.startswith("direct:"):
             want = self.mode.split(":", 1)[1]
             if want not in self._instances:
                 raise RuntimeError(f"instance {want} not live")
             return want
+        candidates = [i for i in ids if i not in exclude]
+        if not candidates:
+            raise NoHealthyInstances(
+                f"all {len(ids)} live instance(s) of {self.endpoint.path} "
+                f"failed this request"
+            )
+        # breaker-aware: skip open/exhausted instances, but if EVERY
+        # candidate is ejected, fall back to the full candidate set — a
+        # last-ditch try beats a guaranteed failure
+        healthy = [i for i in candidates if self._breaker.available(i)]
+        if healthy:
+            candidates = healthy
         if self.mode == "random":
-            return random.choice(ids)
+            return random.choice(candidates)
         if self.mode == "kv" and self._router is not None:
             token_ids = None
             if isinstance(request, dict):
@@ -546,7 +587,7 @@ class EndpointClient(AsyncEngine):
                 decision = self._router.schedule(token_ids)
                 if decision is not None:
                     iid = self._by_worker.get(decision.worker_id)
-                    if iid in self._instances:
+                    if iid in candidates:
                         return iid
             elif not self._warned_no_tokens:
                 self._warned_no_tokens = True
@@ -556,26 +597,160 @@ class EndpointClient(AsyncEngine):
                     "--model-path to the frontend to enable prefix routing)"
                 )
         # round_robin fallback
-        self._rr = (self._rr + 1) % len(ids)
-        return ids[self._rr]
+        self._rr = (self._rr + 1) % len(candidates)
+        return candidates[self._rr]
 
-    async def _conn(self, iid: str) -> RpcClient:
+    async def _conn(self, iid: str, timeout: Optional[float] = None) -> RpcClient:
         conn = self._conns.get(iid)
         if conn is None or conn.closed:
-            conn = await RpcClient.connect(self._instances[iid].address)
+            conn = await RpcClient.connect(self._instances[iid].address, timeout=timeout)
             self._conns[iid] = conn
         return conn
 
+    async def _evict_conn(self, iid: str, conn: Optional[RpcClient]) -> None:
+        """Drop ``conn`` from the pool — only if the pool still holds that
+        exact connection. A slower failure handler must never close a fresh
+        healthy conn that a concurrent request already re-dialed (its
+        close() would error every in-flight stream on it)."""
+        if conn is None or self._conns.get(iid) is not conn:
+            return
+        del self._conns[iid]
+        try:
+            await conn.close()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.debug("closing failed worker conn", exc_info=True)
+
     async def generate(self, request: Context) -> AsyncIterator[Annotated]:
+        """Route one request, absorbing worker churn.
+
+        Pre-first-token, transport failures (refused dial, reset, stall,
+        draining worker) fail over to the next instance within the policy's
+        retry budget and deadline; repeatedly-failing instances are ejected
+        by the circuit breaker until a half-open probe readmits them. After
+        the first item reaches the caller the request is pinned — later
+        failures surface in-band as error envelopes, and the total deadline
+        keeps bounding the stream.
+        """
         payload = request.data
         if hasattr(payload, "to_dict"):
             payload = payload.to_dict()
         elif hasattr(payload, "model_dump"):
             payload = payload.model_dump(exclude_none=True)
-        iid = self._pick(payload)
-        conn = await self._conn(iid)
-        async for item in conn.generate(self.endpoint.rpc_name, payload, context=request):
-            yield item
+        policy = self.policy
+        deadline = Deadline.after(policy.request_timeout)
+        tried: set = set()
+        attempt = 0
+        last_err: Optional[BaseException] = None
+        while True:
+            if deadline.expired:
+                self.stats["deadline_expired"] += 1
+                raise DeadlineExceeded(
+                    f"{DEADLINE_ERROR}: request budget "
+                    f"({policy.request_timeout:.1f}s) spent after "
+                    f"{attempt} attempt(s)"
+                ) from last_err
+            try:
+                iid = self._pick(payload, exclude=frozenset(tried))
+            except NoHealthyInstances:
+                if not tried:
+                    raise
+                # every live instance failed once this request: widen back
+                # to the full set for whatever budget remains
+                tried.clear()
+                iid = self._pick(payload)
+            self._breaker.acquire(iid)
+            # exactly-once breaker resolution: every exit that calls neither
+            # record_success nor record_failure (deadline expiry, abandoned
+            # generator, application-error first item, unexpected raise)
+            # must release the half-open probe slot, or the instance stays
+            # ejected forever
+            resolved = False
+            first_seen = False
+            conn: Optional[RpcClient] = None
+            try:
+                try:
+                    conn = await self._conn(
+                        iid, timeout=deadline.bound(policy.connect_timeout)
+                    )
+                except KeyError:
+                    raise RetryableRpcError(
+                        f"instance {iid} left the live set"
+                    ) from None
+                async for item in conn.generate(
+                    self.endpoint.rpc_name,
+                    payload,
+                    context=request,
+                    deadline=deadline,
+                    inter_item_timeout=policy.inter_item_timeout,
+                    raise_transport=True,
+                ):
+                    if not first_seen:
+                        first_seen = True
+                        if not item.is_error:
+                            self._breaker.record_success(iid)
+                            resolved = True
+                    yield item
+                if not first_seen:
+                    self._breaker.record_success(iid)  # clean empty stream
+                    resolved = True
+                return
+            except asyncio.CancelledError:
+                raise
+            except DeadlineExceeded as e:
+                # budget spent — not the instance's fault, no breaker penalty
+                self.stats["deadline_expired"] += 1
+                if first_seen:
+                    yield Annotated.from_error(str(e))
+                    return
+                raise
+            except (ConnectionError, OSError) as e:
+                if deadline.expired and not first_seen:
+                    # the dial/read was cut by the request budget running
+                    # out, not by the worker misbehaving: classify as
+                    # deadline expiry — no breaker penalty for a healthy
+                    # instance that merely got a ~0s connect window
+                    self.stats["deadline_expired"] += 1
+                    raise DeadlineExceeded(
+                        f"{DEADLINE_ERROR}: request budget "
+                        f"({policy.request_timeout:.1f}s) spent after "
+                        f"{attempt + 1} attempt(s)"
+                    ) from e
+                # refused/timed-out dial, reset, stall, draining worker
+                self._breaker.record_failure(iid)
+                resolved = True
+                self.stats["failures"] += 1
+                if not isinstance(e, (RetryableRpcError, WorkerStalled)):
+                    # the transport itself failed: drop the pooled conn so
+                    # the next attempt (or request) dials fresh. NOT on a
+                    # stall or a retryable rejection — there the multiplexed
+                    # connection itself is healthy, and closing it would
+                    # kill every other in-flight stream to that worker.
+                    # Identity-guarded: only this attempt's conn is evicted
+                    await self._evict_conn(iid, conn)
+                if first_seen:
+                    # tokens already delivered: failover would duplicate
+                    # them — surface the break in-band instead
+                    yield Annotated.from_error(
+                        f"connection to worker lost mid-stream: {e}"
+                    )
+                    return
+                tried.add(iid)
+                attempt += 1
+                last_err = e
+                if attempt >= policy.max_attempts:
+                    raise AllInstancesFailed(
+                        f"request failed on {len(tried)} instance(s) after "
+                        f"{attempt} attempt(s): {e}"
+                    ) from e
+                self.stats["failovers"] += 1
+                delay = deadline.bound(policy.backoff(attempt, self._retry_rng))
+                if delay:
+                    await asyncio.sleep(delay)
+            finally:
+                if not resolved:
+                    self._breaker.release(iid)
 
     async def close(self) -> None:
         self._closed = True

@@ -1,0 +1,364 @@
+"""Deterministic fault injection for the distributed planes.
+
+Every dial in the runtime (statestore, message bus, RPC, KV transfer) goes
+through :func:`open_connection` below. With no injector installed the
+returned stream proxies cost one None-check per op; with one installed,
+connects and per-frame reads/writes consult the injector's rule set and can
+
+- **refuse**  — the dial raises ``ConnectionRefusedError`` (dead worker,
+  statestore outage);
+- **delay**   — the op completes after ``delay`` seconds (slow network,
+  delayed watch events);
+- **reset**   — the op raises ``ConnectionResetError`` (half-open
+  connection, mid-stream worker death);
+- **stall**   — the op blocks until :meth:`FaultInjector.release_stalls`
+  (wedged worker; released stalls then surface as resets, like a half-open
+  TCP connection finally dying).
+
+Determinism: rule matching is positional (per-plane/addr op counters), and
+any probabilistic rules draw from one seeded RNG — the same op sequence
+under the same seed yields the same fault schedule. Tests assert recovery
+behavior (failover, breaker trips, deadline expiry, re-registration)
+without hand-rolled socket tricks, and chaos runs are replayable from the
+seed alone.
+
+Activation:
+
+- programmatic: ``with faults.active(FaultInjector(rules, seed=42)): ...``
+  (or ``install()``/``uninstall()`` for non-scoped use);
+- environment:  ``DYN_TPU_FAULTS='[{"plane": "rpc", "action": "refuse"}]'``
+  plus optional ``DYN_TPU_FAULT_SEED`` — parsed on first dial, so operator
+  chaos drills need no code changes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+import os
+import random
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+PLANES = ("statestore", "bus", "rpc", "transfer")
+ACTIONS = ("refuse", "delay", "reset", "stall")
+POINTS = ("connect", "read", "write")
+
+
+@dataclass
+class FaultRule:
+    """One fault to inject. Matching is AND across the fields:
+
+    ``plane``       which transport ("statestore" | "bus" | "rpc" |
+                    "transfer" | "*").
+    ``point``       where it fires: "connect" (per dial), "read"/"write"
+                    (per frame on an established connection).
+    ``action``      refuse | delay | reset | stall (refuse only makes sense
+                    at connect; reset/delay/stall anywhere).
+    ``match_addr``  exact "host:port" (None = any address).
+    ``after_ops``   skip the first N matching ops (per plane+addr counter
+                    for connects, per connection for reads/writes).
+    ``max_fires``   total firings across the injector (None = unlimited).
+    ``probability`` chance to fire when otherwise matching; draws from the
+                    injector's seeded RNG (1.0 = always, deterministic).
+    ``delay``       seconds, for action="delay".
+    """
+
+    plane: str = "*"
+    point: str = "connect"
+    action: str = "refuse"
+    match_addr: Optional[str] = None
+    after_ops: int = 0
+    max_fires: Optional[int] = None
+    probability: float = 1.0
+    delay: float = 0.0
+    fired: int = field(default=0, compare=False)
+
+    def matches(self, plane: str, addr: str, point: str, op_index: int) -> bool:
+        if self.point != point:
+            return False
+        if self.plane != "*" and self.plane != plane:
+            return False
+        if self.match_addr is not None and self.match_addr != addr:
+            return False
+        if op_index < self.after_ops:
+            return False
+        if self.max_fires is not None and self.fired >= self.max_fires:
+            return False
+        return True
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultRule":
+        known = {k: d[k] for k in (
+            "plane", "point", "action", "match_addr", "after_ops",
+            "max_fires", "probability", "delay",
+        ) if k in d}
+        return cls(**known)
+
+
+@dataclass
+class FaultDecision:
+    plane: str
+    addr: str
+    point: str
+    op_index: int
+    action: str
+
+
+class FaultInjector:
+    """Holds the rule set, the seeded RNG, and the decision log.
+
+    The decision log records every fired fault in order — a chaos test that
+    fails can print it (plus the seed) so the exact schedule is replayable.
+    """
+
+    def __init__(self, rules: Optional[List[FaultRule]] = None, seed: int = 0):
+        self.rules: List[FaultRule] = list(rules or [])
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.log: List[FaultDecision] = []
+        self._connect_ops: Dict[Tuple[str, str], int] = {}
+        self._stall_release = asyncio.Event()
+
+    def add_rule(self, rule: FaultRule) -> FaultRule:
+        self.rules.append(rule)
+        return rule
+
+    def remove_rule(self, rule: FaultRule) -> None:
+        with contextlib.suppress(ValueError):
+            self.rules.remove(rule)
+
+    def clear_rules(self) -> None:
+        self.rules.clear()
+        self.release_stalls()
+
+    def release_stalls(self) -> None:
+        """Wake every stalled op; each then raises ConnectionResetError
+        (a wedged connection that finally dies, not one that recovers)."""
+        self._stall_release.set()
+        self._stall_release = asyncio.Event()
+
+    # -- decision core -----------------------------------------------------
+
+    def decide(self, plane: str, addr: str, point: str, op_index: int
+               ) -> Optional[FaultRule]:
+        for rule in self.rules:
+            if not rule.matches(plane, addr, point, op_index):
+                continue
+            if rule.probability < 1.0 and self.rng.random() >= rule.probability:
+                continue
+            rule.fired += 1
+            self.log.append(FaultDecision(plane, addr, point, op_index, rule.action))
+            return rule
+        return None
+
+    async def _apply(self, rule: FaultRule, what: str) -> None:
+        if rule.action == "delay":
+            await asyncio.sleep(rule.delay)
+            return
+        if rule.action == "reset":
+            raise ConnectionResetError(f"injected reset ({what})")
+        if rule.action == "stall":
+            release = self._stall_release
+            await release.wait()
+            raise ConnectionResetError(f"injected stall released ({what})")
+        if rule.action == "refuse":
+            raise ConnectionRefusedError(f"injected refusal ({what})")
+        raise ValueError(f"unknown fault action {rule.action!r}")
+
+    # -- connection faulting ----------------------------------------------
+
+    async def before_connect(self, plane: str, addr: str) -> None:
+        key = (plane, addr)
+        op = self._connect_ops.get(key, 0)
+        self._connect_ops[key] = op + 1
+        rule = self.decide(plane, addr, "connect", op)
+        if rule is not None:
+            await self._apply(rule, f"connect {plane} {addr}")
+
+
+class _ConnFaults:
+    """Per-connection read/write op counters + rule application.
+
+    Consults the *currently installed* injector on every op — not the one
+    (if any) active at dial time — so an injector installed mid-run can
+    break live connections, exactly like a real outage would. With no
+    injector installed this is a None-check fast path.
+    """
+
+    __slots__ = ("plane", "addr", "reads", "writes", "broken")
+
+    def __init__(self, plane: str, addr: str):
+        self.plane = plane
+        self.addr = addr
+        self.reads = 0
+        self.writes = 0
+        self.broken = False
+
+    def check_broken(self) -> None:
+        if self.broken:
+            raise ConnectionResetError(
+                f"injected: connection already broken ({self.plane} {self.addr})"
+            )
+
+    async def before(self, point: str) -> None:
+        injector = _active
+        if injector is None:  # callers pre-check, but keep this guard too
+            return
+        self.check_broken()
+        op = self.reads if point == "read" else self.writes
+        if point == "read":
+            self.reads += 1
+        else:
+            self.writes += 1
+        rule = injector.decide(self.plane, self.addr, point, op)
+        if rule is not None:
+            try:
+                await injector._apply(
+                    rule, f"{point} {self.plane} {self.addr}"
+                )
+            except ConnectionError:
+                self.broken = True
+                raise
+
+
+class _FaultyReader:
+    """StreamReader proxy consulting the injector on every read call. The
+    framed codec issues up to three reads per frame (prelude, header,
+    body), so ``after_ops`` on read rules counts read *calls*, not frames —
+    deterministic either way, since the call sequence is fixed per frame."""
+
+    def __init__(self, inner: asyncio.StreamReader, state: _ConnFaults):
+        self._inner = inner
+        self._state = state
+
+    async def readexactly(self, n: int) -> bytes:
+        # None-check inline, not inside before(): the inactive fast path
+        # must not even allocate the before() coroutine per frame read
+        if _active is not None:
+            await self._state.before("read")
+        return await self._inner.readexactly(n)
+
+    async def read(self, n: int = -1) -> bytes:
+        if _active is not None:
+            await self._state.before("read")
+        return await self._inner.read(n)
+
+    async def readline(self) -> bytes:
+        if _active is not None:
+            await self._state.before("read")
+        return await self._inner.readline()
+
+    def at_eof(self) -> bool:
+        return self._inner.at_eof()
+
+
+class _FaultyWriter:
+    """StreamWriter proxy; write faults fire in drain() (every frame write
+    in this codebase is a write()+drain() pair)."""
+
+    def __init__(self, inner: asyncio.StreamWriter, state: _ConnFaults):
+        self._inner = inner
+        self._state = state
+
+    def write(self, data: bytes) -> None:
+        if _active is not None:
+            # a broken connection swallows nothing: fail the write itself
+            self._state.check_broken()
+        self._inner.write(data)
+
+    async def drain(self) -> None:
+        if _active is not None:
+            await self._state.before("write")
+        await self._inner.drain()
+
+    def close(self) -> None:
+        self._inner.close()
+
+    def is_closing(self) -> bool:
+        return self._inner.is_closing()
+
+    async def wait_closed(self) -> None:
+        await self._inner.wait_closed()
+
+    def get_extra_info(self, name: str, default=None):
+        return self._inner.get_extra_info(name, default)
+
+
+# =========================================================================
+# activation
+# =========================================================================
+
+_active: Optional[FaultInjector] = None
+_env_checked = False
+
+
+def install(injector: FaultInjector) -> None:
+    global _active
+    _active = injector
+
+
+def uninstall() -> None:
+    global _active
+    if _active is not None:
+        _active.release_stalls()
+    _active = None
+
+
+@contextlib.contextmanager
+def active(injector: FaultInjector) -> Iterator[FaultInjector]:
+    """Scope an injector over a block; always uninstalled on exit."""
+    install(injector)
+    try:
+        yield injector
+    finally:
+        uninstall()
+
+
+def current() -> Optional[FaultInjector]:
+    """The active injector, if any; checks the environment once."""
+    global _active, _env_checked
+    if _active is None and not _env_checked:
+        _env_checked = True
+        spec = os.environ.get("DYN_TPU_FAULTS")
+        if spec:
+            try:
+                _active = injector_from_spec(
+                    spec, seed=int(os.environ.get("DYN_TPU_FAULT_SEED", "0"))
+                )
+                logger.warning(
+                    "fault injection ACTIVE from DYN_TPU_FAULTS (%d rules, seed=%d)",
+                    len(_active.rules), _active.seed,
+                )
+            except (ValueError, TypeError):
+                logger.exception("malformed DYN_TPU_FAULTS spec ignored")
+    return _active
+
+
+def injector_from_spec(spec: str, seed: int = 0) -> FaultInjector:
+    """Parse a JSON list of rule dicts into an injector."""
+    raw = json.loads(spec)
+    if not isinstance(raw, list):
+        raise ValueError("DYN_TPU_FAULTS must be a JSON list of rule objects")
+    return FaultInjector([FaultRule.from_dict(d) for d in raw], seed=seed)
+
+
+async def open_connection(host: str, port: int, plane: str = "rpc"):
+    """Dial ``host:port``, subject to the active injector (if any).
+
+    Every runtime transport dials through here so one harness can fault any
+    plane. The returned streams are always wrapped (a None-check per op when
+    no injector is installed) so that an injector installed *later* can
+    break connections that are already live — a real outage doesn't spare
+    established sockets.
+    """
+    inj = current()
+    if inj is not None:
+        await inj.before_connect(plane, f"{host}:{port}")
+    reader, writer = await asyncio.open_connection(host, port)
+    state = _ConnFaults(plane, f"{host}:{port}")
+    return _FaultyReader(reader, state), _FaultyWriter(writer, state)

@@ -1,0 +1,327 @@
+"""Request-path fault tolerance: deadlines, retry budgets, circuit breaking.
+
+The direct-dial design (statestore hands the client a worker address, the
+client dials it — runtime/rpc.py) is one hop faster than the reference's
+broker-mediated path, but it also means the client is the only party that can
+absorb worker churn: there is no NATS to re-queue a request whose chosen
+instance died between watch events. This module is that absorption layer:
+
+- :class:`ResiliencePolicy` — the per-client knob bundle: total request
+  deadline, connect timeout, inter-item stall bound, pre-first-token retry
+  budget with exponential backoff + jitter, and circuit-breaker tuning.
+- :class:`Deadline` — a monotonic time budget threaded from the HTTP edge
+  through ``EndpointClient`` into the RPC header, so workers can shed
+  requests that expired in flight.
+- :class:`CircuitBreaker` — per-instance closed → open → half-open state
+  machine; repeatedly-failing instances are ejected from routing until a
+  half-open probe proves them healthy again.
+
+Semantics contract (docs/resilience.md): failover is only legal while no
+response item has been delivered to the caller — after the first token the
+request is pinned to its instance and failures surface in-band.
+
+Reference analogue: the reference leans on NATS redelivery + etcd liveness
+(SURVEY.md §5); this is the equivalent capability re-designed for the
+direct-dial data plane.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+# Canonical message prefix for deadline errors crossing process boundaries as
+# Annotated error envelopes; the HTTP edge maps it to 504 vs the generic 502.
+DEADLINE_ERROR = "deadline exceeded"
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's total time budget ran out (connect, queueing, or an
+    inter-item gap). Not retryable: the budget is already spent."""
+
+
+class RetryableRpcError(ConnectionError):
+    """A worker rejected the request before streaming anything (draining,
+    endpoint briefly unregistered) — safe to fail over to another instance."""
+
+
+class WorkerStalled(ConnectionError):
+    """The worker accepted the request but exceeded the inter-item stall
+    bound without producing anything — treated like a dead connection."""
+
+
+class NoHealthyInstances(RuntimeError):
+    """No live instance is available to try (empty set, or every breaker
+    open and the last-ditch pass also failed)."""
+
+
+class AllInstancesFailed(ConnectionError):
+    """The pre-first-token retry budget is exhausted; carries the last
+    underlying failure as ``__cause__``."""
+
+
+def _monotonic() -> float:
+    return time.monotonic()
+
+
+class Deadline:
+    """A monotonic time budget. ``budget=None`` means unlimited."""
+
+    __slots__ = ("_t0", "_budget", "_clock")
+
+    def __init__(self, budget: Optional[float], clock: Callable[[], float] = _monotonic):
+        self._clock = clock
+        self._t0 = clock()
+        self._budget = budget
+
+    @classmethod
+    def after(cls, budget: Optional[float],
+              clock: Callable[[], float] = _monotonic) -> "Deadline":
+        return cls(budget, clock)
+
+    @property
+    def budget(self) -> Optional[float]:
+        return self._budget
+
+    def remaining(self) -> Optional[float]:
+        """Seconds left (may be ≤ 0); None when unlimited."""
+        if self._budget is None:
+            return None
+        return self._budget - (self._clock() - self._t0)
+
+    @property
+    def expired(self) -> bool:
+        rem = self.remaining()
+        return rem is not None and rem <= 0
+
+    def bound(self, timeout: Optional[float]) -> Optional[float]:
+        """Combine with another timeout: the tighter of the two (None = no
+        bound from that side)."""
+        rem = self.remaining()
+        if rem is None:
+            return timeout
+        if timeout is None:
+            return max(rem, 0.0)
+        return max(min(rem, timeout), 0.0)
+
+    def check(self, what: str = "") -> None:
+        if self.expired:
+            raise DeadlineExceeded(
+                f"{DEADLINE_ERROR}{f' ({what})' if what else ''}: "
+                f"budget {self._budget:.3f}s spent"
+            )
+
+
+def _env_float(name: str, default: Optional[float]) -> Optional[float]:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return None if v <= 0 else v
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass
+class ResiliencePolicy:
+    """Per-client resilience knobs. The defaults keep today's behavior for
+    patient callers (no total deadline) while bounding the failure modes
+    that used to hang or error: connects time out, stalled workers are cut,
+    and pre-first-token failures fail over instead of surfacing.
+
+    ``request_timeout``      total budget for the request (None = unlimited);
+                             propagated to the worker in the RPC header.
+    ``connect_timeout``      per-attempt dial bound.
+    ``inter_item_timeout``   max gap between stream items (None = unlimited);
+                             also bounds time-to-first-token.
+    ``max_attempts``         pre-first-token tries across instances.
+    ``backoff_*`` / ``jitter`` exponential backoff between attempts;
+                             jitter is a 0..jitter fraction added on top.
+    ``breaker_*``            consecutive-failure threshold, open-state
+                             cooldown, and half-open probe admission count.
+    ``seed``                 fixes the jitter RNG (tests / reproducibility).
+    """
+
+    request_timeout: Optional[float] = None
+    connect_timeout: float = 5.0
+    inter_item_timeout: Optional[float] = None
+    max_attempts: int = 3
+    backoff_base: float = 0.05
+    backoff_multiplier: float = 2.0
+    backoff_max: float = 2.0
+    jitter: float = 0.5
+    breaker_threshold: int = 5
+    breaker_cooldown: float = 5.0
+    breaker_half_open_probes: int = 1
+    seed: Optional[int] = None
+
+    def rng(self) -> random.Random:
+        return random.Random(self.seed)
+
+    def backoff(self, attempt: int, rng: Optional[random.Random] = None) -> float:
+        """Delay before retry ``attempt`` (1-based): exponential with jitter."""
+        base = min(
+            self.backoff_base * (self.backoff_multiplier ** max(attempt - 1, 0)),
+            self.backoff_max,
+        )
+        if self.jitter <= 0:
+            return base
+        r = (rng or random).random()
+        return base * (1.0 + self.jitter * r)
+
+    @classmethod
+    def from_env(cls, prefix: str = "DYN_TPU_") -> "ResiliencePolicy":
+        """Build a policy from ``DYN_TPU_REQUEST_TIMEOUT`` etc. Unset or
+        malformed values keep the defaults. ``0`` disables the *optional*
+        timeouts (``REQUEST_TIMEOUT``, ``INTER_ITEM_TIMEOUT`` → unlimited);
+        the knobs that must stay positive (``CONNECT_TIMEOUT``,
+        ``BREAKER_COOLDOWN``) fall back to their defaults when ≤ 0."""
+        d = cls()
+        return cls(
+            request_timeout=_env_float(prefix + "REQUEST_TIMEOUT", d.request_timeout),
+            connect_timeout=_env_float(prefix + "CONNECT_TIMEOUT", d.connect_timeout)
+            or d.connect_timeout,
+            inter_item_timeout=_env_float(
+                prefix + "INTER_ITEM_TIMEOUT", d.inter_item_timeout
+            ),
+            max_attempts=max(1, _env_int(prefix + "MAX_ATTEMPTS", d.max_attempts)),
+            breaker_threshold=max(
+                1, _env_int(prefix + "BREAKER_THRESHOLD", d.breaker_threshold)
+            ),
+            breaker_cooldown=_env_float(
+                prefix + "BREAKER_COOLDOWN", d.breaker_cooldown
+            )
+            or d.breaker_cooldown,
+        )
+
+
+# Breaker states (plain strings so they read well in logs/metrics).
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+@dataclass
+class _BreakerSlot:
+    state: str = CLOSED
+    consecutive_failures: int = 0
+    opened_at: float = 0.0
+    half_open_inflight: int = 0
+
+
+class CircuitBreaker:
+    """Per-key (endpoint instance) circuit breaker.
+
+    closed    — all traffic admitted; ``threshold`` consecutive failures
+                trip the breaker open.
+    open      — no traffic for ``cooldown`` seconds.
+    half_open — up to ``half_open_probes`` concurrent probes admitted;
+                one success closes the breaker, one failure re-opens it
+                (restarting the cooldown).
+    """
+
+    def __init__(
+        self,
+        threshold: int = 5,
+        cooldown: float = 5.0,
+        half_open_probes: int = 1,
+        clock: Callable[[], float] = _monotonic,
+    ):
+        self.threshold = max(1, threshold)
+        self.cooldown = cooldown
+        self.half_open_probes = max(1, half_open_probes)
+        self._clock = clock
+        self._slots: Dict[str, _BreakerSlot] = {}
+
+    def _slot(self, key: str) -> _BreakerSlot:
+        slot = self._slots.get(key)
+        if slot is None:
+            slot = self._slots[key] = _BreakerSlot()
+        return slot
+
+    def state(self, key: str) -> str:
+        slot = self._slots.get(key)
+        if slot is None:
+            return CLOSED
+        if slot.state == OPEN and self._clock() - slot.opened_at >= self.cooldown:
+            return HALF_OPEN
+        return slot.state
+
+    def available(self, key: str) -> bool:
+        """Pure check: may a request be routed to ``key`` right now? Safe to
+        call while *filtering* candidates — it never consumes a probe slot
+        (that's :meth:`acquire`, called once for the chosen instance)."""
+        st = self.state(key)
+        if st == CLOSED:
+            return True
+        if st == OPEN:
+            return False
+        slot = self._slots[key]
+        return slot.half_open_inflight < self.half_open_probes
+
+    def acquire(self, key: str) -> None:
+        """Commit a routing decision to ``key``: in half-open state this
+        consumes a probe slot (released by record_success/record_failure)."""
+        slot = self._slots.get(key)
+        if slot is None or slot.state == CLOSED:
+            return
+        if self.state(key) == HALF_OPEN:
+            if slot.state == OPEN:  # cooldown just elapsed: materialize
+                slot.state = HALF_OPEN
+                slot.half_open_inflight = 0
+            slot.half_open_inflight += 1
+
+    def release(self, key: str) -> None:
+        """Un-commit an :meth:`acquire` that resolved with *neither* success
+        nor failure (deadline expiry, abandoned stream, application error):
+        the half-open probe slot must return to the pool or the instance
+        stays ejected forever."""
+        slot = self._slots.get(key)
+        if slot is not None and slot.half_open_inflight > 0:
+            slot.half_open_inflight -= 1
+
+    def record_success(self, key: str) -> None:
+        slot = self._slots.get(key)
+        if slot is None:
+            return
+        slot.state = CLOSED
+        slot.consecutive_failures = 0
+        slot.half_open_inflight = 0
+
+    def record_failure(self, key: str) -> None:
+        slot = self._slot(key)
+        if slot.state == HALF_OPEN:
+            # failed probe: straight back to open, cooldown restarts
+            slot.state = OPEN
+            slot.opened_at = self._clock()
+            slot.half_open_inflight = 0
+            return
+        slot.consecutive_failures += 1
+        if slot.consecutive_failures >= self.threshold and slot.state != OPEN:
+            slot.state = OPEN
+            slot.opened_at = self._clock()
+
+    def forget(self, key: str) -> None:
+        """Drop state for an instance that left the live set."""
+        self._slots.pop(key, None)
+
+    def prune(self, live_keys) -> None:
+        """Drop state for every instance not in ``live_keys`` — leak
+        containment for recovery paths that replace the live set wholesale
+        without per-instance delete events."""
+        for key in [k for k in self._slots if k not in live_keys]:
+            del self._slots[key]
+
+    def snapshot(self) -> Dict[str, str]:
+        return {k: self.state(k) for k in self._slots}

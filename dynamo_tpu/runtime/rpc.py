@@ -9,11 +9,18 @@ codec. Same capability (streaming, cancellation, graceful drain), one less
 network hop on every token.
 
 Wire protocol (header JSON + body):
-  client→worker: {id, op:"generate", endpoint} body=request JSON
+  client→worker: {id, op:"generate", endpoint, deadline_ms?} body=request JSON
                  {id, op:"stop"|"kill"}        (mid-stream cancellation)
   worker→client: {id, op:"item"}  body=one Annotated dict JSON
                  {id, op:"done"}
-                 {id, op:"error", message}
+                 {id, op:"error", message, code?, retryable?}
+
+``deadline_ms`` is the request's *remaining* budget at send time (relative,
+not wall-clock — hosts don't share clocks); the worker sheds requests whose
+budget is already spent and stops streams whose budget expires mid-flight.
+Error replies carry ``retryable`` (safe to fail over to another instance:
+draining, transport trouble) and ``code`` ("deadline" | "draining" |
+"unknown_endpoint") so clients can map them without string matching.
 """
 
 from __future__ import annotations
@@ -24,9 +31,17 @@ import json
 import logging
 from typing import Any, AsyncIterator, Dict, Optional, Tuple
 
+from dynamo_tpu.runtime import faults
 from dynamo_tpu.runtime.annotated import Annotated
-from dynamo_tpu.runtime.codec import TwoPartMessage, read_frame, write_frame
+from dynamo_tpu.runtime.codec import CodecError, TwoPartMessage, read_frame, write_frame
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.resilience import (
+    DEADLINE_ERROR,
+    Deadline,
+    DeadlineExceeded,
+    RetryableRpcError,
+    WorkerStalled,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -78,14 +93,35 @@ class RpcServer:
                     frame = await read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionError):
                     return
-                h = json.loads(frame.header)
+                except CodecError as e:
+                    # garbage bytes / corrupt frame: this connection's stream
+                    # position is unrecoverable — drop it, leave every other
+                    # connection (and this server) untouched
+                    logger.warning("malformed rpc frame, closing connection: %s", e)
+                    return
+                try:
+                    h = json.loads(frame.header)
+                    if not isinstance(h, dict):
+                        raise ValueError("header is not a JSON object")
+                except (ValueError, UnicodeDecodeError) as e:
+                    logger.warning("malformed rpc header, closing connection: %s", e)
+                    return
                 op = h.get("op")
                 if op == "generate":
+                    if h.get("id") is None:
+                        async with write_lock:
+                            await write_frame(writer, TwoPartMessage(
+                                json.dumps({"id": None, "op": "error",
+                                            "message": "missing request id"}).encode(),
+                                b""))
+                        continue
                     if self._draining:
                         async with write_lock:
                             await write_frame(writer, TwoPartMessage(
                                 json.dumps({"id": h["id"], "op": "error",
-                                            "message": "worker draining"}).encode(), b""))
+                                            "message": "worker draining",
+                                            "code": "draining",
+                                            "retryable": True}).encode(), b""))
                         continue
                     task = asyncio.create_task(
                         self._serve_request(h, frame.body, writer, write_lock, contexts)
@@ -95,7 +131,7 @@ class RpcServer:
                     task.add_done_callback(self._inflight.discard)
                     task.add_done_callback(conn_tasks.discard)
                 elif op in ("stop", "kill"):
-                    ctx = contexts.get(h["id"])
+                    ctx = contexts.get(h.get("id"))
                     if ctx is not None:
                         if op == "kill":
                             ctx.context.kill()
@@ -119,7 +155,23 @@ class RpcServer:
 
         if engine is None:
             await send({"id": req_id, "op": "error",
-                        "message": f"no such endpoint {h.get('endpoint')!r}"})
+                        "message": f"no such endpoint {h.get('endpoint')!r}",
+                        "code": "unknown_endpoint"})
+            return
+        # the client sends its REMAINING budget; re-anchor it to this host's
+        # clock. A request that expired in the queue/network is shed before
+        # it touches the engine (reference: no analogue — NATS just redelivers)
+        deadline: Optional[Deadline] = None
+        deadline_ms = h.get("deadline_ms")
+        if deadline_ms is not None:
+            try:
+                deadline = Deadline.after(float(deadline_ms) / 1000.0)
+            except (TypeError, ValueError):
+                deadline = None
+        if deadline is not None and deadline.expired:
+            await send({"id": req_id, "op": "error",
+                        "message": f"{DEADLINE_ERROR}: expired before start",
+                        "code": "deadline"})
             return
         try:
             payload = json.loads(body) if body else None
@@ -129,6 +181,14 @@ class RpcServer:
             if hasattr(stream, "__await__"):
                 stream = await stream
             async for item in stream:
+                if deadline is not None and deadline.expired:
+                    # nobody is waiting for these tokens anymore: stop the
+                    # engine and tell the client why the stream ended
+                    ctx.context.kill()
+                    await send({"id": req_id, "op": "error",
+                                "message": f"{DEADLINE_ERROR}: mid-stream",
+                                "code": "deadline"})
+                    return
                 d = item.to_dict() if isinstance(item, Annotated) else item
                 await send({"id": req_id, "op": "item"}, json.dumps(d).encode())
             await send({"id": req_id, "op": "done"})
@@ -159,10 +219,20 @@ class RpcClient:
         self.closed = False
 
     @classmethod
-    async def connect(cls, address: str) -> "RpcClient":
+    async def connect(cls, address: str, timeout: Optional[float] = None) -> "RpcClient":
         host, _, port = address.rpartition(":")
         c = cls(host or "127.0.0.1", int(port))
-        c._reader, c._writer = await asyncio.open_connection(c.host, c.port)
+        dial = faults.open_connection(c.host, c.port, plane="rpc")
+        if timeout is not None:
+            # asyncio.wait_for, not asyncio.timeout (py3.10 floor)
+            try:
+                c._reader, c._writer = await asyncio.wait_for(dial, timeout)
+            except asyncio.TimeoutError:
+                raise WorkerStalled(
+                    f"connect to {address} timed out after {timeout:.1f}s"
+                ) from None
+        else:
+            c._reader, c._writer = await dial
         c._reader_task = asyncio.create_task(c._read_loop())
         return c
 
@@ -173,13 +243,17 @@ class RpcClient:
         if self._writer:
             self._writer.close()
         for q in self._streams.values():
-            q.put_nowait(("error", "connection closed"))
+            q.put_nowait(("error", {"message": "connection closed", "retryable": True}))
 
     async def _read_loop(self) -> None:
         try:
             while True:
                 frame = await read_frame(self._reader)
                 h = json.loads(frame.header)
+                if not isinstance(h, dict):
+                    # same hardening as the server side: a JSON-valid but
+                    # non-object header must not kill the reader silently
+                    raise ValueError("response header is not a JSON object")
                 q = self._streams.get(h.get("id"))
                 if q is None:
                     continue
@@ -189,21 +263,49 @@ class RpcClient:
                 elif op == "done":
                     q.put_nowait(("done", None))
                 elif op == "error":
-                    q.put_nowait(("error", h.get("message", "remote error")))
+                    q.put_nowait(("error", {
+                        "message": h.get("message", "remote error"),
+                        "code": h.get("code"),
+                        "retryable": bool(h.get("retryable")),
+                    }))
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             self.closed = True
             for q in self._streams.values():
-                q.put_nowait(("error", "connection lost"))
+                q.put_nowait(("error", {"message": "connection lost", "retryable": True}))
+        except (CodecError, ValueError):
+            # a server speaking garbage is as dead as a closed socket
+            logger.warning("malformed frame from worker %s:%d", self.host, self.port)
+            self.closed = True
+            if self._writer:
+                self._writer.close()
+            for q in self._streams.values():
+                q.put_nowait(("error", {"message": "malformed response frame",
+                                        "retryable": True}))
 
     async def _send(self, header: dict, body: bytes = b"") -> None:
         async with self._send_lock:
             await write_frame(self._writer, TwoPartMessage(json.dumps(header).encode(), body))
 
     async def generate(
-        self, endpoint: str, request: Any, context: Optional[Context] = None
+        self,
+        endpoint: str,
+        request: Any,
+        context: Optional[Context] = None,
+        deadline: Optional[Deadline] = None,
+        inter_item_timeout: Optional[float] = None,
+        raise_transport: bool = False,
     ) -> AsyncIterator[Annotated]:
         """Call a remote endpoint; yields Annotated items. Propagates local
-        context stop/kill to the worker."""
+        context stop/kill to the worker.
+
+        ``deadline`` bounds the whole stream (and rides the RPC header so
+        the worker sheds expired requests); ``inter_item_timeout`` bounds
+        each gap between items (and time-to-first-token). With
+        ``raise_transport=True`` transport-level failures (connection
+        lost/closed, worker draining, stalls, deadline expiry) raise typed
+        exceptions instead of yielding an error item — the failover path in
+        EndpointClient needs to distinguish them from application errors,
+        which are always yielded in-band."""
         req_id = next(self._ids)
         q: asyncio.Queue = asyncio.Queue()
         self._streams[req_id] = q
@@ -216,6 +318,10 @@ class RpcClient:
         header = {"id": req_id, "op": "generate", "endpoint": endpoint}
         if context is not None:
             header["request_id"] = context.id
+        if deadline is not None:
+            rem = deadline.remaining()
+            if rem is not None:
+                header["deadline_ms"] = max(int(rem * 1000), 0)
         await self._send(header, json.dumps(payload).encode())
 
         monitor: Optional[asyncio.Task] = None
@@ -230,13 +336,51 @@ class RpcClient:
             monitor = asyncio.create_task(watch_cancel())
         try:
             while True:
-                kind, data = await q.get()
+                gap = inter_item_timeout
+                if deadline is not None:
+                    gap = deadline.bound(gap)
+                if gap is None:
+                    kind, data = await q.get()
+                else:
+                    try:
+                        kind, data = await asyncio.wait_for(q.get(), gap)
+                    except asyncio.TimeoutError:
+                        # stop the worker before reporting: its tokens have
+                        # no consumer anymore either way
+                        try:
+                            await self._send({"id": req_id, "op": "kill"})
+                        except (ConnectionError, OSError):
+                            pass
+                        # inter_item_timeout None means the gap bound came
+                        # entirely from the deadline — classify as deadline
+                        # even if the timer fired a clock-tick early
+                        if deadline is not None and (
+                            deadline.expired or inter_item_timeout is None
+                        ):
+                            msg = f"{DEADLINE_ERROR}: waiting for stream item"
+                            if raise_transport:
+                                raise DeadlineExceeded(msg) from None
+                            yield Annotated.from_error(msg)
+                            return
+                        msg = (f"worker stalled: no item within "
+                               f"{inter_item_timeout:.1f}s")
+                        if raise_transport:
+                            raise WorkerStalled(msg) from None
+                        yield Annotated.from_error(msg)
+                        return
                 if kind == "item":
                     yield Annotated.from_dict(json.loads(data))
                 elif kind == "done":
                     return
                 else:
-                    yield Annotated.from_error(str(data))
+                    info = data if isinstance(data, dict) else {"message": str(data)}
+                    msg = str(info.get("message", "remote error"))
+                    if raise_transport:
+                        if info.get("code") == "deadline":
+                            raise DeadlineExceeded(msg)
+                        if info.get("retryable"):
+                            raise RetryableRpcError(msg)
+                    yield Annotated.from_error(msg)
                     return
         finally:
             if monitor:

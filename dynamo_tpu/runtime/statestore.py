@@ -41,6 +41,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Dict, List, Optional, Set, Tuple
 
+from dynamo_tpu.runtime import faults
 from dynamo_tpu.runtime.codec import TwoPartMessage, read_frame, write_frame
 
 logger = logging.getLogger(__name__)
@@ -682,7 +683,9 @@ class StateStoreClient:
         return c
 
     async def _dial(self) -> None:
-        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._reader, self._writer = await faults.open_connection(
+            self.host, self.port, plane="statestore"
+        )
         self._connected.set()
         self._reader_task = asyncio.create_task(self._read_loop())
 
@@ -949,7 +952,9 @@ class StandbyStateStore:
         down_since: Optional[float] = None
         while not self.promoted.is_set():
             try:
-                reader, writer = await asyncio.open_connection(host, port)
+                reader, writer = await faults.open_connection(
+                    host, port, plane="statestore"
+                )
             except OSError:
                 now = time.monotonic()
                 if down_since is None:

@@ -23,6 +23,7 @@ A second signal during the drain skips straight to the hard exit.
 from __future__ import annotations
 
 import asyncio
+import inspect
 import logging
 import os
 import signal
@@ -80,7 +81,11 @@ async def serve_until_shutdown(drt, engine=None) -> None:
     async def _graceful() -> None:
         await drt.shutdown()  # lease revoke → RPC drain → transports
         if engine is not None and hasattr(engine, "close"):
-            engine.close()
+            result = engine.close()
+            if inspect.isawaitable(result):
+                # async engines return a coroutine — awaiting it here is the
+                # difference between real cleanup and silently skipping it
+                await result
 
     try:
         # asyncio.wait_for, not asyncio.timeout: the latter is py3.11+ and

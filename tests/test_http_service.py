@@ -157,6 +157,125 @@ def test_completions_endpoint(service, run):
     run(_with_service(service, fn))
 
 
+class _PreFailEngine:
+    """Fails before producing anything: yields one error envelope."""
+
+    def __init__(self, message):
+        self.message = message
+
+    async def generate(self, ctx):
+        from dynamo_tpu.runtime.annotated import Annotated
+
+        yield Annotated.from_error(self.message)
+
+
+class _RaisingEngine:
+    def __init__(self, exc):
+        self.exc = exc
+
+    async def generate(self, ctx):
+        raise self.exc
+        yield  # pragma: no cover
+
+
+class _MidStreamFailEngine:
+    """Two good chat chunks, then an error envelope."""
+
+    async def generate(self, ctx):
+        from dynamo_tpu.runtime.annotated import Annotated
+
+        base = {"id": "c9", "object": "chat.completion.chunk", "created": 5,
+                "model": "flaky"}
+        for tok in ("hi", " there"):
+            yield Annotated.from_data(
+                {**base, "choices": [{"index": 0, "delta": {"content": tok}}]}
+            )
+        yield Annotated.from_error("worker exploded mid-stream")
+
+
+def _flaky_service():
+    from dynamo_tpu.runtime.resilience import AllInstancesFailed, DeadlineExceeded
+
+    manager = ModelManager()
+    manager.add_chat_model("upstream-dead", _PreFailEngine("connection lost"))
+    manager.add_chat_model(
+        "upstream-deadline", _PreFailEngine("deadline exceeded: budget spent")
+    )
+    manager.add_chat_model(
+        "raises-502", _RaisingEngine(AllInstancesFailed("3 instances failed"))
+    )
+    manager.add_chat_model(
+        "raises-504", _RaisingEngine(DeadlineExceeded("deadline exceeded: 2s"))
+    )
+    manager.add_chat_model("flaky", _MidStreamFailEngine())
+    return HttpService(manager, host="127.0.0.1", port=0)
+
+
+@pytest.mark.parametrize("stream", [False, True])
+@pytest.mark.parametrize("model,status", [
+    ("upstream-dead", 502),
+    ("upstream-deadline", 504),
+    ("raises-502", 502),
+    ("raises-504", 504),
+])
+def test_pre_first_token_failures_map_to_502_504(run, model, status, stream):
+    """An upstream that fails before the first token must surface as a real
+    HTTP error (502, or 504 for deadline expiry) — not a 200 carrying an
+    error payload."""
+
+    async def fn(session, base):
+        async with session.post(
+            f"{base}/v1/chat/completions",
+            json={"model": model,
+                  "messages": [{"role": "user", "content": "x"}],
+                  "stream": stream},
+        ) as resp:
+            assert resp.status == status, await resp.text()
+            body = await resp.json()
+            assert body["error"]["type"] == "internal_error"
+
+    run(_with_service(_flaky_service(), fn))
+
+
+def test_mid_stream_failure_emits_error_finish_chunk(run):
+    """After the first token the stream is committed: a failure must close
+    it with an error event AND a well-formed final chunk whose choice has
+    finish_reason "error", then [DONE] — no dangling streams."""
+
+    async def fn(session, base):
+        async with session.post(
+            f"{base}/v1/chat/completions",
+            json={"model": "flaky",
+                  "messages": [{"role": "user", "content": "x"}],
+                  "stream": True},
+        ) as resp:
+            assert resp.status == 200
+            raw = (await resp.read()).decode()
+        frames = [f for f in raw.split("\n\n") if f.strip()]
+        assert frames[-1] == "data: [DONE]"
+        assert any(f.startswith("event: error") for f in frames)
+        data_frames = [
+            json.loads(f[len("data: "):])
+            for f in frames
+            if f.startswith("data: ") and not f.endswith("[DONE]")
+        ]
+        # the delivered prefix arrived intact …
+        texts = [
+            ch["delta"].get("content")
+            for fr in data_frames
+            for ch in fr.get("choices", [])
+            if ch.get("delta", {}).get("content")
+        ]
+        assert texts == ["hi", " there"]
+        # … and the final data chunk terminates the choice
+        final = data_frames[-1]
+        assert final["choices"][0]["finish_reason"] == "error"
+        assert final["choices"][0]["delta"] == {}
+        assert final.get("id") == "c9" and final.get("model") == "flaky"
+
+    run(_with_service(_flaky_service(), fn))
+
+
 def test_sse_template_n2_choice_indices():
     """The SSE fast path must key its template by choice index: n=2 streams
     interleave single-choice chunks with identical id/created (VERDICT r5

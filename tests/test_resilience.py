@@ -1,0 +1,540 @@
+"""Request-path fault tolerance: deadlines, failover, circuit breaking.
+
+Unit tests drive the Deadline/backoff/CircuitBreaker primitives with fake
+clocks and fixed seeds; the integration tests stand up a real mock cluster
+(statestore + N workers + EndpointClient) and prove the acceptance scenario:
+a worker killed mid-load causes ZERO failed requests pre-first-token
+(failover), latency stays bounded (deadline), and the breaker ejects then
+re-admits the restarted worker — deterministic under a fixed fault seed.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.annotated import Annotated
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.faults import FaultInjector, FaultRule
+from dynamo_tpu.runtime.resilience import (
+    CLOSED,
+    DEADLINE_ERROR,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    ResiliencePolicy,
+    WorkerStalled,
+)
+from dynamo_tpu.runtime.rpc import RpcClient, RpcServer
+from dynamo_tpu.runtime.statestore import StateStoreServer
+
+NO_BUS = "127.0.0.1:1"  # unreachable → runtime runs without an event plane
+
+
+# -- primitives ---------------------------------------------------------------
+
+
+class TestDeadline:
+    def test_budget_accounting(self):
+        t = [0.0]
+        d = Deadline.after(1.0, clock=lambda: t[0])
+        assert d.remaining() == pytest.approx(1.0)
+        assert not d.expired
+        t[0] = 0.6
+        assert d.remaining() == pytest.approx(0.4)
+        assert d.bound(2.0) == pytest.approx(0.4)  # deadline is tighter
+        assert d.bound(0.1) == pytest.approx(0.1)  # other bound is tighter
+        assert d.bound(None) == pytest.approx(0.4)
+        t[0] = 1.5
+        assert d.expired
+        assert d.bound(5.0) == 0.0
+        with pytest.raises(DeadlineExceeded):
+            d.check("unit")
+
+    def test_unlimited(self):
+        d = Deadline.after(None)
+        assert d.remaining() is None
+        assert not d.expired
+        assert d.bound(3.0) == 3.0
+        assert d.bound(None) is None
+        d.check()  # never raises
+
+
+class TestBackoff:
+    def test_deterministic_under_seed(self):
+        p = ResiliencePolicy(seed=123, backoff_base=0.1, backoff_multiplier=2.0,
+                             backoff_max=0.4, jitter=0.5)
+        a = [p.backoff(i, p.rng()) for i in range(1, 6)]
+        # same seed, fresh rng each time → reproducible; and a single rng
+        # stream is reproducible against itself
+        r1, r2 = p.rng(), p.rng()
+        assert [p.backoff(i, r1) for i in range(1, 6)] == [
+            p.backoff(i, r2) for i in range(1, 6)
+        ]
+        del a
+
+    def test_exponential_and_bounded(self):
+        p = ResiliencePolicy(seed=1, backoff_base=0.1, backoff_multiplier=2.0,
+                             backoff_max=0.4, jitter=0.5)
+        rng = p.rng()
+        for attempt in range(1, 8):
+            base = min(0.1 * 2.0 ** (attempt - 1), 0.4)
+            d = p.backoff(attempt, rng)
+            assert base <= d <= base * 1.5 + 1e-9, (attempt, d)
+
+    def test_no_jitter(self):
+        p = ResiliencePolicy(jitter=0.0, backoff_base=0.2, backoff_multiplier=2.0,
+                             backoff_max=1.0)
+        assert p.backoff(1) == pytest.approx(0.2)
+        assert p.backoff(2) == pytest.approx(0.4)
+        assert p.backoff(10) == pytest.approx(1.0)
+
+
+class TestPolicyEnv:
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("DYN_TPU_REQUEST_TIMEOUT", "12.5")
+        monkeypatch.setenv("DYN_TPU_MAX_ATTEMPTS", "5")
+        monkeypatch.setenv("DYN_TPU_BREAKER_THRESHOLD", "2")
+        p = ResiliencePolicy.from_env()
+        assert p.request_timeout == 12.5
+        assert p.max_attempts == 5
+        assert p.breaker_threshold == 2
+        # unset keeps defaults; 0 disables a timeout
+        monkeypatch.setenv("DYN_TPU_REQUEST_TIMEOUT", "0")
+        assert ResiliencePolicy.from_env().request_timeout is None
+
+
+class TestCircuitBreaker:
+    def test_state_machine(self):
+        t = [0.0]
+        br = CircuitBreaker(threshold=3, cooldown=10.0, half_open_probes=1,
+                            clock=lambda: t[0])
+        assert br.state("a") == CLOSED and br.available("a")
+        br.record_failure("a")
+        br.record_failure("a")
+        assert br.state("a") == CLOSED  # below threshold
+        br.record_failure("a")
+        assert br.state("a") == OPEN and not br.available("a")
+        # cooldown elapses → half-open, one probe admitted
+        t[0] = 10.5
+        assert br.state("a") == HALF_OPEN and br.available("a")
+        br.acquire("a")
+        assert not br.available("a")  # probe slot consumed
+        br.record_failure("a")  # failed probe → open again, cooldown restarts
+        assert br.state("a") == OPEN
+        t[0] = 15.0
+        assert br.state("a") == OPEN  # only 4.5s into the fresh cooldown
+        t[0] = 21.0
+        assert br.state("a") == HALF_OPEN
+        br.acquire("a")
+        br.record_success("a")  # successful probe → closed
+        assert br.state("a") == CLOSED and br.available("a")
+
+    def test_success_resets_failure_streak(self):
+        br = CircuitBreaker(threshold=3, cooldown=10.0)
+        for _ in range(2):
+            br.record_failure("w")
+        br.record_success("w")
+        for _ in range(2):
+            br.record_failure("w")
+        assert br.state("w") == CLOSED  # streak broken by the success
+
+    def test_available_never_consumes_probe_slots(self):
+        t = [0.0]
+        br = CircuitBreaker(threshold=1, cooldown=1.0, half_open_probes=1,
+                            clock=lambda: t[0])
+        br.record_failure("w")
+        t[0] = 1.5
+        # filtering many candidates must not eat the probe budget
+        for _ in range(10):
+            assert br.available("w")
+        br.acquire("w")
+        assert not br.available("w")
+
+    def test_forget(self):
+        br = CircuitBreaker(threshold=1, cooldown=100.0)
+        br.record_failure("w")
+        assert br.state("w") == OPEN
+        br.forget("w")
+        assert br.state("w") == CLOSED
+
+    def test_release_returns_unresolved_probe_slot(self):
+        """An acquire that resolves with neither success nor failure
+        (deadline expiry, abandoned stream) must release its half-open
+        probe slot — otherwise the instance is ejected forever."""
+        t = [0.0]
+        br = CircuitBreaker(threshold=1, cooldown=1.0, half_open_probes=1,
+                            clock=lambda: t[0])
+        br.record_failure("w")
+        t[0] = 1.5
+        br.acquire("w")
+        assert not br.available("w")
+        br.release("w")
+        assert br.available("w")  # slot back in the pool
+        # release after record_* must not double-free (guarded at zero)
+        br.acquire("w")
+        br.record_success("w")
+        br.release("w")
+        assert br.available("w")
+
+    def test_prune_drops_only_stale_keys(self):
+        br = CircuitBreaker(threshold=1, cooldown=100.0)
+        br.record_failure("live")
+        br.record_failure("gone")
+        br.prune({"live"})
+        assert br.state("live") == OPEN  # survives: still in the live set
+        assert br.state("gone") == CLOSED  # pruned
+
+
+# -- rpc-level deadline + stall behavior --------------------------------------
+
+
+class CountingEngine(AsyncEngine):
+    def __init__(self, n: int = 3):
+        self.n = n
+        self.calls = 0
+
+    async def generate(self, request: Context):
+        self.calls += 1
+        for i in range(self.n):
+            await asyncio.sleep(0)
+            yield Annotated.from_data({"i": i})
+
+
+class OneItemThenHang(AsyncEngine):
+    async def generate(self, request: Context):
+        yield Annotated.from_data({"i": 0})
+        await request.context.stopped()
+
+
+class HangForever(AsyncEngine):
+    async def generate(self, request: Context):
+        await request.context.stopped()
+        return
+        yield  # pragma: no cover — makes this an async generator
+
+
+class TestRpcDeadlines:
+    def test_expired_request_is_shed_before_the_engine(self, run):
+        async def go():
+            eng = CountingEngine()
+            server = RpcServer(host="127.0.0.1", port=0)
+            server.register("e", eng)
+            await server.start()
+            client = await RpcClient.connect(f"127.0.0.1:{server.port}")
+            with pytest.raises(DeadlineExceeded):
+                async for _ in client.generate(
+                    "e", {}, deadline=Deadline.after(0.0), raise_transport=True
+                ):
+                    pass
+            await asyncio.sleep(0.2)  # let the server process the frame
+            assert eng.calls == 0, "expired request must not touch the engine"
+            # default (non-raising) path surfaces the canonical error prefix
+            items = [
+                i async for i in client.generate("e", {}, deadline=Deadline.after(0.0))
+            ]
+            assert items[-1].is_error
+            assert items[-1].error_message().startswith(DEADLINE_ERROR)
+            await client.close()
+            await server.stop()
+
+        run(go())
+
+    def test_inter_item_stall_is_bounded(self, run):
+        async def go():
+            server = RpcServer(host="127.0.0.1", port=0)
+            server.register("h", OneItemThenHang())
+            server.register("dead", HangForever())
+            await server.start()
+            client = await RpcClient.connect(f"127.0.0.1:{server.port}")
+            t0 = time.monotonic()
+            items = [
+                i async for i in client.generate("h", {}, inter_item_timeout=0.3)
+            ]
+            assert time.monotonic() - t0 < 5.0
+            assert items[0].data == {"i": 0}
+            assert items[-1].is_error and "stalled" in items[-1].error_message()
+            # pre-first-item stall raises the typed error under raise_transport
+            with pytest.raises(WorkerStalled):
+                async for _ in client.generate(
+                    "dead", {}, inter_item_timeout=0.3, raise_transport=True
+                ):
+                    pass
+            await client.close()
+            await server.stop()
+
+        run(go())
+
+
+# -- mock cluster --------------------------------------------------------------
+
+
+class TagEngine(AsyncEngine):
+    """Streams 3 items tagged with the worker's name."""
+
+    def __init__(self, tag: str):
+        self.tag = tag
+
+    async def generate(self, request: Context):
+        for i in range(3):
+            await asyncio.sleep(0)
+            yield Annotated.from_data({"i": i, "worker": self.tag})
+
+
+def _policy(**kw) -> ResiliencePolicy:
+    base = dict(
+        request_timeout=10.0,
+        connect_timeout=1.0,
+        max_attempts=4,
+        backoff_base=0.01,
+        backoff_max=0.05,
+        breaker_threshold=2,
+        breaker_cooldown=1.0,
+        seed=7,
+    )
+    base.update(kw)
+    return ResiliencePolicy(**base)
+
+
+async def _cluster(n: int, policy: ResiliencePolicy, engine_for=TagEngine):
+    ss = StateStoreServer(port=0)
+    await ss.start()
+    rts, infos = [], []
+    for i in range(n):
+        rt = await DistributedRuntime.create(ss.url, NO_BUS)
+        ep = rt.namespace("res").component("w").endpoint("gen")
+        infos.append(await ep.serve(engine_for(f"w{i}")))
+        rts.append(rt)
+    fe = await DistributedRuntime.create(ss.url, NO_BUS)
+    client = await fe.namespace("res").component("w").endpoint("gen").client(
+        "round_robin", policy=policy
+    )
+    await client.wait_for_instances(n, timeout=10)
+    return ss, rts, infos, fe, client
+
+
+async def _teardown(ss, rts, fe, client):
+    await client.close()
+    for rt in rts + [fe]:
+        await rt.shutdown()
+    await ss.stop()
+
+
+class TestFailover:
+    def test_worker_killed_mid_load_zero_failures_and_breaker_cycle(self, run):
+        """The acceptance scenario, deterministic under a fixed fault seed:
+        one of three workers 'dies' mid-load (its address refuses dials and
+        resets in-flight writes), every request still succeeds pre-first-token
+        via failover, the breaker ejects the dead worker, and after 'restart'
+        (faults cleared) a half-open probe re-admits it."""
+
+        async def go():
+            ss, rts, infos, fe, client = await _cluster(3, _policy())
+            victim = infos[1]
+            served = []
+
+            async def one():
+                items = [i async for i in client.generate(Context({}))]
+                assert items, "request produced nothing"
+                assert not any(i.is_error for i in items), [
+                    i.error_message() for i in items if i.is_error
+                ]
+                served.append(items[0].data["worker"])
+
+            inj = FaultInjector(seed=42)
+            with faults.active(inj):
+                # healthy warm-up: all three workers serve
+                for _ in range(6):
+                    await one()
+                assert set(served) == {"w0", "w1", "w2"}
+
+                # kill w1 mid-load: pooled connection resets on next write,
+                # re-dials are refused — exactly "died between watch events"
+                inj.add_rule(FaultRule(plane="rpc", point="write",
+                                       action="reset", match_addr=victim.address))
+                inj.add_rule(FaultRule(plane="rpc", point="connect",
+                                       action="refuse", match_addr=victim.address))
+                served.clear()
+                for _ in range(8):
+                    await one()  # ZERO failed requests: failover absorbs the death
+                assert set(served) == {"w0", "w2"}
+                assert client.stats["failovers"] >= 1
+
+                # breaker ejected the victim after `threshold` failures …
+                assert client._breaker.state(victim.instance_id) == OPEN
+                # … so routing stops even *trying* it (failure count frozen)
+                frozen = client.stats["failures"]
+                served.clear()
+                for _ in range(4):
+                    await one()
+                assert client.stats["failures"] == frozen
+                assert set(served) == {"w0", "w2"}
+
+                # 'restart' the worker: faults lifted, cooldown elapses,
+                # one half-open probe succeeds → breaker closes, w1 serves
+                inj.clear_rules()
+                await asyncio.sleep(1.1)
+                served.clear()
+                for _ in range(6):
+                    await one()
+                assert "w1" in set(served), (
+                    f"restarted worker never re-admitted (seed=42, "
+                    f"fault log={inj.log})"
+                )
+                assert client._breaker.state(victim.instance_id) == CLOSED
+
+            await _teardown(ss, rts, fe, client)
+
+        run(go())
+
+    def test_failover_on_real_worker_death(self, run):
+        """No harness: actually stop one worker's RPC server (lease still
+        live, so the instance stays listed) — requests must still succeed."""
+
+        async def go():
+            ss, rts, infos, fe, client = await _cluster(3, _policy())
+            await rts[1]._rpc_server.stop(drain_timeout=0.1)
+            served = set()
+            for _ in range(8):
+                items = [i async for i in client.generate(Context({}))]
+                assert not any(i.is_error for i in items)
+                served.add(items[0].data["worker"])
+            assert served == {"w0", "w2"}
+            await _teardown(ss, rts, fe, client)
+
+        run(go())
+
+    def test_stalled_worker_is_cut_and_ejected(self, run):
+        """A wedged worker (accepts requests, never answers) must not hang
+        callers: the inter-item bound cuts it, failover retries elsewhere,
+        and the breaker eventually stops routing to it."""
+
+        def engine_for(tag):
+            return HangForever() if tag == "w0" else TagEngine(tag)
+
+        async def go():
+            policy = _policy(inter_item_timeout=0.3, breaker_cooldown=30.0)
+            ss, rts, infos, fe, client = await _cluster(2, policy, engine_for)
+            t0 = time.monotonic()
+            for _ in range(6):
+                items = [i async for i in client.generate(Context({}))]
+                assert not any(i.is_error for i in items)
+                assert items[0].data["worker"] == "w1"
+            assert time.monotonic() - t0 < 10.0
+            assert client._breaker.state(infos[0].instance_id) == OPEN
+            await _teardown(ss, rts, fe, client)
+
+        run(go())
+
+    def test_deadline_bounds_total_latency_when_all_workers_hang(self, run):
+        async def go():
+            policy = _policy(request_timeout=0.8, inter_item_timeout=0.2,
+                             max_attempts=10)
+            ss, rts, infos, fe, client = await _cluster(
+                2, policy, lambda tag: HangForever()
+            )
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceeded):
+                async for _ in client.generate(Context({})):
+                    pass
+            elapsed = time.monotonic() - t0
+            assert elapsed < 5.0, f"deadline did not bound latency ({elapsed:.1f}s)"
+            assert client.stats["deadline_expired"] >= 1
+            await _teardown(ss, rts, fe, client)
+
+        run(go())
+
+    def test_one_streams_stall_does_not_kill_concurrent_streams(self, run):
+        """A per-request stall must not evict the shared multiplexed
+        connection: a concurrent healthy stream to the same worker (already
+        past its first token, hence pinned) must finish untouched."""
+
+        class MixedEngine(AsyncEngine):
+            async def generate(self, request: Context):
+                if request.data.get("hang"):
+                    await request.context.stopped()
+                    return
+                for i in range(5):
+                    await asyncio.sleep(0.1)
+                    yield Annotated.from_data({"i": i, "worker": "w0"})
+
+        async def go():
+            policy = _policy(inter_item_timeout=0.25, max_attempts=2)
+            ss, rts, infos, fe, client = await _cluster(
+                1, policy, lambda tag: MixedEngine()
+            )
+
+            async def healthy():
+                return [i async for i in client.generate(Context({}))]
+
+            async def stalled():
+                try:
+                    async for _ in client.generate(Context({"hang": True})):
+                        pass
+                except (ConnectionError, OSError, RuntimeError):
+                    return "failed"
+                return "ok?"
+
+            good, bad = await asyncio.gather(healthy(), stalled())
+            assert bad == "failed"  # the stalled request fails cleanly …
+            assert len(good) == 5 and not any(i.is_error for i in good), [
+                i.error_message() if i.is_error else i.data for i in good
+            ]  # … without collateral damage to the healthy stream
+            await _teardown(ss, rts, fe, client)
+
+        run(go())
+
+    def test_graceful_shutdown_awaits_async_engine_close(self, run):
+        """`serve_until_shutdown` must await an async engine.close() —
+        synchronous invocation silently skipped the cleanup coroutine."""
+        from dynamo_tpu.runtime import worker
+
+        class Drt:
+            async def wait_closed(self):
+                return
+
+            async def shutdown(self):
+                self.shut = True
+
+        class AsyncCloseEngine:
+            def __init__(self):
+                self.closed = False
+
+            def close(self):
+                async def _close():
+                    await asyncio.sleep(0)
+                    self.closed = True
+
+                return _close()
+
+        class SyncCloseEngine:
+            def __init__(self):
+                self.closed = False
+
+            def close(self):
+                self.closed = True
+
+        a, s = AsyncCloseEngine(), SyncCloseEngine()
+        run(worker.serve_until_shutdown(Drt(), a))
+        run(worker.serve_until_shutdown(Drt(), s))
+        assert a.closed, "async close() coroutine was not awaited"
+        assert s.closed
+
+    def test_draining_worker_fails_over(self, run):
+        """A draining worker answers `retryable` — the client must fail over
+        instead of surfacing the draining error."""
+
+        async def go():
+            ss, rts, infos, fe, client = await _cluster(2, _policy())
+            rts[0]._rpc_server._draining = True  # rejects with retryable=True
+            for _ in range(6):
+                items = [i async for i in client.generate(Context({}))]
+                assert not any(i.is_error for i in items)
+                assert items[0].data["worker"] == "w1"
+            await _teardown(ss, rts, fe, client)
+
+        run(go())
