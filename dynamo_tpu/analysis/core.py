@@ -196,6 +196,7 @@ def all_rules() -> List[Rule]:
         CancelledSwallowRule,
         DanglingTaskRule,
         UnawaitedCoroutineRule,
+        UnboundedQueueRule,
     )
     from dynamo_tpu.analysis.rules_jax import (
         ImportTimeJaxComputeRule,
@@ -209,6 +210,7 @@ def all_rules() -> List[Rule]:
         UnawaitedCoroutineRule(),
         DanglingTaskRule(),
         CancelledSwallowRule(),
+        UnboundedQueueRule(),
         JitHostSyncRule(),
         UnmarkedHostSyncRule(),
         ImportTimeJaxComputeRule(),

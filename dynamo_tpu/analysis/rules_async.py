@@ -345,3 +345,40 @@ class CancelledSwallowRule(Rule):
                         f"failures (no log, no re-raise); log the error so "
                         f"retry storms are visible",
                     )
+
+
+class UnboundedQueueRule(Rule):
+    name = "unbounded-queue"
+    description = (
+        "asyncio.Queue() constructed without maxsize in the runtime layer "
+        "buffers frames/events without bound; a slow or wedged consumer "
+        "then grows worker memory until the OOM killer applies the "
+        "backpressure instead"
+    )
+
+    # the hot data/control planes where every queue sits between a producer
+    # that can outrun its consumer (token streams, watch events, bus frames);
+    # queues elsewhere (tests, tools, CLI) are not flagged
+    SCOPE = "dynamo_tpu/runtime/"
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not module.relpath.startswith(self.SCOPE):
+            return
+        imports = collect_imports(ast.walk(module.tree), module.package)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if resolve_call(node.func, imports) != "asyncio.Queue":
+                continue
+            # an explicit bound (positional or keyword, even a computed one)
+            # is a deliberate choice; only the silent default is flagged
+            if node.args or any(kw.arg == "maxsize" for kw in node.keywords):
+                continue
+            yield Finding(
+                module.relpath,
+                node.lineno,
+                self.name,
+                "asyncio.Queue() without maxsize buffers without bound under "
+                "a slow consumer; set maxsize (and handle overflow) or "
+                "justify with a disable comment",
+            )

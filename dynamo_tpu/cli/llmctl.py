@@ -6,6 +6,16 @@
     python -m dynamo_tpu.cli.llmctl http remove chat-models <name>
     python -m dynamo_tpu.cli.llmctl disagg get
     python -m dynamo_tpu.cli.llmctl disagg set --max-local-prefill-length 2000
+    python -m dynamo_tpu.cli.llmctl worker list <dyn://ns.comp.ep>
+    python -m dynamo_tpu.cli.llmctl worker drain <dyn://ns.comp.ep> <worker_id|all>
+    python -m dynamo_tpu.cli.llmctl worker undrain <dyn://ns.comp.ep> <worker_id|all>
+
+``worker drain`` writes a drain control key the target worker watches
+(``.../endpoints/{ep}/drain/{worker_id}``): routers stop sending it new
+work, in-flight streams finish, and the process can be restarted with zero
+failed requests (docs/overload.md has the rolling-restart runbook).
+``undrain`` deletes the key. ``worker list`` shows each live instance with
+its draining flag and last load snapshot.
 
 Writes/deletes ``{ns}/models/{kind}/{name}`` entries WITHOUT a lease (they
 outlive this process, like the reference's `for_cli` etcd config) so an
@@ -54,6 +64,15 @@ def build_parser() -> argparse.ArgumentParser:
     dset = dverbs.add_parser("set")
     dset.add_argument("--max-local-prefill-length", type=int, default=None)
     dset.add_argument("--max-prefill-queue-size", type=int, default=None)
+
+    worker = sub.add_parser("worker", help="drain/undrain/list endpoint workers")
+    wverbs = worker.add_subparsers(dest="verb", required=True)
+    wls = wverbs.add_parser("list")
+    wls.add_argument("endpoint", help="dyn://ns.comp.ep")
+    for verb in ("drain", "undrain"):
+        wp = wverbs.add_parser(verb)
+        wp.add_argument("endpoint", help="dyn://ns.comp.ep")
+        wp.add_argument("worker_id", help="worker id (from `worker list`) or 'all'")
     return p
 
 
@@ -68,6 +87,45 @@ async def amain(argv: list) -> int:
     url = args.statestore or os.environ.get("DYN_TPU_STATESTORE", "127.0.0.1:37901")
     store = await StateStoreClient.connect(url)
     try:
+        if args.plane == "worker":
+            ns, comp, ep = parse_endpoint_path(args.endpoint)
+            base = f"{ns}/components/{comp}/endpoints/{ep}"
+            if args.verb == "list":
+                from dynamo_tpu.runtime.distributed import InstanceInfo
+
+                entries = await store.get_prefix(f"{base}/instances/")
+                drains = await store.get_prefix(f"{base}/drain/")
+                drained = {k.rsplit("/", 1)[-1] for k in drains}
+                for key in sorted(entries):
+                    try:
+                        info = InstanceInfo.from_json(entries[key])
+                    except (ValueError, KeyError):
+                        continue
+                    flag = (
+                        "DRAINING"
+                        if info.draining or info.worker_id in drained
+                        or "all" in drained
+                        else "serving"
+                    )
+                    load = json.dumps(info.load) if info.load else "-"
+                    print(f"{info.worker_id:14s} {info.instance_id:18s} "
+                          f"{info.address:22s} {flag:9s} {load}")
+                if not entries:
+                    print(f"(no live instances for {args.endpoint})")
+                return 0
+            key = f"{base}/drain/{args.worker_id}"
+            if args.verb == "drain":
+                # no lease: the drain order outlives this CLI process; the
+                # worker's drain watcher applies it within one watch event
+                await store.put(key, b"1")
+                print(f"draining {args.worker_id} on {args.endpoint}")
+            else:
+                ok = await store.delete(key)
+                print(
+                    f"undrained {args.worker_id}" if ok
+                    else f"{args.worker_id} was not draining"
+                )
+            return 0
         if args.plane == "disagg":
             from dynamo_tpu.disagg.protocols import CONFIG_KEY, DisaggConfig
 

@@ -112,7 +112,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host-cache-blocks", type=int, default=0,
                    help="host-RAM KV tier size in blocks (0 = disabled)")
     p.add_argument("--router-mode", default="random",
-                   help="random | round_robin | kv | direct:<instance_id>")
+                   help="random | round_robin | kv | load (least-loaded) | "
+                        "direct:<instance_id>")
     p.add_argument("--namespace", default="dynamo",
                    help="registry namespace for out=discover model watching")
     p.add_argument("--statestore", default=None, help="statestore url for distributed mode")

@@ -47,6 +47,11 @@ GAUGES = [
     ("num_requests_waiting", "Requests queued or awaiting remote prefill"),
     ("gpu_cache_usage_perc", "KV pool usage fraction"),
     ("gpu_prefix_cache_hit_rate", "Prefix cache hit rate"),
+    # overload-protection plane (docs/overload.md): RPC pending depth,
+    # cumulative admission sheds, and the drain flag per worker
+    ("rpc_queue_depth", "RPC-layer pending requests (accepted, not finished)"),
+    ("shed_requests", "Requests shed by admission control (cumulative)"),
+    ("draining", "1 while the worker is draining (no new work routed)"),
 ]
 
 

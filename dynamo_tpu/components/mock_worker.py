@@ -31,14 +31,19 @@ async def run_mock_worker(
     while True:
         active = max(0, min(slots_total, active + rng.randint(-3, 3)))
         blocks = int(blocks_total * min(1.0, active / slots_total + rng.random() * 0.2))
+        waiting = rng.randint(0, 4)
         m = ForwardPassMetrics(
             request_active_slots=active,
             request_total_slots=slots_total,
             kv_active_blocks=blocks,
             kv_total_blocks=blocks_total,
-            num_requests_waiting=rng.randint(0, 4),
+            num_requests_waiting=waiting,
             gpu_cache_usage_perc=blocks / blocks_total,
             gpu_prefix_cache_hit_rate=rng.random() * 0.6,
+            # exercise the overload dashboard columns too
+            rpc_queue_depth=active + waiting,
+            shed_requests=0,
+            draining=0,
         )
         await ns.publish(
             KV_METRICS_SUBJECT, {"worker_id": wid, "metrics": m.to_dict()}

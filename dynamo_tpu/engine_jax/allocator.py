@@ -161,6 +161,14 @@ class BlockAllocator:
     def active_blocks(self) -> int:
         return self.num_blocks - self.free_blocks
 
+    @property
+    def reclaimable_blocks(self) -> int:
+        """Blocks with valid contents but refcount 0 (the LRU reuse pool).
+        They count as *free* for admission — allocation can evict them — but
+        evicting costs future prefix-cache hits; exported separately so the
+        overload dashboards can tell hard headroom from warm cache."""
+        return len(self._cached)
+
     def usage(self) -> float:
         return self.active_blocks / self.num_blocks if self.num_blocks else 0.0
 

@@ -1996,6 +1996,10 @@ class JaxServingEngine(AsyncEngine):
             "request_total_slots": self.config.max_slots,
             "kv_active_blocks": self.allocator.active_blocks,
             "kv_total_blocks": self.num_blocks,
+            # direct admission signals (runtime/admission.py gates on free
+            # KV headroom; reclaimable = the warm-cache share of it)
+            "kv_free_blocks": self.allocator.free_blocks,
+            "kv_reclaimable_blocks": self.allocator.reclaimable_blocks,
             "num_requests_waiting": len(self._pending) + len(self._awaiting),
             "gpu_cache_usage_perc": self.allocator.usage(),
             "gpu_prefix_cache_hit_rate": self.allocator.hit_tokens / probe,

@@ -159,6 +159,12 @@ class ForwardPassMetrics:
     gpu_cache_usage_perc: float = 0.0
     gpu_prefix_cache_hit_rate: float = 0.0
     data_parallel_rank: Optional[int] = None
+    # overload-protection extras (attach_kv_publishing merges them in):
+    # RPC-layer pending requests, requests shed by admission control, and
+    # the drain flag (1 ⇒ schedulers must not pick this worker)
+    rpc_queue_depth: int = 0
+    shed_requests: int = 0
+    draining: int = 0
 
     def to_dict(self) -> dict:
         from dataclasses import asdict

@@ -51,6 +51,10 @@ class DefaultWorkerSelector:
         best: list[Tuple[str, float, int]] = []
         best_logit = float("-inf")
         for wid, m in workers.items():
+            if m.draining:
+                # drain contract: no new work, however good the KV overlap —
+                # in-flight streams finish and the worker restarts clean
+                continue
             overlap = overlaps.get(wid, 0)
             slots_norm = (
                 m.request_active_slots / m.request_total_slots
@@ -63,6 +67,8 @@ class DefaultWorkerSelector:
                 best = [(wid, logit, overlap)]
             elif abs(logit - best_logit) <= 1e-9:
                 best.append((wid, logit, overlap))
+        if not best:
+            return None  # every worker draining: caller falls back / retries
         wid, logit, overlap = self._rng.choice(best)
         return SchedulingDecision(worker_id=wid, overlap_blocks=overlap, logit=logit)
 

@@ -168,6 +168,13 @@ class ServiceMetrics:
         self.ttft = self.registry.register(
             Histogram(f"{prefix}_time_to_first_token_seconds", "TTFT", ("model",))
         )
+        self.overloaded = self.registry.register(
+            Counter(
+                f"{prefix}_overloaded_total",
+                "Requests shed with 429 + Retry-After (upstream overload)",
+                ("model",),
+            )
+        )
 
     def inflight_guard(self, model: str, endpoint: str, request_type: str) -> "InflightGuard":
         return InflightGuard(self, model, endpoint, request_type)
@@ -198,6 +205,13 @@ class InflightGuard:
 
     def mark_ok(self) -> None:
         self.status = "success"
+
+    def mark_shed(self) -> None:
+        """Request answered 429 (overload shed): its own status label + a
+        dedicated counter, so dashboards can tell deliberate load shedding
+        from actual failures."""
+        self.status = "overloaded"
+        self._m.overloaded.inc(1, model=self.model)
 
     def mark_first_token(self) -> None:
         if self._first_token_at is None and self._start is not None:

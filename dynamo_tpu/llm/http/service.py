@@ -19,6 +19,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from ...runtime.admission import OVERLOAD_ERROR, OverloadedError
 from ...runtime.annotated import Annotated
 from ...runtime.engine import AsyncEngine, Context
 from ...runtime.resilience import (
@@ -219,6 +220,9 @@ class HttpService:
             return _error_response(e.status, e.message)
         except DeadlineExceeded as e:
             return _error_response(504, str(e) or DEADLINE_ERROR)
+        except OverloadedError as e:
+            guard.mark_shed()
+            return _overloaded_response(str(e), e.retry_after_ms)
         except (NoHealthyInstances, AllInstancesFailed, ConnectionError, OSError) as e:
             return _error_response(502, f"upstream failure: {e}")
 
@@ -229,7 +233,11 @@ class HttpService:
             and first_item.is_error
         ):
             msg = first_item.error_message() or "upstream failure"
-            return _error_response(_upstream_status(msg), msg)
+            status = _upstream_status(msg)
+            if status == 429:
+                guard.mark_shed()
+                return _overloaded_response(msg)
+            return _error_response(status, msg)
 
         resp = web.StreamResponse(
             status=200,
@@ -314,8 +322,12 @@ class HttpService:
                         msg = item.error_message() or "engine error"
                         if not chunks:
                             # upstream failed before producing anything:
-                            # 502/504, not a generic server error
-                            return _error_response(_upstream_status(msg), msg)
+                            # 429/502/504, not a generic server error
+                            status = _upstream_status(msg)
+                            if status == 429:
+                                guard.mark_shed()
+                                return _overloaded_response(msg)
+                            return _error_response(status, msg)
                         return _error_response(500, msg)
                     if item.data is None:
                         continue
@@ -329,6 +341,9 @@ class HttpService:
             return _error_response(e.status, e.message)
         except DeadlineExceeded as e:
             return _error_response(504, str(e) or DEADLINE_ERROR)
+        except OverloadedError as e:
+            guard.mark_shed()
+            return _overloaded_response(str(e), e.retry_after_ms)
         except (NoHealthyInstances, AllInstancesFailed, ConnectionError, OSError) as e:
             return _error_response(502, f"upstream failure: {e}")
         if not chunks:
@@ -480,9 +495,34 @@ class _SseTemplate:
 
 def _upstream_status(message: str) -> int:
     """Pre-first-token upstream failures: 504 when the request's deadline
-    expired (the canonical message prefix crosses process boundaries in the
-    error envelope), 502 for everything else upstream."""
-    return 504 if message.startswith(DEADLINE_ERROR) else 502
+    expired, 429 when every instance shed it as overloaded (the canonical
+    message prefixes cross process boundaries in the error envelope), 502
+    for everything else upstream."""
+    if message.startswith(DEADLINE_ERROR):
+        return 504
+    if message.startswith(OVERLOAD_ERROR):
+        return 429
+    return 502
+
+
+def _overloaded_response(message: str, retry_after_ms: int = 0) -> web.Response:
+    """429 with ``Retry-After`` (whole seconds, minimum 1) and an
+    OpenAI-error-schema body: overload is the one upstream failure where
+    the right client behavior is *back off and retry the same edge*, so it
+    gets its own status + hint instead of the generic 502."""
+    retry_after_s = max(1, -(-int(retry_after_ms) // 1000)) if retry_after_ms else 1
+    return web.json_response(
+        {
+            "error": {
+                "message": message,
+                "type": "overloaded_error",
+                "param": None,
+                "code": "overloaded",
+            }
+        },
+        status=429,
+        headers={"Retry-After": str(retry_after_s)},
+    )
 
 
 async def _write_error_finish(resp: web.StreamResponse, envelope: Optional[dict],

@@ -1,0 +1,300 @@
+"""Worker admission control: bound the pending queue, shed overload early.
+
+PR 2 made the request path survive *dead* workers; this module makes it
+survive *busy* ones. Without it a traffic spike queues unboundedly inside
+every worker — memory grows, every queued request eventually times out, and
+the failure mode is cascading timeouts instead of fast, bounded degradation.
+
+Three cooperating pieces, all env-tunable via ``DYN_TPU_ADMIT_*``:
+
+- :class:`AdmissionPolicy` — the knob bundle: pending-queue bound, optional
+  KV-block floor, retry-hint base, and the per-stream send-queue cap +
+  slow-consumer bound used by ``runtime/rpc.py``'s backpressure layer.
+- :class:`AdmissionController` — the per-worker gate. ``try_admit`` checks
+  the live pending count (RPC in-flight tasks) and, when the serving engine
+  exposes capacity (``engine_jax`` free decode slots + free KV blocks from
+  ``engine_jax/allocator.py``), the engine's headroom. Over-budget requests
+  are answered with a typed, *retryable* ``OVERLOADED`` reply carrying the
+  queue depth and a ``retry_after_ms`` hint — they never silently queue.
+- :class:`LoadSnapshot` — the compact load view workers piggyback on RPC
+  replies and statestore instance-key heartbeats; routers use it to pick the
+  least-loaded live instance and to stop dispatching to draining workers.
+
+Reference analogue: the dynamo_tpu paper's KV-cache-aware router routes on
+capacity signals published by workers; here the same signals also gate
+admission at the worker so a router with a stale view cannot overrun it.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from dynamo_tpu.runtime.resilience import RetryableRpcError
+
+# Canonical message prefix for overload errors crossing process boundaries
+# (mirrors resilience.DEADLINE_ERROR); the HTTP edge maps it to 429.
+OVERLOAD_ERROR = "overloaded"
+
+
+class OverloadedError(RetryableRpcError):
+    """A worker shed the request before doing any work (queue full / no KV
+    headroom). Retryable by design — another instance may have capacity —
+    but it must NOT trip the circuit breaker: the worker is healthy, just
+    busy, and ejecting it would amplify the overload on its siblings.
+    Soft-eject (avoid it for ``retry_after_ms``) instead."""
+
+    def __init__(self, message: str, queue_depth: int = 0, retry_after_ms: int = 0):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.retry_after_ms = retry_after_ms
+        # the snapshot the gate decided on (worker side only; not wired) —
+        # lets the shed reply reuse it instead of probing the engine twice
+        self.load: Optional[LoadSnapshot] = None
+
+
+class SlowConsumer(ConnectionError):
+    """A stream's reader stopped draining tokens for longer than the
+    slow-consumer bound while its bounded send queue was full. The stream
+    is cut (context killed) so worker memory stays bounded."""
+
+
+@dataclass
+class LoadSnapshot:
+    """Compact per-worker load view (wire form is short-keyed JSON).
+
+    ``queue_depth`` counts requests the worker has accepted but not
+    finished beyond its engine slots (RPC in-flight + engine waiting);
+    ``active_slots``/``total_slots`` and the KV block counters come from
+    the engine when it exposes capacity, and stay 0/0 for engines that
+    don't (routers then fall back to queue depth alone).
+    """
+
+    active_slots: int = 0
+    total_slots: int = 0
+    queue_depth: int = 0
+    kv_free_blocks: int = 0
+    kv_total_blocks: int = 0
+    draining: bool = False
+
+    def utilization(self) -> float:
+        """Scalar load score for least-loaded routing (lower = freer).
+
+        Slot occupancy plus queue pressure plus KV pressure; engines
+        without capacity reporting contribute queue depth only (scaled so
+        one queued request ≈ one busy slot on an 8-slot worker)."""
+        score = 0.0
+        if self.total_slots > 0:
+            score += self.active_slots / self.total_slots
+            score += self.queue_depth / self.total_slots
+        else:
+            score += self.queue_depth / 8.0
+        if self.kv_total_blocks > 0:
+            score += 1.0 - (self.kv_free_blocks / self.kv_total_blocks)
+        return score
+
+    def to_wire(self) -> dict:
+        out: Dict[str, Any] = {"q": self.queue_depth}
+        if self.total_slots:
+            out["s"] = self.active_slots
+            out["S"] = self.total_slots
+        if self.kv_total_blocks:
+            out["kf"] = self.kv_free_blocks
+            out["kt"] = self.kv_total_blocks
+        if self.draining:
+            out["d"] = 1
+        return out
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "LoadSnapshot":
+        try:
+            return cls(
+                active_slots=int(d.get("s", 0)),
+                total_slots=int(d.get("S", 0)),
+                queue_depth=int(d.get("q", 0)),
+                kv_free_blocks=int(d.get("kf", 0)),
+                kv_total_blocks=int(d.get("kt", 0)),
+                draining=bool(d.get("d", 0)),
+            )
+        except (TypeError, ValueError):
+            return cls()
+
+
+def _env_pos_int(name: str, default: int) -> int:
+    """Positive-int env knob: unset, malformed, zero, or negative values all
+    clamp to the default — a bad value must degrade to sane behavior, never
+    to an admission gate that rejects everything (0) or admits everything
+    (negative treated as unbounded)."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def _env_pos_float(name: str, default: float) -> float:
+    """Positive-float env knob with the same clamping contract."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def _env_nonneg_int(name: str, default: int) -> int:
+    """Non-negative int knob (0 is a meaningful 'disabled' value)."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v >= 0 else default
+
+
+@dataclass
+class AdmissionPolicy:
+    """Per-worker overload knobs (``AdmissionPolicy.from_env()``).
+
+    ``max_pending``          hard bound on concurrently accepted requests
+                             (engine slots + queued); above it, shed.
+    ``min_free_kv_blocks``   shed token-bearing requests when the engine's
+                             free KV blocks drop below this floor
+                             (0 = disabled; engines without an allocator
+                             are never KV-gated).
+    ``retry_after_ms``       base client back-off hint on a shed; scaled by
+                             how far over budget the queue is.
+    ``send_queue_cap``       per-stream bounded send queue in the RPC
+                             server — a slow reader backpressures the
+                             generator instead of buffering tokens.
+    ``slow_consumer_timeout``  how long a stream's send queue may stay full
+                             before the stream is cut as a slow consumer.
+    """
+
+    max_pending: int = 64
+    min_free_kv_blocks: int = 0
+    retry_after_ms: int = 200
+    send_queue_cap: int = 32
+    slow_consumer_timeout: float = 30.0
+
+    @classmethod
+    def from_env(cls, prefix: str = "DYN_TPU_ADMIT_") -> "AdmissionPolicy":
+        d = cls()
+        return cls(
+            max_pending=_env_pos_int(prefix + "MAX_PENDING", d.max_pending),
+            min_free_kv_blocks=_env_nonneg_int(
+                prefix + "MIN_FREE_KV_BLOCKS", d.min_free_kv_blocks
+            ),
+            retry_after_ms=_env_pos_int(prefix + "RETRY_AFTER_MS", d.retry_after_ms),
+            send_queue_cap=_env_pos_int(prefix + "SEND_QUEUE", d.send_queue_cap),
+            slow_consumer_timeout=_env_pos_float(
+                prefix + "SLOW_CONSUMER_TIMEOUT", d.slow_consumer_timeout
+            ),
+        )
+
+
+class AdmissionController:
+    """The per-worker admission gate + load snapshot source.
+
+    ``engine_probe`` (optional) returns the serving engine's capacity dict
+    (``metrics_snapshot()`` shape: request_active_slots / request_total_slots
+    / kv_active_blocks / kv_total_blocks / num_requests_waiting); without it
+    the gate bounds the RPC pending count alone.
+    """
+
+    def __init__(
+        self,
+        policy: Optional[AdmissionPolicy] = None,
+        engine_probe: Optional[Callable[[], Dict[str, Any]]] = None,
+    ):
+        self.policy = policy or AdmissionPolicy.from_env()
+        self.engine_probe = engine_probe
+        self.admitted = 0
+        self.shed = 0
+        self.slow_consumer_cuts = 0
+
+    def _engine_state(self) -> Dict[str, Any]:
+        if self.engine_probe is None:
+            return {}
+        try:
+            return self.engine_probe() or {}
+        except Exception:  # a broken probe must not take down admission
+            return {}
+
+    def snapshot(self, pending: int, draining: bool = False) -> LoadSnapshot:
+        es = self._engine_state()
+        total_blocks = int(es.get("kv_total_blocks", 0) or 0)
+        # prefer the engine's own free count (engine_jax reports it, and it
+        # correctly counts reclaimable cached blocks as free); fall back to
+        # total − active for engines that only publish the generic pair
+        if "kv_free_blocks" in es:
+            free_blocks = int(es.get("kv_free_blocks", 0) or 0)
+        else:
+            free_blocks = max(total_blocks - int(es.get("kv_active_blocks", 0) or 0), 0)
+        active = int(es.get("request_active_slots", 0) or 0)
+        total_slots = int(es.get("request_total_slots", 0) or 0)
+        waiting = int(es.get("num_requests_waiting", 0) or 0)
+        # ``pending`` (RPC in-flight) already contains both the requests
+        # holding engine slots and the engine-queued ones; queue_depth is
+        # the excess beyond the slots, not a double count. The engine's own
+        # waiting figure wins when larger (requests can enter it by
+        # non-RPC paths, e.g. remote prefill).
+        if total_slots > 0:
+            queue = max(pending - active, waiting, 0)
+        else:
+            queue = pending
+        return LoadSnapshot(
+            active_slots=active,
+            total_slots=total_slots,
+            queue_depth=queue,
+            kv_free_blocks=free_blocks,
+            kv_total_blocks=total_blocks,
+            draining=draining,
+        )
+
+    def retry_after_ms(self, snap: LoadSnapshot) -> int:
+        """Back-off hint scaled by overshoot: the deeper the queue relative
+        to the budget, the longer the hint (capped at 5s)."""
+        base = self.policy.retry_after_ms
+        over = snap.queue_depth / max(self.policy.max_pending, 1)
+        return min(int(base * (1.0 + over)), 5_000)
+
+    def try_admit(self, pending: int) -> Optional[OverloadedError]:
+        """Admit or shed one incoming request given ``pending`` already
+        accepted. Returns None when admitted, or the typed error to reply
+        with when shed (the caller formats the wire reply)."""
+        snap = self.snapshot(pending)
+        err: Optional[OverloadedError] = None
+        if pending >= self.policy.max_pending:
+            err = OverloadedError(
+                f"{OVERLOAD_ERROR}: pending queue full "
+                f"({pending}/{self.policy.max_pending})",
+                queue_depth=snap.queue_depth,
+                retry_after_ms=self.retry_after_ms(snap),
+            )
+        elif (
+            self.policy.min_free_kv_blocks > 0
+            and snap.kv_total_blocks > 0
+            and snap.kv_free_blocks < self.policy.min_free_kv_blocks
+        ):
+            err = OverloadedError(
+                f"{OVERLOAD_ERROR}: KV pressure "
+                f"({snap.kv_free_blocks} free blocks < "
+                f"{self.policy.min_free_kv_blocks} floor)",
+                queue_depth=snap.queue_depth,
+                retry_after_ms=self.retry_after_ms(snap),
+            )
+        if err is not None:
+            self.shed += 1
+            err.load = snap
+            return err
+        self.admitted += 1
+        return None

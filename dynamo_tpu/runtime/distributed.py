@@ -23,10 +23,12 @@ import json
 import logging
 import os
 import random
+import time
 import uuid
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional
 
+from dynamo_tpu.runtime.admission import LoadSnapshot, OverloadedError
 from dynamo_tpu.runtime.annotated import Annotated
 from dynamo_tpu.runtime.bus import MessageBusClient
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
@@ -113,6 +115,12 @@ class InstanceInfo:
     instance_id: str
     address: str  # host:port of the worker's rpc server
     worker_id: str
+    # overload-protection extras, refreshed by the worker's load-report
+    # heartbeat (re-put of this key): routers stop dispatching to draining
+    # instances and prefer the least-loaded ones. Optional on the wire so
+    # entries written by older workers still parse.
+    draining: bool = False
+    load: Optional[dict] = None  # LoadSnapshot wire form
 
     def to_json(self) -> bytes:
         return json.dumps(self.__dict__).encode()
@@ -120,7 +128,11 @@ class InstanceInfo:
     @classmethod
     def from_json(cls, raw: bytes) -> "InstanceInfo":
         d = json.loads(raw)
-        return cls(**{k: d[k] for k in ("instance_id", "address", "worker_id")})
+        return cls(
+            **{k: d[k] for k in ("instance_id", "address", "worker_id")},
+            draining=bool(d.get("draining", False)),
+            load=d.get("load") if isinstance(d.get("load"), dict) else None,
+        )
 
 
 class DistributedRuntime:
@@ -140,6 +152,16 @@ class DistributedRuntime:
         self._primary_lease: Optional[Lease] = None
         self._closed = asyncio.Event()
         self._background: list = []
+        # drain signal: load reporters re-put instance keys immediately on
+        # a drain toggle instead of waiting out their heartbeat interval.
+        # One event per reporter — a shared event would only wake whichever
+        # reporter clears it first.
+        self._drain_listeners: List[asyncio.Event] = []
+        # who ordered the drain: "local" (SIGUSR1 / API) and/or "store"
+        # (llmctl drain keys). Tracked separately so a statestore resync —
+        # which only knows about keys — can never undo an operator's
+        # signal-initiated drain, and vice versa.
+        self._drain_sources: set = set()
 
     @classmethod
     async def create(
@@ -185,6 +207,36 @@ class DistributedRuntime:
             self._rpc_server = RpcServer(host="0.0.0.0", port=0)
             await self._rpc_server.start()
         return self._rpc_server
+
+    @property
+    def draining(self) -> bool:
+        if self._rpc_server is not None:
+            return self._rpc_server.draining
+        return bool(self._drain_sources)
+
+    def set_draining(self, flag: bool, source: str = "local") -> None:
+        """Enter/leave drain mode: the RPC server rejects new requests with a
+        retryable ``draining`` reply (in-flight streams keep running), and
+        every endpoint's load reporter re-puts its instance key with the
+        draining flag so routers stop dispatching new work here. SIGUSR1
+        toggles the ``local`` source (runtime/worker.py); ``llmctl worker
+        drain`` drives the ``store`` source via control keys. The worker
+        drains while ANY source wants it — an undrain through one channel
+        must not cancel a drain ordered through the other."""
+        if flag:
+            self._drain_sources.add(source)
+        else:
+            self._drain_sources.discard(source)
+        effective = bool(self._drain_sources)
+        if self._rpc_server is not None:
+            self._rpc_server.set_draining(effective)
+        logger.info(
+            "worker %s %s (sources: %s)", self.worker_id,
+            "DRAINING" if effective else "undrained",
+            sorted(self._drain_sources) or "none",
+        )
+        for ev in self._drain_listeners:
+            ev.set()
 
     def namespace(self, name: str) -> "Namespace":
         return Namespace(self, name)
@@ -258,6 +310,13 @@ class Endpoint:
         return f"{self.component.base_key}/endpoints/{self.name}/instances/"
 
     @property
+    def drain_prefix(self) -> str:
+        """Operator drain control keys: ``{drain_prefix}{worker_id}`` (or
+        ``.../all``) present ⇒ that worker drains; deleted ⇒ undrain.
+        Written without a lease (llmctl) so they survive the CLI process."""
+        return f"{self.component.base_key}/endpoints/{self.name}/drain/"
+
+    @property
     def rpc_name(self) -> str:
         ns = self.component.namespace.name
         return f"{ns}.{self.component.name}.{self.name}"
@@ -310,7 +369,115 @@ class Endpoint:
         rt._background.append(
             asyncio.create_task(self._reregister_on_lease_loss(rt, lease, info, keys))
         )
+        rt._background.append(
+            asyncio.create_task(self._load_report_loop(rt, server, info))
+        )
+        rt._background.append(asyncio.create_task(self._drain_control_loop(rt)))
         return info
+
+    async def _load_report_loop(self, rt: "DistributedRuntime", server, info: InstanceInfo) -> None:
+        """Statestore heartbeat: periodically re-put the instance key with a
+        fresh load snapshot (+ draining flag). Every watching client gets
+        the put event, so the router's load view rides the existing watch
+        plane — no extra subscription. A drain toggle wakes the loop for an
+        immediate re-put."""
+        from dynamo_tpu.runtime.admission import _env_pos_float
+
+        interval = _env_pos_float("DYN_TPU_LOAD_REPORT_INTERVAL", 2.0)
+        wake = asyncio.Event()
+        rt._drain_listeners.append(wake)
+        try:
+            while True:
+                try:
+                    await asyncio.wait_for(wake.wait(), interval)
+                except asyncio.TimeoutError:
+                    pass
+                wake.clear()
+                snap = server.load_snapshot()
+                info.draining = snap.draining
+                info.load = snap.to_wire()
+                key = self.instances_prefix + info.instance_id
+                payload = info.to_json()
+                # keep the leased-key set fresh so re-registration after
+                # lease loss re-publishes current load, not the
+                # serve()-time snapshot
+                self._leased_keys[key] = payload
+                try:
+                    await rt.store.put(key, payload, lease=self._serve_lease)
+                except asyncio.CancelledError:
+                    raise
+                except (ConnectionError, RuntimeError, OSError):
+                    logger.debug("load report put failed", exc_info=True)
+        finally:
+            # the listener list lives as long as the runtime; this reporter
+            # doesn't — leaving the event behind would grow the list on
+            # every serve cycle
+            if wake in rt._drain_listeners:
+                rt._drain_listeners.remove(wake)
+
+    async def _drain_control_loop(self, rt: "DistributedRuntime") -> None:
+        """Apply operator drain keys (``llmctl worker drain``): a key put
+        under :attr:`drain_prefix` naming this worker (or ``all``) enters
+        drain mode; its deletion undrains. A drain issued while this worker
+        was down applies on arrival — but a restarted worker gets a fresh
+        worker_id, so a stale per-worker drain key never wedges the
+        replacement.
+
+        On every (re)subscription — and on every delete event — the CURRENT
+        key set is authoritative for the ``store`` drain source: an undrain
+        (key delete) that happened while the watch was down never produces
+        a delete event, and deleting ``.../all`` must not undrain a worker
+        whose per-worker key still exists (or the reverse). Only the
+        ``store`` source is touched: a SIGUSR1-initiated drain survives any
+        number of statestore resyncs."""
+
+        def _mine(key: str) -> bool:
+            return key.rsplit("/", 1)[-1] in (rt.worker_id, "all")
+
+        async def _apply_key_set() -> None:
+            wanted = any(_mine(k) for k in
+                         await rt.store.get_prefix(self.drain_prefix))
+            rt.set_draining(wanted, source="store")
+
+        backoff = 0.5
+        while True:
+            watcher = None
+            try:
+                try:
+                    await rt.store.get("__ping__")
+                except (ConnectionError, RuntimeError):
+                    # the client may have given up reconnecting entirely
+                    # (outage longer than its reconnect window): re-dial
+                    await rt.reconnect_store()
+                watcher = await rt.store.watch_prefix(
+                    self.drain_prefix, include_existing=True
+                )
+                await _apply_key_set()
+                backoff = 0.5  # healthy watch established
+                async for ev in watcher:
+                    if not _mine(ev.key):
+                        continue
+                    if ev.type == "put":
+                        rt.set_draining(True, source="store")
+                    else:
+                        await _apply_key_set()
+            except asyncio.CancelledError:
+                raise
+            except (ConnectionError, RuntimeError, OSError):
+                logger.warning("drain watch for %s lost; retrying", self.path,
+                               exc_info=True)
+            finally:
+                if watcher is not None:
+                    # unregister from the client — an abandoned watcher
+                    # leaks its event queue on every retry
+                    try:
+                        await watcher.cancel()
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        logger.debug("drain watcher cancel failed", exc_info=True)
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 10.0)
 
     async def add_leased_key(self, key: str, value: bytes) -> None:
         """Register an extra key under the serve lease; it participates in
@@ -389,8 +556,14 @@ class EndpointClient(AsyncEngine):
         )
         self._retry_rng = self.policy.rng()
         # observability: how often the resilience layer actually worked
-        self.stats = {"failures": 0, "failovers": 0, "deadline_expired": 0}
+        self.stats = {"failures": 0, "failovers": 0, "deadline_expired": 0,
+                      "overloaded": 0}
         self._instances: Dict[str, InstanceInfo] = {}
+        # per-instance load view: fed by reply piggybacks (freshest) and
+        # instance-key heartbeats (watch events); drives `load` mode picks,
+        # draining avoidance, and overload soft-ejects
+        self._loads: Dict[str, LoadSnapshot] = {}
+        self._avoid_until: Dict[str, float] = {}  # overload soft-eject, monotonic
         # stable worker_id → live instance_id: KV events/metrics are keyed by
         # worker_id (which survives lease loss), instances come and go
         self._by_worker: Dict[str, str] = {}
@@ -404,7 +577,7 @@ class EndpointClient(AsyncEngine):
         self._closed = False
         self._warned_no_tokens = False
 
-    VALID_MODES = ("random", "round_robin", "kv")
+    VALID_MODES = ("random", "round_robin", "kv", "load")
 
     async def start(self) -> None:
         if self.mode not in self.VALID_MODES and not self.mode.startswith("direct:"):
@@ -442,9 +615,14 @@ class EndpointClient(AsyncEngine):
                         continue
                     self._instances[iid] = info
                     self._by_worker[info.worker_id] = iid
+                    if info.load is not None:
+                        # heartbeat re-put: adopt the worker's own load view
+                        self._loads[iid] = LoadSnapshot.from_wire(info.load)
                     self._ready.set()
                 else:
                     gone = self._instances.pop(iid, None)
+                    self._loads.pop(iid, None)
+                    self._avoid_until.pop(iid, None)
                     self._breaker.forget(iid)
                     conn = self._conns.pop(iid, None)
                     if conn is not None:
@@ -486,6 +664,8 @@ class EndpointClient(AsyncEngine):
                     # (delete events handle the common case).
                     self._breaker.prune(self._instances)
                     self._instances.clear()
+                    self._loads.clear()
+                    self._avoid_until.clear()
                     if self._router is not None:
                         for wid in self._by_worker:
                             self._router.remove_worker(wid)
@@ -549,6 +729,22 @@ class EndpointClient(AsyncEngine):
     def instance_ids(self) -> List[str]:
         return sorted(self._instances)
 
+    def _note_load(self, iid: str, wire: dict) -> None:
+        """Adopt a load snapshot piggybacked on an RPC reply header."""
+        self._loads[iid] = LoadSnapshot.from_wire(wire)
+
+    def _is_draining(self, iid: str) -> bool:
+        info = self._instances.get(iid)
+        if info is not None and info.draining:
+            return True
+        snap = self._loads.get(iid)
+        return snap is not None and snap.draining
+
+    def _load_score(self, iid: str) -> float:
+        snap = self._loads.get(iid)
+        # unknown load = assume free: new instances get traffic immediately
+        return snap.utilization() if snap is not None else 0.0
+
     def _pick(self, request: Any, exclude: frozenset = frozenset()) -> str:
         ids = sorted(self._instances)
         if not ids:
@@ -564,12 +760,36 @@ class EndpointClient(AsyncEngine):
                 f"all {len(ids)} live instance(s) of {self.endpoint.path} "
                 f"failed this request"
             )
+        # drain-aware, strictly: a draining instance gets NO new work (its
+        # in-flight streams finish; that is the whole zero-downtime-restart
+        # contract). If every live instance is draining there is nothing
+        # legal to pick.
+        serving = [i for i in candidates if not self._is_draining(i)]
+        if not serving:
+            raise NoHealthyInstances(
+                f"all {len(candidates)} live instance(s) of "
+                f"{self.endpoint.path} are draining"
+            )
+        candidates = serving
         # breaker-aware: skip open/exhausted instances, but if EVERY
         # candidate is ejected, fall back to the full candidate set — a
         # last-ditch try beats a guaranteed failure
         healthy = [i for i in candidates if self._breaker.available(i)]
         if healthy:
             candidates = healthy
+        # overload soft-eject: prefer instances outside their retry_after
+        # window; unlike the breaker this never blocks the last resort
+        now = time.monotonic()
+        rested = [i for i in candidates if self._avoid_until.get(i, 0.0) <= now]
+        if rested:
+            candidates = rested
+        if self.mode == "load":
+            best = min(self._load_score(i) for i in candidates)
+            pool = [i for i in candidates if self._load_score(i) <= best + 1e-9]
+            # rotate among equally-loaded instances so a cold start (no
+            # load views yet) degrades to round-robin, not herd-on-first
+            self._rr = (self._rr + 1) % len(pool)
+            return pool[self._rr]
         if self.mode == "random":
             return random.choice(candidates)
         if self.mode == "kv" and self._router is not None:
@@ -604,6 +824,8 @@ class EndpointClient(AsyncEngine):
         conn = self._conns.get(iid)
         if conn is None or conn.closed:
             conn = await RpcClient.connect(self._instances[iid].address, timeout=timeout)
+            # freshest load signal: piggybacked on this worker's replies
+            conn.on_load = lambda wire, _iid=iid: self._note_load(_iid, wire)
             self._conns[iid] = conn
         return conn
 
@@ -705,6 +927,30 @@ class EndpointClient(AsyncEngine):
                     yield Annotated.from_error(str(e))
                     return
                 raise
+            except OverloadedError as e:
+                # the worker is healthy, just BUSY: a prompt typed rejection
+                # proves liveness, so the breaker records a success (a
+                # half-open probe answering OVERLOADED must re-admit, and an
+                # overloaded fleet must never breaker-eject itself into a
+                # smaller, even more overloaded one). Soft-eject instead:
+                # avoid this instance for its retry_after hint and fail over.
+                self._breaker.record_success(iid)
+                resolved = True
+                self.stats["overloaded"] += 1
+                self._avoid_until[iid] = (
+                    time.monotonic() + max(e.retry_after_ms, 1) / 1000.0
+                )
+                tried.add(iid)
+                attempt += 1
+                last_err = e
+                if attempt >= policy.max_attempts:
+                    # surface the typed overload (not AllInstancesFailed) so
+                    # the HTTP edge can answer 429 + Retry-After
+                    raise
+                self.stats["failovers"] += 1
+                delay = deadline.bound(policy.backoff(attempt, self._retry_rng))
+                if delay:
+                    await asyncio.sleep(delay)
             except (ConnectionError, OSError) as e:
                 if deadline.expired and not first_seen:
                     # the dial/read was cut by the request budget running
@@ -833,12 +1079,22 @@ async def attach_kv_publishing(
     bridge = KvPublishBridge(ns, worker_id)
     if hasattr(engine, "set_event_sink"):
         engine.set_event_sink(bridge)
+    server = ns.runtime._rpc_server
+    if server is not None and hasattr(engine, "metrics_snapshot"):
+        # the RPC server registers the *wrapper* engine (no capacity API);
+        # point its admission gate at the core engine's real capacity
+        server.admission.engine_probe = engine.metrics_snapshot
 
     async def metrics_loop():
         while True:
             await asyncio.sleep(interval)
             try:
                 snap = engine.metrics_snapshot()
+                if server is not None:
+                    # overload observability rides the same metrics stream
+                    snap["rpc_queue_depth"] = server.inflight_count
+                    snap["shed_requests"] = server.admission.shed
+                    snap["draining"] = int(server.draining)
                 await ns.publish(
                     KV_METRICS_SUBJECT, {"worker_id": worker_id, "metrics": snap}
                 )

@@ -127,10 +127,15 @@ def _env_float(name: str, default: Optional[float]) -> Optional[float]:
 
 
 def _env_int(name: str, default: int) -> int:
+    """Count knobs (attempts, breaker threshold): malformed, zero, or
+    negative values clamp to the default — "0 retries" or "-1 failures to
+    trip" are misconfigurations, not policies (same contract as the
+    ``DYN_TPU_ADMIT_*`` parsers in runtime/admission.py)."""
     try:
-        return int(os.environ.get(name, default))
+        v = int(os.environ.get(name, default))
     except ValueError:
         return default
+    return v if v > 0 else default
 
 
 @dataclass

@@ -19,19 +19,37 @@ Wire protocol (header JSON + body):
 not wall-clock — hosts don't share clocks); the worker sheds requests whose
 budget is already spent and stops streams whose budget expires mid-flight.
 Error replies carry ``retryable`` (safe to fail over to another instance:
-draining, transport trouble) and ``code`` ("deadline" | "draining" |
-"unknown_endpoint") so clients can map them without string matching.
+draining, overloaded, transport trouble) and ``code`` ("deadline" |
+"draining" | "overloaded" | "unknown_endpoint") so clients can map them
+without string matching. ``overloaded`` replies additionally carry
+``queue_depth`` + ``retry_after_ms``, and terminal replies (``done`` /
+``error``) piggyback a compact ``load`` snapshot so routers keep a live
+per-instance load view at zero extra round trips.
+
+Backpressure: every response stream writes through a bounded per-stream
+send queue (``AdmissionPolicy.send_queue_cap``). A slow reader fills the
+queue and the generator *pauses* instead of buffering tokens in worker
+memory; a reader that stays stalled past ``slow_consumer_timeout`` gets the
+stream cut (engine context killed).
 """
 
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import itertools
 import json
 import logging
-from typing import Any, AsyncIterator, Dict, Optional, Tuple
+import time
+from typing import Any, AsyncIterator, Callable, Dict, Optional, Tuple
 
 from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.admission import (
+    AdmissionController,
+    LoadSnapshot,
+    OverloadedError,
+    SlowConsumer,
+)
 from dynamo_tpu.runtime.annotated import Annotated
 from dynamo_tpu.runtime.codec import CodecError, TwoPartMessage, read_frame, write_frame
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
@@ -46,20 +64,111 @@ from dynamo_tpu.runtime.resilience import (
 logger = logging.getLogger(__name__)
 
 
+class _StreamSender:
+    """Bounded per-stream send queue + drain task.
+
+    The generator side awaits :meth:`send`, which blocks once ``cap`` frames
+    are queued — that pause IS the backpressure (the engine stream stops
+    being pulled). A queue that stays full past ``stall_timeout`` means the
+    reader is gone or wedged: :meth:`send` raises :class:`SlowConsumer` so
+    the caller can kill the stream instead of holding tokens forever.
+    """
+
+    def __init__(self, writer: asyncio.StreamWriter, write_lock: asyncio.Lock,
+                 cap: int, stall_timeout: float):
+        self.cap = max(cap, 1)
+        self.stall_timeout = stall_timeout
+        self.peak = 0  # high-water mark, for tests/metrics
+        self.dead: Optional[BaseException] = None
+        self._q: asyncio.Queue = asyncio.Queue(maxsize=self.cap)
+        self._task = asyncio.create_task(self._drain(writer, write_lock))
+
+    async def _drain(self, writer: asyncio.StreamWriter, lock: asyncio.Lock) -> None:
+        while True:
+            frame = await self._q.get()
+            if frame is None:
+                return
+            try:
+                async with lock:
+                    await write_frame(writer, frame)
+            except (ConnectionError, OSError) as e:
+                self.dead = e
+                return
+
+    async def send(self, header: dict, payload: bytes = b"") -> None:
+        if self.dead is not None:
+            raise ConnectionError(f"stream writer dead: {self.dead}")
+        frame = TwoPartMessage(json.dumps(header).encode(), payload)
+        try:
+            self._q.put_nowait(frame)
+        except asyncio.QueueFull:
+            # queue full: the reader is behind. Block (backpressure) up to
+            # the slow-consumer bound, then cut the stream.
+            try:
+                await asyncio.wait_for(self._q.put(frame), self.stall_timeout)
+            except asyncio.TimeoutError:
+                raise SlowConsumer(
+                    f"send queue full ({self.cap}) for "
+                    f"{self.stall_timeout:.1f}s — reader stalled"
+                ) from None
+        self.peak = max(self.peak, self._q.qsize())
+        if self.dead is not None:
+            raise ConnectionError(f"stream writer dead: {self.dead}")
+
+    async def close(self) -> None:
+        """Flush queued frames and stop the drain task. Must be awaited from
+        the request task's ``finally`` — if that task is itself being
+        cancelled, the drain task is cancelled too (never leaked). BOTH
+        waits are bounded: a reader whose TCP buffer wedged mid-``drain()``
+        would otherwise pin this request in ``_inflight`` forever, eating
+        an admission slot on a healthy worker."""
+        if self.dead is None and not self._task.done():
+            try:
+                await asyncio.wait_for(self._q.put(None), self.stall_timeout)
+                # wait_for cancels the drain task on timeout — exactly the
+                # slow-consumer cut, applied at stream end
+                await asyncio.wait_for(self._task, self.stall_timeout)
+                return
+            except asyncio.TimeoutError:
+                pass  # reader wedged mid-close: abandon the flush
+            except asyncio.CancelledError:
+                self._task.cancel()
+                raise
+        self._task.cancel()
+
+
 class RpcServer:
     """Serves registered engines over TCP; tracks in-flight requests and
     drains them on stop (reference PushEndpoint semantics)."""
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 admission: Optional[AdmissionController] = None):
         self.host = host
         self.port = port
         self._engines: Dict[str, AsyncEngine] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._inflight: set = set()
         self._draining = False
+        self.admission = admission or AdmissionController()
+        self.send_queue_peak = 0  # high-water mark across all streams
 
     def register(self, endpoint: str, engine: AsyncEngine) -> None:
         self._engines[endpoint] = engine
+        # engines exposing capacity (engine_jax metrics_snapshot) feed the
+        # admission gate + load snapshots; wrapper engines without it leave
+        # the gate bounding the RPC pending count alone
+        if self.admission.engine_probe is None and hasattr(engine, "metrics_snapshot"):
+            self.admission.engine_probe = engine.metrics_snapshot
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def set_draining(self, flag: bool) -> None:
+        self._draining = bool(flag)
+
+    def load_snapshot(self) -> LoadSnapshot:
+        return self.admission.snapshot(len(self._inflight), draining=self._draining)
 
     async def start(self) -> None:
         from dynamo_tpu.runtime.netutil import TrackedServer
@@ -121,7 +230,29 @@ class RpcServer:
                                 json.dumps({"id": h["id"], "op": "error",
                                             "message": "worker draining",
                                             "code": "draining",
-                                            "retryable": True}).encode(), b""))
+                                            "retryable": True,
+                                            "load": self.load_snapshot().to_wire(),
+                                            }).encode(), b""))
+                        continue
+                    shed = self.admission.try_admit(len(self._inflight))
+                    if shed is not None:
+                        # bounded degradation: answer NOW with a typed,
+                        # retryable rejection + back-off hint instead of
+                        # queueing the request toward a timeout. The gate's
+                        # own snapshot rides the reply — no second engine
+                        # probe at the worker's busiest moment.
+                        load = shed.load or self.load_snapshot()
+                        load.draining = self._draining
+                        async with write_lock:
+                            await write_frame(writer, TwoPartMessage(
+                                json.dumps({"id": h["id"], "op": "error",
+                                            "message": str(shed),
+                                            "code": "overloaded",
+                                            "retryable": True,
+                                            "queue_depth": shed.queue_depth,
+                                            "retry_after_ms": shed.retry_after_ms,
+                                            "load": load.to_wire(),
+                                            }).encode(), b""))
                         continue
                     task = asyncio.create_task(
                         self._serve_request(h, frame.body, writer, write_lock, contexts)
@@ -148,64 +279,108 @@ class RpcServer:
     async def _serve_request(self, h, body, writer, write_lock, contexts) -> None:
         req_id = h["id"]
         engine = self._engines.get(h.get("endpoint", ""))
+        policy = self.admission.policy
+        # all frames for this stream ride a BOUNDED queue: a slow reader
+        # pauses the generator (backpressure) instead of growing worker
+        # memory, and a stalled one gets the stream cut below
+        sender = _StreamSender(writer, write_lock, policy.send_queue_cap,
+                               policy.slow_consumer_timeout)
 
         async def send(header: dict, payload: bytes = b"") -> None:
-            async with write_lock:
-                await write_frame(writer, TwoPartMessage(json.dumps(header).encode(), payload))
+            await sender.send(header, payload)
 
-        if engine is None:
-            await send({"id": req_id, "op": "error",
-                        "message": f"no such endpoint {h.get('endpoint')!r}",
-                        "code": "unknown_endpoint"})
-            return
-        # the client sends its REMAINING budget; re-anchor it to this host's
-        # clock. A request that expired in the queue/network is shed before
-        # it touches the engine (reference: no analogue — NATS just redelivers)
-        deadline: Optional[Deadline] = None
-        deadline_ms = h.get("deadline_ms")
-        if deadline_ms is not None:
-            try:
-                deadline = Deadline.after(float(deadline_ms) / 1000.0)
-            except (TypeError, ValueError):
-                deadline = None
-        if deadline is not None and deadline.expired:
-            await send({"id": req_id, "op": "error",
-                        "message": f"{DEADLINE_ERROR}: expired before start",
-                        "code": "deadline"})
-            return
+        def load_wire() -> dict:
+            return self.load_snapshot().to_wire()
+
+        ctx: Optional[Context] = None
         try:
-            payload = json.loads(body) if body else None
-            ctx = Context(payload, request_id=h.get("request_id"))
-            contexts[req_id] = ctx
-            stream = engine.generate(ctx)
-            if hasattr(stream, "__await__"):
-                stream = await stream
-            async for item in stream:
-                if deadline is not None and deadline.expired:
-                    # nobody is waiting for these tokens anymore: stop the
-                    # engine and tell the client why the stream ended
-                    ctx.context.kill()
-                    await send({"id": req_id, "op": "error",
-                                "message": f"{DEADLINE_ERROR}: mid-stream",
-                                "code": "deadline"})
-                    return
-                d = item.to_dict() if isinstance(item, Annotated) else item
-                await send({"id": req_id, "op": "item"}, json.dumps(d).encode())
-            await send({"id": req_id, "op": "done"})
-        except (ConnectionError, asyncio.CancelledError):
-            raise
-        except Exception as e:
-            logger.exception("rpc handler error (req %s)", req_id)
+            if engine is None:
+                await send({"id": req_id, "op": "error",
+                            "message": f"no such endpoint {h.get('endpoint')!r}",
+                            "code": "unknown_endpoint", "load": load_wire()})
+                return
+            # the client sends its REMAINING budget; re-anchor it to this
+            # host's clock. A request that expired in the queue/network is
+            # shed before it touches the engine (reference: no analogue —
+            # NATS just redelivers)
+            deadline: Optional[Deadline] = None
+            deadline_ms = h.get("deadline_ms")
+            if deadline_ms is not None:
+                try:
+                    deadline = Deadline.after(float(deadline_ms) / 1000.0)
+                except (TypeError, ValueError):
+                    deadline = None
+            if deadline is not None and deadline.expired:
+                await send({"id": req_id, "op": "error",
+                            "message": f"{DEADLINE_ERROR}: expired before start",
+                            "code": "deadline", "load": load_wire()})
+                return
             try:
-                await send({"id": req_id, "op": "error", "message": str(e)})
-            except ConnectionError:
-                pass
+                payload = json.loads(body) if body else None
+                ctx = Context(payload, request_id=h.get("request_id"))
+                contexts[req_id] = ctx
+                stream = engine.generate(ctx)
+                if hasattr(stream, "__await__"):
+                    stream = await stream
+                async for item in stream:
+                    if deadline is not None and deadline.expired:
+                        # nobody is waiting for these tokens anymore: stop
+                        # the engine and tell the client why the stream ended
+                        ctx.context.kill()
+                        await send({"id": req_id, "op": "error",
+                                    "message": f"{DEADLINE_ERROR}: mid-stream",
+                                    "code": "deadline", "load": load_wire()})
+                        return
+                    d = item.to_dict() if isinstance(item, Annotated) else item
+                    await send({"id": req_id, "op": "item"}, json.dumps(d).encode())
+                await send({"id": req_id, "op": "done", "load": load_wire()})
+            except SlowConsumer as e:
+                # reader stalled with a full queue: kill the engine context
+                # and drop the stream — no reply can reach a reader that
+                # stopped reading, and holding its tokens would defeat the
+                # memory bound. Mark the sender dead so close() below
+                # cancels instead of waiting out another flush window.
+                self.admission.slow_consumer_cuts += 1
+                logger.warning("cutting stream %s: %s", req_id, e)
+                sender.dead = e
+                if ctx is not None:
+                    ctx.context.kill()
+            except (ConnectionError, asyncio.CancelledError):
+                raise
+            except Exception as e:
+                logger.exception("rpc handler error (req %s)", req_id)
+                try:
+                    await send({"id": req_id, "op": "error", "message": str(e),
+                                "load": load_wire()})
+                except (ConnectionError, SlowConsumer):
+                    pass
         finally:
             contexts.pop(req_id, None)
+            self.send_queue_peak = max(self.send_queue_peak, sender.peak)
+            await sender.close()
+
+
+def _force_push(q: asyncio.Queue, item) -> None:
+    """Deliver a terminal event even to a full (slow-consumer) queue by
+    dropping the oldest buffered frame — the stream is ending in an error
+    either way, and the consumer must observe the termination."""
+    try:
+        q.put_nowait(item)
+    except asyncio.QueueFull:
+        with contextlib.suppress(asyncio.QueueEmpty):
+            q.get_nowait()
+        with contextlib.suppress(asyncio.QueueFull):
+            q.put_nowait(item)
 
 
 class RpcClient:
     """Multiplexed client connection to one worker."""
+
+    # per-stream receive buffer bound: past this many undelivered frames the
+    # consumer is considered slow; the read loop first blocks (propagating
+    # TCP backpressure to the worker), then cuts the stream
+    STREAM_QUEUE_CAP = 256
+    SLOW_CONSUMER_TIMEOUT = 30.0
 
     def __init__(self, host: str, port: int):
         self.host = host
@@ -216,7 +391,16 @@ class RpcClient:
         self._streams: Dict[int, asyncio.Queue] = {}
         self._reader_task: Optional[asyncio.Task] = None
         self._send_lock = asyncio.Lock()
+        self._kill_tasks: set = set()
+        # per-stream cumulative stall clock: started when a stream's queue
+        # first overflows, cleared only when a put succeeds WITHOUT waiting
+        # — a consumer trickling one frame per grace window must not reset
+        # the timer and stall the shared reader forever
+        self._stall_since: Dict[Any, float] = {}
         self.closed = False
+        # optional hook: piggybacked worker load snapshots from reply
+        # headers (EndpointClient feeds its per-instance load view with it)
+        self.on_load: Optional[Callable[[dict], None]] = None
 
     @classmethod
     async def connect(cls, address: str, timeout: Optional[float] = None) -> "RpcClient":
@@ -243,7 +427,23 @@ class RpcClient:
         if self._writer:
             self._writer.close()
         for q in self._streams.values():
-            q.put_nowait(("error", {"message": "connection closed", "retryable": True}))
+            _force_push(q, ("error", {"message": "connection closed", "retryable": True}))
+
+    def _cut_slow_stream(self, req_id, q: asyncio.Queue) -> None:
+        """Local consumer stopped draining: drop the stream (bounded client
+        memory, mirror of the server-side slow-consumer cut) and tell the
+        worker to stop generating for it."""
+        self._streams.pop(req_id, None)
+        _force_push(q, ("error", {"message": "slow consumer: stream dropped "
+                                             "locally", "retryable": False}))
+
+        async def _kill():
+            with contextlib.suppress(ConnectionError, OSError):
+                await self._send({"id": req_id, "op": "kill"})
+
+        t = asyncio.get_running_loop().create_task(_kill())
+        self._kill_tasks.add(t)
+        t.add_done_callback(self._kill_tasks.discard)
 
     async def _read_loop(self) -> None:
         try:
@@ -254,24 +454,60 @@ class RpcClient:
                     # same hardening as the server side: a JSON-valid but
                     # non-object header must not kill the reader silently
                     raise ValueError("response header is not a JSON object")
+                load = h.get("load")
+                if isinstance(load, dict) and self.on_load is not None:
+                    try:
+                        self.on_load(load)
+                    except Exception:
+                        logger.debug("on_load hook failed", exc_info=True)
                 q = self._streams.get(h.get("id"))
                 if q is None:
                     continue
                 op = h.get("op")
                 if op == "item":
-                    q.put_nowait(("item", frame.body))
+                    item = ("item", frame.body)
                 elif op == "done":
-                    q.put_nowait(("done", None))
+                    item = ("done", None)
                 elif op == "error":
-                    q.put_nowait(("error", {
+                    item = ("error", {
                         "message": h.get("message", "remote error"),
                         "code": h.get("code"),
                         "retryable": bool(h.get("retryable")),
-                    }))
+                        "queue_depth": h.get("queue_depth"),
+                        "retry_after_ms": h.get("retry_after_ms"),
+                    })
+                else:
+                    continue
+                try:
+                    q.put_nowait(item)
+                    self._stall_since.pop(h.get("id"), None)
+                except asyncio.QueueFull:
+                    # consumer is STREAM_QUEUE_CAP frames behind: stop
+                    # reading the socket (TCP backpressure reaches the
+                    # worker's bounded send queue). Blocking here stalls
+                    # every stream on this multiplexed connection, so the
+                    # stall budget is CUMULATIVE per stream — once a
+                    # stream has spent SLOW_CONSUMER_TIMEOUT blocking the
+                    # reader it is cut, even if it trickled frames through
+                    rid = h.get("id")
+                    now = time.monotonic()
+                    start = self._stall_since.setdefault(rid, now)
+                    budget = self.SLOW_CONSUMER_TIMEOUT - (now - start)
+                    delivered = False
+                    if budget > 0:
+                        try:
+                            await asyncio.wait_for(q.put(item), budget)
+                            delivered = True
+                        except asyncio.TimeoutError:
+                            pass
+                    if not delivered:
+                        self._stall_since.pop(rid, None)
+                        self._cut_slow_stream(rid, q)
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             self.closed = True
             for q in self._streams.values():
-                q.put_nowait(("error", {"message": "connection lost", "retryable": True}))
+                _force_push(q, ("error", {"message": "connection lost",
+                                          "retryable": True}))
         except (CodecError, ValueError):
             # a server speaking garbage is as dead as a closed socket
             logger.warning("malformed frame from worker %s:%d", self.host, self.port)
@@ -279,8 +515,8 @@ class RpcClient:
             if self._writer:
                 self._writer.close()
             for q in self._streams.values():
-                q.put_nowait(("error", {"message": "malformed response frame",
-                                        "retryable": True}))
+                _force_push(q, ("error", {"message": "malformed response frame",
+                                          "retryable": True}))
 
     async def _send(self, header: dict, body: bytes = b"") -> None:
         async with self._send_lock:
@@ -307,7 +543,7 @@ class RpcClient:
         EndpointClient needs to distinguish them from application errors,
         which are always yielded in-band."""
         req_id = next(self._ids)
-        q: asyncio.Queue = asyncio.Queue()
+        q: asyncio.Queue = asyncio.Queue(maxsize=self.STREAM_QUEUE_CAP)
         self._streams[req_id] = q
         if hasattr(request, "to_dict"):
             payload = request.to_dict()
@@ -378,6 +614,12 @@ class RpcClient:
                     if raise_transport:
                         if info.get("code") == "deadline":
                             raise DeadlineExceeded(msg)
+                        if info.get("code") == "overloaded":
+                            raise OverloadedError(
+                                msg,
+                                queue_depth=int(info.get("queue_depth") or 0),
+                                retry_after_ms=int(info.get("retry_after_ms") or 0),
+                            )
                         if info.get("retryable"):
                             raise RetryableRpcError(msg)
                     yield Annotated.from_error(msg)
@@ -386,3 +628,4 @@ class RpcClient:
             if monitor:
                 monitor.cancel()
             self._streams.pop(req_id, None)
+            self._stall_since.pop(req_id, None)

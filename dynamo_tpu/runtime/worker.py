@@ -18,6 +18,13 @@ Exit codes:
   reference uses for the same condition)
 
 A second signal during the drain skips straight to the hard exit.
+
+SIGUSR1 toggles **drain mode** without exiting: the worker stays registered
+(instance key re-put with ``draining: true``), routers stop dispatching new
+work to it, the RPC server rejects stragglers with a retryable ``draining``
+reply, and in-flight streams run to completion — the operator half of a
+zero-downtime rolling restart (``llmctl worker drain`` does the same through
+the statestore; docs/overload.md has the runbook).
 """
 
 from __future__ import annotations
@@ -38,10 +45,14 @@ DEFAULT_TIMEOUT = 30.0
 
 
 def graceful_timeout() -> float:
+    """Drain window before the hard exit. Malformed, zero, or negative env
+    values clamp to the default — honoring ``0`` would turn every graceful
+    shutdown into an instant 911, and a negative value is never meaningful."""
     try:
-        return float(os.environ.get("DYN_TPU_GRACEFUL_SHUTDOWN_TIMEOUT", DEFAULT_TIMEOUT))
+        v = float(os.environ.get("DYN_TPU_GRACEFUL_SHUTDOWN_TIMEOUT", DEFAULT_TIMEOUT))
     except ValueError:
         return DEFAULT_TIMEOUT
+    return v if v > 0 else DEFAULT_TIMEOUT
 
 
 async def serve_until_shutdown(drt, engine=None) -> None:
@@ -68,6 +79,15 @@ async def serve_until_shutdown(drt, engine=None) -> None:
         try:
             loop.add_signal_handler(sig, on_signal, sig.name)
         except (NotImplementedError, RuntimeError):  # non-main thread / platform
+            pass
+
+    def on_drain_toggle() -> None:
+        drt.set_draining(not drt.draining)
+
+    if hasattr(signal, "SIGUSR1") and hasattr(drt, "set_draining"):
+        try:
+            loop.add_signal_handler(signal.SIGUSR1, on_drain_toggle)
+        except (NotImplementedError, RuntimeError):
             pass
 
     closed = asyncio.create_task(drt.wait_closed())

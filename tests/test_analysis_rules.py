@@ -151,6 +151,67 @@ def test_suppression_on_standalone_comment_line_covers_next_stmt(tmp_path):
     assert "blocking-call-in-async" not in rules_fired(findings)
 
 
+# -- unbounded-queue --------------------------------------------------------
+
+RUNTIME = "dynamo_tpu/runtime/mod.py"
+
+UNBOUNDED_QUEUE_CASES = [
+    ("bare_queue", "import asyncio\nq = asyncio.Queue()\n", True),
+    (
+        "from_import",
+        "from asyncio import Queue\nq = Queue()\n",
+        True,
+    ),
+    (
+        "in_class_init",
+        "import asyncio\nclass C:\n    def __init__(self):\n"
+        "        self.q = asyncio.Queue()\n",
+        True,
+    ),
+    ("maxsize_kw_ok", "import asyncio\nq = asyncio.Queue(maxsize=64)\n", False),
+    ("maxsize_pos_ok", "import asyncio\nq = asyncio.Queue(64)\n", False),
+    (
+        "computed_bound_ok",
+        "import asyncio\ndef f(cap):\n    return asyncio.Queue(maxsize=cap)\n",
+        False,
+    ),
+    (
+        "explicit_zero_is_deliberate",
+        # maxsize=0 is the same unbounded behavior, but written out — a
+        # reviewer can see the choice; only the silent default is flagged
+        "import asyncio\nq = asyncio.Queue(maxsize=0)\n",
+        False,
+    ),
+    ("other_queue_class_ok", "import queue\nq = queue.Queue()\n", False),
+]
+
+
+@pytest.mark.parametrize(
+    "name,src,expect", UNBOUNDED_QUEUE_CASES, ids=[c[0] for c in UNBOUNDED_QUEUE_CASES]
+)
+def test_unbounded_queue(tmp_path, name, src, expect):
+    findings = lint_tree(tmp_path, {RUNTIME: src})
+    fired = "unbounded-queue" in rules_fired(findings)
+    assert fired == expect, [f.render() for f in findings]
+
+
+def test_unbounded_queue_scoped_to_runtime(tmp_path):
+    """The rule is scoped: the same construct outside dynamo_tpu/runtime/
+    (tools, tests, examples) is not the hot data plane and stays quiet."""
+    src = "import asyncio\nq = asyncio.Queue()\n"
+    findings = lint_tree(tmp_path, {"dynamo_tpu/cli/mod.py": src, "tools/x.py": src})
+    assert "unbounded-queue" not in rules_fired(findings)
+
+
+def test_unbounded_queue_suppressed(tmp_path):
+    src = (
+        "import asyncio\n"
+        "q = asyncio.Queue()  # dynlint: disable=unbounded-queue\n"
+    )
+    findings = lint_tree(tmp_path, {RUNTIME: src})
+    assert "unbounded-queue" not in rules_fired(findings)
+
+
 # -- unawaited-coroutine / dangling-task ------------------------------------
 
 UNAWAITED_CASES = [

@@ -19,6 +19,7 @@ import os
 import pytest
 
 from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.admission import OverloadedError
 from dynamo_tpu.runtime.annotated import Annotated
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
@@ -36,12 +37,13 @@ NO_BUS = "127.0.0.1:1"
 
 
 class ChunkEngine(AsyncEngine):
-    def __init__(self, tag: str):
+    def __init__(self, tag: str, delay: float = 0.0):
         self.tag = tag
+        self.delay = delay
 
     async def generate(self, request: Context):
         for i in range(4):
-            await asyncio.sleep(0)
+            await asyncio.sleep(self.delay)
             yield Annotated.from_data({"i": i, "worker": self.tag})
 
 
@@ -55,14 +57,15 @@ def _chaos_rules(reset_p: float, refuse_p: float):
 
 
 async def _run_chaos(n_workers: int, n_requests: int, reset_p: float,
-                     refuse_p: float, seed: int):
+                     refuse_p: float, seed: int, concurrency: int = 1,
+                     engine_delay: float = 0.0):
     ss = StateStoreServer(port=0)
     await ss.start()
     rts = []
     for i in range(n_workers):
         rt = await DistributedRuntime.create(ss.url, NO_BUS)
         await rt.namespace("chaos").component("w").endpoint("g").serve(
-            ChunkEngine(f"w{i}")
+            ChunkEngine(f"w{i}", delay=engine_delay)
         )
         rts.append(rt)
     fe = await DistributedRuntime.create(ss.url, NO_BUS)
@@ -84,6 +87,10 @@ async def _run_chaos(n_workers: int, n_requests: int, reset_p: float,
             items = [
                 i async for i in client.generate(Context({"req": idx}))
             ]
+        except OverloadedError:
+            # bounded degradation, not a failure: the shed carried a
+            # retry_after hint and cost the worker ~nothing
+            return "clean-failure:OverloadedError"
         except (DeadlineExceeded, AllInstancesFailed, NoHealthyInstances) as e:
             return f"clean-failure:{type(e).__name__}"
         if not items:
@@ -95,11 +102,14 @@ async def _run_chaos(n_workers: int, n_requests: int, reset_p: float,
         return "ok"
 
     with faults.active(inj):
-        for idx in range(n_requests):
+        for start in range(0, n_requests, concurrency):
             # the 10s bound is the no-hang invariant: well above the 8s
             # request deadline, so hitting it means the deadline failed
-            outcome = await asyncio.wait_for(one(idx), timeout=10.0)
-            outcomes.append(outcome)
+            wave = [
+                asyncio.wait_for(one(idx), timeout=10.0)
+                for idx in range(start, min(start + concurrency, n_requests))
+            ]
+            outcomes.extend(await asyncio.gather(*wave))
 
     # faults cleared: the cluster must fully recover
     await asyncio.sleep(0.6)  # one breaker cooldown
@@ -139,6 +149,24 @@ def test_chaos_fast_deterministic(run):
     outcomes, recovered, inj = run(go())
     _assert_invariants(outcomes, recovered, inj, CHAOS_SEED)
     assert len(inj.log) > 0, "chaos run injected no faults — rates too low"
+
+
+def test_chaos_overload_and_faults_combined(run, monkeypatch):
+    """Tier-1: overload AND transport faults at once, seeded. Tiny admission
+    budgets + concurrent waves + slow engines force OVERLOADED sheds while
+    resets/refusals force failover — the combination must still degrade
+    cleanly: typed failures only, no hangs, no corruption, full recovery."""
+    monkeypatch.setenv("DYN_TPU_ADMIT_MAX_PENDING", "2")
+    seed = CHAOS_SEED + 100
+
+    def go():
+        return _run_chaos(
+            n_workers=3, n_requests=24, reset_p=0.05, refuse_p=0.10,
+            seed=seed, concurrency=8, engine_delay=0.02,
+        )
+
+    outcomes, recovered, inj = run(go())
+    _assert_invariants(outcomes, recovered, inj, seed)
 
 
 @pytest.mark.slow

@@ -194,7 +194,10 @@ class TestDisaggMultiProcess:
                 "--max-model-len", "128", "--kv-block-size", "8",
                 "--port", str(agg_port),
             ])
-            assert _wait_port(agg_port)
+            # a fresh jax server builds its engine before binding: give it
+            # the same generous warmup budget as the disagg trio above, not
+            # the 20s infra default (observed flaky on a loaded host)
+            assert _wait_port(agg_port, timeout=60.0)
             deadline = time.time() + 90
             agg_body = None
             while time.time() < deadline:

@@ -194,6 +194,7 @@ class _MidStreamFailEngine:
 
 
 def _flaky_service():
+    from dynamo_tpu.runtime.admission import OverloadedError
     from dynamo_tpu.runtime.resilience import AllInstancesFailed, DeadlineExceeded
 
     manager = ModelManager()
@@ -206,6 +207,16 @@ def _flaky_service():
     )
     manager.add_chat_model(
         "raises-504", _RaisingEngine(DeadlineExceeded("deadline exceeded: 2s"))
+    )
+    manager.add_chat_model(
+        "raises-429",
+        _RaisingEngine(
+            OverloadedError("overloaded: pending queue full (4/4)",
+                            queue_depth=6, retry_after_ms=2300)
+        ),
+    )
+    manager.add_chat_model(
+        "envelope-429", _PreFailEngine("overloaded: pending queue full (4/4)")
     )
     manager.add_chat_model("flaky", _MidStreamFailEngine())
     return HttpService(manager, host="127.0.0.1", port=0)
@@ -233,6 +244,39 @@ def test_pre_first_token_failures_map_to_502_504(run, model, status, stream):
             assert resp.status == status, await resp.text()
             body = await resp.json()
             assert body["error"]["type"] == "internal_error"
+
+    run(_with_service(_flaky_service(), fn))
+
+
+@pytest.mark.parametrize("stream", [False, True])
+@pytest.mark.parametrize("model,retry_after", [
+    ("raises-429", "3"),     # typed: ceil(2300ms) → 3s
+    ("envelope-429", "1"),   # in-band envelope: default 1s hint
+])
+def test_overloaded_maps_to_429_with_retry_after(run, model, retry_after, stream):
+    """An upstream that shed the request as OVERLOADED (typed exception from
+    the router, or the canonical message prefix in an error envelope) must
+    surface as 429 with a Retry-After header and an OpenAI-shaped error
+    body — not a generic 502."""
+
+    async def fn(session, base):
+        async with session.post(
+            f"{base}/v1/chat/completions",
+            json={"model": model,
+                  "messages": [{"role": "user", "content": "x"}],
+                  "stream": stream},
+        ) as resp:
+            assert resp.status == 429, await resp.text()
+            assert resp.headers.get("Retry-After") == retry_after
+            body = await resp.json()
+            assert body["error"]["type"] == "overloaded_error"
+            assert body["error"]["code"] == "overloaded"
+            assert body["error"]["message"].startswith("overloaded")
+        # shed requests get their own status label + counter
+        async with session.get(f"{base}/metrics") as resp:
+            text = await resp.text()
+        assert f'dynamo_frontend_overloaded_total{{model="{model}"}} 1' in text
+        assert 'status="overloaded"' in text
 
     run(_with_service(_flaky_service(), fn))
 
