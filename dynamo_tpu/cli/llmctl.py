@@ -7,6 +7,7 @@
     python -m dynamo_tpu.cli.llmctl disagg get
     python -m dynamo_tpu.cli.llmctl disagg set --max-local-prefill-length 2000
     python -m dynamo_tpu.cli.llmctl worker list <dyn://ns.comp.ep>
+    python -m dynamo_tpu.cli.llmctl worker health [--json] <dyn://ns.comp.ep>
     python -m dynamo_tpu.cli.llmctl worker drain <dyn://ns.comp.ep> <worker_id|all>
     python -m dynamo_tpu.cli.llmctl worker undrain <dyn://ns.comp.ep> <worker_id|all>
 
@@ -15,7 +16,9 @@
 work, in-flight streams finish, and the process can be restarted with zero
 failed requests (docs/overload.md has the rolling-restart runbook).
 ``undrain`` deletes the key. ``worker list`` shows each live instance with
-its draining flag and last load snapshot.
+its draining flag and last load snapshot. ``worker health`` reads the same
+instance keys and shows the health plane's view: state, last heartbeat age,
+and the stall/reap counters (docs/health.md has the stuck-worker runbook).
 
 Writes/deletes ``{ns}/models/{kind}/{name}`` entries WITHOUT a lease (they
 outlive this process, like the reference's `for_cli` etcd config) so an
@@ -69,6 +72,9 @@ def build_parser() -> argparse.ArgumentParser:
     wverbs = worker.add_subparsers(dest="verb", required=True)
     wls = wverbs.add_parser("list")
     wls.add_argument("endpoint", help="dyn://ns.comp.ep")
+    wh = wverbs.add_parser("health", help="per-instance health state")
+    wh.add_argument("endpoint", help="dyn://ns.comp.ep")
+    wh.add_argument("--json", action="store_true", dest="as_json")
     for verb in ("drain", "undrain"):
         wp = wverbs.add_parser(verb)
         wp.add_argument("endpoint", help="dyn://ns.comp.ep")
@@ -111,6 +117,53 @@ async def amain(argv: list) -> int:
                     print(f"{info.worker_id:14s} {info.instance_id:18s} "
                           f"{info.address:22s} {flag:9s} {load}")
                 if not entries:
+                    print(f"(no live instances for {args.endpoint})")
+                return 0
+            if args.verb == "health":
+                import time
+
+                from dynamo_tpu.runtime.distributed import InstanceInfo
+
+                entries = await store.get_prefix(f"{base}/instances/")
+                now = time.time()
+                rows = []
+                for key in sorted(entries):
+                    try:
+                        info = InstanceInfo.from_json(entries[key])
+                    except (ValueError, KeyError):
+                        continue
+                    counters = info.health_counters or {}
+                    rows.append({
+                        "worker_id": info.worker_id,
+                        "instance_id": info.instance_id,
+                        "address": info.address,
+                        "health": info.health,
+                        "draining": bool(info.draining),
+                        # heartbeat age from the worker's last re-put; None
+                        # for pre-health-plane workers that never stamp ts
+                        "heartbeat_age_s": (
+                            round(max(now - info.ts, 0.0), 1)
+                            if info.ts else None
+                        ),
+                        "stalls_total": int(counters.get("stalls_total", 0)),
+                        "reaped_requests_total": int(
+                            counters.get("reaped_requests_total", 0)
+                        ),
+                    })
+                if args.as_json:
+                    print(json.dumps(rows, indent=2))
+                    return 0
+                for r in rows:
+                    age = r["heartbeat_age_s"]
+                    hb = "-" if age is None else f"{age:.1f}s"
+                    print(
+                        f'{r["worker_id"]:14s} {r["instance_id"]:18s} '
+                        f'{r["health"]:9s} '
+                        f'{"DRAINING" if r["draining"] else "serving":9s} '
+                        f'hb={hb:>7s} stalls={r["stalls_total"]} '
+                        f'reaped={r["reaped_requests_total"]}'
+                    )
+                if not rows:
                     print(f"(no live instances for {args.endpoint})")
                 return 0
             key = f"{base}/drain/{args.worker_id}"

@@ -52,7 +52,15 @@ GAUGES = [
     ("rpc_queue_depth", "RPC-layer pending requests (accepted, not finished)"),
     ("shed_requests", "Requests shed by admission control (cumulative)"),
     ("draining", "1 while the worker is draining (no new work routed)"),
+    # health plane (docs/health.md): cumulative engine stalls and
+    # reaped stuck requests per worker
+    ("stalls_total", "Engine-stall detections (cumulative)"),
+    ("reaped_requests_total", "Stuck requests reaped past deadline (cumulative)"),
 ]
+
+# health_state is a string on the wire; Prometheus wants a number. Unknown
+# states map to the unhealthy value so a future state is never read as fine.
+HEALTH_STATE_VALUES = {"healthy": 0, "degraded": 1, "unhealthy": 2}
 
 
 class MetricsAggregator:
@@ -109,6 +117,20 @@ class MetricsAggregator:
                 lines.append(
                     f'{full}{{namespace="{ns_esc}",worker="{w_esc}"}} {value}'
                 )
+        full = f"{self.prefix}_health_state"
+        lines.append(
+            f"# HELP {full} Worker health state "
+            f"(0=healthy, 1=degraded, 2=unhealthy)"
+        )
+        lines.append(f"# TYPE {full} gauge")
+        for worker_id, m in sorted(live.items()):
+            value = HEALTH_STATE_VALUES.get(
+                getattr(m, "health_state", "healthy"), 2
+            )
+            lines.append(
+                f'{full}{{namespace="{_escape_label(self.namespace)}",'
+                f'worker="{_escape_label(str(worker_id))}"}} {value}'
+            )
         for name, idx, help_text in (
             ("router_isl_blocks_total", 0, "Prompt blocks seen by the KV router"),
             ("router_hit_blocks_total", 1, "Prompt blocks served from prefix cache"),

@@ -44,6 +44,11 @@ async def run_mock_worker(
             rpc_queue_depth=active + waiting,
             shed_requests=0,
             draining=0,
+            # health plane columns (deterministically healthy: the mock
+            # exists so dashboards render the fields, not to flap)
+            health_state="healthy",
+            stalls_total=0,
+            reaped_requests_total=0,
         )
         await ns.publish(
             KV_METRICS_SUBJECT, {"worker_id": wid, "metrics": m.to_dict()}

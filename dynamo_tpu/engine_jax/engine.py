@@ -69,6 +69,7 @@ from dynamo_tpu.models.llama import (
 )
 from dynamo_tpu.runtime.annotated import Annotated
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.health import EngineHeartbeat
 
 logger = logging.getLogger(__name__)
 
@@ -428,6 +429,12 @@ class JaxServingEngine(AsyncEngine):
         self.total_generated_tokens = 0
         self.total_prompt_tokens = 0
         self.preemptions = 0
+
+        # health plane: the step loop beats this once per iteration; a busy
+        # engine whose beats stop is a wedged engine thread (device hang,
+        # deadlocked posted callback) — runtime/health.py HealthMonitor
+        # turns that into an `unhealthy` self-drain
+        self.heartbeat = EngineHeartbeat()
 
         # (with_logprobs, with_penalties, with_sampling) variants, compiled
         # lazily per need
@@ -1027,6 +1034,10 @@ class JaxServingEngine(AsyncEngine):
                             # wake periodically to sweep remote-prefill timeouts
                             self._cond.wait(timeout=1.0)
                             break
+                        # parking idle: record it, or the last busy beat
+                        # would age into a false stall (health.py reads
+                        # busy-at-last-beat, and an idle park beats no more)
+                        self.heartbeat.beat(busy=False)
                         self._cond.wait()
                     if self._shutdown:
                         # drain posted callbacks before exiting: callers of
@@ -1035,6 +1046,21 @@ class JaxServingEngine(AsyncEngine):
                         # awaiting task forever on a close() race
                         self._run_posted()
                         return
+                # liveness beat BEFORE the work: if the dispatch below (or a
+                # posted callback / spill harvest) wedges, the recorded busy
+                # flag plus a growing beat age is exactly the stall
+                # signature the health monitor detects. Every wake source of
+                # the idle-wait predicate above counts as busy — a wedge in
+                # a posted callback on an otherwise-idle engine must not
+                # masquerade as an idle park.
+                self.heartbeat.beat(busy=bool(
+                    self._pending
+                    or self._posted
+                    or self._inflight is not None
+                    or any(s is not None for s in self._slots)
+                    or self._awaiting
+                    or self._pending_spills
+                ))
                 self._run_posted()
                 self._sweep_remote_timeouts()
                 idle = (

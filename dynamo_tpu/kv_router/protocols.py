@@ -165,6 +165,12 @@ class ForwardPassMetrics:
     rpc_queue_depth: int = 0
     shed_requests: int = 0
     draining: int = 0
+    # health plane (runtime/health.py): self-checked state plus cumulative
+    # engine-stall and reaped-stuck-request counters; schedulers skip
+    # "unhealthy" workers like draining ones
+    health_state: str = "healthy"
+    stalls_total: int = 0
+    reaped_requests_total: int = 0
 
     def to_dict(self) -> dict:
         from dataclasses import asdict

@@ -51,9 +51,11 @@ class DefaultWorkerSelector:
         best: list[Tuple[str, float, int]] = []
         best_logit = float("-inf")
         for wid, m in workers.items():
-            if m.draining:
+            if m.draining or m.health_state == "unhealthy":
                 # drain contract: no new work, however good the KV overlap —
-                # in-flight streams finish and the worker restarts clean
+                # in-flight streams finish and the worker restarts clean.
+                # Unhealthy workers (health plane) are skipped the same way:
+                # a wedged engine's warm prefix cache is worthless.
                 continue
             overlap = overlaps.get(wid, 0)
             slots_norm = (

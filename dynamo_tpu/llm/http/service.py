@@ -84,6 +84,16 @@ class ModelManager:
     def model_names(self) -> list[str]:
         return sorted(set(self._chat) | set(self._completions))
 
+    def engines_by_model(self) -> dict[str, list[AsyncEngine]]:
+        """name → engines serving it across endpoint kinds (health rollup)."""
+        out: dict[str, list[AsyncEngine]] = {}
+        for table in (self._chat, self._completions):
+            for name, engine in table.items():
+                engines = out.setdefault(name, [])
+                if engine not in engines:
+                    engines.append(engine)
+        return out
+
 
 class HttpService:
     def __init__(
@@ -144,9 +154,48 @@ class HttpService:
     # -- handlers ----------------------------------------------------------
 
     async def _health(self, _request: web.Request) -> web.Response:
-        return web.json_response({"status": "healthy", "models": self.manager.model_names()})
+        """Real readiness, not a hardcoded string: per-model status derived
+        from discovery + instance health. A served model with ZERO
+        non-draining healthy instances makes the whole edge ``unhealthy``
+        (503) so load balancers stop sending it traffic; impaired-but-
+        serving models report ``degraded``. In-process engines (no
+        discovery) count as healthy — process liveness is ``GET /live``."""
+        overall = "healthy"
+        models: dict = {}
+        for name, engines in self.manager.engines_by_model().items():
+            entry: dict = {"status": "healthy"}
+            for engine in engines:
+                summary_fn = getattr(engine, "health_summary", None)
+                if summary_fn is None:
+                    continue  # in-process engine: no instance plane
+                summary = summary_fn()
+                # SUM across a model's engines (chat vs completions may be
+                # distinct clients) — a later summary must not clobber the
+                # counts that justified an earlier engine's verdict
+                for k in ("instances", "serving", "draining", "unhealthy"):
+                    entry[k] = entry.get(k, 0) + int(summary.get(k, 0))
+                if summary.get("serving", 0) == 0:
+                    # ANY engine with zero serving instances means some
+                    # endpoint kind of this model is dead
+                    entry["status"] = "unhealthy"
+                elif (
+                    summary.get("unhealthy", 0) or summary.get("draining", 0)
+                ) and entry["status"] == "healthy":
+                    entry["status"] = "degraded"
+            models[name] = entry
+            if entry["status"] == "unhealthy":
+                overall = "unhealthy"
+            elif entry["status"] == "degraded" and overall == "healthy":
+                overall = "degraded"
+        return web.json_response(
+            {"status": overall, "models": models},
+            status=503 if overall == "unhealthy" else 200,
+        )
 
     async def _live(self, _request: web.Request) -> web.Response:
+        """Pure process liveness (the container restart signal) — never
+        coupled to upstream health, or a dead worker fleet would make the
+        orchestrator restart a perfectly good frontend."""
         return web.json_response({"live": True})
 
     async def _metrics(self, _request: web.Request) -> web.Response:

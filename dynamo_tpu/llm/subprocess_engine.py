@@ -15,6 +15,13 @@ asyncio: a fork/exec child speaking the framed two-part codec
 - **crash isolation**: an EOF on the pair fails every in-flight request with
   a clean error item; with ``restart_on_crash`` the child respawns with
   backoff and NEW requests proceed (in-flight ones are failed, not replayed).
+- **crash-loop protection**: the restart backoff is capped and, crucially,
+  NOT reset by a start that dies again within ``min_uptime`` — a child that
+  crashes right after its ready handshake escalates the delay instead of
+  hot-looping. After ``max_fast_crashes`` consecutive fast crashes the host
+  stops respawning, fails pending requests, and reports itself
+  ``unhealthy`` through the health plane (``health_state`` is swept by
+  runtime/health.py's HealthMonitor, which self-drains the worker).
 """
 
 from __future__ import annotations
@@ -94,12 +101,20 @@ class SubprocessEngine(AsyncEngine):
         restart_on_crash: bool = True,
         ready_timeout: float = 60.0,
         restart_backoff: float = 0.5,
+        max_restart_backoff: float = 10.0,
+        min_uptime: float = 5.0,
+        max_fast_crashes: int = 5,
         env: Optional[Dict[str, str]] = None,
     ):
         self.user_path = user_path
         self.restart_on_crash = restart_on_crash
         self.ready_timeout = ready_timeout
         self.restart_backoff = restart_backoff
+        self.max_restart_backoff = max_restart_backoff
+        # a child that survives less than this after its ready handshake is
+        # a *fast crash*: the backoff keeps escalating instead of resetting
+        self.min_uptime = min_uptime
+        self.max_fast_crashes = max(1, max_fast_crashes)
         # extra environment for the child (merged over the parent's): how a
         # host passes engine config (model paths, device selection) without
         # polluting its own process env — the reference passes env to its
@@ -116,6 +131,17 @@ class SubprocessEngine(AsyncEngine):
         self._ready = asyncio.Event()
         self._restart_task: Optional[asyncio.Task] = None
         self._start_lock: Optional[asyncio.Lock] = None
+        # crash-loop state (see module docstring): escalating delay that
+        # only resets after a child survives min_uptime, plus the
+        # consecutive-fast-crash counter behind the give-up circuit
+        self._restart_delay = restart_backoff
+        self._fast_crashes = 0
+        self._ready_at: Optional[float] = None
+        self._gave_up = False
+        # health-plane self-report, swept by HealthMonitor.check(): flips to
+        # "unhealthy" when the crash loop gives up, which self-drains the
+        # worker instead of hot-looping a doomed child forever
+        self.health_state = "healthy"
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -158,6 +184,7 @@ class SubprocessEngine(AsyncEngine):
                 f"{header.get('error', 'unknown')}"
             )
         self._ready.set()
+        self._ready_at = asyncio.get_running_loop().time()
         self._tasks.append(asyncio.create_task(self._read_loop()))
         logger.info(
             "user engine %s running in subprocess pid=%d",
@@ -232,26 +259,85 @@ class SubprocessEngine(AsyncEngine):
                 ("error", f"engine subprocess died (exit={exit_code})")
             )
         self._ready.clear()
-        if not self._closing and self.restart_on_crash:
-            logger.warning(
-                "user engine subprocess died (exit=%s); restarting", exit_code
+        if self._closing or not self.restart_on_crash:
+            return
+        # crash-loop accounting: a child that died within min_uptime of
+        # ready is a fast crash — escalate, don't reset, the backoff
+        uptime = None
+        if self._ready_at is not None:
+            uptime = asyncio.get_running_loop().time() - self._ready_at
+        if uptime is not None and uptime >= self.min_uptime:
+            self._fast_crashes = 0
+            self._restart_delay = self.restart_backoff
+        else:
+            self._fast_crashes += 1
+        if self._fast_crashes >= self.max_fast_crashes:
+            # give up: respawning a child that dies in under min_uptime
+            # max_fast_crashes times in a row only burns CPU and masks the
+            # failure. Mark unhealthy — the health plane self-drains the
+            # worker — and fail requests fast instead of hot-looping.
+            self._gave_up = True
+            self.health_state = "unhealthy"
+            # wake requests parked in generate()'s ready wait — they
+            # re-check _gave_up and fail fast instead of burning the full
+            # ready_timeout against a child that will never come back
+            self._ready.set()
+            logger.error(
+                "user engine %s crash-looping (%d consecutive crashes "
+                "within %.1fs of ready): giving up, worker marked unhealthy",
+                self.user_path, self._fast_crashes, self.min_uptime,
             )
-            self._restart_task = asyncio.create_task(self._restart())
+            return
+        logger.warning(
+            "user engine subprocess died (exit=%s, uptime=%s); restarting "
+            "in %.1fs (fast crashes: %d/%d)",
+            exit_code,
+            f"{uptime:.1f}s" if uptime is not None else "?",
+            self._restart_delay, self._fast_crashes, self.max_fast_crashes,
+        )
+        self._restart_task = asyncio.create_task(self._restart())
 
     async def _restart(self) -> None:
-        delay = self.restart_backoff
         while not self._closing:
+            delay = self._restart_delay
+            # capped escalation, persisted across crash-loop cycles (the
+            # old code reset to the base on every successful start, so a
+            # child crashing right after ready hot-looped at the base delay)
+            self._restart_delay = min(
+                self._restart_delay * 2, self.max_restart_backoff
+            )
             await asyncio.sleep(delay)
             try:
                 await self.start()
                 return
             except (RuntimeError, OSError) as e:
                 logger.error("user engine restart failed: %s", e)
-                delay = min(delay * 2, 10.0)
+                self._fast_crashes += 1
+                if self._fast_crashes >= self.max_fast_crashes:
+                    self._gave_up = True
+                    self.health_state = "unhealthy"
+                    self._ready.set()  # wake parked requests to fail fast
+                    logger.error(
+                        "user engine %s failed %d consecutive (re)starts: "
+                        "giving up, worker marked unhealthy",
+                        self.user_path, self._fast_crashes,
+                    )
+                    return
 
     # -- AsyncEngine ---------------------------------------------------------
 
+    def _gave_up_error(self) -> Annotated:
+        return Annotated.from_error(
+            f"engine subprocess {self.user_path!r} crash-looped and was "
+            f"shut down (worker unhealthy)"
+        )
+
     async def generate(self, request: Context) -> AsyncIterator[Annotated]:
+        if self._gave_up:
+            # crash loop gave up: fail fast with a terminal error instead of
+            # letting callers wait out ready_timeout against a dead child
+            yield self._gave_up_error()
+            return
         if self._start_lock is None:
             self._start_lock = asyncio.Lock()
         async with self._start_lock:
@@ -264,6 +350,11 @@ class SubprocessEngine(AsyncEngine):
             except asyncio.TimeoutError:
                 yield Annotated.from_error("engine subprocess unavailable")
                 return
+        if self._gave_up:
+            # the give-up fired while we were parked on the ready wait
+            # (it sets _ready to wake us): same fast terminal error
+            yield self._gave_up_error()
+            return
         rid = request.id
         kind, payload = _serialize_request(request.data)
         q: asyncio.Queue = asyncio.Queue()

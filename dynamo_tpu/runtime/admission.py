@@ -77,6 +77,9 @@ class LoadSnapshot:
     kv_free_blocks: int = 0
     kv_total_blocks: int = 0
     draining: bool = False
+    # health-plane state (runtime/health.py): "healthy" | "degraded" |
+    # "unhealthy"; routers skip unhealthy instances like draining ones
+    health: str = "healthy"
 
     def utilization(self) -> float:
         """Scalar load score for least-loaded routing (lower = freer).
@@ -104,6 +107,8 @@ class LoadSnapshot:
             out["kt"] = self.kv_total_blocks
         if self.draining:
             out["d"] = 1
+        if self.health != "healthy":
+            out["h"] = self.health
         return out
 
     @classmethod
@@ -116,6 +121,7 @@ class LoadSnapshot:
                 kv_free_blocks=int(d.get("kf", 0)),
                 kv_total_blocks=int(d.get("kt", 0)),
                 draining=bool(d.get("d", 0)),
+                health=str(d.get("h", "healthy")),
             )
         except (TypeError, ValueError):
             return cls()

@@ -32,6 +32,7 @@ from dynamo_tpu.runtime.admission import LoadSnapshot, OverloadedError
 from dynamo_tpu.runtime.annotated import Annotated
 from dynamo_tpu.runtime.bus import MessageBusClient
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.health import UNHEALTHY, HealthMonitor, HealthPolicy
 from dynamo_tpu.runtime.resilience import (
     DEADLINE_ERROR,
     AllInstancesFailed,
@@ -121,6 +122,12 @@ class InstanceInfo:
     # entries written by older workers still parse.
     draining: bool = False
     load: Optional[dict] = None  # LoadSnapshot wire form
+    # health plane (runtime/health.py): self-checked state, wall-clock time
+    # of the last heartbeat re-put, and the monitor's stall/reap counters —
+    # `llmctl worker health` reads exactly these keys
+    health: str = "healthy"
+    ts: float = 0.0
+    health_counters: Optional[dict] = None
 
     def to_json(self) -> bytes:
         return json.dumps(self.__dict__).encode()
@@ -132,6 +139,12 @@ class InstanceInfo:
             **{k: d[k] for k in ("instance_id", "address", "worker_id")},
             draining=bool(d.get("draining", False)),
             load=d.get("load") if isinstance(d.get("load"), dict) else None,
+            health=str(d.get("health", "healthy")),
+            ts=float(d.get("ts") or 0.0),
+            health_counters=(
+                d.get("health_counters")
+                if isinstance(d.get("health_counters"), dict) else None
+            ),
         )
 
 
@@ -149,6 +162,7 @@ class DistributedRuntime:
         self.advertise_host = advertise_host
         self._store_url: str = ""
         self._rpc_server: Optional[RpcServer] = None
+        self._health_monitor = None  # runtime/health.py, created with the server
         self._primary_lease: Optional[Lease] = None
         self._closed = asyncio.Event()
         self._background: list = []
@@ -206,7 +220,19 @@ class DistributedRuntime:
         if self._rpc_server is None:
             self._rpc_server = RpcServer(host="0.0.0.0", port=0)
             await self._rpc_server.start()
+            # the health plane rides the server: self-checks (engine
+            # heartbeat, loop lag), the stuck-request reaper, and the
+            # unhealthy→self-drain→recover cycle (drain source "health")
+            self._health_monitor = HealthMonitor(
+                server=self._rpc_server, set_draining=self.set_draining
+            )
+            self._rpc_server.health = self._health_monitor
+            self._health_monitor.start()
         return self._rpc_server
+
+    @property
+    def health_monitor(self):
+        return self._health_monitor
 
     @property
     def draining(self) -> bool:
@@ -247,6 +273,8 @@ class DistributedRuntime:
     async def shutdown(self) -> None:
         for t in self._background:
             t.cancel()
+        if self._health_monitor is not None:
+            await self._health_monitor.stop()
         if self._primary_lease is not None:
             await self._primary_lease.revoke()
         if self._rpc_server is not None:
@@ -396,6 +424,13 @@ class Endpoint:
                 snap = server.load_snapshot()
                 info.draining = snap.draining
                 info.load = snap.to_wire()
+                # health state + counters ride the same heartbeat key:
+                # `llmctl worker health` and routers read them with zero
+                # extra plane
+                info.health = snap.health
+                info.ts = time.time()
+                if rt._health_monitor is not None:
+                    info.health_counters = rt._health_monitor.counters()
                 key = self.instances_prefix + info.instance_id
                 payload = info.to_json()
                 # keep the leased-key set fresh so re-registration after
@@ -541,6 +576,7 @@ class EndpointClient(AsyncEngine):
         kv_block_size: int = 16,
         route_token_fn: Optional[Callable[[dict], Optional[List[int]]]] = None,
         policy: Optional[ResiliencePolicy] = None,
+        health_policy=None,
     ):
         self.endpoint = endpoint
         self.mode = mode
@@ -549,6 +585,7 @@ class EndpointClient(AsyncEngine):
         # (e.g. raw OpenAI dicts at a frontend) so prefix routing still works
         self.route_token_fn = route_token_fn
         self.policy = policy or ResiliencePolicy()
+        self.health_policy = health_policy or HealthPolicy.from_env()
         self._breaker = CircuitBreaker(
             threshold=self.policy.breaker_threshold,
             cooldown=self.policy.breaker_cooldown,
@@ -557,8 +594,16 @@ class EndpointClient(AsyncEngine):
         self._retry_rng = self.policy.rng()
         # observability: how often the resilience layer actually worked
         self.stats = {"failures": 0, "failovers": 0, "deadline_expired": 0,
-                      "overloaded": 0}
+                      "overloaded": 0, "probes": 0, "probe_failures": 0}
         self._instances: Dict[str, InstanceInfo] = {}
+        # active liveness probing (runtime/health.py): when an instance's
+        # RPC plane goes silent for probe_idle, __ping__ it through the real
+        # dispatch path. Statestore heartbeats do NOT count as liveness —
+        # a zombie worker's asyncio loop keeps heartbeating while its serve
+        # path is wedged; only reply/pong traffic proves the path.
+        self._last_rpc_seen: Dict[str, float] = {}
+        self._probe_failed: Dict[str, float] = {}  # iid → monotonic of failure
+        self._probe_task: Optional[asyncio.Task] = None
         # per-instance load view: fed by reply piggybacks (freshest) and
         # instance-key heartbeats (watch events); drives `load` mode picks,
         # draining avoidance, and overload soft-ejects
@@ -588,6 +633,7 @@ class EndpointClient(AsyncEngine):
         rt = self.endpoint.component.namespace.runtime
         self._watcher = await rt.store.watch_prefix(self.endpoint.instances_prefix)
         self._watch_task = asyncio.create_task(self._watch_loop())
+        self._probe_task = asyncio.create_task(self._probe_loop())
         if self.mode == "kv":
             from dynamo_tpu.kv_router.router import KvRouter
 
@@ -623,6 +669,8 @@ class EndpointClient(AsyncEngine):
                     gone = self._instances.pop(iid, None)
                     self._loads.pop(iid, None)
                     self._avoid_until.pop(iid, None)
+                    self._last_rpc_seen.pop(iid, None)
+                    self._probe_failed.pop(iid, None)
                     self._breaker.forget(iid)
                     conn = self._conns.pop(iid, None)
                     if conn is not None:
@@ -666,6 +714,8 @@ class EndpointClient(AsyncEngine):
                     self._instances.clear()
                     self._loads.clear()
                     self._avoid_until.clear()
+                    self._last_rpc_seen.clear()
+                    self._probe_failed.clear()
                     if self._router is not None:
                         for wid in self._by_worker:
                             self._router.remove_worker(wid)
@@ -730,8 +780,12 @@ class EndpointClient(AsyncEngine):
         return sorted(self._instances)
 
     def _note_load(self, iid: str, wire: dict) -> None:
-        """Adopt a load snapshot piggybacked on an RPC reply header."""
+        """Adopt a load snapshot piggybacked on an RPC reply header. A reply
+        is also proof of RPC-plane liveness: it refreshes the probe clock
+        and clears a stale probe failure."""
         self._loads[iid] = LoadSnapshot.from_wire(wire)
+        self._last_rpc_seen[iid] = time.monotonic()
+        self._probe_failed.pop(iid, None)
 
     def _is_draining(self, iid: str) -> bool:
         info = self._instances.get(iid)
@@ -739,6 +793,16 @@ class EndpointClient(AsyncEngine):
             return True
         snap = self._loads.get(iid)
         return snap is not None and snap.draining
+
+    def _is_unhealthy(self, iid: str) -> bool:
+        """Worker-self-reported unhealthy (instance-key heartbeat or reply
+        piggyback). Unhealthy workers also self-drain, but the piggyback can
+        land a heartbeat interval earlier — honor whichever arrives first."""
+        info = self._instances.get(iid)
+        if info is not None and info.health == UNHEALTHY:
+            return True
+        snap = self._loads.get(iid)
+        return snap is not None and snap.health == UNHEALTHY
 
     def _load_score(self, iid: str) -> float:
         snap = self._loads.get(iid)
@@ -760,17 +824,29 @@ class EndpointClient(AsyncEngine):
                 f"all {len(ids)} live instance(s) of {self.endpoint.path} "
                 f"failed this request"
             )
-        # drain-aware, strictly: a draining instance gets NO new work (its
-        # in-flight streams finish; that is the whole zero-downtime-restart
-        # contract). If every live instance is draining there is nothing
-        # legal to pick.
-        serving = [i for i in candidates if not self._is_draining(i)]
+        # drain/health-aware, strictly: a draining or self-reported
+        # unhealthy instance gets NO new work (its in-flight streams
+        # finish; that is the whole zero-downtime-restart contract, and an
+        # unhealthy worker is proactively routed around before requests pay
+        # for the discovery). If every live instance is out there is
+        # nothing legal to pick.
+        serving = [
+            i for i in candidates
+            if not self._is_draining(i) and not self._is_unhealthy(i)
+        ]
         if not serving:
             raise NoHealthyInstances(
                 f"all {len(candidates)} live instance(s) of "
-                f"{self.endpoint.path} are draining"
+                f"{self.endpoint.path} are draining or unhealthy"
             )
         candidates = serving
+        # probe-aware: skip instances whose last liveness probe failed
+        # (zombie suspects), but — unlike the drain filter — fall back to
+        # them when nothing else is left: a suspect beats a guaranteed
+        # failure, and probes keep running to re-admit it
+        responsive = [i for i in candidates if i not in self._probe_failed]
+        if responsive:
+            candidates = responsive
         # breaker-aware: skip open/exhausted instances, but if EVERY
         # candidate is ejected, fall back to the full candidate set — a
         # last-ditch try beats a guaranteed failure
@@ -819,6 +895,119 @@ class EndpointClient(AsyncEngine):
         # round_robin fallback
         self._rr = (self._rr + 1) % len(candidates)
         return candidates[self._rr]
+
+    async def _probe_loop(self) -> None:
+        """Actively ``__ping__`` instances whose RPC plane has been silent
+        for ``health_policy.probe_idle`` seconds. A failed or timed-out
+        probe marks the instance a zombie suspect (skipped by ``_pick``)
+        and feeds the circuit breaker; probing continues so a recovered
+        worker is re-admitted by its next successful pong."""
+        idle = self.health_policy.probe_idle
+        interval = min(max(idle / 2.0, 0.05), idle)
+        while True:
+            await asyncio.sleep(interval)
+            now = time.monotonic()
+            due = []
+            for iid, info in list(self._instances.items()):
+                if not info.ts and info.health_counters is None:
+                    # pre-health-plane worker (no heartbeat stamp yet, or an
+                    # old binary that drops unknown ops): probing it would
+                    # time out forever and breaker-eject a healthy worker
+                    continue
+                last = self._last_rpc_seen.get(iid)
+                if last is None:
+                    # first sight: start the idle clock, don't probe yet
+                    self._last_rpc_seen[iid] = now
+                    continue
+                if now - last >= idle:
+                    due.append(iid)
+            if not due:
+                continue
+
+            async def _safe(iid: str) -> None:
+                try:
+                    await self._probe_one(iid)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    logger.debug("probe of %s failed unexpectedly", iid,
+                                 exc_info=True)
+
+            # concurrent: one wedged instance must not stall the sweep for a
+            # full probe_timeout and delay every other detection/readmission
+            await asyncio.gather(*[_safe(i) for i in due])
+
+    async def _probe_one(self, iid: str) -> None:
+        self.stats["probes"] += 1
+        timeout = self.health_policy.probe_timeout
+        # sampled BEFORE the await: the pong's own load piggyback clears the
+        # suspect mark via _note_load while ping() is still in flight, so
+        # checking afterwards would make probe-driven readmission dead code
+        was_suspect = iid in self._probe_failed
+        conn: Optional[RpcClient] = None
+        try:
+            conn = await self._conn(iid, timeout=timeout)
+            pong = await conn.ping(timeout=timeout)
+        except asyncio.CancelledError:
+            raise
+        except WorkerStalled:
+            # socket alive, serve path wedged: THE zombie signature. Mark
+            # the suspect and penalize the breaker — but keep the pooled
+            # connection: in-flight streams on it may still be draining,
+            # and closing it would error every one of them.
+            self.stats["probe_failures"] += 1
+            self._probe_failed[iid] = time.monotonic()
+            self._breaker.record_failure(iid)
+            return
+        except KeyError:
+            # the instance left the live set mid-probe: nothing to mark —
+            # a suspect entry for a gone instance would linger forever
+            self._probe_failed.pop(iid, None)
+            return
+        except (ConnectionError, OSError):
+            # dead transport: drop the pooled conn so the next attempt
+            # re-dials
+            self.stats["probe_failures"] += 1
+            self._probe_failed[iid] = time.monotonic()
+            self._breaker.record_failure(iid)
+            await self._evict_conn(iid, conn or self._conns.get(iid))
+            return
+        self._last_rpc_seen[iid] = time.monotonic()
+        if pong.get("health") == UNHEALTHY:
+            # the worker answered (liveness proven — no breaker penalty)
+            # but diagnosed itself unhealthy: keep it out of rotation
+            self.stats["probe_failures"] += 1
+            self._probe_failed[iid] = time.monotonic()
+            return
+        self._probe_failed.pop(iid, None)
+        if was_suspect:
+            # probe-driven recovery readmits the instance (clears the
+            # probe-induced breaker failures); routine pongs deliberately
+            # do NOT record_success (a worker failing real requests while
+            # answering pings must still trip the breaker)
+            self._breaker.record_success(iid)
+
+    def health_summary(self) -> dict:
+        """Instance-health rollup for the HTTP ``/health`` edge: how many
+        live instances exist and how many are actually serving (not
+        draining, not unhealthy, not a zombie suspect)."""
+        ids = list(self._instances)
+        draining = sum(1 for i in ids if self._is_draining(i))
+        unhealthy = sum(
+            1 for i in ids
+            if self._is_unhealthy(i) or i in self._probe_failed
+        )
+        serving = sum(
+            1 for i in ids
+            if not self._is_draining(i) and not self._is_unhealthy(i)
+            and i not in self._probe_failed
+        )
+        return {
+            "instances": len(ids),
+            "serving": serving,
+            "draining": draining,
+            "unhealthy": unhealthy,
+        }
 
     async def _conn(self, iid: str, timeout: Optional[float] = None) -> RpcClient:
         conn = self._conns.get(iid)
@@ -1002,6 +1191,8 @@ class EndpointClient(AsyncEngine):
         self._closed = True
         if self._watch_task:
             self._watch_task.cancel()
+        if self._probe_task:
+            self._probe_task.cancel()
         if self._kv_task:
             self._kv_task.cancel()
         if self._watcher:
@@ -1095,6 +1286,15 @@ async def attach_kv_publishing(
                     snap["rpc_queue_depth"] = server.inflight_count
                     snap["shed_requests"] = server.admission.shed
                     snap["draining"] = int(server.draining)
+                    # health plane: state + stall/reap counters, so the KV
+                    # scheduler and dashboards see zombies without a new
+                    # subscription
+                    snap["health_state"] = server.health_state()
+                    if server.health is not None:
+                        snap["stalls_total"] = server.health.stalls_total
+                        snap["reaped_requests_total"] = (
+                            server.health.reaped_requests_total
+                        )
                 await ns.publish(
                     KV_METRICS_SUBJECT, {"worker_id": worker_id, "metrics": snap}
                 )

@@ -45,8 +45,8 @@ from typing import Dict, Iterator, List, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 PLANES = ("statestore", "bus", "rpc", "transfer")
-ACTIONS = ("refuse", "delay", "reset", "stall")
-POINTS = ("connect", "read", "write")
+ACTIONS = ("refuse", "delay", "reset", "stall", "wedge")
+POINTS = ("connect", "read", "write", "serve")
 
 
 @dataclass
@@ -56,9 +56,12 @@ class FaultRule:
     ``plane``       which transport ("statestore" | "bus" | "rpc" |
                     "transfer" | "*").
     ``point``       where it fires: "connect" (per dial), "read"/"write"
-                    (per frame on an established connection).
-    ``action``      refuse | delay | reset | stall (refuse only makes sense
-                    at connect; reset/delay/stall anywhere).
+                    (per frame on an established connection), "serve"
+                    (server-side dispatch gate, once per request/probe —
+                    see :func:`serve_gate`).
+    ``action``      refuse | delay | reset | stall | wedge (refuse only
+                    makes sense at connect; wedge only at serve;
+                    reset/delay/stall anywhere).
     ``match_addr``  exact "host:port" (None = any address).
     ``after_ops``   skip the first N matching ops (per plane+addr counter
                     for connects, per connection for reads/writes).
@@ -122,7 +125,9 @@ class FaultInjector:
         self.rng = random.Random(seed)
         self.log: List[FaultDecision] = []
         self._connect_ops: Dict[Tuple[str, str], int] = {}
+        self._serve_ops: Dict[Tuple[str, str], int] = {}
         self._stall_release = asyncio.Event()
+        self._wedge_release = asyncio.Event()
 
     def add_rule(self, rule: FaultRule) -> FaultRule:
         self.rules.append(rule)
@@ -135,12 +140,21 @@ class FaultInjector:
     def clear_rules(self) -> None:
         self.rules.clear()
         self.release_stalls()
+        self.release_wedges()
 
     def release_stalls(self) -> None:
         """Wake every stalled op; each then raises ConnectionResetError
         (a wedged connection that finally dies, not one that recovers)."""
         self._stall_release.set()
         self._stall_release = asyncio.Event()
+
+    def release_wedges(self) -> None:
+        """Wake every wedged serve gate; each request then PROCEEDS (an
+        engine that un-sticks, unlike a stall's final death) — the
+        self-healing half of a zombie-worker scenario. A wedge rule still
+        installed re-wedges subsequent requests."""
+        self._wedge_release.set()
+        self._wedge_release = asyncio.Event()
 
     # -- decision core -----------------------------------------------------
 
@@ -166,6 +180,12 @@ class FaultInjector:
             release = self._stall_release
             await release.wait()
             raise ConnectionResetError(f"injected stall released ({what})")
+        if rule.action == "wedge":
+            # zombie worker: the request parks here forever (connection
+            # accepted, stream silent). On release it proceeds normally.
+            release = self._wedge_release
+            await release.wait()
+            return
         if rule.action == "refuse":
             raise ConnectionRefusedError(f"injected refusal ({what})")
         raise ValueError(f"unknown fault action {rule.action!r}")
@@ -179,6 +199,14 @@ class FaultInjector:
         rule = self.decide(plane, addr, "connect", op)
         if rule is not None:
             await self._apply(rule, f"connect {plane} {addr}")
+
+    async def before_serve(self, plane: str, addr: str) -> None:
+        key = (plane, addr)
+        op = self._serve_ops.get(key, 0)
+        self._serve_ops[key] = op + 1
+        rule = self.decide(plane, addr, "serve", op)
+        if rule is not None:
+            await self._apply(rule, f"serve {plane} {addr}")
 
 
 class _ConnFaults:
@@ -306,6 +334,7 @@ def uninstall() -> None:
     global _active
     if _active is not None:
         _active.release_stalls()
+        _active.release_wedges()
     _active = None
 
 
@@ -345,6 +374,21 @@ def injector_from_spec(spec: str, seed: int = 0) -> FaultInjector:
     if not isinstance(raw, list):
         raise ValueError("DYN_TPU_FAULTS must be a JSON list of rule objects")
     return FaultInjector([FaultRule.from_dict(d) for d in raw], seed=seed)
+
+
+async def serve_gate(plane: str, addr: str) -> None:
+    """Server-side dispatch gate, consulted once per request/probe before
+    the engine sees it (runtime/rpc.py ``_serve_request`` and ``__ping__``).
+
+    ``addr`` is the serving side's own listen address, so a ``serve`` rule
+    with ``match_addr`` targets one worker in a cluster. The ``wedge``
+    action makes that worker a deterministic zombie: connections accepted,
+    requests and pings parked forever — the health plane (probe timeouts,
+    stall detection) must route around it. No injector ⇒ one None-check.
+    """
+    inj = current()
+    if inj is not None:
+        await inj.before_serve(plane, addr)
 
 
 async def open_connection(host: str, port: int, plane: str = "rpc"):

@@ -1,0 +1,297 @@
+"""Worker health plane: proactive liveness, stall detection, stuck-request
+reaping, and self-healing.
+
+PR 2/3 made the request path *react* well to failure (failover, breakers,
+overload sheds, drain mode) — but every one of those mechanisms fires only
+after a user request has already paid for the discovery. A **zombie worker**
+(registered in the statestore, accepting TCP, engine thread wedged) keeps
+attracting traffic until each routed request burns its full deadline. This
+module is the *proactive* half of fault tolerance:
+
+- :class:`HealthPolicy` — the knob bundle, env-tunable via ``DYN_TPU_HEALTH_*``
+  with the same clamping contract as the admission parsers (malformed / zero /
+  negative → defaults).
+- :class:`EngineHeartbeat` — a monotonic progress counter the ``engine_jax``
+  step loop bumps every iteration. No beat while the engine is busy for
+  longer than ``stall_timeout`` ⇒ the engine thread is wedged.
+- :class:`HealthMonitor` — the per-worker self-check loop: engine-heartbeat
+  stall detection, an asyncio event-loop lag probe, sub-engine health
+  aggregation (e.g. a crash-looping subprocess engine), and the
+  **stuck-request reaper** (``RpcServer.reap_expired``) that aborts requests
+  past ``deadline + reap_grace``, returning their slots and KV blocks to the
+  engine and emitting a terminal error item. An ``unhealthy`` worker
+  self-drains through PR 3's drain machinery (source ``"health"``) and
+  re-admits itself after ``recovery_checks`` consecutive passing checks.
+
+Health states ride the existing planes: the load-report heartbeat re-puts
+the instance key with ``health`` (+ stall/reap counters), RPC replies
+piggyback it in the ``load`` snapshot, and ``EndpointClient`` actively
+probes silent instances with the ``__ping__`` RPC verb (runtime/rpc.py) —
+which round-trips through the real dispatch path, so a wedged worker times
+the probe out instead of answering from a healthy socket.
+
+States: ``healthy`` (full service) → ``degraded`` (observably impaired —
+event-loop lag — but still serving) → ``unhealthy`` (self-drained, routed
+around). See docs/health.md for the runbook.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import time
+import weakref
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from dynamo_tpu.runtime.admission import _env_pos_float, _env_pos_int
+
+logger = logging.getLogger(__name__)
+
+# Health states (plain strings: they cross the wire in load snapshots and
+# instance keys, and read well in logs/metrics).
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+UNHEALTHY = "unhealthy"
+STATES = (HEALTHY, DEGRADED, UNHEALTHY)
+
+# drain source the monitor uses with DistributedRuntime.set_draining — kept
+# distinct from "local" (SIGUSR1) and "store" (llmctl) so a self-heal never
+# cancels an operator's drain and vice versa
+DRAIN_SOURCE = "health"
+
+
+@dataclass
+class HealthPolicy:
+    """Per-worker health knobs (``HealthPolicy.from_env()``).
+
+    ``stall_timeout``       seconds the engine heartbeat may go silent while
+                            the engine is busy before the worker is stalled
+                            (``DYN_TPU_HEALTH_STALL_S``).
+    ``check_interval``      self-check cadence (``DYN_TPU_HEALTH_CHECK_INTERVAL``).
+    ``loop_lag_threshold``  event-loop lag above this marks the worker
+                            degraded (``DYN_TPU_HEALTH_LOOP_LAG_S``).
+    ``reap_grace``          how far past its deadline a stuck request may
+                            linger before the reaper aborts it
+                            (``DYN_TPU_HEALTH_REAP_GRACE_S``).
+    ``probe_idle``          clients ping an instance that produced no RPC
+                            traffic for this long (``DYN_TPU_HEALTH_PROBE_IDLE_S``).
+    ``probe_timeout``       per-ping bound (``DYN_TPU_HEALTH_PROBE_TIMEOUT_S``).
+    ``recovery_checks``     consecutive passing checks before an unhealthy
+                            worker re-admits itself
+                            (``DYN_TPU_HEALTH_RECOVERY_CHECKS``).
+    """
+
+    stall_timeout: float = 10.0
+    check_interval: float = 1.0
+    loop_lag_threshold: float = 1.0
+    reap_grace: float = 5.0
+    probe_idle: float = 10.0
+    probe_timeout: float = 2.0
+    recovery_checks: int = 3
+
+    @classmethod
+    def from_env(cls, prefix: str = "DYN_TPU_HEALTH_") -> "HealthPolicy":
+        d = cls()
+        return cls(
+            stall_timeout=_env_pos_float(prefix + "STALL_S", d.stall_timeout),
+            check_interval=_env_pos_float(
+                prefix + "CHECK_INTERVAL", d.check_interval
+            ),
+            loop_lag_threshold=_env_pos_float(
+                prefix + "LOOP_LAG_S", d.loop_lag_threshold
+            ),
+            reap_grace=_env_pos_float(prefix + "REAP_GRACE_S", d.reap_grace),
+            probe_idle=_env_pos_float(prefix + "PROBE_IDLE_S", d.probe_idle),
+            probe_timeout=_env_pos_float(
+                prefix + "PROBE_TIMEOUT_S", d.probe_timeout
+            ),
+            recovery_checks=_env_pos_int(
+                prefix + "RECOVERY_CHECKS", d.recovery_checks
+            ),
+        )
+
+
+class EngineHeartbeat:
+    """Monotonic progress signal bumped by the engine's step loop.
+
+    ``beat(busy=...)`` is called once per loop iteration from the engine
+    thread; the monitor reads ``age()``/``busy`` from the asyncio thread.
+    Single-word reads/writes only (GIL-atomic) — deliberately no lock, so a
+    wedged engine thread can never wedge the monitor through it. ``busy``
+    records whether the engine had work at the LAST beat: an idle engine
+    parks in its condition wait (no beats, busy False — not a stall); a
+    busy one that stops beating is exactly the zombie signature.
+    """
+
+    __slots__ = ("_last", "_busy", "beats")
+
+    def __init__(self) -> None:
+        self._last = time.monotonic()
+        self._busy = False
+        self.beats = 0
+
+    def beat(self, busy: bool) -> None:
+        self._busy = bool(busy)
+        self.beats += 1
+        # written last: a reader seeing the fresh timestamp sees fresh state
+        self._last = time.monotonic()
+
+    @property
+    def busy(self) -> bool:
+        return self._busy
+
+    def age(self) -> float:
+        return time.monotonic() - self._last
+
+
+# every constructed monitor, for the test-suite leak guard (conftest fails a
+# test that leaves a started monitor running past teardown)
+_MONITORS: "weakref.WeakSet[HealthMonitor]" = weakref.WeakSet()
+
+
+def live_monitors() -> list:
+    """Monitors whose check task is still running (leak-guard hook)."""
+    return [m for m in _MONITORS if m._task is not None and not m._task.done()]
+
+
+class HealthMonitor:
+    """Per-worker self-check loop + health state machine.
+
+    ``server`` is duck-typed (an :class:`~dynamo_tpu.runtime.rpc.RpcServer`):
+    it provides ``engines()`` for the heartbeat/sub-engine sweep and
+    ``reap_expired()`` for the stuck-request reaper. ``set_draining(flag,
+    source=...)`` is the runtime hook the unhealthy⇄healthy transitions
+    drive (PR 3 drain machinery; absent in bare-server tests).
+    """
+
+    def __init__(
+        self,
+        policy: Optional[HealthPolicy] = None,
+        server=None,
+        set_draining: Optional[Callable] = None,
+    ):
+        self.policy = policy or HealthPolicy.from_env()
+        self.server = server
+        self.set_draining = set_draining
+        self.state = HEALTHY
+        # counters published on the metrics plane + instance-key heartbeats
+        # (reaped_requests_total is a property over the server's counter —
+        # one source of truth, whoever drives reap_expired)
+        self.stalls_total = 0
+        self.checks_total = 0
+        self.loop_lag = 0.0
+        self.loop_lag_max = 0.0
+        self._stalled = False
+        self._healthy_streak = 0
+        self._task: Optional[asyncio.Task] = None
+        _MONITORS.add(self)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None
+
+    @property
+    def reaped_requests_total(self) -> int:
+        """The server's reap counter (single source of truth — tests and
+        manual sweeps call ``reap_expired`` too, and two counters would
+        silently diverge)."""
+        return getattr(self.server, "reaped_total", 0) or 0
+
+    def counters(self) -> dict:
+        return {
+            "stalls_total": self.stalls_total,
+            "reaped_requests_total": self.reaped_requests_total,
+        }
+
+    # -- check loop --------------------------------------------------------
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        interval = self.policy.check_interval
+        while True:
+            t0 = loop.time()
+            await asyncio.sleep(interval)
+            # the sleep doubles as the event-loop lag probe: oversleep means
+            # something (a blocking call, a starved loop) held the thread
+            lag = max(loop.time() - t0 - interval, 0.0)
+            try:
+                self.check(lag)
+                if self.server is not None:
+                    await self.server.reap_expired(self.policy.reap_grace)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # a broken check must degrade to "no health plane", never
+                # take the worker down with it
+                logger.exception("health check failed")
+
+    def check(self, lag: float = 0.0) -> str:
+        """Run one self-check pass (sync; the loop calls it, tests may too).
+        Returns the resulting state."""
+        self.checks_total += 1
+        self.loop_lag = lag
+        self.loop_lag_max = max(self.loop_lag_max, lag)
+        stalled = False
+        sub_unhealthy = False
+        engines = self.server.engines() if self.server is not None else ()
+        for eng in engines:
+            hb = getattr(eng, "heartbeat", None)
+            if (
+                hb is not None
+                and hb.busy
+                and hb.age() > self.policy.stall_timeout
+            ):
+                stalled = True
+            # sub-engine self-reports (e.g. a subprocess engine that gave up
+            # its crash-loop) bubble up to the worker state
+            if getattr(eng, "health_state", HEALTHY) == UNHEALTHY:
+                sub_unhealthy = True
+        if stalled and not self._stalled:
+            self.stalls_total += 1
+            logger.error(
+                "engine stall detected: busy with no step-loop progress for "
+                "> %.1fs", self.policy.stall_timeout,
+            )
+        self._stalled = stalled
+        if stalled or sub_unhealthy:
+            candidate = UNHEALTHY
+        elif lag > self.policy.loop_lag_threshold:
+            candidate = DEGRADED
+        else:
+            candidate = HEALTHY
+        self._transition(candidate)
+        return self.state
+
+    def _transition(self, new: str) -> None:
+        if self.state == UNHEALTHY and new != UNHEALTHY:
+            # hysteresis: one good check must not flap an unhealthy worker
+            # back into rotation — require a full recovery streak
+            self._healthy_streak += 1
+            if self._healthy_streak < self.policy.recovery_checks:
+                return
+        if new == UNHEALTHY:
+            self._healthy_streak = 0
+        if new == self.state:
+            return
+        old, self.state = self.state, new
+        log = logger.warning if new != HEALTHY else logger.info
+        log("worker health: %s -> %s", old, new)
+        if self.set_draining is not None:
+            if new == UNHEALTHY:
+                # self-drain: routers stop dispatching here, in-flight
+                # streams finish; the statestore registration stays (the
+                # worker is sick, not gone)
+                self.set_draining(True, source=DRAIN_SOURCE)
+            elif old == UNHEALTHY:
+                self.set_draining(False, source=DRAIN_SOURCE)

@@ -11,9 +11,17 @@ network hop on every token.
 Wire protocol (header JSON + body):
   client→worker: {id, op:"generate", endpoint, deadline_ms?} body=request JSON
                  {id, op:"stop"|"kill"}        (mid-stream cancellation)
+                 {id, op:"ping"}               (liveness probe, ``__ping__``)
   worker→client: {id, op:"item"}  body=one Annotated dict JSON
                  {id, op:"done"}
                  {id, op:"error", message, code?, retryable?}
+                 {id, op:"pong", health, load} (probe reply)
+
+``ping`` answers through the SAME dispatch gate ordinary requests pass
+(faults.serve_gate) and carries the worker's health-plane state — a zombie
+worker (socket alive, engine wedged) times the probe out instead of
+answering from a healthy accept loop, and a self-diagnosed ``unhealthy``
+worker says so. EndpointClient probes silent instances with it.
 
 ``deadline_ms`` is the request's *remaining* budget at send time (relative,
 not wall-clock — hosts don't share clocks); the worker sheds requests whose
@@ -137,6 +145,26 @@ class _StreamSender:
         self._task.cancel()
 
 
+class RequestTrack:
+    """One in-flight request's registry entry — the health plane's view.
+
+    Filled in as ``_serve_request`` progresses (deadline, engine context,
+    stream sender); the stuck-request reaper sweeps these to find requests
+    whose deadline expired without the stream ever terminating."""
+
+    __slots__ = ("req_id", "started", "deadline", "ctx", "sender", "task",
+                 "reaped")
+
+    def __init__(self, req_id):
+        self.req_id = req_id
+        self.started = time.monotonic()
+        self.deadline: Optional[Deadline] = None
+        self.ctx: Optional[Context] = None
+        self.sender = None
+        self.task: Optional[asyncio.Task] = None
+        self.reaped = False
+
+
 class RpcServer:
     """Serves registered engines over TCP; tracks in-flight requests and
     drains them on stop (reference PushEndpoint semantics)."""
@@ -148,9 +176,22 @@ class RpcServer:
         self._engines: Dict[str, AsyncEngine] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._inflight: set = set()
+        self._tracks: set = set()  # RequestTrack per in-flight request
         self._draining = False
         self.admission = admission or AdmissionController()
         self.send_queue_peak = 0  # high-water mark across all streams
+        # health plane (runtime/health.py): the monitor attaches itself here;
+        # its state rides every load snapshot and every pong
+        self.health = None
+        self.reaped_total = 0
+
+    def engines(self) -> list:
+        """Registered engines (the health monitor sweeps these for
+        heartbeats and sub-engine health self-reports)."""
+        return list(self._engines.values())
+
+    def health_state(self) -> str:
+        return self.health.state if self.health is not None else "healthy"
 
     def register(self, endpoint: str, engine: AsyncEngine) -> None:
         self._engines[endpoint] = engine
@@ -168,7 +209,9 @@ class RpcServer:
         self._draining = bool(flag)
 
     def load_snapshot(self) -> LoadSnapshot:
-        return self.admission.snapshot(len(self._inflight), draining=self._draining)
+        snap = self.admission.snapshot(len(self._inflight), draining=self._draining)
+        snap.health = self.health_state()
+        return snap
 
     async def start(self) -> None:
         from dynamo_tpu.runtime.netutil import TrackedServer
@@ -254,13 +297,29 @@ class RpcServer:
                                             "load": load.to_wire(),
                                             }).encode(), b""))
                         continue
+                    track = RequestTrack(h["id"])
                     task = asyncio.create_task(
-                        self._serve_request(h, frame.body, writer, write_lock, contexts)
+                        self._serve_request(h, frame.body, writer, write_lock,
+                                            contexts, track)
                     )
+                    track.task = task
                     self._inflight.add(task)
+                    self._tracks.add(track)
                     conn_tasks.add(task)
                     task.add_done_callback(self._inflight.discard)
                     task.add_done_callback(conn_tasks.discard)
+                    task.add_done_callback(
+                        lambda _t, tr=track: self._tracks.discard(tr)
+                    )
+                elif op == "ping":
+                    # liveness probe: answered by a task so a wedged serve
+                    # gate hangs the PONG (the probe's whole point), never
+                    # this connection's read loop
+                    t = asyncio.create_task(
+                        self._pong(h.get("id"), writer, write_lock)
+                    )
+                    conn_tasks.add(t)
+                    t.add_done_callback(conn_tasks.discard)
                 elif op in ("stop", "kill"):
                     ctx = contexts.get(h.get("id"))
                     if ctx is not None:
@@ -276,8 +335,72 @@ class RpcServer:
                 t.cancel()
             writer.close()
 
-    async def _serve_request(self, h, body, writer, write_lock, contexts) -> None:
+    async def _pong(self, req_id, writer, write_lock) -> None:
+        """Answer a ``ping`` THROUGH the serve gate (the path requests take),
+        carrying health state + load. A wedged worker never answers; the
+        prober's timeout is the detection."""
+        try:
+            await faults.serve_gate("rpc", f"{self.host}:{self.port}")
+            header = {
+                "id": req_id, "op": "pong",
+                "health": self.health_state(),
+                "load": self.load_snapshot().to_wire(),
+            }
+            async with write_lock:
+                await write_frame(
+                    writer, TwoPartMessage(json.dumps(header).encode(), b"")
+                )
+        except (ConnectionError, OSError):
+            pass  # prober gone; nothing to answer
+
+    async def reap_expired(self, grace: float) -> int:
+        """Abort in-flight requests whose deadline expired more than
+        ``grace`` seconds ago: emit a terminal error item, kill the engine
+        context (the engine then returns the request's slot and KV blocks),
+        and cancel the serve task. This is leak recovery — the in-stream
+        deadline check only runs when an item arrives, so a request whose
+        engine never yields would otherwise hold its RPC slot, engine slot,
+        and KV blocks forever. Driven by the health monitor's check loop."""
+        reaped = 0
+        for track in list(self._tracks):
+            if track.reaped or track.deadline is None:
+                continue
+            rem = track.deadline.remaining()
+            if rem is None or rem > -grace:
+                continue
+            track.reaped = True
+            reaped += 1
+            self.reaped_total += 1
+            logger.warning(
+                "reaping stuck request %s (deadline exceeded by %.1fs, "
+                "age %.1fs)", track.req_id, -rem,
+                time.monotonic() - track.started,
+            )
+            if track.sender is not None:
+                # terminal error item first — the cancel below flushes the
+                # sender queue, so the client observes the termination
+                try:
+                    await asyncio.wait_for(track.sender.send({
+                        "id": track.req_id, "op": "error",
+                        "message": (
+                            f"{DEADLINE_ERROR}: request reaped "
+                            f"{-rem:.1f}s past its deadline (stuck)"
+                        ),
+                        "code": "deadline",
+                        "load": self.load_snapshot().to_wire(),
+                    }), 1.0)
+                except (asyncio.TimeoutError, ConnectionError, OSError):
+                    pass  # reader gone/stalled: the kill below still runs
+            if track.ctx is not None:
+                track.ctx.context.kill()
+            if track.task is not None and not track.task.done():
+                track.task.cancel()
+        return reaped
+
+    async def _serve_request(self, h, body, writer, write_lock, contexts,
+                             track: Optional[RequestTrack] = None) -> None:
         req_id = h["id"]
+        track = track or RequestTrack(req_id)
         engine = self._engines.get(h.get("endpoint", ""))
         policy = self.admission.policy
         # all frames for this stream ride a BOUNDED queue: a slow reader
@@ -285,6 +408,7 @@ class RpcServer:
         # memory, and a stalled one gets the stream cut below
         sender = _StreamSender(writer, write_lock, policy.send_queue_cap,
                                policy.slow_consumer_timeout)
+        track.sender = sender
 
         async def send(header: dict, payload: bytes = b"") -> None:
             await sender.send(header, payload)
@@ -310,15 +434,21 @@ class RpcServer:
                     deadline = Deadline.after(float(deadline_ms) / 1000.0)
                 except (TypeError, ValueError):
                     deadline = None
+            track.deadline = deadline
             if deadline is not None and deadline.expired:
                 await send({"id": req_id, "op": "error",
                             "message": f"{DEADLINE_ERROR}: expired before start",
                             "code": "deadline", "load": load_wire()})
                 return
+            # fault-injection dispatch gate: a `wedge` rule parks the
+            # request here forever — the deterministic zombie-worker fault
+            # the health plane (probes + reaper) must absorb
+            await faults.serve_gate("rpc", f"{self.host}:{self.port}")
             try:
                 payload = json.loads(body) if body else None
                 ctx = Context(payload, request_id=h.get("request_id"))
                 contexts[req_id] = ctx
+                track.ctx = ctx
                 stream = engine.generate(ctx)
                 if hasattr(stream, "__await__"):
                     stream = await stream
@@ -468,6 +598,9 @@ class RpcClient:
                     item = ("item", frame.body)
                 elif op == "done":
                     item = ("done", None)
+                elif op == "pong":
+                    item = ("pong", {"health": h.get("health", "healthy"),
+                                     "load": load})
                 elif op == "error":
                     item = ("error", {
                         "message": h.get("message", "remote error"),
@@ -521,6 +654,34 @@ class RpcClient:
     async def _send(self, header: dict, body: bytes = b"") -> None:
         async with self._send_lock:
             await write_frame(self._writer, TwoPartMessage(json.dumps(header).encode(), body))
+
+    async def ping(self, timeout: float = 2.0) -> dict:
+        """Probe the worker's liveness through the real dispatch path.
+
+        Returns the pong payload (``{"health": ..., "load": ...}``). Raises
+        :class:`WorkerStalled` when no pong arrives within ``timeout`` (a
+        healthy socket whose serve path is wedged — the zombie signature)
+        and ``ConnectionError`` when the transport itself is dead."""
+        req_id = next(self._ids)
+        q: asyncio.Queue = asyncio.Queue(maxsize=1)
+        self._streams[req_id] = q
+        try:
+            await self._send({"id": req_id, "op": "ping"})
+            try:
+                kind, data = await asyncio.wait_for(q.get(), timeout)
+            except asyncio.TimeoutError:
+                raise WorkerStalled(
+                    f"no pong from {self.host}:{self.port} within "
+                    f"{timeout:.1f}s"
+                ) from None
+            if kind != "pong":
+                info = data if isinstance(data, dict) else {}
+                raise ConnectionError(
+                    f"ping failed: {info.get('message', kind)}"
+                )
+            return data
+        finally:
+            self._streams.pop(req_id, None)
 
     async def generate(
         self,

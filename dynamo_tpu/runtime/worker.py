@@ -25,6 +25,12 @@ work to it, the RPC server rejects stragglers with a retryable ``draining``
 reply, and in-flight streams run to completion — the operator half of a
 zero-downtime rolling restart (``llmctl worker drain`` does the same through
 the statestore; docs/overload.md has the runbook).
+
+The health plane (runtime/health.py) drives the same machinery through a
+third, independent drain source: an ``unhealthy`` self-diagnosis (engine
+stall, crash-looping subprocess engine) self-drains the worker and a
+recovery streak undrains it — neither ever cancels a SIGUSR1 or llmctl
+drain, because each source is tracked separately (docs/health.md).
 """
 
 from __future__ import annotations

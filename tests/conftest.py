@@ -49,3 +49,22 @@ def run():
         return asyncio.run(coro)
 
     return _run
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_health_monitors():
+    """Fail any test that leaves a HealthMonitor check task running past
+    teardown: a leaked monitor keeps reaping/draining state in the
+    background of every later test (imported lazily — the guard must not
+    drag runtime modules into tests that never touch them)."""
+    yield
+    import sys
+
+    health = sys.modules.get("dynamo_tpu.runtime.health")
+    if health is None:
+        return
+    leaked = health.live_monitors()
+    assert not leaked, (
+        f"{len(leaked)} HealthMonitor task(s) leaked past test teardown — "
+        f"stop() the monitor (or shutdown() its DistributedRuntime)"
+    )

@@ -1,0 +1,732 @@
+"""Health plane: liveness probing, stall detection, stuck-request reaping,
+and self-healing workers.
+
+Unit tests drive HealthPolicy/EngineHeartbeat/HealthMonitor and the RPC
+``__ping__`` verb + reaper directly; the integration tests prove the
+acceptance scenarios:
+
+- a 3-worker mock cluster with one worker wedged via the new ``wedge``
+  fault (connection accepted, serve path never progresses) under load: the
+  zombie is probe-detected and routed around quickly, with zero
+  client-visible failures, and re-admitted once the wedge clears;
+- a real JaxServingEngine whose step thread is deterministically wedged:
+  the engine heartbeat stall marks the worker unhealthy (self-drain), the
+  reaper aborts the stuck request past deadline+grace, and — once the
+  thread un-sticks — the allocator's ``free_blocks`` recovers to the
+  pre-wedge value and the worker re-admits itself.
+"""
+
+import asyncio
+import dataclasses
+import threading
+import time
+
+import pytest
+
+from dynamo_tpu.cli import llmctl
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.annotated import Annotated
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.faults import FaultInjector, FaultRule
+from dynamo_tpu.runtime.health import (
+    DEGRADED,
+    HEALTHY,
+    UNHEALTHY,
+    EngineHeartbeat,
+    HealthMonitor,
+    HealthPolicy,
+    live_monitors,
+)
+from dynamo_tpu.runtime.resilience import Deadline, ResiliencePolicy, WorkerStalled
+from dynamo_tpu.runtime.rpc import RpcClient, RpcServer
+from dynamo_tpu.runtime.statestore import StateStoreServer
+
+NO_BUS = "127.0.0.1:1"
+SEED = 20260803
+
+
+async def _wait_until(cond, timeout: float = 10.0, interval: float = 0.02) -> None:
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"condition not met within {timeout}s")
+        await asyncio.sleep(interval)
+
+
+# -- policy / env parsing -----------------------------------------------------
+
+
+class TestHealthPolicyEnv:
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("DYN_TPU_HEALTH_STALL_S", "4.5")
+        monkeypatch.setenv("DYN_TPU_HEALTH_CHECK_INTERVAL", "0.25")
+        monkeypatch.setenv("DYN_TPU_HEALTH_LOOP_LAG_S", "2")
+        monkeypatch.setenv("DYN_TPU_HEALTH_REAP_GRACE_S", "1.5")
+        monkeypatch.setenv("DYN_TPU_HEALTH_PROBE_IDLE_S", "3")
+        monkeypatch.setenv("DYN_TPU_HEALTH_PROBE_TIMEOUT_S", "0.75")
+        monkeypatch.setenv("DYN_TPU_HEALTH_RECOVERY_CHECKS", "7")
+        p = HealthPolicy.from_env()
+        assert p.stall_timeout == 4.5
+        assert p.check_interval == 0.25
+        assert p.loop_lag_threshold == 2.0
+        assert p.reap_grace == 1.5
+        assert p.probe_idle == 3.0
+        assert p.probe_timeout == 0.75
+        assert p.recovery_checks == 7
+
+    @pytest.mark.parametrize("bad", ["0", "-2", "soonish", ""])
+    def test_bad_values_clamp_to_defaults(self, monkeypatch, bad):
+        """Malformed/zero/negative knobs clamp to defaults (same contract
+        as the DYN_TPU_ADMIT_* parsers): a 0 stall timeout would declare
+        every busy engine stalled; a negative probe interval would spin."""
+        d = HealthPolicy()
+        for var in ("STALL_S", "CHECK_INTERVAL", "LOOP_LAG_S",
+                    "REAP_GRACE_S", "PROBE_IDLE_S", "PROBE_TIMEOUT_S",
+                    "RECOVERY_CHECKS"):
+            monkeypatch.setenv(f"DYN_TPU_HEALTH_{var}", bad)
+        assert HealthPolicy.from_env() == d
+
+
+# -- heartbeat + monitor state machine ---------------------------------------
+
+
+class _FakeServer:
+    def __init__(self, engines=()):
+        self._engines = list(engines)
+
+    def engines(self):
+        return list(self._engines)
+
+    async def reap_expired(self, grace):
+        return 0
+
+
+class _HbEngine:
+    def __init__(self):
+        self.heartbeat = EngineHeartbeat()
+
+
+class TestEngineHeartbeat:
+    def test_beat_and_age(self):
+        hb = EngineHeartbeat()
+        assert not hb.busy
+        hb.beat(busy=True)
+        assert hb.busy and hb.beats == 1
+        assert hb.age() < 1.0
+        hb.beat(busy=False)
+        assert not hb.busy and hb.beats == 2
+
+
+class TestHealthMonitorStates:
+    def _monitor(self, engines, **policy_kw):
+        calls = []
+        kw = dict(stall_timeout=10.0, recovery_checks=3)
+        kw.update(policy_kw)
+        mon = HealthMonitor(
+            HealthPolicy(**kw),
+            server=_FakeServer(engines),
+            set_draining=lambda flag, source=None: calls.append((flag, source)),
+        )
+        return mon, calls
+
+    def test_busy_stalled_heartbeat_marks_unhealthy_once(self):
+        eng = _HbEngine()
+        eng.heartbeat.beat(busy=True)
+        eng.heartbeat._last = time.monotonic() - 100.0  # silent for 100s
+        mon, calls = self._monitor([eng])
+        assert mon.check() == UNHEALTHY
+        assert mon.stalls_total == 1
+        assert calls == [(True, "health")]
+        # a persistent stall is ONE stall, not one per check
+        assert mon.check() == UNHEALTHY
+        assert mon.stalls_total == 1
+        assert calls == [(True, "health")]
+
+    def test_idle_engine_never_stalls(self):
+        eng = _HbEngine()
+        eng.heartbeat.beat(busy=False)  # idle: parked in its cond wait
+        eng.heartbeat._last = time.monotonic() - 100.0
+        mon, calls = self._monitor([eng])
+        assert mon.check() == HEALTHY
+        assert mon.stalls_total == 0 and calls == []
+
+    def test_recovery_needs_consecutive_checks(self):
+        eng = _HbEngine()
+        eng.heartbeat.beat(busy=True)
+        eng.heartbeat._last = time.monotonic() - 100.0
+        mon, calls = self._monitor([eng], recovery_checks=3)
+        assert mon.check() == UNHEALTHY
+        eng.heartbeat.beat(busy=True)  # progress resumed
+        # hysteresis: two good checks are not enough
+        assert mon.check() == UNHEALTHY
+        assert mon.check() == UNHEALTHY
+        assert mon.check() == HEALTHY
+        assert calls == [(True, "health"), (False, "health")]
+        # one bad check resets the streak
+        eng.heartbeat._last = time.monotonic() - 100.0
+        assert mon.check() == UNHEALTHY
+        eng.heartbeat.beat(busy=True)
+        assert mon.check() == UNHEALTHY
+        eng.heartbeat._last = time.monotonic() - 100.0
+        assert mon.check() == UNHEALTHY
+        eng.heartbeat.beat(busy=True)
+        assert mon.check() == UNHEALTHY  # streak restarted at 1
+        assert mon.stalls_total == 3
+
+    def test_loop_lag_degrades_without_draining(self):
+        mon, calls = self._monitor([], loop_lag_threshold=1.0)
+        assert mon.check(lag=5.0) == DEGRADED
+        assert calls == []  # degraded serves; only unhealthy drains
+        assert mon.check(lag=0.0) == HEALTHY
+        assert mon.loop_lag_max == 5.0
+
+    def test_subengine_self_report_bubbles_up(self):
+        class GaveUp:
+            health_state = UNHEALTHY
+
+        mon, calls = self._monitor([GaveUp()])
+        assert mon.check() == UNHEALTHY
+        assert calls == [(True, "health")]
+        assert mon.stalls_total == 0  # sick sub-engine, not a stall
+
+    def test_start_stop_and_leak_registry(self, run):
+        async def go():
+            mon = HealthMonitor(HealthPolicy(check_interval=0.02),
+                                server=_FakeServer())
+            mon.start()
+            assert mon in live_monitors()
+            await asyncio.sleep(0.08)
+            assert mon.checks_total >= 1
+            await mon.stop()
+            assert mon not in live_monitors()
+
+        run(go())
+
+
+# -- __ping__ verb ------------------------------------------------------------
+
+
+class QuickEngine(AsyncEngine):
+    async def generate(self, request: Context):
+        yield Annotated.from_data({"ok": True})
+
+
+class TestPingVerb:
+    def test_pong_carries_health_and_load(self, run):
+        async def go():
+            server = RpcServer(host="127.0.0.1", port=0)
+            server.register("e", QuickEngine())
+            await server.start()
+            client = await RpcClient.connect(f"127.0.0.1:{server.port}")
+            pong = await client.ping(timeout=2.0)
+            assert pong["health"] == HEALTHY
+            assert isinstance(pong["load"], dict)
+            # a self-diagnosed unhealthy worker says so in the pong
+            mon = HealthMonitor(server=server)
+            mon.state = UNHEALTHY
+            server.health = mon
+            pong = await client.ping(timeout=2.0)
+            assert pong["health"] == UNHEALTHY
+            await client.close()
+            await server.stop()
+
+        run(go())
+
+    def test_wedged_serve_path_times_the_ping_out(self, run):
+        """The probe's whole point: a zombie (socket accepts, dispatch gate
+        never progresses) must FAIL the ping, not answer it — and generate
+        replies keep flowing on other workers' healthy paths."""
+
+        async def go():
+            server = RpcServer(host="127.0.0.1", port=0)
+            server.register("e", QuickEngine())
+            await server.start()
+            client = await RpcClient.connect(f"127.0.0.1:{server.port}")
+            addr = f"{server.host}:{server.port}"
+            inj = FaultInjector([FaultRule(
+                plane="rpc", point="serve", action="wedge", match_addr=addr,
+            )], seed=SEED)
+            with faults.active(inj):
+                with pytest.raises(WorkerStalled):
+                    await client.ping(timeout=0.3)
+            # injector gone (wedges released): the parked pong proceeds and
+            # later pings answer again
+            pong = await client.ping(timeout=2.0)
+            assert pong["health"] == HEALTHY
+            await client.close()
+            await server.stop()
+
+        run(go())
+
+
+# -- stuck-request reaper -----------------------------------------------------
+
+
+class HungEngine(AsyncEngine):
+    """Accepts the request, never yields — the engine-side zombie."""
+
+    def __init__(self):
+        self.contexts = []
+
+    async def generate(self, request: Context):
+        self.contexts.append(request)
+        await asyncio.Event().wait()
+        yield  # pragma: no cover
+
+
+class TestReaper:
+    def test_reaps_past_deadline_plus_grace(self, run):
+        async def go():
+            eng = HungEngine()
+            server = RpcServer(host="127.0.0.1", port=0)
+            server.register("e", eng)
+            await server.start()
+            client = await RpcClient.connect(f"127.0.0.1:{server.port}")
+            # hand-rolled stream: deadline rides the header but the consumer
+            # imposes no local bound, so the terminal error item we receive
+            # is provably the REAPER's, not the client deadline path's
+            q: asyncio.Queue = asyncio.Queue(maxsize=8)
+            client._streams[901] = q
+            await client._send(
+                {"id": 901, "op": "generate", "endpoint": "e",
+                 "deadline_ms": 50}, b"{}",
+            )
+            await _wait_until(lambda: eng.contexts)
+            await asyncio.sleep(0.15)  # deadline (50ms) + grace (50ms) spent
+            assert await server.reap_expired(grace=0.05) == 1
+            kind, data = await asyncio.wait_for(q.get(), 5.0)
+            assert kind == "error"
+            assert data["code"] == "deadline"
+            assert "reaped" in data["message"]
+            # slot + engine context recovered: context killed, task cancelled
+            assert eng.contexts[0].context.is_killed
+            await _wait_until(lambda: server.inflight_count == 0)
+            assert server.reaped_total == 1
+            # idempotent: nothing left to reap
+            assert await server.reap_expired(grace=0.05) == 0
+            client._streams.pop(901, None)
+            await client.close()
+            await server.stop()
+
+        run(go())
+
+    def test_inside_deadline_requests_left_alone(self, run):
+        async def go():
+            eng = HungEngine()
+            server = RpcServer(host="127.0.0.1", port=0)
+            server.register("e", eng)
+            await server.start()
+            client = await RpcClient.connect(f"127.0.0.1:{server.port}")
+            q: asyncio.Queue = asyncio.Queue(maxsize=8)
+            client._streams[902] = q
+            await client._send(
+                {"id": 902, "op": "generate", "endpoint": "e",
+                 "deadline_ms": 60_000}, b"{}",
+            )
+            await _wait_until(lambda: eng.contexts)
+            assert await server.reap_expired(grace=0.05) == 0
+            assert server.inflight_count == 1
+            # deadline-less requests are never reaped either
+            client._streams[903] = asyncio.Queue(maxsize=8)
+            await client._send(
+                {"id": 903, "op": "generate", "endpoint": "e"}, b"{}",
+            )
+            await _wait_until(lambda: len(eng.contexts) == 2)
+            assert await server.reap_expired(grace=0.05) == 0
+            for ctx in eng.contexts:
+                ctx.context.kill()
+            client._streams.pop(902, None)
+            client._streams.pop(903, None)
+            await client.close()
+            # the hung engine never observes the kill: cut the drain short
+            await server.stop(drain_timeout=0.1)
+
+        run(go())
+
+
+# -- cluster helpers ----------------------------------------------------------
+
+
+class TagEngine(AsyncEngine):
+    def __init__(self, tag: str):
+        self.tag = tag
+
+    async def generate(self, request: Context):
+        for i in range(3):
+            await asyncio.sleep(0.005)
+            yield Annotated.from_data({"i": i, "worker": self.tag})
+
+
+def _policy(**kw) -> ResiliencePolicy:
+    base = dict(request_timeout=8.0, connect_timeout=0.5,
+                inter_item_timeout=0.5, max_attempts=4, backoff_base=0.005,
+                backoff_max=0.02, breaker_threshold=3, breaker_cooldown=0.5,
+                seed=SEED)
+    base.update(kw)
+    return ResiliencePolicy(**base)
+
+
+def _health_policy(**kw) -> HealthPolicy:
+    base = dict(probe_idle=0.3, probe_timeout=0.4, check_interval=0.1,
+                recovery_checks=2, stall_timeout=0.3, reap_grace=0.2)
+    base.update(kw)
+    return HealthPolicy(**base)
+
+
+async def _cluster(n, policy, health_policy=None, engine_for=TagEngine,
+                   mode="round_robin"):
+    ss = StateStoreServer(port=0)
+    await ss.start()
+    rts, infos = [], []
+    for i in range(n):
+        rt = await DistributedRuntime.create(ss.url, NO_BUS)
+        ep = rt.namespace("hp").component("w").endpoint("gen")
+        infos.append(await ep.serve(engine_for(f"w{i}")))
+        rts.append(rt)
+    fe = await DistributedRuntime.create(ss.url, NO_BUS)
+    client = await fe.namespace("hp").component("w").endpoint("gen").client(
+        mode, policy=policy, health_policy=health_policy or _health_policy()
+    )
+    await client.wait_for_instances(n, timeout=10)
+    return ss, rts, infos, fe, client
+
+
+async def _teardown(ss, rts, fe, client):
+    await client.close()
+    for rt in rts + [fe]:
+        await rt.shutdown()
+    await ss.stop()
+
+
+# -- zombie-worker chaos acceptance -------------------------------------------
+
+
+class TestZombieWorkerChaos:
+    def test_wedged_worker_probed_out_and_readmitted(self, run, monkeypatch):
+        """3-worker cluster, one wedged via the deterministic ``wedge``
+        fault under load: the zombie is probe-detected and routed around
+        within roughly one probe interval, every client request still
+        succeeds (pre-first-token failover absorbs the discovery), and the
+        worker re-admits once the wedge clears."""
+        # fast heartbeat re-puts: probing only starts once an instance key
+        # carries a health-plane stamp (pre-health-plane workers are never
+        # probed — they'd drop the ping op and look like zombies forever)
+        monkeypatch.setenv("DYN_TPU_LOAD_REPORT_INTERVAL", "0.1")
+
+        async def go():
+            ss, rts, infos, fe, client = await _cluster(3, _policy())
+            iid0 = infos[0].instance_id
+            addr0 = f"{rts[0]._rpc_server.host}:{rts[0]._rpc_server.port}"
+
+            failures, served_by = [], []
+
+            async def one():
+                try:
+                    items = [i async for i in client.generate(Context({}))]
+                except Exception as e:  # any raise = failed request
+                    failures.append(repr(e))
+                    return
+                errs = [i.error_message() for i in items if i.is_error]
+                if errs or not items:
+                    failures.append(str(errs or "empty"))
+                else:
+                    served_by.append(items[0].data["worker"])
+
+            async def wave(n, concurrency=3):
+                for start in range(0, n, concurrency):
+                    await asyncio.gather(
+                        *[one() for _ in range(min(concurrency, n - start))]
+                    )
+
+            # phase 1: healthy cluster serves everyone
+            await wave(9)
+            assert failures == []
+            assert set(served_by) == {"w0", "w1", "w2"}
+
+            # phase 2: wedge worker 0's serve path (zombie: TCP accepts,
+            # engine never progresses) and keep the load coming
+            inj = FaultInjector([FaultRule(
+                plane="rpc", point="serve", action="wedge", match_addr=addr0,
+            )], seed=SEED)
+            faults.install(inj)
+            try:
+                t_wedge = time.monotonic()
+                load = asyncio.create_task(wave(30))
+                await _wait_until(lambda: iid0 in client._probe_failed,
+                                  timeout=10.0)
+                detect_s = time.monotonic() - t_wedge
+                # detection within ~one probe cycle (idle 0.3 + timeout 0.4
+                # + loop slack) — generous bound for loaded CI hosts
+                assert detect_s < 5.0, f"zombie detected only after {detect_s:.1f}s"
+                await load
+                assert failures == [], (
+                    f"client-visible failures with a wedged worker: "
+                    f"{failures[:5]}"
+                )
+                # steady state: the zombie gets no new work
+                served_by.clear()
+                await wave(12)
+                assert failures == []
+                assert "w0" not in set(served_by)
+                assert set(served_by) == {"w1", "w2"}
+                for _ in range(20):
+                    assert client._pick({}) != iid0
+
+                # phase 3: the wedge clears (engine un-sticks) — the next
+                # successful probe (or reply piggyback) clears the zombie
+                # suspicion, and the breaker's cooldown + half-open cycle
+                # readmits the worker (wedge-era probe failures tripped it)
+                inj.clear_rules()
+                await _wait_until(lambda: iid0 not in client._probe_failed,
+                                  timeout=10.0)
+                await _wait_until(lambda: client._breaker.available(iid0),
+                                  timeout=10.0)
+                served_by.clear()
+                await wave(18)
+                assert failures == []
+                assert "w0" in set(served_by), "recovered worker got no traffic"
+            finally:
+                faults.uninstall()
+            assert client.stats["probe_failures"] >= 1
+            await _teardown(ss, rts, fe, client)
+
+        run(go())
+
+
+# -- engine-thread stall + reap + allocator recovery --------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_engine_parts():
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+
+    cfg = dataclasses.replace(LLAMA_PRESETS["tiny"], dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+class TestEngineStallAndReap:
+    def test_stall_detect_reap_and_self_heal(self, run, tiny_engine_parts):
+        """The full zombie lifecycle on a REAL engine: wedge the step
+        thread (posted blocking callback), watch the heartbeat stall mark
+        the worker unhealthy + self-drain, the reaper abort the stuck
+        request past deadline+grace, and — after the thread un-sticks —
+        the allocator's free_blocks recover to the pre-wedge value and the
+        health state return to healthy (undrain)."""
+        from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+        from dynamo_tpu.llm.protocols.common import (
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+
+        model_cfg, params = tiny_engine_parts
+
+        async def go():
+            eng = JaxServingEngine(
+                model_cfg, params,
+                EngineConfig(max_slots=2, kv_block_size=8, max_model_len=128),
+            )
+            server = RpcServer(host="127.0.0.1", port=0)
+            server.register("e", eng)
+            await server.start()
+            drains = []
+            mon = HealthMonitor(
+                _health_policy(check_interval=0.05),
+                server=server,
+                set_draining=lambda flag, source=None: drains.append(
+                    (flag, source)
+                ),
+            )
+            server.health = mon
+            mon.start()
+            client = await RpcClient.connect(f"127.0.0.1:{server.port}")
+            try:
+                # warm the jit caches so the timed request's deadline isn't
+                # spent compiling
+                warm = PreprocessedRequest(
+                    token_ids=[1, 2, 3],
+                    stop_conditions=StopConditions(max_tokens=2,
+                                                   ignore_eos=True),
+                    sampling_options=SamplingOptions(),
+                )
+                items = [i async for i in client.generate("e", warm.to_dict())]
+                assert not any(i.is_error for i in items)
+                await _wait_until(lambda: eng.allocator.free_blocks
+                                  == eng.num_blocks)
+                free0 = eng.allocator.free_blocks
+
+                req = PreprocessedRequest(
+                    token_ids=[4, 5, 6, 7],
+                    stop_conditions=StopConditions(max_tokens=100_000,
+                                                   ignore_eos=True),
+                    sampling_options=SamplingOptions(),
+                )
+                stream = client.generate(
+                    "e", req.to_dict(), deadline=Deadline.after(1.0),
+                )
+                first = await stream.__anext__()
+                assert not first.is_error  # decoding, allocation held
+                assert eng.allocator.free_blocks < free0
+
+                # wedge the engine thread deterministically
+                gate = threading.Event()
+                eng.post(gate.wait)
+                try:
+                    # heartbeat stalls while busy → unhealthy → self-drain
+                    await _wait_until(lambda: mon.state == UNHEALTHY,
+                                      timeout=10.0)
+                    assert (True, "health") in drains
+                    assert mon.stalls_total >= 1
+                    # the stuck request is reaped past deadline+grace: RPC
+                    # slot freed, terminal error delivered, context killed
+                    await _wait_until(lambda: server.reaped_total >= 1,
+                                      timeout=10.0)
+                    rest = [i async for i in stream]
+                    assert rest and rest[-1].is_error
+                    assert rest[-1].error_message().startswith(
+                        "deadline exceeded"
+                    )
+                    await _wait_until(lambda: server.inflight_count == 0)
+                finally:
+                    gate.set()  # the engine thread un-sticks
+
+                # leak recovery: the killed request's slot + KV blocks are
+                # returned — free_blocks recovers to the pre-wedge value
+                await _wait_until(
+                    lambda: eng.allocator.free_blocks == free0, timeout=10.0
+                )
+                # self-heal: beats resume → recovery streak → healthy +
+                # undrain
+                await _wait_until(lambda: mon.state == HEALTHY, timeout=10.0)
+                assert drains[-1] == (False, "health")
+                # and the engine still serves
+                items = [i async for i in client.generate("e", warm.to_dict())]
+                assert not any(i.is_error for i in items)
+            finally:
+                await mon.stop()
+                await client.close()
+                await server.stop()
+                eng.close()
+
+        run(go())
+
+
+# -- health on the discovery plane + llmctl ----------------------------------
+
+
+class TestHealthPublication:
+    def test_unhealthy_state_rides_heartbeat_and_is_skipped(self, run,
+                                                            monkeypatch):
+        monkeypatch.setenv("DYN_TPU_LOAD_REPORT_INTERVAL", "0.1")
+
+        async def go():
+            ss, rts, infos, fe, client = await _cluster(2, _policy())
+            iid0 = infos[0].instance_id
+            # force worker 0's monitor unhealthy (as a stall would)
+            rts[0]._health_monitor.state = UNHEALTHY
+            await _wait_until(lambda: client._is_unhealthy(iid0))
+            for _ in range(20):
+                assert client._pick({}) != iid0
+            summary = client.health_summary()
+            assert summary["instances"] == 2
+            assert summary["serving"] == 1
+            assert summary["unhealthy"] >= 1
+            # recovery propagates the same way
+            rts[0]._health_monitor.state = HEALTHY
+            await _wait_until(lambda: not client._is_unhealthy(iid0))
+            assert client.health_summary()["serving"] == 2
+            await _teardown(ss, rts, fe, client)
+
+        run(go())
+
+    def test_pre_health_plane_instances_never_probed(self, run, monkeypatch):
+        """An instance key without a health-plane stamp (old worker binary:
+        no ts, no counters — and no ping handler) must not be probed: the
+        ping would time out forever and breaker-eject a healthy worker."""
+        monkeypatch.setenv("DYN_TPU_LOAD_REPORT_INTERVAL", "30")
+
+        async def go():
+            ss, rts, infos, fe, client = await _cluster(2, _policy())
+            # let the initial stamped re-puts land (the drain watcher's
+            # first sync wakes each load reporter once), THEN rewrite the
+            # entries to look like an old worker wrote them — the next real
+            # re-put is an interval (30s) away, far past this test
+            await _wait_until(lambda: all(
+                i.ts > 0 for i in client._instances.values()
+            ))
+            for info in client._instances.values():
+                info.ts = 0.0
+                info.health_counters = None
+            client._last_rpc_seen.clear()
+            client.stats["probes"] = 0
+            await asyncio.sleep(0.8)  # several probe intervals (0.15s)
+            assert client.stats["probes"] == 0
+            assert not client._probe_failed
+            await _teardown(ss, rts, fe, client)
+
+        run(go())
+
+    def test_llmctl_worker_health(self, run, capsys, monkeypatch):
+        monkeypatch.setenv("DYN_TPU_LOAD_REPORT_INTERVAL", "0.1")
+
+        async def go():
+            ss, rts, infos, fe, client = await _cluster(2, _policy())
+            # wait for a heartbeat re-put so ts/health/counters are stamped
+            await _wait_until(lambda: all(
+                i.ts > 0 for i in client._instances.values()
+            ))
+            capsys.readouterr()
+            rc = await llmctl.amain([
+                "--statestore", ss.url, "worker", "health", "dyn://hp.w.gen",
+            ])
+            assert rc == 0
+            out = capsys.readouterr().out
+            lines = [ln for ln in out.splitlines() if ln.strip()]
+            assert len(lines) == 2
+            for ln in lines:
+                assert "healthy" in ln and "hb=" in ln and "stalls=0" in ln
+            rc = await llmctl.amain([
+                "--statestore", ss.url, "worker", "health", "--json",
+                "dyn://hp.w.gen",
+            ])
+            assert rc == 0
+            import json as _json
+
+            rows = _json.loads(capsys.readouterr().out)
+            assert len(rows) == 2
+            by_wid = {r["worker_id"]: r for r in rows}
+            for rt in rts:
+                row = by_wid[rt.worker_id]
+                assert row["health"] == "healthy"
+                assert row["heartbeat_age_s"] is not None
+                assert row["reaped_requests_total"] == 0
+            await _teardown(ss, rts, fe, client)
+
+        run(go())
+
+
+# -- kv scheduler skips unhealthy workers ------------------------------------
+
+
+def test_kv_scheduler_skips_unhealthy():
+    import random
+
+    from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.kv_router.scheduler import DefaultWorkerSelector
+
+    sel = DefaultWorkerSelector(rng=random.Random(0))
+    workers = {
+        "sick": ForwardPassMetrics(health_state="unhealthy"),
+        "ok": ForwardPassMetrics(),
+    }
+    for _ in range(10):
+        d = sel.select_worker(workers, {"sick": 100}, isl_blocks=4)
+        assert d is not None and d.worker_id == "ok"
+    # every worker unhealthy → no decision (caller falls back / retries)
+    workers["ok"].health_state = "unhealthy"
+    assert sel.select_worker(workers, {}, isl_blocks=1) is None

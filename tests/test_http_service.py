@@ -44,9 +44,69 @@ def test_models_listing(service, run):
 def test_health_and_live(service, run):
     async def fn(session, base):
         async with session.get(f"{base}/health") as resp:
-            assert (await resp.json())["status"] == "healthy"
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["status"] == "healthy"
+            assert body["models"]["echo"]["status"] == "healthy"
         async with session.get(f"{base}/live") as resp:
             assert (await resp.json())["live"] is True
+
+    run(_with_service(service, fn))
+
+
+class _SummaryEngine:
+    """Engine stand-in exposing the EndpointClient health_summary API."""
+
+    def __init__(self, instances, serving, draining=0, unhealthy=0):
+        self._s = {"instances": instances, "serving": serving,
+                   "draining": draining, "unhealthy": unhealthy}
+
+    def health_summary(self):
+        return dict(self._s)
+
+    async def generate(self, request):  # pragma: no cover - unused
+        yield None
+
+
+def test_health_reports_unhealthy_model_as_503(run):
+    """A served model with ZERO non-draining healthy instances must flip
+    /health to 503 + "unhealthy" (real readiness, not a hardcoded string);
+    /live stays pure process liveness (200)."""
+    manager = ModelManager()
+    manager.add_chat_model("dead", _SummaryEngine(instances=2, serving=0,
+                                                  unhealthy=2))
+    manager.add_chat_model("fine", _SummaryEngine(instances=2, serving=2))
+    service = HttpService(manager, host="127.0.0.1", port=0)
+
+    async def fn(session, base):
+        async with session.get(f"{base}/health") as resp:
+            assert resp.status == 503
+            body = await resp.json()
+            assert body["status"] == "unhealthy"
+            assert body["models"]["dead"]["status"] == "unhealthy"
+            assert body["models"]["dead"]["serving"] == 0
+            assert body["models"]["fine"]["status"] == "healthy"
+        async with session.get(f"{base}/live") as resp:
+            assert resp.status == 200
+            assert (await resp.json())["live"] is True
+
+    run(_with_service(service, fn))
+
+
+def test_health_reports_degraded_model_as_200(run):
+    """Some-but-not-all instances out: the model (and edge) is degraded —
+    still serving, still 200, but visibly impaired for dashboards."""
+    manager = ModelManager()
+    manager.add_chat_model("limping", _SummaryEngine(instances=3, serving=1,
+                                                     draining=1, unhealthy=1))
+    service = HttpService(manager, host="127.0.0.1", port=0)
+
+    async def fn(session, base):
+        async with session.get(f"{base}/health") as resp:
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["status"] == "degraded"
+            assert body["models"]["limping"]["status"] == "degraded"
 
     run(_with_service(service, fn))
 

@@ -41,6 +41,18 @@ BROKEN_ENGINE = '''
 raise ImportError("this engine cannot even import")
 '''
 
+# crashes moments after the ready handshake, unprompted — the crash-loop
+# shape: every respawn succeeds, then dies again within min_uptime
+CRASH_LOOP_ENGINE = '''
+import os, threading
+from dynamo_tpu.runtime.annotated import Annotated
+
+threading.Timer(0.05, lambda: os._exit(17)).start()
+
+async def generate(request):
+    yield Annotated.from_data({"i": 0})
+'''
+
 
 def run(coro):
     return asyncio.run(coro)
@@ -131,6 +143,66 @@ class TestSubprocessEngine:
         with caplog.at_level(logging.INFO, logger="dynamo_tpu.llm.subprocess_engine"):
             run(go())
         assert any("engine booted ok" in r.getMessage() for r in caplog.records)
+
+    def test_crash_loop_gives_up_and_marks_unhealthy(self, tmp_path):
+        """A child that dies within min_uptime of every ready handshake must
+        not be respawned forever: after max_fast_crashes consecutive fast
+        crashes the host stops, fails requests fast, and reports itself
+        unhealthy to the health plane (HealthMonitor sweeps health_state)."""
+        f = tmp_path / "eng.py"
+        f.write_text(CRASH_LOOP_ENGINE)
+
+        async def go():
+            eng = SubprocessEngine(
+                str(f), restart_backoff=0.05, max_restart_backoff=0.2,
+                min_uptime=5.0, max_fast_crashes=3,
+            )
+            try:
+                await eng.start()
+                deadline = asyncio.get_running_loop().time() + 30.0
+                while not eng._gave_up:
+                    assert asyncio.get_running_loop().time() < deadline, (
+                        "crash loop never gave up"
+                    )
+                    await asyncio.sleep(0.05)
+                assert eng.health_state == "unhealthy"
+                assert eng._fast_crashes >= 3
+                # escalating, capped backoff — never reset by the doomed
+                # restarts in between
+                assert eng._restart_delay <= eng.max_restart_backoff
+                assert eng._restart_delay > eng.restart_backoff
+                # requests now fail fast with a terminal error, no respawn
+                items = await asyncio.wait_for(collect(eng, {}), 2.0)
+                assert len(items) == 1 and items[0].is_error
+                assert "crash-looped" in items[0].error_message()
+            finally:
+                await eng.close()
+
+        run(go())
+
+    def test_slow_crash_resets_the_crash_loop_counter(self, tmp_path):
+        """A child that served longer than min_uptime before dying is a
+        fresh failure, not part of a loop: counters and backoff reset."""
+        f = tmp_path / "eng.py"
+        f.write_text(CRASH_ENGINE)
+
+        async def go():
+            eng = SubprocessEngine(
+                str(f), restart_backoff=0.05, min_uptime=0.01,
+                max_fast_crashes=2,
+            )
+            try:
+                for _ in range(3):  # 3 crashes > max_fast_crashes...
+                    items = await collect(eng, {})
+                    assert items[-1].is_error
+                    await asyncio.sleep(0.3)  # child respawns
+                # ...but each ran past min_uptime, so no give-up
+                assert not eng._gave_up
+                assert eng.health_state == "healthy"
+            finally:
+                await eng.close()
+
+        run(go())
 
     def test_cancellation_propagates(self, tmp_path):
         f = tmp_path / "eng.py"
