@@ -536,13 +536,23 @@ def bench_concurrency() -> dict:
 def drive_wave(engine, prompts, gen_tokens):
     """Run one concurrent wave; returns (total_out, elapsed, ttfts,
     decode_tok_s) where decode_tok_s is the decode-phase rate (all lanes
-    prefilled → done), guarded against a degenerate zero-length phase."""
+    prefilled → done), guarded against a degenerate zero-length phase.
+
+    TTFT and per-token inter-token gaps additionally feed the tracing
+    plane's phase histograms (runtime/tracing.py) so the BENCH json can
+    report p50/p95/p99 latency shape from the same source operators scrape
+    in production. Multi-token items spread their arrival gap evenly — the
+    engine emits whole decode chunks, the consumer-visible per-token rate
+    is gap/chunk."""
     from dynamo_tpu.llm.protocols.common import (
         PreprocessedRequest,
         SamplingOptions,
         StopConditions,
     )
+    from dynamo_tpu.runtime import tracing
     from dynamo_tpu.runtime.engine import Context
+
+    trace_on = tracing.enabled()
 
     async def one(prompt):
         req = PreprocessedRequest(
@@ -552,12 +562,21 @@ def drive_wave(engine, prompts, gen_tokens):
         )
         t0 = time.perf_counter()
         ttft = first_abs = None
+        prev = None
         n = 0
         async for item in engine.generate(Context(req)):
             got = len(((item.data) or {}).get("token_ids", []))
             if got and ttft is None:
                 first_abs = time.perf_counter()
                 ttft = first_abs - t0
+                prev = first_abs
+                if trace_on:
+                    tracing.observe_phase("ttft", ttft)
+            elif got:
+                now = time.perf_counter()
+                if trace_on and prev is not None:
+                    tracing.observe_phase("inter_token", (now - prev) / got)
+                prev = now
             n += got
         return ttft, n, first_abs
 
@@ -775,6 +794,13 @@ def main() -> None:
     # the timed set so no timed request hits the prefix cache
     drive_wave(engine, warm_prompts, GEN_TOKENS)
 
+    # latency-shape bookkeeping starts AFTER warmup: reset the tracing
+    # plane's phase histograms so the reported percentiles cover only the
+    # timed waves (warmup's first-boot compile would dominate p99 otherwise)
+    from dynamo_tpu.runtime import tracing as _tracing
+
+    _tracing.configure()
+
     # decode phase (inside drive_wave): every lane prefilled → done. This is
     # the steady state the weight-bandwidth roofline describes; the whole-run
     # rate (which also pays prefill+admission) rides along as
@@ -841,6 +867,19 @@ def main() -> None:
         "warmup_compile_s": round(warmup_s, 1),
         "warmup_variants": warmup_timings,
     }
+    # latency SHAPE from the tracing plane's phase histograms (ttft /
+    # inter_token observed by drive_wave, queue_wait / prefill / decode by
+    # the engine's own phase spans): the perf trajectory captures p50/p95/
+    # p99, not just throughput. Empty when DYN_TPU_TRACE=0.
+    phases = _tracing.phase_summary()
+    if phases:
+        out["phase_latency"] = phases
+        ttft_ph = phases.get("ttft", {})
+        itl_ph = phases.get("inter_token", {})
+        out["ttft_p99_ms"] = ttft_ph.get("p99_ms")
+        out["itl_p50_ms"] = itl_ph.get("p50_ms")
+        out["itl_p95_ms"] = itl_ph.get("p95_ms")
+        out["itl_p99_ms"] = itl_ph.get("p99_ms")
     alt_enabled = os.environ.get(
         "BENCH_ALT_MODE", os.environ.get("BENCH_INT8", "1")
     )

@@ -203,6 +203,7 @@ def all_rules() -> List[Rule]:
         JitHostSyncRule,
         UnmarkedHostSyncRule,
     )
+    from dynamo_tpu.analysis.rules_metrics import MetricNameValidRule
     from dynamo_tpu.analysis.rules_protocol import EndpointProtocolDriftRule
 
     return [
@@ -215,6 +216,7 @@ def all_rules() -> List[Rule]:
         UnmarkedHostSyncRule(),
         ImportTimeJaxComputeRule(),
         EndpointProtocolDriftRule(),
+        MetricNameValidRule(),
     ]
 
 

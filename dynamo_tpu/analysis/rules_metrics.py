@@ -1,0 +1,176 @@
+"""Metric registration hygiene rule.
+
+Prometheus silently drops (or a scraper rejects) samples whose metric name
+violates the exposition grammar ``[a-zA-Z_:][a-zA-Z0-9_:]*`` — and a metric
+without a help string renders a dashboard nobody can read. Both mistakes
+pass every unit test (the in-process registry accepts any string) and only
+surface when an operator's scrape breaks. ``metric-name-valid`` checks the
+two static registration surfaces:
+
+- constructor calls to the no-dep primitives (``Counter``/``Gauge``/
+  ``Histogram`` from ``llm/http/metrics.py``): the name argument (literal or
+  f-string with a computed prefix) must fit the grammar, and the help
+  argument must be a non-empty string;
+- table-driven gauge catalogs (module-level ``*GAUGES = [(name, help), …]``
+  lists like ``components/metrics.py``): every entry's name and help are
+  validated the same way.
+
+Names built entirely at runtime can't be checked statically and are skipped
+— the rule is a tripwire for the common literal case, not a proof.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional, Tuple
+
+from dynamo_tpu.analysis.core import (
+    Finding,
+    Module,
+    Project,
+    Rule,
+    collect_imports,
+    resolve_call,
+)
+
+# full-name grammar, and the looser body grammar for literal *fragments*
+# of an f-string name (the computed prefix supplies the leading character)
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_FRAGMENT_RE = re.compile(r"^[a-zA-Z0-9_:]*$")
+
+_METRIC_CLASSES = ("Counter", "Gauge", "Histogram")
+
+
+def _is_metric_constructor(resolved: Optional[str]) -> bool:
+    """True for the project's metric primitives: a bare local name (inside
+    ``llm/http/metrics.py`` itself, or any module defining compatible
+    primitives) or an import resolving into a ``…metrics`` module. A
+    ``collections.Counter`` import resolves to its real module and is
+    never mistaken for a metric."""
+    if resolved is None:
+        return False
+    if resolved in _METRIC_CLASSES:
+        return True
+    for cls in _METRIC_CLASSES:
+        if resolved.endswith(f".metrics.{cls}"):
+            return True
+    return False
+
+
+def _literal_name_problem(node: ast.expr) -> Optional[str]:
+    """Why this name expression is invalid, or None (valid / uncheckable)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        if not _NAME_RE.match(node.value):
+            return (
+                f"metric name {node.value!r} does not match the Prometheus "
+                f"grammar [a-zA-Z_:][a-zA-Z0-9_:]*"
+            )
+        return None
+    if isinstance(node, ast.JoinedStr):
+        for i, part in enumerate(node.values):
+            if not isinstance(part, ast.Constant):
+                continue  # computed piece: uncheckable, assume a sane prefix
+            text = str(part.value)
+            pattern = _NAME_RE if i == 0 else _FRAGMENT_RE
+            if not pattern.match(text):
+                return (
+                    f"metric name fragment {text!r} contains characters "
+                    f"outside the Prometheus grammar [a-zA-Z0-9_:]"
+                )
+        return None
+    return None  # fully dynamic name: nothing to check statically
+
+
+def _help_problem(node: Optional[ast.expr], name_hint: str) -> Optional[str]:
+    if node is None:
+        return f"metric {name_hint} is registered without a help string"
+    if isinstance(node, ast.Constant):
+        if not isinstance(node.value, str) or not node.value.strip():
+            return f"metric {name_hint} has an empty help string"
+    return None  # computed help: uncheckable
+
+
+def _name_hint(node: ast.expr) -> str:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return repr(node.value)
+    if isinstance(node, ast.JoinedStr):
+        parts = [
+            str(p.value) if isinstance(p, ast.Constant) else "{…}"
+            for p in node.values
+        ]
+        return repr("".join(parts))
+    return "<dynamic>"
+
+
+def _gauge_table_entries(
+    module: Module,
+) -> Iterator[Tuple[ast.expr, Optional[ast.expr], int]]:
+    """(name_expr, help_expr, line) from module-level ``*GAUGES`` lists of
+    (name, help[, …]) tuples."""
+    for stmt in module.tree.body:
+        targets: List[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+            value = stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets = [stmt.target]
+            value = stmt.value
+        else:
+            continue
+        named = any(
+            isinstance(t, ast.Name) and t.id.endswith("GAUGES") for t in targets
+        )
+        if not named or not isinstance(value, (ast.List, ast.Tuple)):
+            continue
+        for item in value.elts:
+            if not isinstance(item, (ast.Tuple, ast.List)) or not item.elts:
+                continue
+            name_expr = item.elts[0]
+            help_expr = item.elts[1] if len(item.elts) > 1 else None
+            yield name_expr, help_expr, item.lineno
+
+
+class MetricNameValidRule(Rule):
+    name = "metric-name-valid"
+    description = (
+        "metric/gauge registered with a name outside the Prometheus "
+        "exposition grammar [a-zA-Z_:][a-zA-Z0-9_:]*, or with a missing/"
+        "empty help string — the error only surfaces when an operator's "
+        "scrape breaks"
+    )
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        # constructor calls to the metric primitives
+        imports = collect_imports(ast.walk(module.tree), module.package)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not _is_metric_constructor(resolve_call(node.func, imports)):
+                continue
+            if not node.args:
+                continue
+            name_expr = node.args[0]
+            problem = _literal_name_problem(name_expr)
+            if problem is not None:
+                yield Finding(module.relpath, node.lineno, self.name, problem)
+            help_expr: Optional[ast.expr] = (
+                node.args[1] if len(node.args) > 1 else None
+            )
+            if help_expr is None:
+                for kw in node.keywords:
+                    if kw.arg in ("help_", "help"):
+                        help_expr = kw.value
+                        break
+            problem = _help_problem(help_expr, _name_hint(name_expr))
+            if problem is not None:
+                yield Finding(module.relpath, node.lineno, self.name, problem)
+
+        # table-driven gauge catalogs (components/metrics.py GAUGES)
+        for name_expr, help_expr, line in _gauge_table_entries(module):
+            problem = _literal_name_problem(name_expr)
+            if problem is not None:
+                yield Finding(module.relpath, line, self.name, problem)
+            problem = _help_problem(help_expr, _name_hint(name_expr))
+            if problem is not None:
+                yield Finding(module.relpath, line, self.name, problem)

@@ -10,6 +10,8 @@
     python -m dynamo_tpu.cli.llmctl worker health [--json] <dyn://ns.comp.ep>
     python -m dynamo_tpu.cli.llmctl worker drain <dyn://ns.comp.ep> <worker_id|all>
     python -m dynamo_tpu.cli.llmctl worker undrain <dyn://ns.comp.ep> <worker_id|all>
+    python -m dynamo_tpu.cli.llmctl trace dump [--limit N] [--worker ID] <dyn://ns.comp.ep>
+    python -m dynamo_tpu.cli.llmctl trace show <dyn://ns.comp.ep> <trace_id>
 
 ``worker drain`` writes a drain control key the target worker watches
 (``.../endpoints/{ep}/drain/{worker_id}``): routers stop sending it new
@@ -19,6 +21,12 @@ failed requests (docs/overload.md has the rolling-restart runbook).
 its draining flag and last load snapshot. ``worker health`` reads the same
 instance keys and shows the health plane's view: state, last heartbeat age,
 and the stall/reap counters (docs/health.md has the stuck-worker runbook).
+
+``trace dump`` dials every live instance's RPC port and drains its
+in-process flight recorder as JSONL (one trace per line, same-trace spans
+from different workers merged); ``trace show`` renders one trace's span
+tree — the "where did this request's time go" view (docs/observability.md
+has the runbook).
 
 Writes/deletes ``{ns}/models/{kind}/{name}`` entries WITHOUT a lease (they
 outlive this process, like the reference's `for_cli` etcd config) so an
@@ -68,6 +76,18 @@ def build_parser() -> argparse.ArgumentParser:
     dset.add_argument("--max-local-prefill-length", type=int, default=None)
     dset.add_argument("--max-prefill-queue-size", type=int, default=None)
 
+    trace = sub.add_parser("trace", help="dump/show worker request traces")
+    tverbs = trace.add_subparsers(dest="verb", required=True)
+    tdump = tverbs.add_parser("dump", help="flight-recorder traces as JSONL")
+    tdump.add_argument("endpoint", help="dyn://ns.comp.ep")
+    tdump.add_argument("--limit", type=int, default=0,
+                       help="newest N traces per worker (0 = all retained)")
+    tdump.add_argument("--worker", default=None,
+                       help="only this worker id (from `worker list`)")
+    tshow = tverbs.add_parser("show", help="render one trace's span tree")
+    tshow.add_argument("endpoint", help="dyn://ns.comp.ep")
+    tshow.add_argument("trace_id")
+
     worker = sub.add_parser("worker", help="drain/undrain/list endpoint workers")
     wverbs = worker.add_subparsers(dest="verb", required=True)
     wls = wverbs.add_parser("list")
@@ -93,6 +113,8 @@ async def amain(argv: list) -> int:
     url = args.statestore or os.environ.get("DYN_TPU_STATESTORE", "127.0.0.1:37901")
     store = await StateStoreClient.connect(url)
     try:
+        if args.plane == "trace":
+            return await _trace_cmd(args, store)
         if args.plane == "worker":
             ns, comp, ep = parse_endpoint_path(args.endpoint)
             base = f"{ns}/components/{comp}/endpoints/{ep}"
@@ -231,6 +253,72 @@ async def amain(argv: list) -> int:
             return 0 if ok else 1
     finally:
         await store.close()
+    return 0
+
+
+async def _trace_cmd(args, store) -> int:
+    """``trace dump`` / ``trace show``: dial each live instance's RPC port
+    and read its flight recorder (the ``trace_dump`` RPC verb). Spans of the
+    same trace recorded by different workers (disaggregated prefill/decode)
+    are merged back into one trace before printing."""
+    from dynamo_tpu.runtime import tracing
+    from dynamo_tpu.runtime.distributed import InstanceInfo, parse_endpoint_path
+    from dynamo_tpu.runtime.rpc import RpcClient
+
+    ns, comp, ep = parse_endpoint_path(args.endpoint)
+    base = f"{ns}/components/{comp}/endpoints/{ep}"
+    entries = await store.get_prefix(f"{base}/instances/")
+    want_worker = getattr(args, "worker", None)
+    want_trace = getattr(args, "trace_id", None)
+    merged: dict = {}  # trace_id → entry with spans merged across workers
+    dialed = 0
+    for key in sorted(entries):
+        try:
+            info = InstanceInfo.from_json(entries[key])
+        except (ValueError, KeyError):
+            continue
+        if want_worker is not None and info.worker_id != want_worker:
+            continue
+        try:
+            client = await RpcClient.connect(info.address, timeout=5.0)
+        except (ConnectionError, OSError) as e:
+            print(f"(worker {info.worker_id} at {info.address} unreachable: {e})",
+                  file=sys.stderr)
+            continue
+        try:
+            traces = await client.trace_dump(
+                limit=getattr(args, "limit", 0) or 0, trace_id=want_trace
+            )
+        except (ConnectionError, OSError) as e:
+            print(f"(trace dump from {info.worker_id} failed: {e})",
+                  file=sys.stderr)
+            continue
+        finally:
+            await client.close()
+        dialed += 1
+        for t in traces:
+            entry = merged.setdefault(
+                t["trace_id"],
+                {"trace_id": t["trace_id"], "spans": [], "pinned": False},
+            )
+            entry["spans"].extend(t.get("spans", []))
+            entry["pinned"] = entry["pinned"] or bool(t.get("pinned"))
+    if args.verb == "show":
+        if not merged:
+            print(f"(trace {want_trace} not found on any of {dialed} "
+                  f"reachable worker(s) of {args.endpoint})")
+            return 1
+        for entry in merged.values():
+            print(tracing.render_trace(entry))
+        return 0
+    for entry in sorted(
+        merged.values(),
+        key=lambda e: min((s.get("start", 0.0) for s in e["spans"]), default=0.0),
+    ):
+        print(json.dumps(entry, sort_keys=True))
+    if not merged:
+        print(f"(no traces retained on {dialed} reachable worker(s) of "
+              f"{args.endpoint})", file=sys.stderr)
     return 0
 
 

@@ -29,15 +29,9 @@ from typing import Dict, Tuple
 from aiohttp import web
 
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.llm.http.metrics import escape_label as _escape_label
 
 logger = logging.getLogger(__name__)
-
-
-def _escape_label(v: str) -> str:
-    """Escape a Prometheus text-format label value (backslash, quote,
-    newline) — an id containing any of these would otherwise corrupt the
-    whole /metrics exposition."""
-    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 GAUGES = [
     ("request_active_slots", "Decode slots currently occupied"),
@@ -142,6 +136,43 @@ class MetricsAggregator:
                 lines.append(
                     f'{full}{{namespace="{_escape_label(self.namespace)}",worker="{_escape_label(str(worker_id))}"}} {totals[idx]}'
                 )
+        # request-phase latency quantiles (runtime/tracing.py span durations,
+        # summarized worker-side by attach_kv_publishing): one gauge per
+        # (worker, phase, quantile) plus a sample-count companion
+        full = f"{self.prefix}_phase_latency_ms"
+        lines.append(
+            f"# HELP {full} Request-phase latency quantile from trace spans"
+        )
+        lines.append(f"# TYPE {full} gauge")
+        count_lines = []
+        ns_esc = _escape_label(self.namespace)
+        for worker_id, m in sorted(live.items()):
+            phases = getattr(m, "phase_latency", None)
+            if not isinstance(phases, dict):
+                continue
+            w_esc = _escape_label(str(worker_id))
+            for phase in sorted(phases):
+                stats = phases[phase]
+                if not isinstance(stats, dict):
+                    continue
+                p_esc = _escape_label(str(phase))
+                for q in ("p50", "p95", "p99"):
+                    val = stats.get(f"{q}_ms")
+                    if val is None:
+                        continue
+                    lines.append(
+                        f'{full}{{namespace="{ns_esc}",worker="{w_esc}",'
+                        f'phase="{p_esc}",quantile="{q}"}} {val}'
+                    )
+                count_lines.append(
+                    f'{self.prefix}_phase_latency_count{{namespace="{ns_esc}",'
+                    f'worker="{w_esc}",phase="{p_esc}"}} '
+                    f'{int(stats.get("count", 0))}'
+                )
+        full = f"{self.prefix}_phase_latency_count"
+        lines.append(f"# HELP {full} Samples behind the phase latency quantiles")
+        lines.append(f"# TYPE {full} gauge")
+        lines.extend(count_lines)
         full = f"{self.prefix}_up"
         lines.append(f"# HELP {full} Workers currently reporting metrics")
         lines.append(f"# TYPE {full} gauge")

@@ -40,6 +40,7 @@ from dynamo_tpu.disagg.protocols import (
     RemotePrefillRequest,
 )
 from dynamo_tpu.disagg.transfer import KvTransferClient, _engine_call
+from dynamo_tpu.runtime import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -242,6 +243,24 @@ async def run_prefill_worker(
     logger.info("prefill worker consuming %s", queue)
 
     async def handle(req: RemotePrefillRequest) -> None:
+        # the request's trace context rode the queue (RemotePrefillRequest.
+        # traceparent): this worker's spans — remote prefill + kv transfer —
+        # join the decode request's trace, so a disaggregated request reads
+        # as ONE trace end to end. set_current: the transfer plane's
+        # kv_transfer spans nest under this one via the contextvar.
+        with tracing.span(
+            "disagg.remote_prefill",
+            parent=tracing.parse_traceparent(req.traceparent),
+            attributes={"request_id": req.request_id,
+                        "prompt_tokens": len(req.token_ids),
+                        "cached_tokens": req.cached_tokens},
+            set_current=True,
+        ) as pspan:
+            await _handle_inner(req, pspan)
+
+    async def _handle_inner(
+        req: RemotePrefillRequest, pspan=None
+    ) -> None:
         # same-process decode engine → device path: pages stay jax arrays
         # and land on the decode mesh via device_put, no host staging
         from dynamo_tpu.disagg.serving import LOCAL_DECODE_ENGINES
@@ -356,6 +375,11 @@ async def run_prefill_worker(
                         if fresh is not None:
                             addr = fresh.decode()
                             addr_cache[req.engine_id] = addr
+            if pspan is not None:
+                pspan.set_attribute("computed_tokens", computed)
+                pspan.set_attribute(
+                    "path", "local" if local_engine is not None else "tcp"
+                )
             logger.info(
                 "prefilled %s%s (%d tokens, computed %d → %d pages)",
                 req.request_id,
@@ -363,6 +387,12 @@ async def run_prefill_worker(
                 len(req.token_ids), computed, k.shape[1],
             )
         except Exception as e:
+            # the failure is reported in-band (send_failure / local
+            # fallback), so it never escapes to the span CM — mark the
+            # span here or the trace would read as a healthy prefill
+            if pspan is not None:
+                pspan.set_attribute("error", f"{type(e).__name__}: {e}")
+                pspan.status = "error"
             logger.exception("prefill failed for %s", req.request_id)
             if local_engine is not None:
                 local_engine.fail_remote_prefill(req.request_id, str(e))

@@ -38,6 +38,10 @@ class RemotePrefillRequest:
     # deployment would fail the check on every request and silently disable
     # the prefix-read optimization (full recompute each time).
     salt_hex: str = ""
+    # W3C trace context of the originating request (runtime/tracing.py):
+    # the prefill worker's spans join the decode request's trace, so one
+    # disaggregated request reads as ONE trace. "" = untraced / old producer.
+    traceparent: str = ""
 
     def to_dict(self) -> dict:
         return {
@@ -51,6 +55,7 @@ class RemotePrefillRequest:
             "model": self.model,
             "prefix_block_ids": self.prefix_block_ids,
             "salt_hex": self.salt_hex,
+            "traceparent": self.traceparent,
         }
 
     @classmethod
@@ -66,6 +71,7 @@ class RemotePrefillRequest:
             model=str(d.get("model", "")),
             prefix_block_ids=list(d.get("prefix_block_ids", [])),
             salt_hex=str(d.get("salt_hex", "")),
+            traceparent=str(d.get("traceparent", "")),
         )
 
 

@@ -57,7 +57,7 @@ class DisaggPolicy:
         return True
 
     def submit(self, request_id, token_ids, block_ids, cached_tokens,
-               sampling, prefix_block_ids=()) -> None:
+               sampling, prefix_block_ids=(), traceparent="") -> None:
         req = RemotePrefillRequest(
             request_id=request_id,
             engine_id=self.engine_id,
@@ -69,6 +69,7 @@ class DisaggPolicy:
             model=self.model,
             prefix_block_ids=list(prefix_block_ids),
             salt_hex=self.salt.hex() if self.salt else "",
+            traceparent=traceparent or "",
         )
         self._enqueue(req)
 

@@ -18,6 +18,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.codec import TwoPartMessage, read_frame, write_frame
 
 logger = logging.getLogger(__name__)
@@ -196,6 +197,13 @@ class LocalKvTransfer:
         self, address: str, request_id: str, first_token: int, block_ids, k, v
     ) -> None:
         # address ignored: the target is in-process
+        tracing.record_event_span(
+            "disagg.kv_transfer",
+            parent=tracing.current_span(),
+            attributes={"op": "send_blocks", "path": "local",
+                        "pages": len(list(block_ids)),
+                        "request_id": request_id},
+        )
         self.decode.complete_remote_prefill(request_id, first_token, list(block_ids), k, v)
 
     async def send_failure(self, address: str, request_id: str, message: str) -> None:
@@ -270,37 +278,52 @@ class KvTransferClient:
         k,
         v,
     ) -> None:
-        if self._use_dev(address):
+        # kv_transfer span: the wire (or device-fabric) time of shipping the
+        # computed pages — nests under the prefill worker's request span via
+        # the ambient contextvar
+        with tracing.span(
+            "disagg.kv_transfer",
+            parent=tracing.current_span(),
+            phase="kv_transfer",
+            attributes={"op": "send_blocks", "pages": len(list(block_ids)),
+                        "address": address, "request_id": request_id},
+        ) as tspan:
+            if self._use_dev(address):
+                try:
+                    await self._send_blocks_dev(
+                        address, request_id, first_token, block_ids, k, v
+                    )
+                    if tspan is not None:
+                        tspan.set_attribute("path", "device")
+                    return
+                except _NoDevicePeer:
+                    self._dev_peers[address] = False  # fall through to TCP
+            k, v = np.asarray(k), np.asarray(v)
+            reader, writer = await self._conn(address)
+            k_raw, v_raw = _pack(k), _pack(v)
+            if tspan is not None:
+                tspan.set_attribute("path", "tcp")
+                tspan.set_attribute("bytes", len(k_raw) + len(v_raw))
+            header = {
+                "op": "kv_blocks",
+                "request_id": request_id,
+                "first_token": int(first_token),
+                "block_ids": list(map(int, block_ids)),
+                "dtype": k.dtype.name,
+                "shape": list(k.shape),
+                "k_bytes": len(k_raw),
+            }
             try:
-                await self._send_blocks_dev(
-                    address, request_id, first_token, block_ids, k, v
-                )
-                return
-            except _NoDevicePeer:
-                self._dev_peers[address] = False  # fall through to TCP
-        k, v = np.asarray(k), np.asarray(v)
-        reader, writer = await self._conn(address)
-        k_raw, v_raw = _pack(k), _pack(v)
-        header = {
-            "op": "kv_blocks",
-            "request_id": request_id,
-            "first_token": int(first_token),
-            "block_ids": list(map(int, block_ids)),
-            "dtype": k.dtype.name,
-            "shape": list(k.shape),
-            "k_bytes": len(k_raw),
-        }
-        try:
-            async with self._locks[address]:
-                await write_frame(
-                    writer, TwoPartMessage(json.dumps(header).encode(), k_raw + v_raw)
-                )
-                await read_frame(reader)  # ack
-        except (ConnectionError, OSError, asyncio.IncompleteReadError):
-            # evict exactly the conn that failed (identity-guarded), so
-            # retries dial fresh without racing concurrent senders
-            self.evict(address, writer)
-            raise
+                async with self._locks[address]:
+                    await write_frame(
+                        writer, TwoPartMessage(json.dumps(header).encode(), k_raw + v_raw)
+                    )
+                    await read_frame(reader)  # ack
+            except (ConnectionError, OSError, asyncio.IncompleteReadError):
+                # evict exactly the conn that failed (identity-guarded), so
+                # retries dial fresh without racing concurrent senders
+                self.evict(address, writer)
+                raise
 
     async def _send_blocks_dev(
         self, address, request_id, first_token, block_ids, k, v
@@ -334,28 +357,41 @@ class KvTransferClient:
         Returns (k, v, hashes): [L, n, bs, KVH, D] pages plus each page's
         registered content hash (-1 = no longer registered). Device-path
         when both ends have a plane, host-staged TCP otherwise."""
-        if self._use_dev(address):
-            try:
-                return await self._read_blocks_dev(address, block_ids)
-            except _NoDevicePeer:
-                self._dev_peers[address] = False
-        reader, writer = await self._conn(address)
-        async with self._locks[address]:
-            await write_frame(
-                writer,
-                TwoPartMessage(
-                    json.dumps(
-                        {"op": "read_blocks", "block_ids": list(map(int, block_ids))}
-                    ).encode(),
-                    b"",
-                ),
-            )
-            frame = await read_frame(reader)
-        h = json.loads(frame.header)
-        k_len = h["k_bytes"]
-        k = _unpack(frame.body[:k_len], h["dtype"], h["shape"])
-        v = _unpack(frame.body[k_len:], h["dtype"], h["shape"])
-        return k, v, h.get("hashes") or [-1] * k.shape[1]
+        with tracing.span(
+            "disagg.kv_transfer",
+            parent=tracing.current_span(),
+            phase="kv_transfer",
+            attributes={"op": "read_blocks", "pages": len(list(block_ids)),
+                        "address": address},
+        ) as tspan:
+            if self._use_dev(address):
+                try:
+                    out = await self._read_blocks_dev(address, block_ids)
+                    if tspan is not None:
+                        tspan.set_attribute("path", "device")
+                    return out
+                except _NoDevicePeer:
+                    self._dev_peers[address] = False
+            reader, writer = await self._conn(address)
+            async with self._locks[address]:
+                await write_frame(
+                    writer,
+                    TwoPartMessage(
+                        json.dumps(
+                            {"op": "read_blocks", "block_ids": list(map(int, block_ids))}
+                        ).encode(),
+                        b"",
+                    ),
+                )
+                frame = await read_frame(reader)
+            h = json.loads(frame.header)
+            k_len = h["k_bytes"]
+            k = _unpack(frame.body[:k_len], h["dtype"], h["shape"])
+            v = _unpack(frame.body[k_len:], h["dtype"], h["shape"])
+            if tspan is not None:
+                tspan.set_attribute("path", "tcp")
+                tspan.set_attribute("bytes", len(frame.body))
+            return k, v, h.get("hashes") or [-1] * k.shape[1]
 
     async def _read_blocks_dev(self, address: str, block_ids) -> tuple:
         reader, writer = await self._conn(address)

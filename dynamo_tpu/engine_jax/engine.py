@@ -67,6 +67,7 @@ from dynamo_tpu.models.llama import (
     lm_head,
     make_kv_cache,
 )
+from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.annotated import Annotated
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 from dynamo_tpu.runtime.health import EngineHeartbeat
@@ -137,7 +138,7 @@ class _Seq:
         "ctx", "request", "prompt", "alloc", "slot", "out_queue", "loop",
         "generated", "emitted", "max_tokens", "eos_ids", "ignore_eos",
         "temperature", "top_k", "top_p", "seed", "logprobs", "enqueue_t",
-        "first_token_t", "remote", "remote_deadline", "prefill_pos",
+        "first_token_t", "admit_t", "remote", "remote_deadline", "prefill_pos",
         "freq_pen", "pres_pen", "out_tokens", "joined_inflight", "wait_hash",
     )
 
@@ -171,6 +172,9 @@ class _Seq:
         self.logprobs = so.logprobs
         self.enqueue_t = time.perf_counter()
         self.first_token_t: Optional[float] = None
+        # first slot admission (tracing: queue_wait ends, prefill begins);
+        # preemption re-admissions keep the original stamp
+        self.admit_t: Optional[float] = None
         self.remote = False  # prefill dispatched to a remote prefill worker
         self.remote_deadline: Optional[float] = None
         self.joined_inflight = False  # parked behind a concurrent identical prefix
@@ -1182,12 +1186,16 @@ class JaxServingEngine(AsyncEngine):
                 # KV + first token already landed, just start decoding
                 seq.slot = free[0]
                 self._slots[seq.slot] = seq
+                if seq.admit_t is None:
+                    seq.admit_t = time.perf_counter()
                 continue
             if seq.alloc is not None:
                 # remote prefill failed/timed out: run the prefill locally on
                 # the allocation we already hold
                 seq.slot = free[0]
                 self._slots[seq.slot] = seq
+                if seq.admit_t is None:
+                    seq.admit_t = time.perf_counter()
                 seq.prefill_pos = min(seq.alloc.cached_tokens, len(seq.prompt) - 1)
                 continue
             if seq.wait_hash is not None:
@@ -1258,6 +1266,13 @@ class JaxServingEngine(AsyncEngine):
                 seq.remote_deadline = time.perf_counter() + self.config.remote_prefill_timeout
                 self._awaiting[seq.ctx.id] = seq
                 first_suffix_block = alloc.cached_tokens // self.config.kv_block_size
+                # trace context rides the prefill request so the remote
+                # worker's spans join THIS request's trace (one trace across
+                # disaggregated prefill/decode)
+                tp = (
+                    tracing.format_traceparent(seq.ctx.context.trace)
+                    if tracing.enabled() else None
+                )
                 policy.submit(
                     request_id=seq.ctx.id,
                     token_ids=seq.prompt,
@@ -1267,6 +1282,7 @@ class JaxServingEngine(AsyncEngine):
                         "temperature": seq.temperature, "top_k": seq.top_k,
                         "top_p": seq.top_p, "seed": seq.seed,
                     },
+                    traceparent=tp or "",
                     # pages backing the cached prefix: the prefill worker
                     # reads these (transfer-plane read_blocks) instead of
                     # recomputing the shared history
@@ -1276,6 +1292,8 @@ class JaxServingEngine(AsyncEngine):
 
             seq.slot = free[0]
             self._slots[seq.slot] = seq
+            if seq.admit_t is None:
+                seq.admit_t = time.perf_counter()
             # the last prompt token is never cached (allocator guarantees it),
             # so every admitted sequence computes at least one position
             seq.prefill_pos = seq.alloc.cached_tokens
@@ -1696,7 +1714,57 @@ class JaxServingEngine(AsyncEngine):
         if finish is not None:
             self._finish(seq, finish, defer_free=defer_free)
 
+    def _record_phase_spans(self, seq: _Seq, reason: FinishReason) -> None:
+        """Retroactive phase spans from the timestamps the hot path already
+        stamps (engine thread, once per request — dispatch loops stay
+        allocation-free). queue_wait = enqueue → slot admission; prefill =
+        admission → first token (remote prefills collapse queue_wait into
+        prefill: the wait WAS the remote compute); decode = first token →
+        finish, with the token count."""
+        now = time.perf_counter()
+        parent = seq.ctx.context.trace
+        status = tracing.STATUS_OK
+        if reason == FinishReason.CANCELLED:
+            status = "cancelled"
+        elif reason == FinishReason.ERROR:
+            status = "error"
+        req_span = tracing.record_span(
+            "engine.request", seq.enqueue_t, now, parent=parent,
+            attributes={
+                "request_id": seq.ctx.id,
+                "prompt_tokens": len(seq.prompt),
+                "output_tokens": seq.emitted,
+                "remote_prefill": seq.remote,
+                "finish_reason": str(getattr(reason, "value", reason)),
+            },
+            status=status,
+        )
+        parent = req_span or parent
+        first = seq.first_token_t
+        prefill_start = seq.enqueue_t
+        if (
+            seq.admit_t is not None
+            and (first is None or seq.admit_t <= first)
+        ):
+            prefill_start = seq.admit_t
+        tracing.record_span(
+            "engine.queue_wait", seq.enqueue_t, prefill_start,
+            parent=parent, phase="queue_wait",
+        )
+        if first is not None:
+            tracing.record_span(
+                "engine.prefill", prefill_start, first, parent=parent,
+                phase="prefill",
+                attributes={"remote": True} if seq.remote else None,
+            )
+            tracing.record_span(
+                "engine.decode", first, now, parent=parent, phase="decode",
+                attributes={"tokens": seq.emitted},
+            )
+
     def _finish(self, seq: _Seq, reason: FinishReason, defer_free: bool = False) -> None:
+        if tracing.enabled():
+            self._record_phase_spans(seq, reason)
         if seq.slot is not None:
             self._slots[seq.slot] = None
             seq.slot = None

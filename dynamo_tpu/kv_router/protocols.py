@@ -171,6 +171,11 @@ class ForwardPassMetrics:
     health_state: str = "healthy"
     stalls_total: int = 0
     reaped_requests_total: int = 0
+    # request-phase latency summary from the tracing plane
+    # (runtime/tracing.py phase_summary): {phase: {count, sum_s, p50_ms,
+    # p95_ms, p99_ms}}; None from workers without tracing enabled.
+    # Rendered by components/metrics.py as per-phase quantile gauges.
+    phase_latency: Optional[dict] = None
 
     def to_dict(self) -> dict:
         from dataclasses import asdict

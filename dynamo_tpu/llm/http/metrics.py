@@ -1,7 +1,11 @@
 """Prometheus-format HTTP service metrics (no external deps).
 
-Counters by model/endpoint/type/status, an inflight gauge, and a
-request-duration histogram, with an RAII-style InflightGuard.
+Counters by model/endpoint/type/status, an inflight gauge, request-duration
++ TTFT + inter-token-latency histograms, with an RAII-style InflightGuard.
+This module also owns the ONE label-escaping/formatting helper pair
+(:func:`escape_label`, :func:`fmt_labels`) every Prometheus renderer in the
+project shares (``components/metrics.py`` included) — duplicated escaping
+logic drifted once already.
 Reference parity: lib/llm/src/http/service/metrics.rs:36-346.
 """
 
@@ -17,12 +21,45 @@ DEFAULT_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
 
+# inter-token gaps sit well under the request-duration buckets: a healthy
+# decode emits every few ms, and the interesting tail is 100 ms-ish stalls
+ITL_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+)
 
-def _fmt_labels(labels: dict[str, str]) -> str:
+
+def escape_label(v: str) -> str:
+    """Escape a Prometheus text-format label value (backslash, quote,
+    newline) — an id containing any of these would otherwise corrupt the
+    whole /metrics exposition."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def fmt_labels(labels: dict[str, str]) -> str:
+    """``{a="x",b="y"}`` with values escaped; empty string for no labels."""
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(
+        f'{k}="{escape_label(str(v))}"' for k, v in sorted(labels.items())
+    )
     return "{" + inner + "}"
+
+
+# historical private name, kept so in-flight callers keep working
+_fmt_labels = fmt_labels
+
+
+def _observe_trace_phase(phase: str, seconds: float) -> None:
+    """Feed an edge-measured phase sample into the tracing plane's shared
+    phase histogram. Lazy import + enabled() gate: this module must stay
+    importable without the runtime tree, and with tracing disabled the
+    streaming hot path must not pay for phase bookkeeping."""
+    try:
+        from dynamo_tpu.runtime import tracing
+    except Exception:  # pragma: no cover - runtime tree absent
+        return
+    if tracing.enabled():
+        tracing.observe_phase(phase, seconds)
 
 
 class Counter:
@@ -126,6 +163,15 @@ class Histogram:
                 yield f"{self.name}_sum{_fmt_labels(labels)} {self._sums[key]:g}"
                 yield f"{self.name}_count{_fmt_labels(labels)} {self._totals[key]}"
 
+    def snapshot(self) -> dict[tuple, tuple[list[int], int, float]]:
+        """{label_values: (cumulative_bucket_counts, total, sum)} — the raw
+        state quantile estimators (tracing.phase_summary, bench.py) read."""
+        with self._lock:
+            return {
+                key: (list(counts), self._totals[key], self._sums[key])
+                for key, counts in self._counts.items()
+            }
+
 
 class Registry:
     def __init__(self) -> None:
@@ -166,7 +212,19 @@ class ServiceMetrics:
             Counter(f"{prefix}_output_tokens_total", "Streamed output tokens", ("model",))
         )
         self.ttft = self.registry.register(
-            Histogram(f"{prefix}_time_to_first_token_seconds", "TTFT", ("model",))
+            Histogram(
+                f"{prefix}_time_to_first_token_seconds",
+                "Time to first streamed SSE chunk with content",
+                ("model",),
+            )
+        )
+        self.itl = self.registry.register(
+            Histogram(
+                f"{prefix}_inter_token_latency_seconds",
+                "Gap between consecutive streamed content chunks",
+                ("model",),
+                buckets=ITL_BUCKETS,
+            )
         )
         self.overloaded = self.registry.register(
             Counter(
@@ -180,7 +238,18 @@ class ServiceMetrics:
         return InflightGuard(self, model, endpoint, request_type)
 
     def render(self) -> str:
-        return self.registry.render()
+        # the phase-latency histogram (runtime/tracing.py) rides the same
+        # exposition: one scrape shows edge metrics AND per-phase latency
+        # of whatever spans this process recorded (lazy import — metrics
+        # must stay importable without the runtime tree)
+        out = self.registry.render()
+        try:
+            from dynamo_tpu.runtime import tracing
+
+            out += tracing.render_phase_metrics()
+        except Exception:  # tracing unavailable must never break /metrics
+            pass
+        return out
 
 
 class InflightGuard:
@@ -197,6 +266,7 @@ class InflightGuard:
         self.status = "error"
         self._start: Optional[float] = None
         self._first_token_at: Optional[float] = None
+        self._last_chunk_at: Optional[float] = None
 
     def __enter__(self) -> "InflightGuard":
         self._start = time.perf_counter()
@@ -217,6 +287,23 @@ class InflightGuard:
         if self._first_token_at is None and self._start is not None:
             self._first_token_at = time.perf_counter()
             self._m.ttft.observe(self._first_token_at - self._start, model=self.model)
+
+    def mark_chunk(self) -> None:
+        """Streaming path: called once per content-bearing SSE chunk.
+        First chunk observes TTFT; every later one observes the gap since
+        the previous chunk (the frontend's inter-token latency). Both also
+        feed the shared phase-latency histogram (``ttft``/``inter_token``
+        phases) when tracing is enabled."""
+        now = time.perf_counter()
+        if self._first_token_at is None:
+            self.mark_first_token()
+            if self._first_token_at is not None and self._start is not None:
+                _observe_trace_phase("ttft", self._first_token_at - self._start)
+        elif self._last_chunk_at is not None:
+            gap = now - self._last_chunk_at
+            self._m.itl.observe(gap, model=self.model)
+            _observe_trace_phase("inter_token", gap)
+        self._last_chunk_at = now
 
     def count_tokens(self, n: int = 1) -> None:
         self._m.output_tokens.inc(n, model=self.model)

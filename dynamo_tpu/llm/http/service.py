@@ -19,6 +19,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from ...runtime import tracing
 from ...runtime.admission import OVERLOAD_ERROR, OverloadedError
 from ...runtime.annotated import Annotated
 from ...runtime.engine import AsyncEngine, Context
@@ -117,6 +118,7 @@ class HttpService:
                 web.get("/metrics", self._metrics),
                 web.get("/health", self._health),
                 web.get("/live", self._live),
+                web.get("/debug/traces", self._debug_traces),
             ]
         )
 
@@ -201,6 +203,21 @@ class HttpService:
     async def _metrics(self, _request: web.Request) -> web.Response:
         return web.Response(text=self.metrics.render(), content_type="text/plain")
 
+    async def _debug_traces(self, request: web.Request) -> web.Response:
+        """Flight-recorder export: one JSON object per line per trace
+        (``?limit=N`` keeps the newest N, ``?trace_id=...`` one trace).
+        Frontend-local spans only — worker traces come via ``llmctl trace``
+        against the worker's RPC port (docs/observability.md)."""
+        try:
+            limit = int(request.query.get("limit", "0"))
+        except ValueError:
+            limit = 0
+        body = tracing.recorder().dump_jsonl(
+            limit=limit, trace_id=request.query.get("trace_id")
+        )
+        return web.Response(text=body + ("\n" if body else ""),
+                            content_type="application/jsonl")
+
     async def _models(self, _request: web.Request) -> web.Response:
         listing = ModelList(data=[ModelInfo(id=n) for n in self.manager.model_names()])
         return web.json_response(listing.model_dump())
@@ -238,14 +255,35 @@ class HttpService:
 
         streaming = bool(oai_req.stream)
         ctx = Context(oai_req)
+        # edge span: the trace's root for locally-originated requests, or a
+        # child of the caller's context when an (optional) W3C traceparent
+        # header arrives — malformed headers just start a fresh root. The
+        # span rides ctx.context.trace into the engine/router layers; the
+        # contextvars make every log line in this handler carry the ids.
+        edge = tracing.start_span(
+            "http.edge",
+            parent=tracing.parse_traceparent(request.headers.get("traceparent")),
+            attributes={"model": oai_req.model, "endpoint": endpoint,
+                        "stream": streaming, "request_id": ctx.id},
+        )
+        tokens = None
+        if edge is not None:
+            ctx.context.trace = edge
+            tokens = (tracing.set_current(edge), tracing.set_request_id(ctx.id))
         guard = self.metrics.inflight_guard(
             oai_req.model, endpoint, "stream" if streaming else "unary"
         )
-
-        with guard:
-            if streaming:
-                return await self._stream_response(request, engine, ctx, guard, chat)
-            return await self._unary_response(engine, ctx, guard, chat)
+        try:
+            with guard:
+                if streaming:
+                    return await self._stream_response(request, engine, ctx, guard, chat)
+                return await self._unary_response(engine, ctx, guard, chat)
+        finally:
+            if edge is not None:
+                edge.end(_EDGE_STATUS.get(guard.status, guard.status))
+            if tokens is not None:
+                tracing.reset_current(tokens[0])
+                tracing.reset_request_id(tokens[1])
 
     async def _stream_response(
         self,
@@ -331,7 +369,7 @@ class HttpService:
                         if k in payload
                     }
                 if _chunk_has_content(payload):
-                    guard.mark_first_token()
+                    guard.mark_chunk()  # TTFT on first, inter-token gap after
                     guard.count_tokens()
                 fast = tmpl.encode(payload)
                 if fast is not None:
@@ -407,6 +445,11 @@ class HttpService:
         guard.mark_ok()
         guard.count_tokens(n_tokens)
         return web.json_response(full.model_dump(exclude_none=True))
+
+
+# InflightGuard status label → edge-span terminal status (the recorder pins
+# "overloaded"/"error"; plain "success" maps to the span-model "ok")
+_EDGE_STATUS = {"success": "ok", "overloaded": "overloaded", "error": "error"}
 
 
 def _extract_tool_calls(full) -> None:

@@ -28,6 +28,7 @@ import uuid
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional
 
+from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.admission import LoadSnapshot, OverloadedError
 from dynamo_tpu.runtime.annotated import Annotated
 from dynamo_tpu.runtime.bus import MessageBusClient
@@ -1051,6 +1052,37 @@ class EndpointClient(AsyncEngine):
             payload = payload.model_dump(exclude_none=True)
         policy = self.policy
         deadline = Deadline.after(policy.request_timeout)
+        # instance-pick span: one per request, covering every attempt.
+        # Parented under the edge span riding the Context (or the ambient
+        # contextvar span); the Context's trace carrier is then pointed at
+        # THIS span so the worker's rpc.serve span nests under the routing
+        # decision that produced it. Failovers/overloads become events —
+        # the trace shows which instances were tried and why they fell over.
+        route = tracing.start_span(
+            "client.route",
+            parent=request.context.trace or tracing.current_span(),
+            attributes={"endpoint": self.endpoint.path, "mode": self.mode},
+        )
+        if route is not None:
+            request.context.trace = route
+        route_status = "error"
+        try:
+            async for item in self._generate_attempts(
+                request, payload, deadline, route
+            ):
+                yield item
+            route_status = "ok"
+        except BaseException as e:
+            route_status = _route_status_of(e)
+            raise
+        finally:
+            if route is not None:
+                route.end(route_status)
+
+    async def _generate_attempts(
+        self, request, payload, deadline, route
+    ) -> AsyncIterator[Annotated]:
+        policy = self.policy
         tried: set = set()
         attempt = 0
         last_err: Optional[BaseException] = None
@@ -1072,6 +1104,10 @@ class EndpointClient(AsyncEngine):
                 tried.clear()
                 iid = self._pick(payload)
             self._breaker.acquire(iid)
+            if route is not None:
+                route.set_attribute("instance", iid)
+                route.set_attribute("attempts", attempt + 1)
+                route.add_event("pick", instance=iid, attempt=attempt + 1)
             # exactly-once breaker resolution: every exit that calls neither
             # record_success nor record_failure (deadline expiry, abandoned
             # generator, application-error first item, unexpected raise)
@@ -1099,6 +1135,8 @@ class EndpointClient(AsyncEngine):
                 ):
                     if not first_seen:
                         first_seen = True
+                        if route is not None:
+                            route.add_event("first_item", instance=iid)
                         if not item.is_error:
                             self._breaker.record_success(iid)
                             resolved = True
@@ -1126,6 +1164,9 @@ class EndpointClient(AsyncEngine):
                 self._breaker.record_success(iid)
                 resolved = True
                 self.stats["overloaded"] += 1
+                if route is not None:
+                    route.add_event("overloaded", instance=iid,
+                                    retry_after_ms=e.retry_after_ms)
                 self._avoid_until[iid] = (
                     time.monotonic() + max(e.retry_after_ms, 1) / 1000.0
                 )
@@ -1156,6 +1197,9 @@ class EndpointClient(AsyncEngine):
                 self._breaker.record_failure(iid)
                 resolved = True
                 self.stats["failures"] += 1
+                if route is not None:
+                    route.add_event("failover", instance=iid,
+                                    error=f"{type(e).__name__}: {e}")
                 if not isinstance(e, (RetryableRpcError, WorkerStalled)):
                     # the transport itself failed: drop the pooled conn so
                     # the next attempt (or request) dials fresh. NOT on a
@@ -1199,6 +1243,20 @@ class EndpointClient(AsyncEngine):
             await self._watcher.cancel()
         for c in self._conns.values():
             await c.close()
+
+
+def _route_status_of(e: BaseException) -> str:
+    """Terminal status of a client.route span from the exception that ended
+    it — typed so the flight recorder pins the interesting ones."""
+    if isinstance(e, DeadlineExceeded):
+        return "deadline"
+    if isinstance(e, OverloadedError):
+        return "overloaded"
+    if isinstance(e, (asyncio.CancelledError, GeneratorExit)):
+        return "cancelled"
+    if isinstance(e, (NoHealthyInstances, AllInstancesFailed)):
+        return "failed_over"
+    return "error"
 
 
 class KvPublishBridge:
@@ -1295,6 +1353,12 @@ async def attach_kv_publishing(
                         snap["reaped_requests_total"] = (
                             server.health.reaped_requests_total
                         )
+                if tracing.enabled():
+                    # phase-latency summary (p50/p95/p99 per phase) rides
+                    # the same stream; components/metrics.py renders it
+                    summary = tracing.phase_summary()
+                    if summary:
+                        snap["phase_latency"] = summary
                 await ns.publish(
                     KV_METRICS_SUBJECT, {"worker_id": worker_id, "metrics": snap}
                 )

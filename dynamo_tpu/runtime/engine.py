@@ -28,15 +28,20 @@ class EngineContext:
     - ``id``       stable request id, propagated across process hops
     - ``stop()``   graceful: the engine should finish the current item and stop
     - ``kill()``   immediate: abandon the stream
+    - ``trace``    tracing parent for this request (``runtime/tracing.py``):
+      a local Span, a ``(trace_id, span_id)`` wire context extracted from a
+      ``traceparent`` header, or None. Riding the context (rather than a
+      contextvar) survives engine-thread hops and async-generator plumbing.
     """
 
-    __slots__ = ("_id", "_stopped", "_killed", "_stop_event")
+    __slots__ = ("_id", "_stopped", "_killed", "_stop_event", "trace")
 
     def __init__(self, request_id: Optional[str] = None):
         self._id = request_id or uuid.uuid4().hex
         self._stopped = False
         self._killed = False
         self._stop_event: Optional[asyncio.Event] = None
+        self.trace = None
 
     @property
     def id(self) -> str:

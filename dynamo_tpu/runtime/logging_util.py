@@ -2,6 +2,13 @@
 
 Reference parity: lib/runtime/src/logging.rs:63-344 (`DYN_LOG`, `DYN_LOGGING_JSONL`,
 per-module filter map). Implemented over stdlib logging.
+
+Trace correlation: every record emitted inside a request context carries the
+request's ``trace_id``/``request_id`` (from the tracing contextvars —
+``runtime/tracing.py``). The JSONL formatter adds them as fields; the plain
+formatter appends ``[trace=… req=…]`` — so grepping a trace id returns the
+request's full log story, interleaved across components, instead of today's
+uncorrelated lines.
 """
 
 from __future__ import annotations
@@ -14,6 +21,25 @@ import sys
 _INITIALIZED = False
 
 
+class TraceContextFilter(logging.Filter):
+    """Stamp ``trace_id``/``request_id`` onto every record from the tracing
+    contextvars. A *filter* (not a formatter concern) so both output formats
+    — and any operator-attached handler downstream — see the fields.
+    Records logged outside any request context get empty strings, keeping
+    formatter lookups unconditional."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        try:
+            from dynamo_tpu.runtime import tracing
+
+            trace_id, request_id = tracing.current_ids()
+        except Exception:  # logging must never fail on tracing trouble
+            trace_id = request_id = None
+        record.trace_id = trace_id or ""
+        record.request_id = request_id or ""
+        return True
+
+
 class JsonlFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
         out = {
@@ -22,9 +48,33 @@ class JsonlFormatter(logging.Formatter):
             "target": record.name,
             "message": record.getMessage(),
         }
+        trace_id = getattr(record, "trace_id", "")
+        if trace_id:
+            out["trace_id"] = trace_id
+        request_id = getattr(record, "request_id", "")
+        if request_id:
+            out["request_id"] = request_id
         if record.exc_info:
             out["exception"] = self.formatException(record.exc_info)
         return json.dumps(out)
+
+
+class PlainFormatter(logging.Formatter):
+    """The human format, with the trace correlation appended only when a
+    record actually has it — quiet startup logs stay untouched."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        base = super().format(record)
+        trace_id = getattr(record, "trace_id", "")
+        request_id = getattr(record, "request_id", "")
+        if trace_id or request_id:
+            parts = []
+            if trace_id:
+                parts.append(f"trace={trace_id}")
+            if request_id:
+                parts.append(f"req={request_id}")
+            return f"{base} [{' '.join(parts)}]"
+        return base
 
 
 def init(level: str | None = None) -> None:
@@ -49,13 +99,14 @@ def init(level: str | None = None) -> None:
             root_level = p
 
     handler = logging.StreamHandler(sys.stderr)
+    handler.addFilter(TraceContextFilter())
     from .config import env_bool
 
     if env_bool("LOGGING_JSONL", False):
         handler.setFormatter(JsonlFormatter())
     else:
         handler.setFormatter(
-            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+            PlainFormatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
         )
     def _resolve_level(name: str, source: str) -> int:
         mapped = {"trace": "DEBUG", "warn": "WARNING"}.get(name.lower(), name.upper())

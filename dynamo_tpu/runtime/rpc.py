@@ -9,13 +9,22 @@ codec. Same capability (streaming, cancellation, graceful drain), one less
 network hop on every token.
 
 Wire protocol (header JSON + body):
-  client→worker: {id, op:"generate", endpoint, deadline_ms?} body=request JSON
+  client→worker: {id, op:"generate", endpoint, deadline_ms?, traceparent?}
+                 body=request JSON
                  {id, op:"stop"|"kill"}        (mid-stream cancellation)
                  {id, op:"ping"}               (liveness probe, ``__ping__``)
+                 {id, op:"trace_dump", limit?, trace_id?}  (flight recorder)
   worker→client: {id, op:"item"}  body=one Annotated dict JSON
                  {id, op:"done"}
                  {id, op:"error", message, code?, retryable?}
                  {id, op:"pong", health, load} (probe reply)
+                 {id, op:"trace_data", count}  body=JSON list of traces
+
+``traceparent`` (W3C wire form, runtime/tracing.py) threads the caller's
+trace context through so the worker's serve/engine spans join the same
+trace; absent or malformed values start a fresh root trace (old binaries
+interoperate). ``trace_dump`` reads the worker's in-process flight
+recorder — ``llmctl trace dump/show`` ride it.
 
 ``ping`` answers through the SAME dispatch gate ordinary requests pass
 (faults.serve_gate) and carries the worker's health-plane state — a zombie
@@ -51,7 +60,7 @@ import logging
 import time
 from typing import Any, AsyncIterator, Callable, Dict, Optional, Tuple
 
-from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime import faults, tracing
 from dynamo_tpu.runtime.admission import (
     AdmissionController,
     LoadSnapshot,
@@ -153,7 +162,7 @@ class RequestTrack:
     whose deadline expired without the stream ever terminating."""
 
     __slots__ = ("req_id", "started", "deadline", "ctx", "sender", "task",
-                 "reaped")
+                 "reaped", "span")
 
     def __init__(self, req_id):
         self.req_id = req_id
@@ -163,6 +172,18 @@ class RequestTrack:
         self.sender = None
         self.task: Optional[asyncio.Task] = None
         self.reaped = False
+        self.span = None  # tracing.Span while serving (reaper adds events)
+
+
+def _record_shed_span(h: dict, code: str, **attrs) -> None:
+    """Even a rejected request leaves a trace: operators debugging "my
+    request vanished" find the shed marker joined to the caller's trace."""
+    tracing.record_event_span(
+        "rpc.shed",
+        parent=tracing.parse_traceparent(h.get("traceparent")),
+        status="overloaded",
+        attributes={"code": code, "request_id": h.get("request_id"), **attrs},
+    )
 
 
 class RpcServer:
@@ -268,6 +289,7 @@ class RpcServer:
                                 b""))
                         continue
                     if self._draining:
+                        _record_shed_span(h, "draining")
                         async with write_lock:
                             await write_frame(writer, TwoPartMessage(
                                 json.dumps({"id": h["id"], "op": "error",
@@ -284,6 +306,8 @@ class RpcServer:
                         # queueing the request toward a timeout. The gate's
                         # own snapshot rides the reply — no second engine
                         # probe at the worker's busiest moment.
+                        _record_shed_span(h, "overloaded",
+                                          queue_depth=shed.queue_depth)
                         load = shed.load or self.load_snapshot()
                         load.draining = self._draining
                         async with write_lock:
@@ -320,6 +344,12 @@ class RpcServer:
                     )
                     conn_tasks.add(t)
                     t.add_done_callback(conn_tasks.discard)
+                elif op == "trace_dump":
+                    t = asyncio.create_task(
+                        self._trace_dump(h, writer, write_lock)
+                    )
+                    conn_tasks.add(t)
+                    t.add_done_callback(conn_tasks.discard)
                 elif op in ("stop", "kill"):
                     ctx = contexts.get(h.get("id"))
                     if ctx is not None:
@@ -353,6 +383,27 @@ class RpcServer:
         except (ConnectionError, OSError):
             pass  # prober gone; nothing to answer
 
+    async def _trace_dump(self, h, writer, write_lock) -> None:
+        """Answer a ``trace_dump`` with this process's flight-recorder
+        contents (bounded by the recorder's own ring — never unbounded).
+        Pure local-memory read: no engine involvement, safe while wedged."""
+        try:
+            traces = tracing.recorder().traces(
+                limit=int(h.get("limit") or 0),
+                trace_id=h.get("trace_id"),
+            )
+            body = json.dumps(traces).encode()
+            header = {"id": h.get("id"), "op": "trace_data",
+                      "count": len(traces)}
+            async with write_lock:
+                await write_frame(
+                    writer, TwoPartMessage(json.dumps(header).encode(), body)
+                )
+        except (ConnectionError, OSError):
+            pass  # requester gone
+        except Exception:
+            logger.exception("trace_dump failed")
+
     async def reap_expired(self, grace: float) -> int:
         """Abort in-flight requests whose deadline expired more than
         ``grace`` seconds ago: emit a terminal error item, kill the engine
@@ -371,6 +422,8 @@ class RpcServer:
             track.reaped = True
             reaped += 1
             self.reaped_total += 1
+            if track.span is not None:
+                track.span.add_event("reaped", overdue_s=round(-rem, 3))
             logger.warning(
                 "reaping stuck request %s (deadline exceeded by %.1fs, "
                 "age %.1fs)", track.req_id, -rem,
@@ -416,6 +469,19 @@ class RpcServer:
         def load_wire() -> dict:
             return self.load_snapshot().to_wire()
 
+        # serve span: joins the caller's trace via the header's traceparent
+        # (absent/malformed → fresh root). Per-PHASE, never per token: the
+        # item loop below touches it with one None-check + one int per item.
+        span = tracing.start_span(
+            "rpc.serve",
+            parent=tracing.parse_traceparent(h.get("traceparent")),
+            attributes={"endpoint": h.get("endpoint"),
+                        "request_id": h.get("request_id")},
+        )
+        track.span = span
+        outcome = "error"
+        n_items = 0
+        first_item_seen = False
         ctx: Optional[Context] = None
         try:
             if engine is None:
@@ -436,17 +502,31 @@ class RpcServer:
                     deadline = None
             track.deadline = deadline
             if deadline is not None and deadline.expired:
+                outcome = "deadline"
                 await send({"id": req_id, "op": "error",
                             "message": f"{DEADLINE_ERROR}: expired before start",
                             "code": "deadline", "load": load_wire()})
                 return
             # fault-injection dispatch gate: a `wedge` rule parks the
             # request here forever — the deterministic zombie-worker fault
-            # the health plane (probes + reaper) must absorb
+            # the health plane (probes + reaper) must absorb. The dispatch
+            # span makes injected wedges/delays VISIBLE in the trace: a
+            # request that sat here shows the wait right where it happened.
+            if span is not None:
+                gate_t0 = time.perf_counter()
             await faults.serve_gate("rpc", f"{self.host}:{self.port}")
+            if span is not None:
+                gate_s = time.perf_counter() - gate_t0
+                if gate_s > 0.001:  # only a measurable wait earns a span
+                    tracing.record_span(
+                        "rpc.dispatch_gate", gate_t0, gate_t0 + gate_s,
+                        parent=span,
+                    )
             try:
                 payload = json.loads(body) if body else None
                 ctx = Context(payload, request_id=h.get("request_id"))
+                # the engine parents its queue/prefill/decode spans here
+                ctx.context.trace = span
                 contexts[req_id] = ctx
                 track.ctx = ctx
                 stream = engine.generate(ctx)
@@ -456,13 +536,20 @@ class RpcServer:
                     if deadline is not None and deadline.expired:
                         # nobody is waiting for these tokens anymore: stop
                         # the engine and tell the client why the stream ended
+                        outcome = "deadline"
                         ctx.context.kill()
                         await send({"id": req_id, "op": "error",
                                     "message": f"{DEADLINE_ERROR}: mid-stream",
                                     "code": "deadline", "load": load_wire()})
                         return
+                    if span is not None:
+                        n_items += 1
+                        if not first_item_seen:
+                            first_item_seen = True
+                            span.add_event("first_item")
                     d = item.to_dict() if isinstance(item, Annotated) else item
                     await send({"id": req_id, "op": "item"}, json.dumps(d).encode())
+                outcome = "ok"
                 await send({"id": req_id, "op": "done", "load": load_wire()})
             except SlowConsumer as e:
                 # reader stalled with a full queue: kill the engine context
@@ -471,11 +558,15 @@ class RpcServer:
                 # memory bound. Mark the sender dead so close() below
                 # cancels instead of waiting out another flush window.
                 self.admission.slow_consumer_cuts += 1
+                outcome = "slow_consumer"
                 logger.warning("cutting stream %s: %s", req_id, e)
                 sender.dead = e
                 if ctx is not None:
                     ctx.context.kill()
-            except (ConnectionError, asyncio.CancelledError):
+            except asyncio.CancelledError:
+                outcome = "cancelled"
+                raise
+            except ConnectionError:
                 raise
             except Exception as e:
                 logger.exception("rpc handler error (req %s)", req_id)
@@ -485,6 +576,11 @@ class RpcServer:
                 except (ConnectionError, SlowConsumer):
                     pass
         finally:
+            if span is not None:
+                # reaper cancellation lands here too: its status wins over
+                # whatever the serve path had reached
+                span.set_attribute("items", n_items)
+                span.end("reaped" if track.reaped else outcome)
             contexts.pop(req_id, None)
             self.send_queue_peak = max(self.send_queue_peak, sender.peak)
             await sender.close()
@@ -601,6 +697,8 @@ class RpcClient:
                 elif op == "pong":
                     item = ("pong", {"health": h.get("health", "healthy"),
                                      "load": load})
+                elif op == "trace_data":
+                    item = ("trace_data", frame.body)
                 elif op == "error":
                     item = ("error", {
                         "message": h.get("message", "remote error"),
@@ -683,6 +781,39 @@ class RpcClient:
         finally:
             self._streams.pop(req_id, None)
 
+    async def trace_dump(
+        self,
+        limit: int = 0,
+        trace_id: Optional[str] = None,
+        timeout: float = 5.0,
+    ) -> list:
+        """Fetch the worker's flight-recorder traces (``llmctl trace``)."""
+        req_id = next(self._ids)
+        q: asyncio.Queue = asyncio.Queue(maxsize=1)
+        self._streams[req_id] = q
+        try:
+            header: Dict[str, Any] = {"id": req_id, "op": "trace_dump"}
+            if limit:
+                header["limit"] = int(limit)
+            if trace_id:
+                header["trace_id"] = trace_id
+            await self._send(header)
+            try:
+                kind, data = await asyncio.wait_for(q.get(), timeout)
+            except asyncio.TimeoutError:
+                raise WorkerStalled(
+                    f"no trace_data from {self.host}:{self.port} within "
+                    f"{timeout:.1f}s"
+                ) from None
+            if kind != "trace_data":
+                info = data if isinstance(data, dict) else {}
+                raise ConnectionError(
+                    f"trace_dump failed: {info.get('message', kind)}"
+                )
+            return json.loads(data) if data else []
+        finally:
+            self._streams.pop(req_id, None)
+
     async def generate(
         self,
         endpoint: str,
@@ -715,6 +846,15 @@ class RpcClient:
         header = {"id": req_id, "op": "generate", "endpoint": endpoint}
         if context is not None:
             header["request_id"] = context.id
+        if tracing.enabled():
+            # propagate the caller's trace context: the Context's carrier
+            # wins (set by the edge/router), contextvar as fallback
+            tp = tracing.format_traceparent(
+                (context.context.trace if context is not None else None)
+                or tracing.current_span()
+            )
+            if tp is not None:
+                header["traceparent"] = tp
         if deadline is not None:
             rem = deadline.remaining()
             if rem is not None:

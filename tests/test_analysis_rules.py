@@ -899,3 +899,117 @@ def test_cli_exit_codes(tmp_path, capsys):
     assert dynlint_main([str(bad), "--no-baseline"]) == 1
     assert dynlint_main([str(tmp_path / "missing")]) == 2
     capsys.readouterr()
+
+
+# -- metric-name-valid -------------------------------------------------------
+
+_METRIC_PRELUDE = "from dynamo_tpu.llm.http.metrics import Counter, Gauge, Histogram\n"
+
+METRIC_NAME_CASES = [
+    (
+        "bad_chars_in_name",
+        _METRIC_PRELUDE + 'c = Counter("my-metric-total", "help text")\n',
+        True,
+    ),
+    (
+        "leading_digit",
+        _METRIC_PRELUDE + 'g = Gauge("9lives", "help text")\n',
+        True,
+    ),
+    (
+        "empty_help",
+        _METRIC_PRELUDE + 'c = Counter("ok_total", "")\n',
+        True,
+    ),
+    (
+        "whitespace_help",
+        _METRIC_PRELUDE + 'c = Counter("ok_total", "   ")\n',
+        True,
+    ),
+    (
+        "missing_help",
+        _METRIC_PRELUDE + 'c = Counter("ok_total")\n',
+        True,
+    ),
+    (
+        "fstring_bad_fragment",
+        _METRIC_PRELUDE
+        + 'def f(prefix):\n    return Histogram(f"{prefix}-duration", "help")\n',
+        True,
+    ),
+    (
+        "gauge_table_bad_name",
+        'GAUGES = [("kv blocks", "KV pool blocks in use")]\n',
+        True,
+    ),
+    (
+        "gauge_table_empty_help",
+        'GAUGES = [("kv_blocks", "")]\n',
+        True,
+    ),
+    (
+        "ok_literal",
+        _METRIC_PRELUDE + 'c = Counter("requests_total", "Total requests")\n',
+        False,
+    ),
+    (
+        "ok_fstring_prefix",
+        _METRIC_PRELUDE
+        + 'def f(prefix):\n    return Counter(f"{prefix}_requests_total", "Total")\n',
+        False,
+    ),
+    (
+        "ok_help_kw",
+        _METRIC_PRELUDE + 'c = Counter("a_total", help_="Total things")\n',
+        False,
+    ),
+    (
+        "ok_gauge_table",
+        'MY_GAUGES = [("kv_blocks", "KV pool blocks in use")]\n',
+        False,
+    ),
+    (
+        "collections_counter_ignored",
+        'from collections import Counter\nc = Counter("not a metric")\n',
+        False,
+    ),
+    (
+        "dynamic_name_uncheckable",
+        _METRIC_PRELUDE + 'def f(name):\n    return Counter(name, "help")\n',
+        False,
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "name,src,expect", METRIC_NAME_CASES, ids=[c[0] for c in METRIC_NAME_CASES]
+)
+def test_metric_name_valid(tmp_path, name, src, expect):
+    findings = lint_tree(tmp_path, {"dynamo_tpu/components/m.py": src})
+    fired = "metric-name-valid" in rules_fired(findings)
+    assert fired == expect, [f.render() for f in findings]
+
+
+def test_metric_name_valid_suppressed(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "dynamo_tpu/components/m.py": _METRIC_PRELUDE
+        + 'c = Counter("bad-name", "help")  # dynlint: disable=metric-name-valid\n'
+    })
+    assert "metric-name-valid" not in rules_fired(findings)
+
+
+def test_metric_name_valid_clean_on_real_metric_modules():
+    """The project's own registration surfaces must stay clean — the rule
+    guards them, so a violation here is a real regression, not baseline
+    fodder."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = analyze_paths(
+        [
+            os.path.join(repo, "dynamo_tpu", "components", "metrics.py"),
+            os.path.join(repo, "dynamo_tpu", "llm", "http", "metrics.py"),
+            os.path.join(repo, "dynamo_tpu", "runtime", "tracing.py"),
+        ],
+        root=repo,
+    )
+    metric_findings = [f for f in findings if f.rule == "metric-name-valid"]
+    assert metric_findings == [], [f.render() for f in metric_findings]

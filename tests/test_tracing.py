@@ -1,0 +1,944 @@
+"""End-to-end distributed request tracing (runtime/tracing.py).
+
+Covers the ISSUE-5 acceptance surface: traceparent inject/extract round
+trips (malformed/absent → fresh root; old-binary headers tolerated), span
+tree assembly across a REAL RpcClient/RpcServer pair, disagg prefill→decode
+trace continuity, flight-recorder ring bounds + slow/errored-trace pinning,
+spans for shed / reaped / failed-over requests, and the overhead guard:
+``DYN_TPU_TRACE=0`` ⇒ zero tracing allocations on the per-token hot path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+
+import pytest
+
+from dynamo_tpu.runtime import tracing
+from dynamo_tpu.runtime.annotated import Annotated
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.rpc import RpcClient, RpcServer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracing(monkeypatch):
+    """Every test gets an enabled, empty recorder; env knobs reset after."""
+    for var in ("DYN_TPU_TRACE", "DYN_TPU_TRACE_RING", "DYN_TPU_TRACE_PINNED",
+                "DYN_TPU_TRACE_SLOW_MS"):
+        monkeypatch.delenv(var, raising=False)
+    tracing.configure()
+    yield
+    tracing.configure()
+
+
+# -- traceparent wire form ---------------------------------------------------
+
+
+class TestTraceparent:
+    def test_round_trip(self):
+        span = tracing.start_span("root")
+        tp = tracing.format_traceparent(span)
+        parsed = tracing.parse_traceparent(tp)
+        assert parsed == (span.trace_id, span.span_id)
+        span.end()
+
+    def test_tuple_context_round_trip(self):
+        ctx = ("ab" * 16, "cd" * 8)
+        assert tracing.parse_traceparent(tracing.format_traceparent(ctx)) == ctx
+
+    @pytest.mark.parametrize("bad", [
+        None, 17, "", "garbage", "00-short-short-01",
+        "00-" + "g" * 32 + "-" + "1" * 16 + "-01",      # non-hex trace id
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",      # all-zero trace id
+        "00-" + "1" * 32 + "-" + "0" * 16 + "-01",      # all-zero span id
+        "00-" + "1" * 32 + "-" + "1" * 16,               # missing flags
+    ])
+    def test_malformed_is_none(self, bad):
+        assert tracing.parse_traceparent(bad) is None
+
+    def test_case_and_whitespace_tolerated(self):
+        tp = "  00-" + "A" * 32 + "-" + "B" * 16 + "-01 "
+        assert tracing.parse_traceparent(tp) == ("a" * 32, "b" * 16)
+
+
+# -- policy env clamping (PR3-style) ----------------------------------------
+
+
+class TestPolicyClamping:
+    def test_defaults(self):
+        p = tracing.TracePolicy.from_env()
+        assert p.enabled is True
+        assert p.ring_size == 256
+        assert p.pinned_size == 64
+        assert p.slow_ms == 2000.0
+
+    _ATTR = {
+        "DYN_TPU_TRACE_RING": "ring_size",
+        "DYN_TPU_TRACE_PINNED": "pinned_size",
+        "DYN_TPU_TRACE_SLOW_MS": "slow_ms",
+    }
+
+    @pytest.mark.parametrize("var,bad", [
+        ("DYN_TPU_TRACE_RING", "banana"),
+        ("DYN_TPU_TRACE_RING", "0"),
+        ("DYN_TPU_TRACE_RING", "-4"),
+        ("DYN_TPU_TRACE_PINNED", "x"),
+        ("DYN_TPU_TRACE_SLOW_MS", "-1"),
+        ("DYN_TPU_TRACE_SLOW_MS", "nan-ish"),
+    ])
+    def test_bad_values_clamp_to_defaults(self, monkeypatch, var, bad):
+        monkeypatch.setenv(var, bad)
+        p = tracing.TracePolicy.from_env()
+        d = tracing.TracePolicy()
+        attr = self._ATTR[var]
+        assert getattr(p, attr) == getattr(d, attr)
+
+    @pytest.mark.parametrize("val,want", [
+        ("0", False), ("false", False), ("no", False), ("off", False),
+        ("1", True), ("true", True), ("anything", True),
+    ])
+    def test_enable_flag(self, monkeypatch, val, want):
+        monkeypatch.setenv("DYN_TPU_TRACE", val)
+        assert tracing.TracePolicy.from_env().enabled is want
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def _span(self, name="s", status="ok", trace_id=None):
+        s = tracing.start_span(name, parent=(trace_id, None) if trace_id else None)
+        s.end(status)
+        return s
+
+    def test_ring_bounded_fifo(self, monkeypatch):
+        monkeypatch.setenv("DYN_TPU_TRACE_RING", "4")
+        tracing.configure()
+        ids = [self._span(f"s{i}").trace_id for i in range(10)]
+        got = {t["trace_id"] for t in tracing.recorder().traces()}
+        assert got == set(ids[-4:])
+        assert tracing.recorder().dropped == 6
+
+    def test_error_trace_pinned_over_healthy_burst(self, monkeypatch):
+        monkeypatch.setenv("DYN_TPU_TRACE_RING", "2")
+        tracing.configure()
+        bad = self._span("boom", status="error")
+        for i in range(20):
+            self._span(f"ok{i}")
+        entry = tracing.recorder().traces(trace_id=bad.trace_id)
+        assert entry and entry[0]["pinned"] is True
+
+    @pytest.mark.parametrize("status", ["deadline", "reaped",
+                                        "failed_over", "cancelled"])
+    def test_interesting_statuses_pin(self, status):
+        s = self._span("x", status=status)
+        assert tracing.recorder().traces(trace_id=s.trace_id)[0]["pinned"]
+
+    def test_shed_storm_cannot_evict_postmortem_traces(self, monkeypatch):
+        """Sheds arrive in storms; pinning them would FIFO-cycle the bounded
+        pinned store and evict exactly the rare reaped/errored traces an
+        operator needs — so `overloaded` traces stay in the ordinary ring."""
+        monkeypatch.setenv("DYN_TPU_TRACE_RING", "4")
+        monkeypatch.setenv("DYN_TPU_TRACE_PINNED", "4")
+        tracing.configure()
+        reaped = self._span("stuck", status="reaped")
+        for i in range(100):
+            self._span(f"shed{i}", status="overloaded")
+        entry = tracing.recorder().traces(trace_id=reaped.trace_id)
+        assert entry and entry[0]["pinned"]
+        shed_pinned = [
+            t for t in tracing.recorder().traces()
+            if t["pinned"] and t["trace_id"] != reaped.trace_id
+        ]
+        assert shed_pinned == []
+
+    def test_slow_span_pins(self, monkeypatch):
+        monkeypatch.setenv("DYN_TPU_TRACE_SLOW_MS", "5")
+        tracing.configure()
+        import time as _t
+
+        s = tracing.start_span("slow")
+        _t.sleep(0.02)
+        s.end()
+        assert tracing.recorder().traces(trace_id=s.trace_id)[0]["pinned"]
+
+    def test_pinned_store_bounded(self, monkeypatch):
+        monkeypatch.setenv("DYN_TPU_TRACE_PINNED", "3")
+        tracing.configure()
+        for i in range(8):
+            self._span(f"e{i}", status="error")
+        rec = tracing.recorder()
+        pinned = [t for t in rec.traces() if t["pinned"]]
+        assert len(pinned) == 3
+
+    def test_dump_jsonl_one_trace_per_line(self):
+        for i in range(3):
+            self._span(f"s{i}")
+        lines = tracing.recorder().dump_jsonl().splitlines()
+        assert len(lines) == 3
+        for line in lines:
+            entry = json.loads(line)
+            assert entry["spans"]
+
+    def test_multi_span_trace_groups(self):
+        root = tracing.start_span("root")
+        child = tracing.start_span("child", parent=root)
+        child.end()
+        root.end()
+        entry = tracing.recorder().traces(trace_id=root.trace_id)[0]
+        assert {s["name"] for s in entry["spans"]} == {"root", "child"}
+        assert {s["trace_id"] for s in entry["spans"]} == {root.trace_id}
+
+    def test_render_trace_tree(self):
+        root = tracing.start_span("root")
+        child = tracing.start_span("child", parent=root, phase="decode")
+        child.add_event("first_item")
+        child.end()
+        root.end()
+        text = tracing.render_trace(
+            tracing.recorder().traces(trace_id=root.trace_id)[0]
+        )
+        assert "root" in text and "child" in text
+        assert "[decode]" in text and "first_item" in text
+        # child renders indented under root
+        root_line = next(i for i, l in enumerate(text.splitlines()) if "root" in l and "trace" not in l)
+        child_line = next(i for i, l in enumerate(text.splitlines()) if "child" in l)
+        assert child_line > root_line
+
+
+# -- phase histograms --------------------------------------------------------
+
+
+class TestPhaseHistograms:
+    def test_span_end_feeds_phase(self):
+        s = tracing.start_span("p", phase="prefill")
+        s.end()
+        summary = tracing.phase_summary()
+        assert summary["prefill"]["count"] == 1
+        assert "p95_ms" in summary["prefill"]
+
+    def test_render_exposition(self):
+        tracing.observe_phase("kv_transfer", 0.02)
+        text = tracing.render_phase_metrics()
+        assert "dynamo_phase_latency_seconds" in text
+        assert 'phase="kv_transfer"' in text
+
+    def test_quantiles_ordered(self):
+        for ms in (1, 2, 3, 50, 200):
+            tracing.observe_phase("decode", ms / 1e3)
+        st = tracing.phase_summary()["decode"]
+        assert st["p50_ms"] <= st["p95_ms"] <= st["p99_ms"]
+        assert st["count"] == 5
+
+
+# -- RPC propagation ---------------------------------------------------------
+
+
+class _Echo(AsyncEngine):
+    def __init__(self, n=3):
+        self.n = n
+
+    async def generate(self, request: Context):
+        for i in range(self.n):
+            await asyncio.sleep(0)
+            yield Annotated.from_data({"i": i})
+
+
+async def _rpc_pair(engine, endpoint="tr.c.e"):
+    server = RpcServer(host="127.0.0.1", port=0)
+    server.register(endpoint, engine)
+    await server.start()
+    client = await RpcClient.connect(f"127.0.0.1:{server.port}")
+    return server, client
+
+
+class TestRpcPropagation:
+    def test_span_tree_across_real_rpc_pair(self, run):
+        async def go():
+            server, client = await _rpc_pair(_Echo())
+            try:
+                root = tracing.start_span("test.root")
+                ctx = Context({"p": 1})
+                ctx.context.trace = root
+                items = [i async for i in client.generate("tr.c.e", {"a": 1},
+                                                          context=ctx)]
+                assert len(items) == 3
+                root.end()
+            finally:
+                await client.close()
+                await server.stop()
+            entry = tracing.recorder().traces(trace_id=root.trace_id)[0]
+            by_name = {s["name"]: s for s in entry["spans"]}
+            assert set(by_name) == {"test.root", "rpc.serve"}
+            serve = by_name["rpc.serve"]
+            assert serve["parent_id"] == root.span_id
+            assert serve["status"] == "ok"
+            assert serve["attributes"]["items"] == 3
+            assert any(e["name"] == "first_item" for e in serve["events"])
+
+        run(go())
+
+    def test_absent_traceparent_starts_fresh_root(self, run):
+        """Old binaries (headers without trace fields) interoperate: the
+        worker starts its own root trace instead of failing."""
+
+        async def go():
+            server, client = await _rpc_pair(_Echo())
+            try:
+                # context WITHOUT a trace carrier and no ambient span —
+                # exactly what an old client binary's header looks like
+                items = [i async for i in client.generate("tr.c.e", {"a": 1})]
+                assert len(items) == 3
+            finally:
+                await client.close()
+                await server.stop()
+            serves = [
+                s for t in tracing.recorder().traces() for s in t["spans"]
+                if s["name"] == "rpc.serve"
+            ]
+            assert len(serves) == 1
+            assert "parent_id" not in serves[0]  # a genuine root
+
+        run(go())
+
+    def test_trace_dump_rpc_verb(self, run):
+        async def go():
+            server, client = await _rpc_pair(_Echo())
+            try:
+                [i async for i in client.generate("tr.c.e", {"a": 1})]
+                traces = await client.trace_dump(limit=10)
+                assert traces and any(
+                    s["name"] == "rpc.serve" for t in traces for s in t["spans"]
+                )
+            finally:
+                await client.close()
+                await server.stop()
+
+        run(go())
+
+
+class TestShedSpans:
+    def test_draining_shed_leaves_trace(self, run):
+        async def go():
+            server, client = await _rpc_pair(_Echo())
+            server.set_draining(True)
+            try:
+                root = tracing.start_span("edge")
+                ctx = Context({})
+                ctx.context.trace = root
+                items = [i async for i in client.generate("tr.c.e", {},
+                                                          context=ctx)]
+                assert items and items[0].is_error
+                root.end()
+            finally:
+                await client.close()
+                await server.stop()
+            entry = tracing.recorder().traces(trace_id=root.trace_id)[0]
+            shed = [s for s in entry["spans"] if s["name"] == "rpc.shed"]
+            assert shed and shed[0]["status"] == "overloaded"
+            assert shed[0]["attributes"]["code"] == "draining"
+
+        run(go())
+
+    def test_overload_shed_leaves_trace(self, run):
+        from dynamo_tpu.runtime.admission import (
+            AdmissionController,
+            AdmissionPolicy,
+        )
+
+        class Hang(AsyncEngine):
+            async def generate(self, request: Context):
+                await asyncio.Event().wait()
+                yield  # pragma: no cover
+
+        async def go():
+            server = RpcServer(
+                host="127.0.0.1", port=0,
+                admission=AdmissionController(AdmissionPolicy(max_pending=1)),
+            )
+            server.register("tr.c.e", Hang())
+            await server.start()
+            client = await RpcClient.connect(f"127.0.0.1:{server.port}")
+            try:
+                first = client.generate("tr.c.e", {})
+                t1 = asyncio.create_task(first.__anext__())
+                for _ in range(100):
+                    if server.inflight_count >= 1:
+                        break
+                    await asyncio.sleep(0.01)
+                root = tracing.start_span("edge2")
+                ctx = Context({})
+                ctx.context.trace = root
+                items = [i async for i in client.generate("tr.c.e", {},
+                                                          context=ctx)]
+                assert items and items[0].is_error
+                root.end()
+                t1.cancel()
+            finally:
+                await client.close()
+                await server.stop(drain_timeout=0.1)
+            entry = tracing.recorder().traces(trace_id=root.trace_id)[0]
+            shed = [s for s in entry["spans"] if s["name"] == "rpc.shed"]
+            assert shed and shed[0]["attributes"]["code"] == "overloaded"
+
+        run(go())
+
+
+class TestReapedSpan:
+    def test_reaped_request_span_status(self, run):
+        class Never(AsyncEngine):
+            async def generate(self, request: Context):
+                await asyncio.Event().wait()
+                yield  # pragma: no cover
+
+        async def go():
+            server, client = await _rpc_pair(Never())
+            try:
+                from dynamo_tpu.runtime.resilience import Deadline
+
+                root = tracing.start_span("edge3")
+                ctx = Context({})
+                ctx.context.trace = root
+                gen = client.generate("tr.c.e", {}, context=ctx,
+                                      deadline=Deadline.after(0.05))
+                task = asyncio.create_task(gen.__anext__())
+                await asyncio.sleep(0.15)  # past the deadline
+                reaped = await server.reap_expired(grace=0.0)
+                assert reaped == 1
+                item = await asyncio.wait_for(task, 5)
+                assert item.is_error
+                root.end()
+            finally:
+                await client.close()
+                await server.stop(drain_timeout=0.1)
+            entry = tracing.recorder().traces(trace_id=root.trace_id)[0]
+            serve = next(s for s in entry["spans"] if s["name"] == "rpc.serve")
+            assert serve["status"] == "reaped"
+            assert any(e["name"] == "reaped" for e in serve["events"])
+            assert entry["pinned"]
+
+        run(go())
+
+
+# -- EndpointClient route span + failover ------------------------------------
+
+
+class TestRouteSpans:
+    def test_failover_recorded_on_route_span(self, run):
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+        from dynamo_tpu.runtime.resilience import ResiliencePolicy
+        from dynamo_tpu.runtime.statestore import StateStoreServer
+
+        NO_BUS = "127.0.0.1:1"
+
+        class Tag(AsyncEngine):
+            def __init__(self, tag):
+                self.tag = tag
+
+            async def generate(self, request: Context):
+                for i in range(2):
+                    await asyncio.sleep(0)
+                    yield Annotated.from_data({"i": i, "w": self.tag})
+
+        async def go():
+            ss = StateStoreServer(port=0)
+            await ss.start()
+            rts = []
+            for i in range(2):
+                rt = await DistributedRuntime.create(ss.url, NO_BUS)
+                ep = rt.namespace("trc").component("w").endpoint("gen")
+                await ep.serve(Tag(f"w{i}"))
+                rts.append(rt)
+            fe = await DistributedRuntime.create(ss.url, NO_BUS)
+            policy = ResiliencePolicy(
+                request_timeout=10.0, connect_timeout=1.0, max_attempts=4,
+                backoff_base=0.01, backoff_max=0.05, seed=7,
+            )
+            client = await fe.namespace("trc").component("w").endpoint(
+                "gen"
+            ).client("round_robin", policy=policy)
+            await client.wait_for_instances(2, timeout=10)
+            # kill one worker's RPC server: its instance key stays (lease
+            # alive) so the router still picks it and must fail over
+            await rts[0]._rpc_server.stop(drain_timeout=0.1)
+            roots = []
+            try:
+                for _ in range(4):
+                    root = tracing.start_span("edge")
+                    ctx = Context({"x": 1})
+                    ctx.context.trace = root
+                    items = [i async for i in client.generate(ctx)]
+                    assert items and not items[-1].is_error
+                    root.end()
+                    roots.append(root)
+            finally:
+                await client.close()
+                for rt in rts + [fe]:
+                    await rt.shutdown()
+                await ss.stop()
+            failover_events = []
+            route_spans = []
+            for root in roots:
+                entry = tracing.recorder().traces(trace_id=root.trace_id)[0]
+                for s in entry["spans"]:
+                    if s["name"] == "client.route":
+                        route_spans.append(s)
+                        assert s["parent_id"] == next(
+                            r.span_id for r in roots
+                            if r.trace_id == s["trace_id"]
+                        )
+                        assert s["attributes"]["mode"] == "round_robin"
+                        failover_events.extend(
+                            e for e in s.get("events", ())
+                            if e["name"] == "failover"
+                        )
+            assert len(route_spans) == 4
+            assert all(s["status"] == "ok" for s in route_spans)
+            assert failover_events, "dead worker never triggered a failover event"
+
+        run(go())
+
+
+class TestLlmctlTrace:
+    def test_trace_dump_and_show_cli(self, run, capsys):
+        """The acceptance path: a served request's trace is retrievable via
+        ``llmctl trace show`` (dialing the worker's RPC port)."""
+        from dynamo_tpu.cli.llmctl import amain
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+        from dynamo_tpu.runtime.statestore import StateStoreServer
+
+        NO_BUS = "127.0.0.1:1"
+
+        async def go():
+            ss = StateStoreServer(port=0)
+            await ss.start()
+            rt = await DistributedRuntime.create(ss.url, NO_BUS)
+            ep = rt.namespace("lt").component("w").endpoint("gen")
+            await ep.serve(_Echo())
+            fe = await DistributedRuntime.create(ss.url, NO_BUS)
+            client = await fe.namespace("lt").component("w").endpoint(
+                "gen"
+            ).client("round_robin")
+            await client.wait_for_instances(1, timeout=10)
+            try:
+                root = tracing.start_span("edge")
+                ctx = Context({"x": 1})
+                ctx.context.trace = root
+                items = [i async for i in client.generate(ctx)]
+                assert items and not items[-1].is_error
+                root.end()
+                rc_dump = await amain(
+                    ["--statestore", ss.url, "trace", "dump", "dyn://lt.w.gen"]
+                )
+                rc_show = await amain(
+                    ["--statestore", ss.url, "trace", "show", "dyn://lt.w.gen",
+                     root.trace_id]
+                )
+                rc_miss = await amain(
+                    ["--statestore", ss.url, "trace", "show", "dyn://lt.w.gen",
+                     "f" * 32]
+                )
+            finally:
+                await client.close()
+                await fe.shutdown()
+                await rt.shutdown()
+                await ss.stop()
+            return root, rc_dump, rc_show, rc_miss
+
+        root, rc_dump, rc_show, rc_miss = run(go())
+        out = capsys.readouterr().out
+        assert rc_dump == 0 and rc_show == 0
+        assert rc_miss == 1  # unknown trace id is a clean nonzero exit
+        # dump emitted JSONL containing the trace; show rendered the tree
+        assert root.trace_id in out
+        assert "rpc.serve" in out
+
+
+# -- engine phase spans (tiny JAX engine) ------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_engine_parts():
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+
+    cfg = dataclasses.replace(LLAMA_PRESETS["tiny"], dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+async def _collect_engine(engine, prompt, max_tokens=4, trace_parent=None):
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    req = PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0),
+    )
+    ctx = Context(req)
+    ctx.context.trace = trace_parent
+    toks = []
+    async for item in engine.generate(ctx):
+        if item.is_error:
+            raise AssertionError(item.error_message())
+        toks.extend((item.data or {}).get("token_ids", []))
+    return toks
+
+
+class TestEnginePhaseSpans:
+    def test_queue_prefill_decode_spans(self, tiny_engine_parts, run):
+        import jax.numpy as jnp
+
+        from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+
+        cfg, params = tiny_engine_parts
+        engine = JaxServingEngine(
+            cfg, params,
+            EngineConfig(max_slots=2, kv_block_size=8, max_model_len=64),
+            cache_dtype=jnp.float32,
+        )
+        try:
+            root = tracing.start_span("edge")
+            toks = run(_collect_engine(
+                engine, list(range(1, 12)), max_tokens=4, trace_parent=root
+            ))
+            assert len(toks) == 4
+            root.end()
+        finally:
+            engine.close()
+        entry = tracing.recorder().traces(trace_id=root.trace_id)[0]
+        by_name = {s["name"]: s for s in entry["spans"]}
+        for name in ("engine.request", "engine.queue_wait", "engine.prefill",
+                     "engine.decode"):
+            assert name in by_name, f"missing {name}: {sorted(by_name)}"
+        req_span = by_name["engine.request"]
+        assert req_span["parent_id"] == root.span_id
+        assert req_span["attributes"]["output_tokens"] == 4
+        assert by_name["engine.decode"]["attributes"]["tokens"] == 4
+        assert by_name["engine.queue_wait"]["phase"] == "queue_wait"
+        assert by_name["engine.prefill"]["phase"] == "prefill"
+        # phase histograms got fed by the span ends
+        summary = tracing.phase_summary()
+        assert summary["prefill"]["count"] >= 1
+        assert summary["decode"]["count"] >= 1
+
+
+# -- disagg prefill→decode continuity ----------------------------------------
+
+
+class TestDisaggTraceContinuity:
+    def test_one_trace_across_prefill_and_decode(self, tiny_engine_parts, run):
+        import jax.numpy as jnp
+
+        from dynamo_tpu.disagg.prefill_worker import (
+            PrefillEngine,
+            run_prefill_worker,
+        )
+        from dynamo_tpu.disagg.protocols import DisaggConfig
+        from dynamo_tpu.disagg.serving import enable_disagg_decode
+        from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+        from dynamo_tpu.runtime.bus import MessageBusServer
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+        from dynamo_tpu.runtime.statestore import StateStoreServer
+
+        cfg, params = tiny_engine_parts
+
+        async def go():
+            ss = StateStoreServer(port=0)
+            bus = MessageBusServer(port=0)
+            await ss.start()
+            await bus.start()
+            rt = await DistributedRuntime.create(ss.url, bus.url)
+            decode = JaxServingEngine(
+                cfg, params,
+                EngineConfig(max_slots=2, kv_block_size=8, max_model_len=128),
+                cache_dtype=jnp.float32,
+            )
+            ep = rt.namespace("dtz").component("decode").endpoint("gen")
+            await enable_disagg_decode(
+                ep, decode, "dec-1",
+                config=DisaggConfig(max_local_prefill_length=8,
+                                    max_prefill_queue_size=10),
+                register_local=False,
+            )
+            pre = PrefillEngine(cfg, params, max_model_len=128, block_size=8)
+            worker_task = asyncio.create_task(run_prefill_worker(rt, "dtz", pre))
+            try:
+                root = tracing.start_span("edge")
+                toks = await asyncio.wait_for(
+                    _collect_engine(decode, list(range(3, 43)), max_tokens=4,
+                                    trace_parent=root),
+                    60,
+                )
+                assert len(toks) == 4
+                root.end()
+            finally:
+                worker_task.cancel()
+                pre.close()
+                decode.close()
+                await rt.shutdown()
+                await bus.stop()
+                await ss.stop()
+            return root
+
+        root = run(go())
+        entry = tracing.recorder().traces(trace_id=root.trace_id)[0]
+        names = {s["name"] for s in entry["spans"]}
+        # ONE trace_id spanning edge → decode engine → remote prefill
+        # worker → kv transfer back into the decode engine
+        assert "disagg.remote_prefill" in names, sorted(names)
+        assert "disagg.kv_transfer" in names, sorted(names)
+        assert "engine.request" in names
+        assert {s["trace_id"] for s in entry["spans"]} == {root.trace_id}
+        req = next(s for s in entry["spans"] if s["name"] == "engine.request")
+        assert req["attributes"]["remote_prefill"] is True
+        prefill = next(
+            s for s in entry["spans"] if s["name"] == "engine.prefill"
+        )
+        assert prefill["attributes"]["remote"] is True
+
+    def test_remote_prefill_request_carries_traceparent(self):
+        from dynamo_tpu.disagg.protocols import RemotePrefillRequest
+
+        tp = "00-" + "a" * 32 + "-" + "b" * 16 + "-01"
+        req = RemotePrefillRequest(
+            request_id="r1", engine_id="e1", token_ids=[1, 2],
+            block_ids=[0], cached_tokens=0, traceparent=tp,
+        )
+        rt = RemotePrefillRequest.from_dict(req.to_dict())
+        assert rt.traceparent == tp
+        # old producers (no trace field) parse fine
+        d = req.to_dict()
+        del d["traceparent"]
+        assert RemotePrefillRequest.from_dict(d).traceparent == ""
+
+
+# -- overhead guard ----------------------------------------------------------
+
+
+class TestDisabledOverhead:
+    def test_zero_tracing_allocations_per_token(self, monkeypatch, run):
+        monkeypatch.setenv("DYN_TPU_TRACE", "0")
+        tracing.configure()
+        assert not tracing.enabled()
+
+        span_inits = []
+        orig_init = tracing.Span.__init__
+
+        def counting_init(self, *a, **kw):
+            span_inits.append(1)
+            orig_init(self, *a, **kw)
+
+        monkeypatch.setattr(tracing.Span, "__init__", counting_init)
+
+        recorded = []
+        monkeypatch.setattr(
+            tracing.FlightRecorder, "record",
+            lambda self, span: recorded.append(span),
+        )
+
+        async def go():
+            server, client = await _rpc_pair(_Echo(n=64))
+            try:
+                ctx = Context({})
+                items = [i async for i in client.generate("tr.c.e", {},
+                                                          context=ctx)]
+                assert len(items) == 64
+            finally:
+                await client.close()
+                await server.stop()
+
+        run(go())
+        assert span_inits == [], "tracing disabled but Span objects were built"
+        assert recorded == []
+        assert len(tracing.recorder()) == 0
+
+    def test_start_span_returns_none_when_disabled(self, monkeypatch):
+        monkeypatch.setenv("DYN_TPU_TRACE", "0")
+        tracing.configure()
+        assert tracing.start_span("x") is None
+        assert tracing.record_span("x", 0.0, 1.0) is None
+        assert tracing.record_event_span("x") is None
+        with tracing.span("y") as s:
+            assert s is None
+
+
+# -- log correlation (satellite) ---------------------------------------------
+
+
+class TestLogCorrelation:
+    def _format(self, formatter):
+        logger = logging.getLogger("tracing.test")
+        record = logger.makeRecord(
+            "tracing.test", logging.INFO, __file__, 1, "hello %s", ("world",),
+            None,
+        )
+        from dynamo_tpu.runtime.logging_util import TraceContextFilter
+
+        TraceContextFilter().filter(record)
+        return formatter.format(record)
+
+    def test_plain_formatter_appends_trace(self):
+        from dynamo_tpu.runtime.logging_util import PlainFormatter
+
+        span = tracing.start_span("req")
+        t1 = tracing.set_current(span)
+        t2 = tracing.set_request_id("req-42")
+        try:
+            out = self._format(PlainFormatter("%(message)s"))
+        finally:
+            tracing.reset_current(t1)
+            tracing.reset_request_id(t2)
+            span.end()
+        assert f"[trace={span.trace_id} req=req-42]" in out
+        assert "hello world" in out
+
+    def test_plain_formatter_quiet_outside_requests(self):
+        from dynamo_tpu.runtime.logging_util import PlainFormatter
+
+        out = self._format(PlainFormatter("%(message)s"))
+        assert out == "hello world"
+
+    def test_jsonl_formatter_fields(self):
+        from dynamo_tpu.runtime.logging_util import JsonlFormatter
+
+        span = tracing.start_span("req")
+        t1 = tracing.set_current(span)
+        t2 = tracing.set_request_id("req-7")
+        try:
+            out = json.loads(self._format(JsonlFormatter()))
+        finally:
+            tracing.reset_current(t1)
+            tracing.reset_request_id(t2)
+            span.end()
+        assert out["trace_id"] == span.trace_id
+        assert out["request_id"] == "req-7"
+
+
+# -- HTTP edge ---------------------------------------------------------------
+
+
+class TestHttpEdge:
+    def _service(self):
+        from dynamo_tpu.llm.engines import EchoEngineFull
+        from dynamo_tpu.llm.http.service import HttpService, ModelManager
+
+        manager = ModelManager()
+        manager.add_chat_model("echo", EchoEngineFull(delay_s=0.0))
+        return HttpService(manager, host="127.0.0.1", port=0)
+
+    def test_edge_span_joins_incoming_traceparent(self, run):
+        import aiohttp
+
+        svc = self._service()
+        incoming_trace = "c" * 32
+        tp = f"00-{incoming_trace}-{'d' * 16}-01"
+
+        async def go():
+            port = await svc.start()
+            try:
+                async with aiohttp.ClientSession() as session:
+                    async with session.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json={"model": "echo", "stream": True,
+                              "messages": [{"role": "user",
+                                            "content": "a b c"}]},
+                        headers={"traceparent": tp},
+                    ) as resp:
+                        assert resp.status == 200
+                        await resp.read()
+                    # debug endpoint exports the same recorder as JSONL
+                    async with session.get(
+                        f"http://127.0.0.1:{port}/debug/traces",
+                        params={"trace_id": incoming_trace},
+                    ) as resp:
+                        assert resp.status == 200
+                        body = await resp.text()
+                    async with session.get(
+                        f"http://127.0.0.1:{port}/metrics"
+                    ) as resp:
+                        metrics = await resp.text()
+            finally:
+                await svc.stop()
+            return body, metrics
+
+        body, metrics = run(go())
+        entry = tracing.recorder().traces(trace_id=incoming_trace)[0]
+        edge = next(s for s in entry["spans"] if s["name"] == "http.edge")
+        assert edge["parent_id"] == "d" * 16
+        assert edge["status"] == "ok"
+        assert edge["attributes"]["model"] == "echo"
+        dumped = json.loads(body.splitlines()[0])
+        assert dumped["trace_id"] == incoming_trace
+        # new satellite histograms on /metrics
+        assert "dynamo_frontend_inter_token_latency_seconds" in metrics
+        assert "dynamo_phase_latency_seconds" in metrics
+        # streaming chunks fed the edge-side phase histograms
+        summary = tracing.phase_summary()
+        assert summary["ttft"]["count"] >= 1
+        assert summary["inter_token"]["count"] >= 1
+
+    def test_shed_edge_span_status(self, run):
+        import aiohttp
+
+        from dynamo_tpu.llm.http.service import HttpService, ModelManager
+        from dynamo_tpu.runtime.admission import OverloadedError
+
+        class Busy(AsyncEngine):
+            async def generate(self, request: Context):
+                raise OverloadedError("overloaded: busy", retry_after_ms=100)
+                yield  # pragma: no cover
+
+        manager = ModelManager()
+        manager.add_chat_model("busy", Busy())
+        svc = HttpService(manager, host="127.0.0.1", port=0)
+
+        async def go():
+            port = await svc.start()
+            try:
+                async with aiohttp.ClientSession() as session:
+                    async with session.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json={"model": "busy", "stream": True,
+                              "messages": [{"role": "user", "content": "x"}]},
+                    ) as resp:
+                        assert resp.status == 429
+            finally:
+                await svc.stop()
+
+        run(go())
+        edges = [
+            s for t in tracing.recorder().traces() for s in t["spans"]
+            if s["name"] == "http.edge"
+        ]
+        assert edges and edges[-1]["status"] == "overloaded"
+
+
+# -- frontend ITL histogram (satellite) --------------------------------------
+
+
+class TestItlHistogram:
+    def test_mark_chunk_observes_gaps(self):
+        from dynamo_tpu.llm.http.metrics import ServiceMetrics
+
+        m = ServiceMetrics("t")
+        with m.inflight_guard("m1", "chat/completions", "stream") as g:
+            g.mark_chunk()   # first: TTFT only
+            g.mark_chunk()   # second: one gap
+            g.mark_chunk()   # third: another gap
+            g.mark_ok()
+        snap = m.itl.snapshot()
+        (counts, total, _sum) = next(iter(snap.values()))
+        assert total == 2
+        ttft_snap = m.ttft.snapshot()
+        assert next(iter(ttft_snap.values()))[1] == 1
+        assert "t_inter_token_latency_seconds" in m.render()
