@@ -809,6 +809,15 @@ def main() -> None:
     for wave in waves:
         out, elapsed, ttfts, decode_tok_s = drive_wave(engine, wave, GEN_TOKENS)
         per_wave.append((out / elapsed, elapsed, out, ttfts, decode_tok_s))
+    # live perf accounting (PR6, runtime/telemetry.py): the gauges a serving
+    # worker would publish, snapped before teardown — lets a reader compare
+    # the offline roofline numbers below against what the live telemetry
+    # plane would have reported for the same run
+    engine_perf = {
+        k: v for k, v in engine.metrics_snapshot().items()
+        if k in ("decode_tokens_per_s", "step_time_ms", "batch_slot_util",
+                 "jit_recompiles", "kv_peak_occupancy_perc")
+    }
     engine.close()
     del engine  # free the primary engine's HBM before the sections
     params = None
@@ -866,6 +875,8 @@ def main() -> None:
         # seconds recorded so regressions are attributable.
         "warmup_compile_s": round(warmup_s, 1),
         "warmup_variants": warmup_timings,
+        # the live-telemetry view of the same run (empty when DYN_TPU_SLO=0)
+        "engine_perf": engine_perf,
     }
     # latency SHAPE from the tracing plane's phase histograms (ttft /
     # inter_token observed by drive_wave, queue_wait / prefill / decode by

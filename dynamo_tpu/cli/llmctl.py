@@ -12,6 +12,8 @@
     python -m dynamo_tpu.cli.llmctl worker undrain <dyn://ns.comp.ep> <worker_id|all>
     python -m dynamo_tpu.cli.llmctl trace dump [--limit N] [--worker ID] <dyn://ns.comp.ep>
     python -m dynamo_tpu.cli.llmctl trace show <dyn://ns.comp.ep> <trace_id>
+    python -m dynamo_tpu.cli.llmctl slo status [--json] [dyn://ns.telemetry.status]
+    python -m dynamo_tpu.cli.llmctl cluster status [--json] [dyn://ns.telemetry.status]
 
 ``worker drain`` writes a drain control key the target worker watches
 (``.../endpoints/{ep}/drain/{worker_id}``): routers stop sending it new
@@ -76,6 +78,20 @@ def build_parser() -> argparse.ArgumentParser:
     dset.add_argument("--max-local-prefill-length", type=int, default=None)
     dset.add_argument("--max-prefill-queue-size", type=int, default=None)
 
+    for plane, verb_help in (
+        ("slo", "SLO compliance + burn-rate alerts from the telemetry plane"),
+        ("cluster", "cluster capacity/health rollup from the telemetry plane"),
+    ):
+        tp = sub.add_parser(plane, help=verb_help)
+        tpv = tp.add_subparsers(dest="verb", required=True)
+        st = tpv.add_parser("status")
+        st.add_argument(
+            "endpoint", nargs="?", default="dyn://dynamo.telemetry.status",
+            help="telemetry aggregator endpoint "
+                 "(default dyn://dynamo.telemetry.status)",
+        )
+        st.add_argument("--json", action="store_true", dest="as_json")
+
     trace = sub.add_parser("trace", help="dump/show worker request traces")
     tverbs = trace.add_subparsers(dest="verb", required=True)
     tdump = tverbs.add_parser("dump", help="flight-recorder traces as JSONL")
@@ -115,15 +131,20 @@ async def amain(argv: list) -> int:
     try:
         if args.plane == "trace":
             return await _trace_cmd(args, store)
+        if args.plane in ("slo", "cluster"):
+            return await _telemetry_cmd(args, store)
         if args.plane == "worker":
             ns, comp, ep = parse_endpoint_path(args.endpoint)
             base = f"{ns}/components/{comp}/endpoints/{ep}"
             if args.verb == "list":
+                import time
+
                 from dynamo_tpu.runtime.distributed import InstanceInfo
 
                 entries = await store.get_prefix(f"{base}/instances/")
                 drains = await store.get_prefix(f"{base}/drain/")
                 drained = {k.rsplit("/", 1)[-1] for k in drains}
+                now = time.time()
                 for key in sorted(entries):
                     try:
                         info = InstanceInfo.from_json(entries[key])
@@ -136,8 +157,14 @@ async def amain(argv: list) -> int:
                         else "serving"
                     )
                     load = json.dumps(info.load) if info.load else "-"
+                    # uptime from the serve()-time stamp; "-" for entries
+                    # written by pre-telemetry workers
+                    up = (
+                        _fmt_duration(max(now - info.started, 0.0))
+                        if info.started else "-"
+                    )
                     print(f"{info.worker_id:14s} {info.instance_id:18s} "
-                          f"{info.address:22s} {flag:9s} {load}")
+                          f"{info.address:22s} {flag:9s} up={up:>8s} {load}")
                 if not entries:
                     print(f"(no live instances for {args.endpoint})")
                 return 0
@@ -253,6 +280,103 @@ async def amain(argv: list) -> int:
             return 0 if ok else 1
     finally:
         await store.close()
+    return 0
+
+
+def _fmt_duration(seconds: float) -> str:
+    """Compact human uptime: 42s, 13m, 7h22m, 3d1h."""
+    s = int(seconds)
+    if s < 60:
+        return f"{s}s"
+    if s < 3600:
+        return f"{s // 60}m"
+    if s < 86400:
+        return f"{s // 3600}h{(s % 3600) // 60}m"
+    return f"{s // 86400}d{(s % 86400) // 3600}h"
+
+
+async def _telemetry_cmd(args, store) -> int:
+    """``slo status`` / ``cluster status``: dial the telemetry aggregator's
+    RPC port (found through ordinary instance discovery) and render its
+    ``telemetry_dump`` — per-model SLO compliance + burn rates, or the
+    cluster capacity rollup (docs/observability.md runbook)."""
+    from dynamo_tpu.runtime.distributed import InstanceInfo, parse_endpoint_path
+    from dynamo_tpu.runtime.rpc import RpcClient
+
+    ns, comp, ep = parse_endpoint_path(args.endpoint)
+    base = f"{ns}/components/{comp}/endpoints/{ep}"
+    entries = await store.get_prefix(f"{base}/instances/")
+    dump = None
+    for key in sorted(entries):
+        try:
+            info = InstanceInfo.from_json(entries[key])
+        except (ValueError, KeyError):
+            continue
+        try:
+            client = await RpcClient.connect(info.address, timeout=5.0)
+        except (ConnectionError, OSError) as e:
+            print(f"(aggregator {info.worker_id} at {info.address} "
+                  f"unreachable: {e})", file=sys.stderr)
+            continue
+        try:
+            dump = await client.telemetry_dump()
+            break  # one live aggregator is authoritative
+        except (ConnectionError, OSError) as e:
+            print(f"(telemetry dump from {info.worker_id} failed: {e})",
+                  file=sys.stderr)
+        finally:
+            await client.close()
+    if dump is None:
+        print(f"(no reachable telemetry aggregator at {args.endpoint})",
+              file=sys.stderr)
+        return 1
+    cluster = dump.get("cluster") or {}
+    if args.plane == "slo":
+        statuses = cluster.get("slo") or dump.get("slo") or []
+        if args.as_json:
+            print(json.dumps(statuses, indent=2))
+            return 0
+        if not statuses:
+            print("(no SLO data yet — no traffic observed)")
+            return 0
+        for s in statuses:
+            model = s.get("labels", {}).get("model", "-")
+            ratio = s.get("ratio_slow")
+            ratio_s = f"{ratio:.4f}" if ratio is not None else "  -   "
+            state = s.get("state", "ok").upper()
+            print(
+                f'{s.get("slo", "?"):16s} model={model:16s} '
+                f'target={s.get("target", 0):.3f} ratio={ratio_s} '
+                f'burn_fast={s.get("burn_fast", 0.0):>7.2f} '
+                f'burn_slow={s.get("burn_slow", 0.0):>7.2f} {state}'
+            )
+        # non-zero exit on an active page makes this scriptable in CI/cron
+        return 2 if any(s.get("state") == "alert" for s in statuses) else 0
+    # cluster status
+    if args.as_json:
+        print(json.dumps(cluster.get("rollup") or {}, indent=2))
+        return 0
+    roll = cluster.get("rollup")
+    if not roll:
+        print("(no cluster rollup — is the aggregator ingesting?)")
+        return 1
+    print(f'namespace={roll.get("namespace", "?")} '
+          f'workers={roll.get("workers", 0)}')
+    for model, e in sorted((roll.get("models") or {}).items()):
+        print(
+            f'{model:20s} workers={e.get("workers", 0)} '
+            f'(unhealthy={e.get("workers_unhealthy", 0)}) '
+            f'slots {e.get("slots_total", 0) - e.get("slots_free", 0)}'
+            f'/{e.get("slots_total", 0)} '
+            f'kv_free {e.get("kv_blocks_free", 0)}/{e.get("kv_blocks_total", 0)} '
+            f'headroom={e.get("headroom_frac", 0.0):.2f} '
+            f'decode={e.get("decode_tokens_per_s", 0.0):.0f} tok/s'
+        )
+    worst = roll.get("worst_worker")
+    if worst:
+        print(f'worst worker: {worst.get("worker_id")} '
+              f'load={worst.get("load")} '
+              f'(median {roll.get("median_worker_load")})')
     return 0
 
 

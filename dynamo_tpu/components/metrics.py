@@ -50,6 +50,16 @@ GAUGES = [
     # reaped stuck requests per worker
     ("stalls_total", "Engine-stall detections (cumulative)"),
     ("reaped_requests_total", "Stuck requests reaped past deadline (cumulative)"),
+    # live engine perf accounting (PR6, docs/observability.md): the offline
+    # BENCH roofline numbers as live per-worker gauges
+    ("decode_tokens_per_s", "Decode throughput EMA (tokens/s)"),
+    ("step_time_ms", "Per-decode-step wall time EMA (ms)"),
+    ("batch_slot_util", "Batch-slot utilization EMA (0..1)"),
+    ("jit_recompiles", "Jitted step-function compilations since boot"),
+    ("kv_peak_occupancy_perc", "Peak KV pool occupancy since boot (0..1)"),
+    # request outcome counters (cumulative; the cluster SLO engine diffs)
+    ("requests_total", "Requests served by the RPC plane (cumulative)"),
+    ("requests_errored", "Requests finished in error (cumulative)"),
 ]
 
 # health_state is a string on the wire; Prometheus wants a number. Unknown
@@ -173,11 +183,33 @@ class MetricsAggregator:
         lines.append(f"# HELP {full} Samples behind the phase latency quantiles")
         lines.append(f"# TYPE {full} gauge")
         lines.extend(count_lines)
+        # per-worker uptime (satellite: `dynamo_uptime_seconds` everywhere a
+        # process exposes metrics; workers push theirs on the stream)
+        full = f"{self.prefix}_uptime_seconds"
+        lines.append(f"# HELP {full} Seconds since the worker process started")
+        lines.append(f"# TYPE {full} gauge")
+        for worker_id, m in sorted(live.items()):
+            up = float(getattr(m, "uptime_s", 0.0) or 0.0)
+            if up > 0:
+                lines.append(
+                    f'{full}{{namespace="{ns_esc}",'
+                    f'worker="{_escape_label(str(worker_id))}"}} {up:g}'
+                )
         full = f"{self.prefix}_up"
         lines.append(f"# HELP {full} Workers currently reporting metrics")
         lines.append(f"# TYPE {full} gauge")
         lines.append(f'{full}{{namespace="{_escape_label(self.namespace)}"}} {len(live)}')
-        return "\n".join(lines) + "\n"
+        out = "\n".join(lines) + "\n"
+        # this process's own uptime + build identity, and — when a cluster
+        # telemetry aggregator is co-hosted — the cluster section
+        try:
+            from dynamo_tpu.runtime import telemetry
+
+            out += telemetry.render_process_info()
+            out += telemetry.render_cluster_metrics()
+        except Exception:  # telemetry unavailable must never break /metrics
+            pass
+        return out
 
 
 async def run_aggregator(

@@ -1,6 +1,15 @@
 """Mock worker: publishes synthetic KV metrics + events for dashboard and
 aggregator testing without any model or TPU.
 
+Emits everything a real worker's ``attach_kv_publishing`` loop does —
+capacity/health gauges, the PR6 engine perf gauges, request outcome
+counters, and a *realistic* ``phase_latency`` summary (cumulative bucket
+counts included) — so the telemetry aggregator, SLO engine, and metric
+renderers exercise the full pipeline in tier-1 without JAX or real
+engines. :class:`MockWorkerStats` is the reusable sample generator tests
+drive directly (deterministic seed, tunable TTFT/ITL centers — an
+"induced latency regression" is one argument).
+
 Reference counterpart: `components/metrics/src/bin/mock_worker.rs:158`.
 
 Run:  python -m dynamo_tpu.components.mock_worker --namespace dynamo
@@ -12,36 +21,112 @@ import argparse
 import asyncio
 import logging
 import random
+import time
+from typing import Dict, List, Optional
 
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
 
 logger = logging.getLogger(__name__)
 
 
-async def run_mock_worker(
-    drt, namespace: str, interval: float = 1.0, worker_id: str | None = None
-) -> None:
-    from dynamo_tpu.runtime.distributed import KV_METRICS_SUBJECT
+class MockWorkerStats:
+    """Synthetic per-worker telemetry state.
 
-    ns = drt.namespace(namespace)
-    wid = worker_id or f"mock-{drt.worker_id}"
-    rng = random.Random(hash(wid) & 0xFFFF)
-    slots_total, blocks_total = 16, 1024
-    active = 0
-    while True:
-        active = max(0, min(slots_total, active + rng.randint(-3, 3)))
-        blocks = int(blocks_total * min(1.0, active / slots_total + rng.random() * 0.2))
-        waiting = rng.randint(0, 4)
-        m = ForwardPassMetrics(
-            request_active_slots=active,
-            request_total_slots=slots_total,
+    Maintains cumulative phase-latency histograms in exactly the shape
+    ``tracing.phase_summary()`` publishes (bucket bounds from
+    ``tracing.PHASE_BUCKETS``, cumulative counts, bucket-interpolated
+    quantiles), plus request counters — so consumers can't tell a mock
+    from a real worker on the wire.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        ttft_ms: float = 250.0,
+        itl_ms: float = 20.0,
+        slots_total: int = 16,
+        blocks_total: int = 1024,
+    ):
+        from dynamo_tpu.runtime.tracing import PHASE_BUCKETS
+
+        self.rng = random.Random(seed)
+        self.ttft_ms = ttft_ms
+        self.itl_ms = itl_ms
+        self.slots_total = slots_total
+        self.blocks_total = blocks_total
+        self.bounds = PHASE_BUCKETS + (float("inf"),)
+        self._counts: Dict[str, List[int]] = {}
+        self._sums: Dict[str, float] = {}
+        self._totals: Dict[str, int] = {}
+        self.requests_total = 0
+        self.requests_errored = 0
+        self.active = 0
+        self.started = time.monotonic()
+
+    def _observe(self, phase: str, seconds: float) -> None:
+        counts = self._counts.setdefault(phase, [0] * len(self.bounds))
+        for i, b in enumerate(self.bounds):
+            if seconds <= b:
+                counts[i] += 1  # cumulative, like llm/http/metrics.Histogram
+        self._sums[phase] = self._sums.get(phase, 0.0) + seconds
+        self._totals[phase] = self._totals.get(phase, 0) + 1
+
+    def _jitter(self, center_ms: float) -> float:
+        # mild right-skew: most samples near center, occasional 2-3x tail
+        base = center_ms * (0.7 + 0.6 * self.rng.random())
+        if self.rng.random() < 0.05:
+            base *= 1.0 + 2.0 * self.rng.random()
+        return base / 1e3
+
+    def tick(self, requests: int = 8, error_rate: float = 0.0) -> None:
+        """Simulate one metrics interval of traffic: ``requests`` finished
+        requests (one TTFT + ~16 inter-token gaps each)."""
+        for _ in range(requests):
+            self.requests_total += 1
+            if self.rng.random() < error_rate:
+                self.requests_errored += 1
+            self._observe("ttft", self._jitter(self.ttft_ms))
+            for _ in range(16):
+                self._observe("inter_token", self._jitter(self.itl_ms))
+        self.active = max(
+            0, min(self.slots_total, self.active + self.rng.randint(-3, 3))
+        )
+
+    def phase_latency(self) -> dict:
+        from dynamo_tpu.runtime.tracing import _bucket_quantile
+
+        out: Dict[str, dict] = {}
+        for phase, counts in self._counts.items():
+            total = self._totals[phase]
+            if total == 0:
+                continue
+            out[phase] = {
+                "count": total,
+                "sum_s": round(self._sums[phase], 6),
+                "p50_ms": _bucket_quantile(self.bounds, counts, total, 0.50),
+                "p95_ms": _bucket_quantile(self.bounds, counts, total, 0.95),
+                "p99_ms": _bucket_quantile(self.bounds, counts, total, 0.99),
+                "buckets": list(counts),
+            }
+        return out
+
+    def metrics(self, model: str = "mock-model") -> ForwardPassMetrics:
+        blocks = int(
+            self.blocks_total
+            * min(1.0, self.active / self.slots_total + self.rng.random() * 0.2)
+        )
+        waiting = self.rng.randint(0, 4)
+        itl_s = max(self.itl_ms, 1e-3) / 1e3
+        return ForwardPassMetrics(
+            request_active_slots=self.active,
+            request_total_slots=self.slots_total,
             kv_active_blocks=blocks,
-            kv_total_blocks=blocks_total,
+            kv_total_blocks=self.blocks_total,
             num_requests_waiting=waiting,
-            gpu_cache_usage_perc=blocks / blocks_total,
-            gpu_prefix_cache_hit_rate=rng.random() * 0.6,
+            gpu_cache_usage_perc=blocks / self.blocks_total,
+            gpu_prefix_cache_hit_rate=self.rng.random() * 0.6,
             # exercise the overload dashboard columns too
-            rpc_queue_depth=active + waiting,
+            rpc_queue_depth=self.active + waiting,
             shed_requests=0,
             draining=0,
             # health plane columns (deterministically healthy: the mock
@@ -49,9 +134,43 @@ async def run_mock_worker(
             health_state="healthy",
             stalls_total=0,
             reaped_requests_total=0,
+            # tracing + telemetry planes (PR5/PR6)
+            phase_latency=self.phase_latency(),
+            decode_tokens_per_s=round(self.active / itl_s, 1),
+            step_time_ms=round(self.itl_ms * (0.9 + 0.2 * self.rng.random()), 2),
+            batch_slot_util=round(self.active / self.slots_total, 3),
+            jit_recompiles=6,  # a healthy engine compiles its variants once
+            kv_peak_occupancy_perc=round(
+                max(blocks / self.blocks_total, 0.5), 3
+            ),
+            requests_total=self.requests_total,
+            requests_errored=self.requests_errored,
+            uptime_s=round(time.monotonic() - self.started, 3),
+            model=model,
         )
+
+
+async def run_mock_worker(
+    drt,
+    namespace: str,
+    interval: float = 1.0,
+    worker_id: str | None = None,
+    model: str = "mock-model",
+    ttft_ms: float = 250.0,
+    itl_ms: float = 20.0,
+) -> None:
+    from dynamo_tpu.runtime.distributed import KV_METRICS_SUBJECT
+
+    ns = drt.namespace(namespace)
+    wid = worker_id or f"mock-{drt.worker_id}"
+    stats = MockWorkerStats(
+        seed=hash(wid) & 0xFFFF, ttft_ms=ttft_ms, itl_ms=itl_ms
+    )
+    while True:
+        stats.tick()
         await ns.publish(
-            KV_METRICS_SUBJECT, {"worker_id": wid, "metrics": m.to_dict()}
+            KV_METRICS_SUBJECT,
+            {"worker_id": wid, "metrics": stats.metrics(model).to_dict()},
         )
         await asyncio.sleep(interval)
 
@@ -63,6 +182,10 @@ def main() -> None:
     p.add_argument("--bus", default=None)
     p.add_argument("--interval", type=float, default=1.0)
     p.add_argument("--worker-id", default=None)
+    p.add_argument("--model", default="mock-model")
+    p.add_argument("--ttft-ms", type=float, default=250.0,
+                   help="synthetic TTFT center (regression drills: raise it)")
+    p.add_argument("--itl-ms", type=float, default=20.0)
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -73,7 +196,9 @@ def main() -> None:
             statestore_url=args.statestore, bus_url=args.bus
         )
         await run_mock_worker(
-            drt, args.namespace, interval=args.interval, worker_id=args.worker_id
+            drt, args.namespace, interval=args.interval,
+            worker_id=args.worker_id, model=args.model,
+            ttft_ms=args.ttft_ms, itl_ms=args.itl_ms,
         )
 
     asyncio.run(run())

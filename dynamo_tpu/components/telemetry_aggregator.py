@@ -1,0 +1,499 @@
+"""Cluster telemetry aggregator: worker streams → rollups, SLOs, burn rates.
+
+``components/metrics.py`` re-exports each worker's latest snapshot; this
+component *consumes* the same streams and turns them into cluster-level
+answers — the telemetry→decision bridge ROADMAP item 4's planner needs:
+
+- **ingest** — the ``kv_metrics`` event-plane stream every worker already
+  publishes (``attach_kv_publishing``): capacity counters, health state,
+  the PR5 ``phase_latency`` summaries (now carrying raw bucket counts),
+  and the new engine perf gauges. Cumulative counters and histogram
+  snapshots are *differenced* per worker so restarts and resets never
+  produce negative rates.
+- **rollups** — per-model cluster capacity headroom (free slots / free KV
+  blocks over totals), worker count by health, worst/median worker by load
+  score, fleet decode tokens/s.
+- **SLOs** — the differenced TTFT/ITL bucket deltas, request outcomes, and
+  health heartbeats feed a :class:`~dynamo_tpu.runtime.telemetry.MetricStore`
+  per model; a :class:`~dynamo_tpu.runtime.telemetry.SloEngine` evaluates
+  the catalog with multi-window burn rates (docs/observability.md).
+
+Surfaces: the ``telemetry_dump`` RPC verb (the aggregator registers a
+``{ns}.telemetry.status`` endpoint so ``llmctl slo status`` / ``llmctl
+cluster status`` can find it through ordinary discovery), a ``/metrics``
+cluster section, and ``GET /debug/slo`` when embedded in a frontend.
+
+Run:  python -m dynamo_tpu.components.telemetry_aggregator --namespace dynamo --port 9092
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import statistics
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.runtime import telemetry
+from dynamo_tpu.runtime.telemetry import (
+    MetricStore,
+    SloEngine,
+    TelemetryPolicy,
+)
+
+logger = logging.getLogger(__name__)
+
+# phase → SLO series fed from worker phase_latency summaries. Bounds come
+# from the tracing plane's histogram (seconds), converted to the telemetry
+# store's native ms.
+_PHASE_SERIES = {"ttft": "ttft_ms", "inter_token": "itl_ms"}
+
+# cluster exposition catalog (metric-name-valid lint checks *GAUGES tables)
+CLUSTER_GAUGES = [
+    ("workers", "Workers currently reporting metrics"),
+    ("workers_unhealthy", "Workers self-reporting unhealthy"),
+    ("slots_total", "Decode slots across the fleet"),
+    ("slots_free", "Free decode slots across the fleet"),
+    ("kv_blocks_total", "KV pool blocks across the fleet"),
+    ("kv_blocks_free", "Free KV pool blocks across the fleet"),
+    ("headroom_frac", "min(free slots, free KV) fraction of fleet capacity"),
+    ("decode_tokens_per_s", "Fleet decode throughput (sum of worker EMAs)"),
+    ("worst_worker_load", "Highest per-worker load score"),
+    ("median_worker_load", "Median per-worker load score"),
+]
+
+
+def _phase_bounds_ms() -> Tuple[float, ...]:
+    from dynamo_tpu.runtime.tracing import PHASE_BUCKETS
+
+    return tuple(b * 1e3 for b in PHASE_BUCKETS)
+
+
+class _WorkerView:
+    """Latest snapshot + the cumulative baselines used for differencing."""
+
+    __slots__ = (
+        "metrics", "last_seen", "model",
+        "phase_counts", "phase_sums", "counters",
+    )
+
+    def __init__(self) -> None:
+        self.metrics: Optional[ForwardPassMetrics] = None
+        self.last_seen = 0.0
+        self.model = ""
+        # phase → cumulative per-bound counts at last ingest
+        self.phase_counts: Dict[str, List[int]] = {}
+        self.phase_sums: Dict[str, float] = {}
+        # counter name → cumulative value at last ingest
+        self.counters: Dict[str, float] = {}
+
+
+def _decumulate(cum: List[int]) -> List[int]:
+    """Prometheus-style cumulative bucket counts → per-bound counts."""
+    out = []
+    prev = 0
+    for c in cum:
+        out.append(max(int(c) - prev, 0))
+        prev = int(c)
+    return out
+
+
+class ClusterTelemetry:
+    """The aggregation core (transport-free, deterministic under test)."""
+
+    def __init__(
+        self,
+        namespace: str,
+        policy: Optional[TelemetryPolicy] = None,
+        expiry: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.namespace = namespace
+        self.policy = policy or TelemetryPolicy.from_env()
+        self.expiry = expiry
+        self.clock = clock
+        from dynamo_tpu.runtime.telemetry import declare_standard_series
+
+        # latency bounds follow the tracing plane's histogram (ms): worker
+        # snapshots diff straight into these series
+        self.store = declare_standard_series(
+            MetricStore(self.policy, clock=clock),
+            latency_bounds=_phase_bounds_ms(),
+        )
+        self.slo_engine = SloEngine(self.store, self.policy, clock=clock)
+        self._workers: Dict[str, _WorkerView] = {}
+
+    # -- ingest --------------------------------------------------------------
+
+    def ingest(self, worker_id: str, metrics: ForwardPassMetrics) -> None:
+        now = self.clock()
+        view = self._workers.get(worker_id)
+        if view is None:
+            view = self._workers[worker_id] = _WorkerView()
+        view.metrics = metrics
+        view.last_seen = now
+        model = getattr(metrics, "model", None) or view.model or "unknown"
+        view.model = model
+
+        # availability: one 0/1 sample per heartbeat per worker, pooled into
+        # the model's gauge series — the window average IS the healthy share
+        available = 1.0 if (
+            getattr(metrics, "health_state", "healthy") != "unhealthy"
+            and not getattr(metrics, "draining", 0)
+        ) else 0.0
+        self.store.series("worker_available", model=model).set(available, now)
+
+        self._ingest_phases(view, metrics, model, now)
+        self._ingest_counters(view, metrics, model, now)
+
+    def _ingest_phases(
+        self, view: _WorkerView, metrics: ForwardPassMetrics,
+        model: str, now: float,
+    ) -> None:
+        phases = getattr(metrics, "phase_latency", None)
+        if not isinstance(phases, dict):
+            return
+        for phase, series_name in _PHASE_SERIES.items():
+            stats = phases.get(phase)
+            if not isinstance(stats, dict):
+                continue
+            cum = stats.get("buckets")
+            if not isinstance(cum, list):
+                continue  # pre-PR6 worker: summary without raw buckets
+            counts = _decumulate(cum)
+            series = self.store.series(series_name, model=model)
+            if len(counts) != len(series.bounds):
+                continue  # bounds drift across versions: skip, never corrupt
+            prev = view.phase_counts.get(phase)
+            sum_ms = float(stats.get("sum_s", 0.0)) * 1e3
+            if prev is None:
+                # first sight: baseline only, observe nothing — the
+                # snapshot may hold hours of already-lived history (a new
+                # aggregator against an old fleet, or a worker returning
+                # after an expiry gap), and dumping it into the current
+                # ring bucket would double-count it at "now" and fire a
+                # false page
+                view.phase_counts[phase] = counts
+                view.phase_sums[phase] = sum_ms
+                continue
+            if len(prev) != len(counts) or any(
+                c < p for c, p in zip(counts, prev)
+            ):
+                # reset (worker restart / tracing.configure): the fresh
+                # process's counts ARE new samples — and small, one
+                # process-lifetime of a just-restarted worker
+                prev = [0] * len(counts)
+                view.phase_sums[phase] = 0.0
+            delta = [c - p for c, p in zip(counts, prev)]
+            d_sum = max(sum_ms - view.phase_sums.get(phase, 0.0), 0.0)
+            if any(delta):
+                series.observe_bucketed(delta, d_sum, now)
+            view.phase_counts[phase] = counts
+            view.phase_sums[phase] = sum_ms
+
+    def _ingest_counters(
+        self, view: _WorkerView, metrics: ForwardPassMetrics,
+        model: str, now: float,
+    ) -> None:
+        for attr, series_name in (
+            ("requests_total", "requests_total"),
+            ("requests_errored", "requests_errored"),
+            ("shed_requests", "requests_shed"),
+        ):
+            cur = float(getattr(metrics, attr, 0) or 0)
+            prev = view.counters.get(attr)
+            if prev is None:
+                # first sight: baseline only (see _ingest_phases)
+                view.counters[attr] = cur
+                continue
+            if cur < prev:  # worker restart: fresh counters are new events
+                prev = 0.0
+            d = cur - prev
+            if d > 0:
+                self.store.series(series_name, model=model).inc(d, now)
+            view.counters[attr] = cur
+
+    # -- rollups -------------------------------------------------------------
+
+    def live_workers(self) -> Dict[str, _WorkerView]:
+        """Workers fresh enough for the capacity rollup. Views are only
+        DELETED on a much longer horizon: a worker quiet past ``expiry``
+        (bus hiccup, GC pause) must drop out of the rollup but keep its
+        diff baselines — deleting them would make its next publish look
+        like first sight and silently skip (or, before the baseline-only
+        fix, double-count) its history."""
+        now = self.clock()
+        cutoff = now - self.expiry
+        drop = now - max(self.expiry * 20, 600.0)
+        self._workers = {
+            w: v for w, v in self._workers.items() if v.last_seen >= drop
+        }
+        return {
+            w: v for w, v in self._workers.items() if v.last_seen >= cutoff
+        }
+
+    @staticmethod
+    def _load_score(m: ForwardPassMetrics) -> float:
+        """Same shape as LoadSnapshot.utilization(): slot + queue + KV
+        pressure; higher = busier."""
+        score = 0.0
+        slots = max(int(m.request_total_slots or 0), 0)
+        if slots > 0:
+            score += m.request_active_slots / slots
+            score += m.num_requests_waiting / slots
+        blocks = max(int(m.kv_total_blocks or 0), 0)
+        if blocks > 0:
+            score += m.kv_active_blocks / blocks
+        return round(score, 4)
+
+    def rollup(self) -> dict:
+        """Instantaneous cluster capacity/health view, per model + total."""
+        live = self.live_workers()
+        models: Dict[str, dict] = {}
+        scores: List[Tuple[str, float]] = []
+        for wid, view in sorted(live.items()):
+            m = view.metrics
+            if m is None:
+                continue
+            entry = models.setdefault(view.model, {
+                "workers": 0, "workers_unhealthy": 0,
+                "slots_total": 0, "slots_free": 0,
+                "kv_blocks_total": 0, "kv_blocks_free": 0,
+                "decode_tokens_per_s": 0.0,
+            })
+            entry["workers"] += 1
+            if getattr(m, "health_state", "healthy") == "unhealthy":
+                entry["workers_unhealthy"] += 1
+            entry["slots_total"] += int(m.request_total_slots or 0)
+            entry["slots_free"] += max(
+                int(m.request_total_slots or 0) - int(m.request_active_slots or 0), 0
+            )
+            entry["kv_blocks_total"] += int(m.kv_total_blocks or 0)
+            entry["kv_blocks_free"] += max(
+                int(m.kv_total_blocks or 0) - int(m.kv_active_blocks or 0), 0
+            )
+            entry["decode_tokens_per_s"] = round(
+                entry["decode_tokens_per_s"]
+                + float(getattr(m, "decode_tokens_per_s", 0.0) or 0.0), 3,
+            )
+            scores.append((wid, self._load_score(m)))
+        for entry in models.values():
+            slot_frac = (
+                entry["slots_free"] / entry["slots_total"]
+                if entry["slots_total"] else 0.0
+            )
+            kv_frac = (
+                entry["kv_blocks_free"] / entry["kv_blocks_total"]
+                if entry["kv_blocks_total"] else 0.0
+            )
+            # headroom is the BINDING constraint: whichever of slots or KV
+            # runs out first caps admission (runtime/admission.py)
+            entry["headroom_frac"] = round(min(slot_frac, kv_frac), 4)
+        worst = max(scores, key=lambda t: t[1]) if scores else None
+        med = (
+            round(statistics.median(s for _, s in scores), 4) if scores else None
+        )
+        return {
+            "namespace": self.namespace,
+            "workers": len(live),
+            "models": models,
+            "worst_worker": (
+                {"worker_id": worst[0], "load": worst[1]} if worst else None
+            ),
+            "median_worker_load": med,
+        }
+
+    def slo_report(self) -> List[dict]:
+        return self.slo_engine.report()
+
+    def dump(self) -> dict:
+        """The ``telemetry_dump`` / ``/debug/slo`` cluster payload."""
+        return {
+            "rollup": self.rollup(),
+            "slo": self.slo_report(),
+            "windows": {
+                "fast_s": self.policy.fast_window,
+                "mid_s": self.policy.mid_window,
+                "slow_s": self.policy.slow_window,
+                "burn_fast": self.policy.burn_fast,
+                "burn_slow": self.policy.burn_slow,
+            },
+        }
+
+    def render_prometheus(self, prefix: str = "dynamo_cluster") -> str:
+        """The cluster /metrics section: capacity + SLO compliance/burn."""
+        from dynamo_tpu.llm.http.metrics import fmt_labels
+
+        roll = self.rollup()
+        lines: List[str] = []
+        per_model_keys = {k for k, _ in CLUSTER_GAUGES} - {
+            "worst_worker_load", "median_worker_load",
+        }
+        for name, help_text in CLUSTER_GAUGES:
+            full = f"{prefix}_{name}"
+            lines.append(f"# HELP {full} {help_text}")
+            lines.append(f"# TYPE {full} gauge")
+            if name == "worst_worker_load":
+                w = roll.get("worst_worker")
+                if w:
+                    lbl = fmt_labels({
+                        "namespace": self.namespace, "worker": w["worker_id"],
+                    })
+                    lines.append(f"{full}{lbl} {w['load']}")
+                continue
+            if name == "median_worker_load":
+                med = roll.get("median_worker_load")
+                if med is not None:
+                    lbl = fmt_labels({"namespace": self.namespace})
+                    lines.append(f"{full}{lbl} {med}")
+                continue
+            if name == "workers":
+                lbl = fmt_labels({"namespace": self.namespace})
+                lines.append(f"{full}{lbl} {roll['workers']}")
+                continue
+            if name in per_model_keys:
+                for model, entry in sorted(roll["models"].items()):
+                    if name not in entry:
+                        continue
+                    lbl = fmt_labels({
+                        "namespace": self.namespace, "model": model,
+                    })
+                    lines.append(f"{full}{lbl} {entry[name]}")
+        # SLO state: compliance ratio over the slow window + fast burn rate
+        comp = f"{prefix}_slo_compliance"
+        burn = f"{prefix}_slo_burn_rate"
+        alert = f"{prefix}_slo_alert"
+        lines.append(f"# HELP {comp} Good-event ratio over the slow window")
+        lines.append(f"# TYPE {comp} gauge")
+        burn_lines = [
+            f"# HELP {burn} Error-budget burn rate over the fast window",
+            f"# TYPE {burn} gauge",
+        ]
+        alert_lines = [
+            f"# HELP {alert} 0=ok 1=burning(ticket) 2=alert(page)",
+            f"# TYPE {alert} gauge",
+        ]
+        for status in self.slo_report():
+            lbl = fmt_labels(dict(
+                status.get("labels", {}),
+                namespace=self.namespace, slo=status["slo"],
+            ))
+            ratio = status.get("ratio_slow")
+            if ratio is not None:
+                lines.append(f"{comp}{lbl} {ratio:.6f}")
+            burn_lines.append(f"{burn}{lbl} {status.get('burn_fast', 0.0)}")
+            state_val = {"ok": 0, "burning": 1, "alert": 2}.get(
+                status.get("state", "ok"), 2
+            )
+            alert_lines.append(f"{alert}{lbl} {state_val}")
+        lines.extend(burn_lines)
+        lines.extend(alert_lines)
+        return "\n".join(lines) + "\n"
+
+
+async def run_telemetry_aggregator(
+    drt,
+    namespace: str,
+    port: int = 0,
+    host: str = "0.0.0.0",
+    expiry: float = 30.0,
+    register: bool = True,
+    ready: Optional[asyncio.Event] = None,
+    bound_port: Optional[List[int]] = None,
+) -> None:
+    """Consume the worker metrics stream, serve the cluster view, and (by
+    default) register a ``{ns}.telemetry.status`` endpoint so ``llmctl slo
+    status`` finds this aggregator through ordinary discovery. The
+    aggregator also installs itself as the process-global cluster
+    (``telemetry.set_cluster``) so the ``telemetry_dump`` RPC verb and any
+    co-hosted frontend's ``/debug/slo`` include it."""
+    from aiohttp import web
+
+    from dynamo_tpu.runtime.annotated import Annotated
+    from dynamo_tpu.runtime.distributed import (
+        KV_METRICS_SUBJECT,
+        resubscribe_forever,
+    )
+    from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+    cluster = ClusterTelemetry(namespace, expiry=expiry)
+    telemetry.set_cluster(cluster)
+    ns = drt.namespace(namespace)
+    consumer = asyncio.create_task(resubscribe_forever(
+        ns, KV_METRICS_SUBJECT,
+        lambda d: cluster.ingest(
+            d["worker_id"], ForwardPassMetrics.from_dict(d["metrics"])
+        ),
+    ))
+
+    if register:
+        class _StatusEngine(AsyncEngine):
+            """RPC-facing view: one item with the full cluster dump."""
+
+            async def generate(self, request: Context):
+                yield Annotated.from_data(telemetry.dump_state())
+
+        await ns.component("telemetry").endpoint("status").serve(_StatusEngine())
+
+    async def metrics_handler(_request):
+        text = cluster.render_prometheus() + telemetry.render_process_info()
+        return web.Response(text=text, content_type="text/plain", charset="utf-8")
+
+    async def slo_handler(_request):
+        return web.json_response(telemetry.dump_state())
+
+    app = web.Application()
+    app.add_routes([
+        web.get("/metrics", metrics_handler),
+        web.get("/debug/slo", slo_handler),
+    ])
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    actual = port
+    for sock in site._server.sockets:  # type: ignore[union-attr]
+        actual = sock.getsockname()[1]
+        break
+    if bound_port is not None:
+        bound_port.append(actual)
+    if ready is not None:
+        ready.set()
+    logger.info("telemetry aggregator for %r on :%d", namespace, actual)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        consumer.cancel()
+        if telemetry.cluster() is cluster:
+            telemetry.set_cluster(None)
+        await runner.cleanup()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="dynamo_tpu telemetry aggregator")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9092)
+    p.add_argument("--statestore", default=None)
+    p.add_argument("--bus", default=None)
+    p.add_argument("--expiry", type=float, default=30.0)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+        drt = await DistributedRuntime.create(
+            statestore_url=args.statestore, bus_url=args.bus
+        )
+        await run_telemetry_aggregator(
+            drt, args.namespace, args.port, host=args.host, expiry=args.expiry
+        )
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

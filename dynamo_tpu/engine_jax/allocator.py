@@ -147,6 +147,13 @@ class BlockAllocator:
         self.probe_tokens = 0
         self.inflight_waits = 0  # admission deferrals onto an in-flight prefill
         self.shared_prefill_tokens = 0  # tokens served by joining one
+        # live occupancy accounting (PR6 telemetry): high-water mark of
+        # hard-held (refcounted) blocks and cumulative acquisitions. Peak
+        # near num_blocks under normal load means the pool — not slots —
+        # is the binding capacity constraint (feeds the SLA planner's
+        # pool-resize decision, ROADMAP item 4).
+        self.peak_active_blocks = 0
+        self.blocks_acquired_total = 0
 
     def set_sink(self, sink: Optional[KvEventSink]) -> None:
         self._sink = sink
@@ -410,11 +417,25 @@ class BlockAllocator:
         if bid in self._cached:  # revive from reuse pool
             del self._cached[bid]
         self._refcount[bid] = self._refcount.get(bid, 0) + 1
+        self._note_occupancy()
 
     def _take_free(self) -> int:
         bid = self._free.pop()
         self._refcount[bid] = 1
+        self._note_occupancy()
         return bid
+
+    def _note_occupancy(self) -> None:
+        self.blocks_acquired_total += 1
+        active = self.active_blocks
+        if active > self.peak_active_blocks:
+            self.peak_active_blocks = active
+
+    def peak_occupancy(self) -> float:
+        """High-water fraction of the pool ever hard-held at once."""
+        return (
+            self.peak_active_blocks / self.num_blocks if self.num_blocks else 0.0
+        )
 
     def _reserve_capacity(self, n: int) -> bool:
         """Make sure the free list has n entries, evicting LRU cached blocks.

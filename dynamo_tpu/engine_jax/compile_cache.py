@@ -10,8 +10,34 @@ reuse is the compiled executable).
 from __future__ import annotations
 
 import os
+import threading
 
 _DEFAULT = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))), ".jax_cache")
+
+# process-global count of jitted-program builds (engine step-fn variants,
+# counts syncs, inject scatters). A steady-state engine compiles a handful
+# at boot and then NEVER again — a climbing count mid-traffic means some
+# shape leaked into a jit signature and every bump stalled decode for a
+# full compile. Surfaced live as ForwardPassMetrics.jit_recompiles.
+_COMPILE_LOCK = threading.Lock()
+_COMPILES: dict[str, int] = {}
+
+
+def record_compile(kind: str = "step") -> None:
+    """Count one jitted-program build (called where engines create a new
+    compiled variant — cache misses in their per-shape fn tables)."""
+    with _COMPILE_LOCK:
+        _COMPILES[kind] = _COMPILES.get(kind, 0) + 1
+
+
+def compile_count() -> int:
+    with _COMPILE_LOCK:
+        return sum(_COMPILES.values())
+
+
+def compile_counts() -> dict[str, int]:
+    with _COMPILE_LOCK:
+        return dict(_COMPILES)
 
 
 def enable_compile_cache(path: str | None = None) -> str:

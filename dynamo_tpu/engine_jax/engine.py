@@ -67,12 +67,64 @@ from dynamo_tpu.models.llama import (
     lm_head,
     make_kv_cache,
 )
-from dynamo_tpu.runtime import tracing
+from dynamo_tpu.engine_jax.compile_cache import compile_count, record_compile
+from dynamo_tpu.runtime import telemetry, tracing
 from dynamo_tpu.runtime.annotated import Annotated
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 from dynamo_tpu.runtime.health import EngineHeartbeat
 
 logger = logging.getLogger(__name__)
+
+
+class _EnginePerf:
+    """Live decode-perf accounting (engine thread only, EMA-smoothed).
+
+    The BENCH files compute tokens/s and roofline fractions *offline*; this
+    makes the same signals live gauges on the metrics stream
+    (``ForwardPassMetrics.decode_tokens_per_s`` etc.) so the telemetry
+    plane — and eventually the SLA planner — can see a decode regression as
+    it happens. Built only when telemetry sampling is enabled
+    (``DYN_TPU_SLO=0`` ⇒ the engine holds ``None`` and the step loop pays
+    one attribute check, asserted by ``tests/test_telemetry.py``).
+
+    Timing anchors on the gap between consecutive *processed* decode chunks
+    (which in pipelined decode equals the chunk's wall time); idle gaps are
+    excluded via :meth:`note_idle` so a quiet engine's throughput gauge
+    reflects its last busy period instead of decaying toward zero.
+    """
+
+    __slots__ = ("decode_tps", "step_time_ms", "slot_util", "_last_t", "_alpha")
+
+    def __init__(self, alpha: float = 0.2):
+        self.decode_tps = 0.0
+        self.step_time_ms = 0.0
+        self.slot_util = 0.0
+        self._last_t: Optional[float] = None
+        self._alpha = alpha
+
+    def _ema(self, prev: float, sample: float) -> float:
+        return sample if prev == 0.0 else prev + self._alpha * (sample - prev)
+
+    def note_decode(self, n_tokens: int, k_steps: int) -> None:
+        now = time.perf_counter()
+        last, self._last_t = self._last_t, now
+        if last is None:
+            return
+        dt = now - last
+        if dt <= 0:
+            return
+        if n_tokens > 0:
+            self.decode_tps = self._ema(self.decode_tps, n_tokens / dt)
+        self.step_time_ms = self._ema(
+            self.step_time_ms, dt * 1e3 / max(k_steps, 1)
+        )
+
+    def note_slots(self, active: int, total: int) -> None:
+        if total > 0:
+            self.slot_util = self._ema(self.slot_util, active / total)
+
+    def note_idle(self) -> None:
+        self._last_t = None
 
 
 @dataclass(frozen=True)
@@ -440,6 +492,12 @@ class JaxServingEngine(AsyncEngine):
         # turns that into an `unhealthy` self-drain
         self.heartbeat = EngineHeartbeat()
 
+        # live perf accounting (telemetry plane): None when sampling is off,
+        # so the step loop's only cost is this attribute's None-check
+        self._perf: Optional[_EnginePerf] = (
+            _EnginePerf() if telemetry.enabled() else None
+        )
+
         # (with_logprobs, with_penalties, with_sampling) variants, compiled
         # lazily per need
         self._decode_fns: Dict[Tuple[bool, bool, bool], Any] = {}
@@ -682,6 +740,7 @@ class JaxServingEngine(AsyncEngine):
         key = (want_lp, want_pen, want_sample)
         fn = self._decode_fns.get(key)
         if fn is None:
+            record_compile("decode")
             fn = self._decode_fns[key] = self._build_decode_fn(
                 want_lp, want_pen, want_sample
             )
@@ -694,6 +753,7 @@ class JaxServingEngine(AsyncEngine):
         key = (want_lp, want_pen, want_sample, want_history)
         fn = self._chunk_fns.get(key)
         if fn is None:
+            record_compile("chunk")
             fn = self._chunk_fns[key] = self._build_chunk_fn(
                 want_lp, want_pen, want_sample, want_history
             )
@@ -772,6 +832,7 @@ class JaxServingEngine(AsyncEngine):
         row index S, dropped by the scatters."""
         fn = self._counts_sync_fns.get((rbucket, pbucket))
         if fn is None:
+            record_compile("counts_sync")
 
             def sync(counts, reset_rows, add_rows, add_toks):
                 counts = counts.at[reset_rows].set(0, mode="drop")
@@ -1079,6 +1140,9 @@ class JaxServingEngine(AsyncEngine):
                 self._harvest_spills(force=idle)
                 if idle:
                     self._release_counts()
+                    if self._perf is not None:
+                        # exclude the idle gap from throughput timing
+                        self._perf.note_idle()
                 self._coalesce_admission_wave()
                 self._admit()
                 self._dispatch_step()
@@ -1599,6 +1663,14 @@ class JaxServingEngine(AsyncEngine):
             self._process_chunk(prev, defer_free=True)
 
     def _process_chunk(self, chunk: _Inflight, defer_free: bool) -> None:
+        if self._perf is not None:
+            # gap between consecutive processed chunks ≈ chunk wall time in
+            # pipelined decode; tokens counted below feed the tps EMA
+            tokens_before = self.total_generated_tokens
+            self._perf.note_slots(
+                sum(1 for s in chunk.lanes if s is not None),
+                self.config.max_slots,
+            )
         if chunk.lps is not None:
             # dynlint: allow-host-sync(leader sync: pipelined fetch — the copy
             # rode the NEXT chunk's compute window, ~free by the time we get)
@@ -1670,6 +1742,11 @@ class JaxServingEngine(AsyncEngine):
             ))
             if finish is not None:
                 self._finish(seq, finish, defer_free=defer_free)
+        if self._perf is not None:
+            self._perf.note_decode(
+                self.total_generated_tokens - tokens_before,
+                self.config.decode_steps,
+            )
 
     def _drain_inflight(self) -> None:
         """Fetch + process any in-flight chunk, then release zombie blocks
@@ -1891,6 +1968,8 @@ class JaxServingEngine(AsyncEngine):
 
     def _inject_fn(self):
         if not hasattr(self, "_inject_jit"):
+            record_compile("inject")
+
             def inject(cache_arr, idx, vals):
                 # padded idx entries are out of range → dropped by the scatter
                 return cache_arr.at[:, idx].set(vals, mode="drop")
@@ -2101,7 +2180,15 @@ class JaxServingEngine(AsyncEngine):
             # deferrals onto a concurrent identical prefix + tokens saved
             "inflight_prefill_waits": self.allocator.inflight_waits,
             "shared_prefill_tokens": self.allocator.shared_prefill_tokens,
+            # live perf accounting (telemetry plane): the BENCH roofline
+            # inputs as gauges; zeros with sampling off (DYN_TPU_SLO=0)
+            "jit_recompiles": compile_count(),
+            "kv_peak_occupancy_perc": round(self.allocator.peak_occupancy(), 4),
         }
+        if self._perf is not None:
+            m["decode_tokens_per_s"] = round(self._perf.decode_tps, 3)
+            m["step_time_ms"] = round(self._perf.step_time_ms, 3)
+            m["batch_slot_util"] = round(self._perf.slot_util, 4)
         if self.host_pool is not None:
             m["host_cache_blocks"] = len(self.host_pool)
             m["host_cache_hits"] = self.host_pool.hits

@@ -173,9 +173,25 @@ class ForwardPassMetrics:
     reaped_requests_total: int = 0
     # request-phase latency summary from the tracing plane
     # (runtime/tracing.py phase_summary): {phase: {count, sum_s, p50_ms,
-    # p95_ms, p99_ms}}; None from workers without tracing enabled.
-    # Rendered by components/metrics.py as per-phase quantile gauges.
+    # p95_ms, p99_ms, buckets}}; None from workers without tracing enabled.
+    # Rendered by components/metrics.py as per-phase quantile gauges; the
+    # cluster telemetry aggregator diffs the raw `buckets` vectors.
     phase_latency: Optional[dict] = None
+    # live engine perf accounting (engine_jax/engine.py, PR6): the roofline
+    # fractions the BENCH files compute offline, as live gauges. Zeros from
+    # engines without perf sampling (DYN_TPU_SLO=0) or non-JAX engines.
+    decode_tokens_per_s: float = 0.0
+    step_time_ms: float = 0.0
+    batch_slot_util: float = 0.0
+    jit_recompiles: int = 0
+    kv_peak_occupancy_perc: float = 0.0
+    # request outcome counters from the RPC server (cumulative): the
+    # cluster SLO engine diffs them for error-rate / overload-share
+    requests_total: int = 0
+    requests_errored: int = 0
+    # process identity for cluster attribution + dashboards
+    uptime_s: float = 0.0
+    model: Optional[str] = None
 
     def to_dict(self) -> dict:
         from dataclasses import asdict

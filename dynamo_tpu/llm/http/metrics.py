@@ -62,6 +62,27 @@ def _observe_trace_phase(phase: str, seconds: float) -> None:
         tracing.observe_phase(phase, seconds)
 
 
+def _observe_slo_latency(series: str, model: str, seconds: float) -> None:
+    """Feed an edge latency sample (TTFT / inter-token) into the telemetry
+    plane's SLO store. Same lazy-import + enabled() discipline as the
+    tracing feed: ``DYN_TPU_SLO=0`` costs one boolean check."""
+    try:
+        from dynamo_tpu.runtime import telemetry
+    except Exception:  # pragma: no cover - runtime tree absent
+        return
+    telemetry.observe_latency(series, seconds * 1e3, model=model)
+
+
+def _count_slo_request(outcome: str, model: str) -> None:
+    """One finished edge request into the SLO store (error-rate and
+    overload-share objectives)."""
+    try:
+        from dynamo_tpu.runtime import telemetry
+    except Exception:  # pragma: no cover - runtime tree absent
+        return
+    telemetry.count_request(outcome, model=model)
+
+
 class Counter:
     def __init__(self, name: str, help_: str, label_names: tuple[str, ...] = ()):
         self.name = name
@@ -249,6 +270,15 @@ class ServiceMetrics:
             out += tracing.render_phase_metrics()
         except Exception:  # tracing unavailable must never break /metrics
             pass
+        try:
+            from dynamo_tpu.runtime import telemetry
+
+            # process identity + uptime, and the cluster section when a
+            # telemetry aggregator is co-hosted with this frontend
+            out += telemetry.render_process_info()
+            out += telemetry.render_cluster_metrics()
+        except Exception:  # telemetry unavailable must never break /metrics
+            pass
         return out
 
 
@@ -298,11 +328,14 @@ class InflightGuard:
         if self._first_token_at is None:
             self.mark_first_token()
             if self._first_token_at is not None and self._start is not None:
-                _observe_trace_phase("ttft", self._first_token_at - self._start)
+                ttft = self._first_token_at - self._start
+                _observe_trace_phase("ttft", ttft)
+                _observe_slo_latency("ttft_ms", self.model, ttft)
         elif self._last_chunk_at is not None:
             gap = now - self._last_chunk_at
             self._m.itl.observe(gap, model=self.model)
             _observe_trace_phase("inter_token", gap)
+            _observe_slo_latency("itl_ms", self.model, gap)
         self._last_chunk_at = now
 
     def count_tokens(self, n: int = 1) -> None:
@@ -312,10 +345,12 @@ class InflightGuard:
         self._m.inflight.add(-1, model=self.model)
         if self._start is not None:
             self._m.duration.observe(time.perf_counter() - self._start, model=self.model)
+        status = self.status if exc_type is None else "error"
         self._m.requests.inc(
             1,
             model=self.model,
             endpoint=self.endpoint,
             request_type=self.request_type,
-            status=self.status if exc_type is None else "error",
+            status=status,
         )
+        _count_slo_request(status, self.model)

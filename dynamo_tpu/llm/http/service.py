@@ -119,6 +119,7 @@ class HttpService:
                 web.get("/health", self._health),
                 web.get("/live", self._live),
                 web.get("/debug/traces", self._debug_traces),
+                web.get("/debug/slo", self._debug_slo),
             ]
         )
 
@@ -205,18 +206,30 @@ class HttpService:
 
     async def _debug_traces(self, request: web.Request) -> web.Response:
         """Flight-recorder export: one JSON object per line per trace
-        (``?limit=N`` keeps the newest N, ``?trace_id=...`` one trace).
+        (``?limit=N`` keeps the newest N, ``?trace_id=...`` one trace,
+        ``?errored=1`` only traces with a non-ok span).
         Frontend-local spans only — worker traces come via ``llmctl trace``
         against the worker's RPC port (docs/observability.md)."""
         try:
             limit = int(request.query.get("limit", "0"))
         except ValueError:
             limit = 0
+        errored = request.query.get("errored", "") not in ("", "0", "false")
         body = tracing.recorder().dump_jsonl(
-            limit=limit, trace_id=request.query.get("trace_id")
+            limit=limit, trace_id=request.query.get("trace_id"),
+            errored=errored,
         )
         return web.Response(text=body + ("\n" if body else ""),
                             content_type="application/jsonl")
+
+    async def _debug_slo(self, _request: web.Request) -> web.Response:
+        """SLO / burn-rate report: the edge's own objectives (fed from the
+        request metrics this process serves) plus — when a cluster
+        telemetry aggregator is co-hosted — the cluster rollup and cluster
+        SLOs (docs/observability.md §Cluster telemetry & SLOs)."""
+        from ...runtime import telemetry
+
+        return web.json_response(telemetry.dump_state())
 
     async def _models(self, _request: web.Request) -> web.Response:
         listing = ModelList(data=[ModelInfo(id=n) for n in self.manager.model_names()])

@@ -22,4 +22,8 @@ ENDPOINT_PROTOCOLS = {
     # serve_stats_endpoint): the request carries no payload, so the entry
     # anchors the REPLY type
     "stats": "dynamo_tpu.kv_router.protocols:ForwardPassMetrics",
+    # telemetry aggregator's cluster-state endpoint
+    # (components/telemetry_aggregator.py): payload-less request, entry
+    # anchors the REPLY type (the telemetry_dump state)
+    "status": "dynamo_tpu.runtime.telemetry:TelemetryDump",
 }

@@ -28,7 +28,7 @@ import uuid
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional
 
-from dynamo_tpu.runtime import tracing
+from dynamo_tpu.runtime import telemetry, tracing
 from dynamo_tpu.runtime.admission import LoadSnapshot, OverloadedError
 from dynamo_tpu.runtime.annotated import Annotated
 from dynamo_tpu.runtime.bus import MessageBusClient
@@ -129,6 +129,9 @@ class InstanceInfo:
     health: str = "healthy"
     ts: float = 0.0
     health_counters: Optional[dict] = None
+    # wall-clock registration time, stamped once at serve(): `llmctl worker
+    # list` renders uptime from it. 0.0 from pre-PR6 workers (tolerated).
+    started: float = 0.0
 
     def to_json(self) -> bytes:
         return json.dumps(self.__dict__).encode()
@@ -146,6 +149,7 @@ class InstanceInfo:
                 d.get("health_counters")
                 if isinstance(d.get("health_counters"), dict) else None
             ),
+            started=float(d.get("started") or 0.0),
         )
 
 
@@ -375,6 +379,7 @@ class Endpoint:
             instance_id=lease.lease_id,
             address=f"{rt.advertise_host}:{server.port}",
             worker_id=rt.worker_id,
+            started=time.time(),
         )
         keys = {self.instances_prefix + info.instance_id: info.to_json()}
         if model_entry is not None:
@@ -1339,11 +1344,22 @@ async def attach_kv_publishing(
             await asyncio.sleep(interval)
             try:
                 snap = engine.metrics_snapshot()
+                # cluster attribution: model name (engines that know it) or
+                # the component name; plus process uptime for dashboards
+                snap.setdefault(
+                    "model",
+                    getattr(engine, "model_name", None)
+                    or endpoint.component.name,
+                )
+                snap["uptime_s"] = round(telemetry.uptime_seconds(), 3)
                 if server is not None:
                     # overload observability rides the same metrics stream
                     snap["rpc_queue_depth"] = server.inflight_count
                     snap["shed_requests"] = server.admission.shed
                     snap["draining"] = int(server.draining)
+                    # request outcome counters for the cluster SLO engine
+                    snap["requests_total"] = server.requests_total
+                    snap["requests_errored"] = server.requests_errored
                     # health plane: state + stall/reap counters, so the KV
                     # scheduler and dashboards see zombies without a new
                     # subscription

@@ -14,11 +14,13 @@ Wire protocol (header JSON + body):
                  {id, op:"stop"|"kill"}        (mid-stream cancellation)
                  {id, op:"ping"}               (liveness probe, ``__ping__``)
                  {id, op:"trace_dump", limit?, trace_id?}  (flight recorder)
+                 {id, op:"telemetry_dump"}     (SLO/perf state, llmctl slo)
   worker→client: {id, op:"item"}  body=one Annotated dict JSON
                  {id, op:"done"}
                  {id, op:"error", message, code?, retryable?}
                  {id, op:"pong", health, load} (probe reply)
                  {id, op:"trace_data", count}  body=JSON list of traces
+                 {id, op:"telemetry_data"}     body=JSON telemetry state
 
 ``traceparent`` (W3C wire form, runtime/tracing.py) threads the caller's
 trace context through so the worker's serve/engine spans join the same
@@ -205,6 +207,12 @@ class RpcServer:
         # its state rides every load snapshot and every pong
         self.health = None
         self.reaped_total = 0
+        # request outcome counters (telemetry plane): cumulative, two int
+        # increments per REQUEST — never per token. The cluster SLO engine
+        # diffs them for the error-rate objective; `cancelled` is excluded
+        # from errors (client hangups are not service failures).
+        self.requests_total = 0
+        self.requests_errored = 0
 
     def engines(self) -> list:
         """Registered engines (the health monitor sweeps these for
@@ -289,6 +297,10 @@ class RpcServer:
                                 b""))
                         continue
                     if self._draining:
+                        # shed replies never reach _serve_request: count
+                        # them here or the overload-share SLO divides by a
+                        # total that excludes exactly the shed traffic
+                        self.requests_total += 1
                         _record_shed_span(h, "draining")
                         async with write_lock:
                             await write_frame(writer, TwoPartMessage(
@@ -301,6 +313,7 @@ class RpcServer:
                         continue
                     shed = self.admission.try_admit(len(self._inflight))
                     if shed is not None:
+                        self.requests_total += 1  # see draining note above
                         # bounded degradation: answer NOW with a typed,
                         # retryable rejection + back-off hint instead of
                         # queueing the request toward a timeout. The gate's
@@ -347,6 +360,12 @@ class RpcServer:
                 elif op == "trace_dump":
                     t = asyncio.create_task(
                         self._trace_dump(h, writer, write_lock)
+                    )
+                    conn_tasks.add(t)
+                    t.add_done_callback(conn_tasks.discard)
+                elif op == "telemetry_dump":
+                    t = asyncio.create_task(
+                        self._telemetry_dump(h, writer, write_lock)
                     )
                     conn_tasks.add(t)
                     t.add_done_callback(conn_tasks.discard)
@@ -403,6 +422,26 @@ class RpcServer:
             pass  # requester gone
         except Exception:
             logger.exception("trace_dump failed")
+
+    async def _telemetry_dump(self, h, writer, write_lock) -> None:
+        """Answer a ``telemetry_dump`` with this process's telemetry state
+        (uptime, build identity, SLO report, and — in an aggregator
+        process — the cluster rollup). Pure local-memory read like
+        ``trace_dump``: safe while the engine is wedged, which is exactly
+        when an operator runs ``llmctl slo status``."""
+        try:
+            from dynamo_tpu.runtime import telemetry
+
+            body = json.dumps(telemetry.dump_state()).encode()
+            header = {"id": h.get("id"), "op": "telemetry_data"}
+            async with write_lock:
+                await write_frame(
+                    writer, TwoPartMessage(json.dumps(header).encode(), body)
+                )
+        except (ConnectionError, OSError):
+            pass  # requester gone
+        except Exception:
+            logger.exception("telemetry_dump failed")
 
     async def reap_expired(self, grace: float) -> int:
         """Abort in-flight requests whose deadline expired more than
@@ -581,6 +620,9 @@ class RpcServer:
                 # whatever the serve path had reached
                 span.set_attribute("items", n_items)
                 span.end("reaped" if track.reaped else outcome)
+            self.requests_total += 1
+            if (track.reaped or outcome not in ("ok", "cancelled")):
+                self.requests_errored += 1
             contexts.pop(req_id, None)
             self.send_queue_peak = max(self.send_queue_peak, sender.peak)
             await sender.close()
@@ -699,6 +741,8 @@ class RpcClient:
                                      "load": load})
                 elif op == "trace_data":
                     item = ("trace_data", frame.body)
+                elif op == "telemetry_data":
+                    item = ("telemetry_data", frame.body)
                 elif op == "error":
                     item = ("error", {
                         "message": h.get("message", "remote error"),
@@ -811,6 +855,30 @@ class RpcClient:
                     f"trace_dump failed: {info.get('message', kind)}"
                 )
             return json.loads(data) if data else []
+        finally:
+            self._streams.pop(req_id, None)
+
+    async def telemetry_dump(self, timeout: float = 5.0) -> dict:
+        """Fetch the worker's telemetry state (``llmctl slo status`` /
+        ``llmctl cluster status``)."""
+        req_id = next(self._ids)
+        q: asyncio.Queue = asyncio.Queue(maxsize=1)
+        self._streams[req_id] = q
+        try:
+            await self._send({"id": req_id, "op": "telemetry_dump"})
+            try:
+                kind, data = await asyncio.wait_for(q.get(), timeout)
+            except asyncio.TimeoutError:
+                raise WorkerStalled(
+                    f"no telemetry_data from {self.host}:{self.port} within "
+                    f"{timeout:.1f}s"
+                ) from None
+            if kind != "telemetry_data":
+                info = data if isinstance(data, dict) else {}
+                raise ConnectionError(
+                    f"telemetry_dump failed: {info.get('message', kind)}"
+                )
+            return json.loads(data) if data else {}
         finally:
             self._streams.pop(req_id, None)
 

@@ -268,15 +268,27 @@ class FlightRecorder:
                 self.dropped += 1
 
     def traces(
-        self, limit: int = 0, trace_id: Optional[str] = None
+        self,
+        limit: int = 0,
+        trace_id: Optional[str] = None,
+        errored: bool = False,
     ) -> List[dict]:
         """Most-recent-last list of trace entries (copies). ``trace_id``
-        filters to one trace; ``limit`` keeps only the newest N."""
+        filters to one trace; ``limit`` keeps only the newest N;
+        ``errored`` keeps only traces containing a non-``ok`` span (the
+        ``GET /debug/traces?errored=1`` filter — slow-but-successful pinned
+        traces are deliberately NOT matched)."""
         with self._lock:
             if trace_id is not None:
                 entry = self._pinned.get(trace_id) or self._ring.get(trace_id)
                 return [json.loads(json.dumps(entry))] if entry else []
             out = list(self._ring.values()) + list(self._pinned.values())
+        if errored:
+            out = [
+                e for e in out
+                if any(s.get("status", STATUS_OK) != STATUS_OK
+                       for s in e["spans"])
+            ]
         out.sort(key=lambda e: min(
             (s.get("start", 0.0) for s in e["spans"]), default=0.0
         ))
@@ -284,11 +296,17 @@ class FlightRecorder:
             out = out[-limit:]
         return json.loads(json.dumps(out))
 
-    def dump_jsonl(self, limit: int = 0, trace_id: Optional[str] = None) -> str:
+    def dump_jsonl(
+        self,
+        limit: int = 0,
+        trace_id: Optional[str] = None,
+        errored: bool = False,
+    ) -> str:
         """One JSON object per line per trace — the export format of the
         debug endpoint and ``llmctl trace dump``."""
         return "\n".join(
-            json.dumps(t, sort_keys=True) for t in self.traces(limit, trace_id)
+            json.dumps(t, sort_keys=True)
+            for t in self.traces(limit, trace_id, errored=errored)
         )
 
     def __len__(self) -> int:
@@ -377,10 +395,15 @@ def render_phase_metrics() -> str:
 
 
 def phase_summary() -> Dict[str, dict]:
-    """Compact per-phase stats {count, sum_s, p50_ms, p95_ms, p99_ms} —
-    published on the worker metrics stream (``attach_kv_publishing``) and
-    recorded by ``bench.py``. Quantiles are bucket-interpolated (the usual
-    Prometheus histogram_quantile estimate)."""
+    """Compact per-phase stats {count, sum_s, p50_ms, p95_ms, p99_ms,
+    buckets} — published on the worker metrics stream
+    (``attach_kv_publishing``) and recorded by ``bench.py``. Quantiles are
+    bucket-interpolated (the usual Prometheus histogram_quantile estimate).
+    ``buckets`` is the raw cumulative bucket-count vector (aligned with
+    :data:`PHASE_BUCKETS` + Inf): the cluster telemetry aggregator
+    (``components/telemetry_aggregator.py``) diffs successive snapshots to
+    rebuild true windowed distributions — quantiles alone can't be merged
+    across workers or windows."""
     hist = _phase_hist()
     out: Dict[str, dict] = {}
     for labels, (counts, total, sum_) in hist.snapshot().items():
@@ -393,6 +416,7 @@ def phase_summary() -> Dict[str, dict]:
             "p50_ms": _bucket_quantile(hist.buckets, counts, total, 0.50),
             "p95_ms": _bucket_quantile(hist.buckets, counts, total, 0.95),
             "p99_ms": _bucket_quantile(hist.buckets, counts, total, 0.99),
+            "buckets": list(counts),
         }
     return out
 

@@ -1,0 +1,290 @@
+"""Prometheus text-exposition validation (ISSUE-6 satellite).
+
+A minimal parser for the Prometheus text format, run against the FULL
+``/metrics`` output of the frontend (ServiceMetrics + phase histograms +
+process identity), the worker-metrics aggregator (components/metrics.py),
+and the cluster telemetry aggregator — so a future metric addition that
+ships malformed exposition (bad name, missing HELP/TYPE, broken label
+escaping, duplicate family) fails tier-1 instead of a production scrape.
+
+The dynlint ``metric-name-valid`` rule checks *registration sites*
+statically; this checks what actually renders, catching hand-built
+exposition lines (f-string renderers) the AST rule can't see.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+import pytest
+
+from dynamo_tpu.components.metrics import MetricsAggregator
+from dynamo_tpu.components.telemetry_aggregator import ClusterTelemetry
+from dynamo_tpu.components.mock_worker import MockWorkerStats
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.llm.http.metrics import ServiceMetrics
+from dynamo_tpu.runtime import telemetry, tracing
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# one label: name="value" with \\, \", \n escapes allowed in the value
+_LABEL_RE = re.compile(
+    r'\s*([a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*"((?:[^"\\\n]|\\.)*)"\s*(,|$)'
+)
+_VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+
+
+class PromParseError(AssertionError):
+    pass
+
+
+def parse_prometheus_text(text: str) -> dict:
+    """Validate + parse an exposition. Returns {family: {"help", "type",
+    "samples": [(name, labels_dict, value)]}}. Raises PromParseError with
+    the offending line on any violation:
+
+    - sample/metadata line syntax and metric-name grammar
+    - label name grammar + quoted, escaped label values
+    - HELP and TYPE present (and non-empty HELP) for every sampled family
+    - at most one HELP/TYPE per family, TYPE from the known set
+    - sample names must match their family (modulo _bucket/_sum/_count
+      for histograms and summaries)
+    """
+    families: dict = {}
+
+    def fam(name: str) -> dict:
+        return families.setdefault(
+            name, {"help": None, "type": None, "samples": []}
+        )
+
+    def base_name(sample_name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count", "_total"):
+            base = sample_name[: -len(suffix)] if sample_name.endswith(suffix) else None
+            if base and base in families and families[base]["type"] in (
+                "histogram", "summary", "counter"
+            ):
+                return base
+        return sample_name
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name, _, help_text = rest.partition(" ")
+            if not _NAME_RE.match(name):
+                raise PromParseError(f"line {lineno}: bad HELP name {name!r}")
+            if not help_text.strip():
+                raise PromParseError(f"line {lineno}: empty HELP for {name}")
+            f = fam(name)
+            if f["help"] is not None:
+                raise PromParseError(f"line {lineno}: duplicate HELP for {name}")
+            f["help"] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):]
+            name, _, type_text = rest.partition(" ")
+            type_text = type_text.strip()
+            if not _NAME_RE.match(name):
+                raise PromParseError(f"line {lineno}: bad TYPE name {name!r}")
+            if type_text not in _VALID_TYPES:
+                raise PromParseError(
+                    f"line {lineno}: unknown TYPE {type_text!r} for {name}"
+                )
+            f = fam(name)
+            if f["type"] is not None:
+                raise PromParseError(f"line {lineno}: duplicate TYPE for {name}")
+            f["type"] = type_text
+            continue
+        if line.startswith("#"):
+            continue  # free-form comment
+        # sample line: name[{labels}] value
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)$", line)
+        if m is None:
+            raise PromParseError(f"line {lineno}: unparsable sample {line!r}")
+        name, label_blob, value_text = m.group(1), m.group(2), m.group(3)
+        labels = {}
+        if label_blob:
+            inner = label_blob[1:-1]
+            pos = 0
+            while pos < len(inner):
+                lm = _LABEL_RE.match(inner, pos)
+                if lm is None:
+                    raise PromParseError(
+                        f"line {lineno}: bad label syntax at {inner[pos:]!r}"
+                    )
+                key = lm.group(1)
+                if not _LABEL_NAME_RE.match(key):
+                    raise PromParseError(f"line {lineno}: bad label name {key!r}")
+                if key in labels:
+                    raise PromParseError(f"line {lineno}: duplicate label {key!r}")
+                labels[key] = lm.group(2)
+                pos = lm.end()
+        try:
+            value = float(value_text)
+        except ValueError:
+            if value_text not in ("+Inf", "-Inf", "NaN"):
+                raise PromParseError(
+                    f"line {lineno}: bad value {value_text!r}"
+                ) from None
+            value = math.inf if value_text == "+Inf" else math.nan
+        fam(base_name(name))["samples"].append((name, labels, value))
+
+    # every family that rendered samples or metadata must be fully declared
+    for name, f in families.items():
+        if f["help"] is None:
+            raise PromParseError(f"family {name}: missing HELP")
+        if f["type"] is None:
+            raise PromParseError(f"family {name}: missing TYPE")
+    return families
+
+
+# -- parser self-tests (it must actually reject malformed input) -------------
+
+
+class TestParserRejectsMalformed:
+    @pytest.mark.parametrize("bad", [
+        "# HELP ok help\n# TYPE ok gauge\nok{unclosed 1",
+        "# HELP ok help\n# TYPE ok gauge\nok{a=unquoted} 1",
+        "# HELP ok help\n# TYPE ok gauge\nok notanumber",
+        "# HELP ok help\n# TYPE ok gauge\nok 1\n# HELP ok again\nok 2",
+        "# HELP 0bad help\n# TYPE 0bad gauge\n",
+        "# HELP ok  \n# TYPE ok gauge\nok 1",       # empty HELP
+        "# HELP ok h\n# TYPE ok wat\nok 1",          # unknown TYPE
+        "ok 1",                                       # no metadata at all
+        '# HELP ok h\n# TYPE ok gauge\nok{a="1",a="2"} 1',  # dup label
+    ])
+    def test_rejects(self, bad):
+        with pytest.raises(PromParseError):
+            parse_prometheus_text(bad)
+
+    def test_accepts_escapes_and_inf(self):
+        text = (
+            "# HELP h histogram\n# TYPE h histogram\n"
+            'h_bucket{le="+Inf",m="a\\"b\\\\c\\nd"} 3\n'
+            "h_sum 1.5\nh_count 3\n"
+        )
+        fams = parse_prometheus_text(text)
+        (name, labels, value) = fams["h"]["samples"][0]
+        assert labels["m"] == 'a\\"b\\\\c\\nd'
+        assert value == math.inf or value == 3  # bucket count value
+
+
+# -- full expositions --------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _fresh_planes():
+    tracing.configure()
+    telemetry.configure()
+    yield
+    tracing.configure()
+    telemetry.configure()
+
+
+def _exercised_frontend() -> ServiceMetrics:
+    m = ServiceMetrics()
+    # nasty label values: quotes, backslashes, newlines must all escape
+    for model in ("llama-8b", 'we"ird\\mo\ndel'):
+        with m.inflight_guard(model, "chat/completions", "stream") as g:
+            g.mark_chunk()
+            g.mark_chunk()
+            g.count_tokens(5)
+            g.mark_ok()
+        with m.inflight_guard(model, "completions", "unary") as g:
+            g.mark_shed()
+    tracing.observe_phase("ttft", 0.2)
+    tracing.observe_phase("decode", 1.2)
+    return m
+
+def test_frontend_metrics_exposition_valid():
+    fams = parse_prometheus_text(_exercised_frontend().render())
+    for family in (
+        "dynamo_frontend_requests_total",
+        "dynamo_frontend_inflight_requests",
+        "dynamo_frontend_request_duration_seconds",
+        "dynamo_frontend_time_to_first_token_seconds",
+        "dynamo_frontend_inter_token_latency_seconds",
+        "dynamo_frontend_overloaded_total",
+        "dynamo_phase_latency_seconds",
+        "dynamo_uptime_seconds",
+        "dynamo_build_info",
+    ):
+        assert family in fams, f"missing family {family}"
+        assert fams[family]["samples"], f"no samples for {family}"
+    # histograms carry the full bucket/sum/count triplet
+    names = {n for (n, _, _) in fams["dynamo_phase_latency_seconds"]["samples"]}
+    assert names == {
+        "dynamo_phase_latency_seconds_bucket",
+        "dynamo_phase_latency_seconds_sum",
+        "dynamo_phase_latency_seconds_count",
+    }
+
+
+def test_worker_aggregator_exposition_valid():
+    agg = MetricsAggregator("name\\sp\"ace")
+    stats = MockWorkerStats(seed=1)
+    stats.tick(requests=12)
+    agg.update("w-1", ForwardPassMetrics.from_dict(stats.metrics("m1").to_dict()))
+    agg.update('w"2', ForwardPassMetrics(uptime_s=3.0))
+    agg.record_hit_rate("w-1", isl_blocks=8, overlap_blocks=4)
+    fams = parse_prometheus_text(agg.render())
+    for family in (
+        "dynamo_worker_request_active_slots",
+        "dynamo_worker_kv_total_blocks",
+        "dynamo_worker_health_state",
+        "dynamo_worker_decode_tokens_per_s",
+        "dynamo_worker_step_time_ms",
+        "dynamo_worker_batch_slot_util",
+        "dynamo_worker_jit_recompiles",
+        "dynamo_worker_kv_peak_occupancy_perc",
+        "dynamo_worker_requests_total",
+        "dynamo_worker_requests_errored",
+        "dynamo_worker_phase_latency_ms",
+        "dynamo_worker_uptime_seconds",
+        "dynamo_worker_up",
+        "dynamo_uptime_seconds",
+        "dynamo_build_info",
+    ):
+        assert family in fams, f"missing family {family}"
+
+
+def test_cluster_telemetry_exposition_valid():
+    ct = ClusterTelemetry(
+        "ns", policy=telemetry.TelemetryPolicy(
+            fast_window=10, mid_window=20, slow_window=40,
+        ),
+    )
+    stats = MockWorkerStats(seed=2)
+    stats.tick(requests=12)
+    ct.ingest("w1", ForwardPassMetrics.from_dict(stats.metrics("m1").to_dict()))
+    fams = parse_prometheus_text(ct.render_prometheus())
+    for family in (
+        "dynamo_cluster_workers",
+        "dynamo_cluster_headroom_frac",
+        "dynamo_cluster_slo_compliance",
+        "dynamo_cluster_slo_burn_rate",
+        "dynamo_cluster_slo_alert",
+    ):
+        assert family in fams, f"missing family {family}"
+
+
+def test_frontend_with_cluster_section_still_valid():
+    """A co-hosted aggregator's cluster section rides the frontend
+    exposition without breaking it (or duplicating families)."""
+    ct = ClusterTelemetry(
+        "ns", policy=telemetry.TelemetryPolicy(
+            fast_window=10, mid_window=20, slow_window=40,
+        ),
+    )
+    stats = MockWorkerStats(seed=3)
+    stats.tick()
+    ct.ingest("w1", ForwardPassMetrics.from_dict(stats.metrics("m1").to_dict()))
+    telemetry.set_cluster(ct)
+    try:
+        fams = parse_prometheus_text(_exercised_frontend().render())
+    finally:
+        telemetry.set_cluster(None)
+    assert "dynamo_cluster_workers" in fams
+    assert "dynamo_frontend_requests_total" in fams

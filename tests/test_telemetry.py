@@ -1,0 +1,952 @@
+"""Cluster telemetry plane (runtime/telemetry.py + telemetry aggregator).
+
+Covers the ISSUE-6 acceptance surface: the bounded ring time-series store
+(counter/gauge/histogram windowed queries, ring aging), the SLO engine's
+multi-window burn-rate state machine under an injected clock, cumulative-
+snapshot differencing in the cluster aggregator, the ``telemetry_dump``
+RPC verb, the end-to-end mock-3-worker regression→alert→recovery
+lifecycle across ``GET /debug/slo`` and ``llmctl slo status``, and the
+overhead guard: ``DYN_TPU_SLO=0`` ⇒ zero telemetry work on the engine
+step loop and the RPC hot path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.components.mock_worker import MockWorkerStats
+from dynamo_tpu.components.telemetry_aggregator import (
+    ClusterTelemetry,
+    _decumulate,
+)
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.runtime import telemetry
+from dynamo_tpu.runtime.telemetry import (
+    COUNTER,
+    GAUGE,
+    HISTOGRAM,
+    MetricStore,
+    Slo,
+    SloEngine,
+    TelemetryPolicy,
+    TimeSeries,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry(monkeypatch):
+    """Every test gets an enabled, empty global store; env knobs reset."""
+    for var in ("DYN_TPU_SLO", "DYN_TPU_SLO_FAST_S", "DYN_TPU_SLO_MID_S",
+                "DYN_TPU_SLO_SLOW_S", "DYN_TPU_SLO_BURN_FAST",
+                "DYN_TPU_SLO_BURN_SLOW", "DYN_TPU_SLO_TTFT_MS",
+                "DYN_TPU_SLO_ITL_MS"):
+        monkeypatch.delenv(var, raising=False)
+    telemetry.configure()
+    yield
+    telemetry.configure()
+
+
+class _Clock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- policy env clamping (PR3-style) ----------------------------------------
+
+
+class TestPolicyClamping:
+    def test_defaults(self):
+        p = TelemetryPolicy.from_env()
+        assert p.enabled is True
+        assert p.fast_window == 300.0
+        assert p.mid_window == 3600.0
+        assert p.slow_window == 21600.0
+        assert p.burn_fast == 14.4
+        assert p.burn_slow == 6.0
+
+    _ATTR = {
+        "DYN_TPU_SLO_FAST_S": "fast_window",
+        "DYN_TPU_SLO_MID_S": "mid_window",
+        "DYN_TPU_SLO_SLOW_S": "slow_window",
+        "DYN_TPU_SLO_BURN_FAST": "burn_fast",
+        "DYN_TPU_SLO_TTFT_MS": "ttft_target_ms",
+    }
+
+    @pytest.mark.parametrize("var,bad", [
+        ("DYN_TPU_SLO_FAST_S", "banana"),
+        ("DYN_TPU_SLO_FAST_S", "0"),
+        ("DYN_TPU_SLO_MID_S", "-4"),
+        ("DYN_TPU_SLO_SLOW_S", "x"),
+        ("DYN_TPU_SLO_BURN_FAST", "-1"),
+        ("DYN_TPU_SLO_TTFT_MS", "nope"),
+    ])
+    def test_bad_values_clamp_to_defaults(self, monkeypatch, var, bad):
+        monkeypatch.setenv(var, bad)
+        p = TelemetryPolicy.from_env()
+        assert getattr(p, self._ATTR[var]) == getattr(
+            TelemetryPolicy(), self._ATTR[var]
+        )
+
+    def test_windows_forced_to_nest(self):
+        # a mid window shorter than fast cannot confirm the fast signal
+        p = TelemetryPolicy(fast_window=100.0, mid_window=5.0, slow_window=1.0)
+        assert p.mid_window >= p.fast_window
+        assert p.slow_window >= p.mid_window
+
+    @pytest.mark.parametrize("val,want", [
+        ("0", False), ("false", False), ("off", False),
+        ("1", True), ("true", True),
+    ])
+    def test_enable_flag(self, monkeypatch, val, want):
+        monkeypatch.setenv("DYN_TPU_SLO", val)
+        assert TelemetryPolicy.from_env().enabled is want
+
+
+# -- ring time series --------------------------------------------------------
+
+
+class TestTimeSeries:
+    def test_counter_window_sum_and_aging(self):
+        clk = _Clock()
+        s = TimeSeries("c", COUNTER, interval=1.0, capacity=20, clock=clk)
+        for _ in range(5):
+            s.inc(2.0)
+            clk.advance(1.0)
+        assert s.window_sum(10.0) == 10.0
+        assert s.window_rate(10.0) == pytest.approx(1.0)
+        clk.advance(20.0)  # everything ages out of any window ≤ 20s
+        assert s.window_sum(10.0) == 0.0
+
+    def test_counter_ring_lap_reclaims_slots(self):
+        clk = _Clock()
+        s = TimeSeries("c", COUNTER, interval=1.0, capacity=4, clock=clk)
+        for _ in range(10):  # laps the 4-slot ring twice
+            s.inc(1.0)
+            clk.advance(1.0)
+        # only the slots still covered by live epochs count
+        assert s.window_sum(100.0) <= 4.0
+
+    def test_gauge_avg_and_last(self):
+        clk = _Clock()
+        s = TimeSeries("g", GAUGE, interval=1.0, capacity=20, clock=clk)
+        for v in (1.0, 0.0, 1.0, 1.0):
+            s.set(v)
+            clk.advance(1.0)
+        assert s.window_avg(10.0) == pytest.approx(0.75)
+        assert s.last() == 1.0
+        assert s.window_count(10.0) == 4
+
+    def test_histogram_percentile_and_fraction(self):
+        clk = _Clock()
+        s = TimeSeries("h", HISTOGRAM, interval=1.0, capacity=20,
+                       bounds=(10.0, 100.0, 1000.0), clock=clk)
+        for v in [5.0] * 90 + [500.0] * 10:
+            s.observe(v)
+        # 90% of mass ≤ 10 → p50 interpolates inside the first bucket
+        assert s.window_percentile(0.50, 10.0) <= 10.0
+        assert s.window_percentile(0.95, 10.0) > 100.0
+        assert s.window_fraction_le(10.0, 10.0) == pytest.approx(0.9)
+        assert s.window_fraction_le(1000.0, 10.0) == pytest.approx(1.0)
+
+    def test_histogram_empty_returns_none(self):
+        s = TimeSeries("h", HISTOGRAM, 1.0, 10, bounds=(1.0,))
+        assert s.window_percentile(0.95, 5.0) is None
+        assert s.window_fraction_le(1.0, 5.0) is None
+
+    def test_observe_bucketed_length_mismatch_rejected(self):
+        s = TimeSeries("h", HISTOGRAM, 1.0, 10, bounds=(1.0, 2.0))
+        with pytest.raises(ValueError):
+            s.observe_bucketed([1, 2])  # bounds are (1, 2, inf) = 3 slots
+
+    def test_kind_mismatch_raises(self):
+        s = TimeSeries("c", COUNTER, 1.0, 10)
+        with pytest.raises(TypeError):
+            s.window_percentile(0.5, 5.0)
+
+
+# -- SLO engine state machine ------------------------------------------------
+
+
+def _slo_setup(clk, **pol_kw):
+    pol = TelemetryPolicy(
+        fast_window=10.0, mid_window=20.0, slow_window=40.0,
+        burn_fast=5.0, burn_slow=2.0, ttft_target_ms=100.0, **pol_kw
+    )
+    store = telemetry.declare_standard_series(MetricStore(pol, clock=clk))
+    store.declare("ttft_ms", HISTOGRAM, bounds=(50.0, 100.0, 1000.0, 10000.0))
+    engine = SloEngine(store, pol, clock=clk)
+    return pol, store, engine
+
+
+class TestSloEngine:
+    def _feed(self, store, clk, ms, n=10, seconds=1.0, model="m"):
+        steps = max(int(seconds), 1)
+        for _ in range(steps):
+            for _ in range(n):
+                store.series("ttft_ms", model=model).observe(ms)
+            clk.advance(1.0)
+
+    def _ttft_status(self, engine):
+        return next(s for s in engine.evaluate() if s.slo == "ttft_p95")
+
+    def test_no_traffic_is_compliant(self):
+        clk = _Clock()
+        _, _, engine = _slo_setup(clk)
+        for s in engine.evaluate():
+            assert s.state == "ok"
+            assert s.compliant
+
+    def test_healthy_traffic_ok(self):
+        clk = _Clock()
+        _, store, engine = _slo_setup(clk)
+        self._feed(store, clk, ms=20.0, seconds=10)
+        st = self._ttft_status(engine)
+        assert st.state == "ok" and st.compliant
+        assert st.burn_fast == 0.0
+
+    def test_regression_pages_within_fast_window(self):
+        clk = _Clock()
+        _, store, engine = _slo_setup(clk)
+        self._feed(store, clk, ms=20.0, seconds=10)  # healthy history
+        self._feed(store, clk, ms=5000.0, seconds=10)  # cliff
+        st = self._ttft_status(engine)
+        assert st.state == "alert"
+        assert st.burn_fast >= 5.0
+
+    def test_ticket_without_page_for_slow_trickle(self):
+        clk = _Clock()
+        _, store, engine = _slo_setup(clk)
+        # 15% bad forever: burn = 3 — above ticket (2), below page (5)
+        for _ in range(40):
+            for _ in range(17):
+                store.series("ttft_ms", model="m").observe(20.0)
+            for _ in range(3):
+                store.series("ttft_ms", model="m").observe(5000.0)
+            clk.advance(1.0)
+        st = self._ttft_status(engine)
+        assert st.state == "burning"
+        assert not st.compliant
+
+    def test_recovery_clears_after_slow_window(self):
+        clk = _Clock()
+        _, store, engine = _slo_setup(clk)
+        self._feed(store, clk, ms=5000.0, seconds=10)
+        assert self._ttft_status(engine).state == "alert"
+        # recovery: healthy traffic. Page clears once fast+mid drain;
+        # the ticket ("burning") persists until the SLOW window drains.
+        self._feed(store, clk, ms=20.0, seconds=25)
+        mid_state = self._ttft_status(engine)
+        assert mid_state.state == "burning"
+        self._feed(store, clk, ms=20.0, seconds=20)  # past the slow window
+        assert self._ttft_status(engine).state == "ok"
+
+    def test_ratio_mode_error_rate(self):
+        clk = _Clock()
+        pol, store, engine = _slo_setup(clk)
+        for _ in range(10):
+            store.series("requests_total", model="m").inc(100)
+            store.series("requests_errored", model="m").inc(5)  # 5% errors
+            clk.advance(1.0)
+        st = next(s for s in engine.evaluate() if s.slo == "error_rate")
+        # 5% bad on a 0.1% budget = 50x burn: page
+        assert st.state == "alert"
+
+    def test_availability_mode(self):
+        clk = _Clock()
+        pol, store, engine = _slo_setup(clk)
+        for _ in range(10):
+            store.series("worker_available", model="m").set(1.0)
+            store.series("worker_available", model="m").set(0.0)
+            clk.advance(1.0)
+        st = next(s for s in engine.evaluate() if s.slo == "availability")
+        assert st.ratio_fast == pytest.approx(0.5)
+        assert st.state == "alert"  # 50% down vs a 1% budget
+
+    def test_per_model_isolation(self):
+        clk = _Clock()
+        _, store, engine = _slo_setup(clk)
+        self._feed(store, clk, ms=20.0, seconds=10, model="good")
+        self._feed(store, clk, ms=5000.0, seconds=10, model="bad")
+        by_model = {
+            s.labels.get("model"): s
+            for s in engine.evaluate() if s.slo == "ttft_p95"
+        }
+        assert by_model["bad"].state == "alert"
+        assert by_model["good"].state == "ok"
+
+
+# -- cluster aggregator ingest ----------------------------------------------
+
+
+class TestClusterIngest:
+    def test_decumulate(self):
+        assert _decumulate([2, 5, 5, 9]) == [2, 3, 0, 4]
+
+    def _metrics(self, stats: MockWorkerStats, model="m1"):
+        # round-trip through the wire form like the bus would
+        return ForwardPassMetrics.from_dict(stats.metrics(model).to_dict())
+
+    def test_first_sight_is_baseline_only(self):
+        """A fresh aggregator meeting a worker with hours of history must
+        NOT dump that history into the current ring bucket — it was lived
+        (and possibly already counted) long ago, and concentrated at "now"
+        it would fire a false page."""
+        clk = _Clock()
+        pol = TelemetryPolicy(fast_window=10, mid_window=20, slow_window=40)
+        ct = ClusterTelemetry("ns", policy=pol, clock=clk)
+        veteran = MockWorkerStats(seed=1, ttft_ms=50000.0)  # awful history
+        veteran.tick(requests=500)
+        ct.ingest("w1", self._metrics(veteran))
+        assert ct.store.series("ttft_ms", model="m1").window_count(40.0) == 0
+        assert ct.store.series("requests_total", model="m1").window_sum(40.0) == 0
+        st = next(
+            s for s in ct.slo_report()
+            if s["slo"] == "ttft_p95" and s["labels"].get("model") == "m1"
+        )
+        assert st["state"] == "ok"
+
+    def test_bucket_deltas_not_recounted(self):
+        clk = _Clock()
+        pol = TelemetryPolicy(fast_window=10, mid_window=20, slow_window=40)
+        ct = ClusterTelemetry("ns", policy=pol, clock=clk)
+        stats = MockWorkerStats(seed=1, ttft_ms=50.0)
+        stats.tick(requests=10)
+        ct.ingest("w1", self._metrics(stats))  # baseline
+        clk.advance(1.0)
+        stats.tick(requests=7)
+        ct.ingest("w1", self._metrics(stats))  # delta: 7 new requests
+        clk.advance(1.0)
+        # third publish with NO new samples: cumulative snapshot unchanged
+        ct.ingest("w1", self._metrics(stats))
+        series = ct.store.series("ttft_ms", model="m1")
+        assert series.window_count(40.0) == 7  # delta only, never recounted
+
+    def test_counter_reset_tolerated(self):
+        clk = _Clock()
+        ct = ClusterTelemetry(
+            "ns",
+            policy=TelemetryPolicy(fast_window=10, mid_window=20, slow_window=40),
+            clock=clk,
+        )
+        stats = MockWorkerStats(seed=1)
+        stats.tick(requests=10)
+        ct.ingest("w1", self._metrics(stats))  # baseline
+        clk.advance(1.0)
+        stats.tick(requests=4)
+        ct.ingest("w1", self._metrics(stats))  # delta: 4
+        clk.advance(1.0)
+        # worker restarted: fresh cumulative counters, smaller than before —
+        # the fresh process's counts are genuinely new events
+        fresh = MockWorkerStats(seed=2)
+        fresh.tick(requests=3)
+        ct.ingest("w1", self._metrics(fresh))
+        total = ct.store.series("requests_total", model="m1").window_sum(40.0)
+        assert total == 7  # 4 (delta) + 3 (post-restart), never negative
+
+    def test_quiet_worker_keeps_baselines_past_expiry(self):
+        """A worker silent past the rollup expiry drops out of capacity
+        rollups but keeps its diff baselines: its next publish must count
+        only the delta, not re-ingest (or skip) its whole history."""
+        clk = _Clock()
+        ct = ClusterTelemetry(
+            "ns",
+            policy=TelemetryPolicy(fast_window=10, mid_window=20, slow_window=40),
+            expiry=5.0, clock=clk,
+        )
+        stats = MockWorkerStats(seed=1, ttft_ms=50.0)
+        stats.tick(requests=10)
+        ct.ingest("w1", self._metrics(stats))  # baseline
+        clk.advance(8.0)  # past expiry, inside the baseline-drop horizon
+        assert ct.rollup()["workers"] == 0  # rollup prune ran
+        stats.tick(requests=6)
+        ct.ingest("w1", self._metrics(stats))
+        series = ct.store.series("ttft_ms", model="m1")
+        assert series.window_count(40.0) == 6  # delta, not 16 and not 0
+
+    def test_rollup_capacity_and_worst_worker(self):
+        clk = _Clock()
+        ct = ClusterTelemetry(
+            "ns",
+            policy=TelemetryPolicy(fast_window=10, mid_window=20, slow_window=40),
+            clock=clk,
+        )
+        busy = ForwardPassMetrics(
+            request_active_slots=8, request_total_slots=8,
+            kv_active_blocks=900, kv_total_blocks=1000, model="m1",
+        )
+        idle = ForwardPassMetrics(
+            request_active_slots=0, request_total_slots=8,
+            kv_active_blocks=0, kv_total_blocks=1000, model="m1",
+        )
+        ct.ingest("busy", busy)
+        ct.ingest("idle", idle)
+        roll = ct.rollup()
+        assert roll["workers"] == 2
+        m = roll["models"]["m1"]
+        assert m["slots_total"] == 16 and m["slots_free"] == 8
+        assert m["kv_blocks_total"] == 2000 and m["kv_blocks_free"] == 1100
+        assert roll["worst_worker"]["worker_id"] == "busy"
+
+    def test_expiry_drops_dead_workers(self):
+        clk = _Clock()
+        ct = ClusterTelemetry(
+            "ns",
+            policy=TelemetryPolicy(fast_window=10, mid_window=20, slow_window=40),
+            expiry=5.0, clock=clk,
+        )
+        ct.ingest("w1", ForwardPassMetrics(model="m1"))
+        clk.advance(10.0)
+        assert ct.rollup()["workers"] == 0
+
+    def test_render_prometheus_names(self):
+        clk = _Clock()
+        ct = ClusterTelemetry(
+            "ns",
+            policy=TelemetryPolicy(fast_window=10, mid_window=20, slow_window=40),
+            clock=clk,
+        )
+        stats = MockWorkerStats(seed=1)
+        stats.tick()
+        ct.ingest("w1", self._metrics(stats))
+        text = ct.render_prometheus()
+        for frag in (
+            'dynamo_cluster_workers{namespace="ns"} 1',
+            "dynamo_cluster_headroom_frac",
+            "dynamo_cluster_slo_compliance",
+            "dynamo_cluster_slo_burn_rate",
+            "dynamo_cluster_slo_alert",
+        ):
+            assert frag in text
+
+
+# -- telemetry_dump RPC verb -------------------------------------------------
+
+
+class TestTelemetryDumpVerb:
+    def test_round_trip(self, run):
+        from dynamo_tpu.runtime.rpc import RpcClient, RpcServer
+
+        async def go():
+            server = RpcServer(host="127.0.0.1", port=0)
+            await server.start()
+            try:
+                client = await RpcClient.connect(f"127.0.0.1:{server.port}")
+                try:
+                    dump = await client.telemetry_dump()
+                finally:
+                    await client.close()
+            finally:
+                await server.stop()
+            return dump
+
+        dump = run(go())
+        assert dump["enabled"] is True
+        assert dump["uptime_s"] > 0
+        assert set(dump["build"]) == {"version", "python", "jax"}
+        assert "slo" in dump
+
+    def test_request_counters_on_server(self, run):
+        from dynamo_tpu.runtime.annotated import Annotated
+        from dynamo_tpu.runtime.engine import AsyncEngine, Context
+        from dynamo_tpu.runtime.rpc import RpcClient, RpcServer
+
+        class _Engine(AsyncEngine):
+            async def generate(self, request: Context):
+                if (request.data or {}).get("boom"):
+                    raise RuntimeError("boom")
+                yield Annotated.from_data({"ok": True})
+
+        async def go():
+            server = RpcServer(host="127.0.0.1", port=0)
+            server.register("t.c.e", _Engine())
+            await server.start()
+            try:
+                client = await RpcClient.connect(f"127.0.0.1:{server.port}")
+                try:
+                    [i async for i in client.generate("t.c.e", {})]
+                    [i async for i in client.generate("t.c.e", {"boom": 1})]
+                finally:
+                    await client.close()
+            finally:
+                await server.stop()
+            return server.requests_total, server.requests_errored
+
+        total, errored = run(go())
+        assert total == 2
+        assert errored == 1
+
+    def test_shed_requests_count_toward_total(self, run):
+        """Shed replies never reach _serve_request; they must still count
+        in requests_total or the overload-share SLO divides shed traffic
+        by a total that excludes it (blind at 100% shed)."""
+        from dynamo_tpu.runtime.rpc import RpcClient, RpcServer
+
+        async def go():
+            server = RpcServer(host="127.0.0.1", port=0)
+            server.set_draining(True)  # every generate is shed, typed+retryable
+            await server.start()
+            try:
+                client = await RpcClient.connect(f"127.0.0.1:{server.port}")
+                try:
+                    items = [i async for i in client.generate("t.c.e", {})]
+                    assert items and items[0].is_error
+                finally:
+                    await client.close()
+            finally:
+                await server.stop()
+            return server.requests_total, server.requests_errored
+
+        total, errored = run(go())
+        assert total == 1
+        assert errored == 0  # a shed is not a service error
+
+
+# -- uptime / build info satellites ------------------------------------------
+
+
+class TestProcessInfo:
+    def test_render_process_info(self):
+        text = telemetry.render_process_info()
+        assert "dynamo_uptime_seconds " in text
+        assert "dynamo_build_info{" in text
+        assert 'python="' in text and 'version="' in text and 'jax="' in text
+
+    def test_frontend_metrics_include_identity(self):
+        from dynamo_tpu.llm.http.metrics import ServiceMetrics
+
+        text = ServiceMetrics().render()
+        assert "dynamo_uptime_seconds" in text
+        assert "dynamo_build_info" in text
+
+    def test_worker_aggregator_metrics_include_identity(self):
+        from dynamo_tpu.components.metrics import MetricsAggregator
+
+        agg = MetricsAggregator("ns")
+        agg.update("w1", ForwardPassMetrics(uptime_s=12.5))
+        text = agg.render()
+        assert 'dynamo_worker_uptime_seconds{namespace="ns",worker="w1"} 12.5' in text
+        assert "dynamo_uptime_seconds" in text
+        assert "dynamo_build_info" in text
+
+    def test_instance_info_started_round_trip(self):
+        from dynamo_tpu.runtime.distributed import InstanceInfo
+
+        info = InstanceInfo("i1", "127.0.0.1:1", "w1", started=123.5)
+        rt = InstanceInfo.from_json(info.to_json())
+        assert rt.started == 123.5
+        # pre-PR6 entries (no field) parse fine
+        d = json.loads(info.to_json())
+        del d["started"]
+        assert InstanceInfo.from_json(json.dumps(d).encode()).started == 0.0
+
+
+# -- engine perf accounting --------------------------------------------------
+
+
+class TestEnginePerf:
+    def test_perf_ema_from_mock_dispatches(self):
+        from dynamo_tpu.engine_jax.engine import _EnginePerf
+
+        perf = _EnginePerf()
+        perf.note_decode(0, 4)  # first call only anchors the clock
+        import time as _time
+
+        _time.sleep(0.01)
+        perf.note_decode(40, 4)
+        assert perf.decode_tps > 0
+        assert perf.step_time_ms > 0
+        perf.note_slots(2, 8)
+        assert perf.slot_util == pytest.approx(0.25)
+        perf.note_idle()
+        # first post-idle sample re-anchors instead of measuring the gap
+        tps = perf.decode_tps
+        perf.note_decode(40, 4)
+        assert perf.decode_tps == tps
+
+    def test_mock_worker_emits_perf_and_phases(self):
+        stats = MockWorkerStats(seed=3, ttft_ms=200.0)
+        stats.tick(requests=20)
+        m = stats.metrics("m1")
+        assert m.model == "m1"
+        assert m.decode_tokens_per_s > 0
+        assert m.batch_slot_util <= 1.0
+        assert m.uptime_s >= 0
+        pl = m.phase_latency
+        assert set(pl) == {"ttft", "inter_token"}
+        for phase in pl.values():
+            assert phase["count"] > 0
+            assert len(phase["buckets"]) > 0
+            # buckets are cumulative → monotone nondecreasing
+            assert all(
+                a <= b for a, b in zip(phase["buckets"], phase["buckets"][1:])
+            )
+            assert phase["buckets"][-1] == phase["count"]
+
+
+class TestJaxEnginePerfLive:
+    """Real tiny JAX engine: the perf gauges go live with sampling on and
+    the accumulator is absent (None-check only) with sampling off."""
+
+    @pytest.fixture(scope="class")
+    def tiny_parts(self):
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+
+        cfg = dataclasses.replace(LLAMA_PRESETS["tiny"], dtype=jnp.float32)
+        return cfg, init_params(jax.random.PRNGKey(0), cfg)
+
+    def _drive(self, engine, run, n_tokens=16):
+        from dynamo_tpu.llm.protocols.common import (
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+        from dynamo_tpu.runtime.engine import Context
+
+        async def go():
+            req = PreprocessedRequest(
+                token_ids=list(range(1, 10)),
+                stop_conditions=StopConditions(
+                    max_tokens=n_tokens, ignore_eos=True
+                ),
+                sampling_options=SamplingOptions(temperature=0.0),
+            )
+            toks = []
+            async for item in engine.generate(Context(req)):
+                if item.is_error:
+                    raise AssertionError(item.error_message())
+                toks.extend((item.data or {}).get("token_ids", []))
+            return toks
+
+        return run(go())
+
+    def test_perf_gauges_live_when_enabled(self, tiny_parts, run):
+        import jax.numpy as jnp
+
+        from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+
+        assert telemetry.enabled()
+        cfg, params = tiny_parts
+        engine = JaxServingEngine(
+            cfg, params,
+            EngineConfig(max_slots=2, kv_block_size=8, max_model_len=64,
+                         decode_steps=2),
+            cache_dtype=jnp.float32,
+        )
+        try:
+            assert engine._perf is not None
+            toks = self._drive(engine, run)
+            assert len(toks) == 16
+            snap = engine.metrics_snapshot()
+        finally:
+            engine.close()
+        assert snap["decode_tokens_per_s"] > 0
+        assert snap["step_time_ms"] > 0
+        assert 0 < snap["batch_slot_util"] <= 1.0
+        assert snap["jit_recompiles"] >= 1
+        assert 0 < snap["kv_peak_occupancy_perc"] <= 1.0
+
+    def test_engine_step_loop_free_when_disabled(
+        self, tiny_parts, run, monkeypatch
+    ):
+        """DYN_TPU_SLO=0: no _EnginePerf is built and the step loop makes
+        zero telemetry calls across a full request (the PR5 zero-alloc
+        pattern applied to the telemetry plane)."""
+        import jax.numpy as jnp
+
+        from dynamo_tpu.engine_jax import engine as engine_mod
+        from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+
+        monkeypatch.setenv("DYN_TPU_SLO", "0")
+        telemetry.configure()
+
+        calls = []
+        for meth in ("note_decode", "note_slots", "note_idle"):
+            monkeypatch.setattr(
+                engine_mod._EnginePerf, meth,
+                lambda self, *a, _m=meth: calls.append(_m),
+            )
+        series_built = []
+        orig_init = TimeSeries.__init__
+        monkeypatch.setattr(
+            TimeSeries, "__init__",
+            lambda self, *a, **kw: (
+                series_built.append(a[0] if a else kw.get("name")),
+                orig_init(self, *a, **kw),
+            )[-1],
+        )
+
+        cfg, params = tiny_parts
+        engine = JaxServingEngine(
+            cfg, params,
+            EngineConfig(max_slots=2, kv_block_size=8, max_model_len=64,
+                         decode_steps=2),
+            cache_dtype=jnp.float32,
+        )
+        try:
+            assert engine._perf is None
+            toks = self._drive(engine, run)
+            assert len(toks) == 16
+            snap = engine.metrics_snapshot()
+        finally:
+            engine.close()
+        assert calls == [], f"perf accounting ran while disabled: {calls}"
+        assert series_built == []
+        assert "decode_tokens_per_s" not in snap
+
+
+# -- overhead guard ----------------------------------------------------------
+
+
+class TestDisabledOverhead:
+    def test_zero_telemetry_work_when_disabled(self, monkeypatch, run):
+        """DYN_TPU_SLO=0: the RPC serve path and the HTTP guard build no
+        TimeSeries and record no samples (same pattern as the PR5
+        DYN_TPU_TRACE=0 guard)."""
+        monkeypatch.setenv("DYN_TPU_SLO", "0")
+        telemetry.configure()
+        assert not telemetry.enabled()
+
+        created = []
+        orig_init = TimeSeries.__init__
+
+        def counting_init(self, *a, **kw):
+            created.append(a[0] if a else kw.get("name"))
+            orig_init(self, *a, **kw)
+
+        monkeypatch.setattr(TimeSeries, "__init__", counting_init)
+
+        from dynamo_tpu.llm.http.metrics import ServiceMetrics
+        from dynamo_tpu.runtime.annotated import Annotated
+        from dynamo_tpu.runtime.engine import AsyncEngine, Context
+        from dynamo_tpu.runtime.rpc import RpcClient, RpcServer
+
+        class _Echo(AsyncEngine):
+            async def generate(self, request: Context):
+                for i in range(64):
+                    yield Annotated.from_data({"i": i})
+
+        async def go():
+            server = RpcServer(host="127.0.0.1", port=0)
+            server.register("t.c.e", _Echo())
+            await server.start()
+            try:
+                client = await RpcClient.connect(f"127.0.0.1:{server.port}")
+                try:
+                    items = [i async for i in client.generate("t.c.e", {})]
+                    assert len(items) == 64
+                finally:
+                    await client.close()
+            finally:
+                await server.stop()
+
+        run(go())
+        # the HTTP edge guard path too
+        metrics = ServiceMetrics()
+        with metrics.inflight_guard("m", "chat/completions", "stream") as g:
+            for _ in range(16):
+                g.mark_chunk()
+            g.mark_ok()
+        assert created == [], f"telemetry series built while disabled: {created}"
+
+    def test_engine_perf_gated_off(self, monkeypatch):
+        """A JaxServingEngine built under DYN_TPU_SLO=0 holds no perf
+        accumulator: the step loop pays one attribute None-check."""
+        monkeypatch.setenv("DYN_TPU_SLO", "0")
+        telemetry.configure()
+        from dynamo_tpu.engine_jax import engine as engine_mod
+
+        calls = []
+        monkeypatch.setattr(
+            engine_mod._EnginePerf, "note_decode",
+            lambda self, *a: calls.append(a),
+        )
+        # construction gate is all we need: without the object the step
+        # loop cannot call into it
+        assert telemetry.enabled() is False
+        perf = engine_mod._EnginePerf() if telemetry.enabled() else None
+        assert perf is None
+        assert calls == []
+
+    def test_sampling_helpers_noop_when_disabled(self, monkeypatch):
+        monkeypatch.setenv("DYN_TPU_SLO", "0")
+        telemetry.configure()
+        telemetry.observe_latency("ttft_ms", 5.0, model="m")
+        telemetry.count_request("error", model="m")
+        # dump still answers (identity only, no series)
+        dump = telemetry.dump_state()
+        assert dump["enabled"] is False
+        assert "series" not in dump
+
+
+# -- end-to-end: regression → alert → recovery --------------------------------
+
+
+class TestSloEndToEnd:
+    @pytest.mark.slow
+    def test_placeholder_slow_marker(self):
+        """Reserved for a longer soak; the tier-1 e2e below is the gate."""
+
+    def test_three_worker_regression_alert_and_recovery(
+        self, run, monkeypatch, capsys
+    ):
+        """The ISSUE-6 acceptance scenario, wall-clock-scaled via the env
+        knobs: 3 mock workers publish on a real bus; one regresses TTFT;
+        the aggregator pages the TTFT-p95 SLO within one fast window;
+        ``GET /debug/slo`` and ``llmctl slo status`` both name the model;
+        recovery clears the alert once the slow window drains."""
+        import aiohttp
+
+        from dynamo_tpu.components.telemetry_aggregator import (
+            run_telemetry_aggregator,
+        )
+        from dynamo_tpu.llm.http.service import HttpService, ModelManager
+        from dynamo_tpu.runtime.bus import MessageBusServer
+        from dynamo_tpu.runtime.distributed import (
+            KV_METRICS_SUBJECT,
+            DistributedRuntime,
+        )
+        from dynamo_tpu.runtime.statestore import StateStoreServer
+
+        # scale hours → fractions of a second; mid == fast so the page
+        # fires within one fast window; page threshold sized for a
+        # one-of-three-workers regression (bad share 1/3 ⇒ burn ≈ 6.7)
+        monkeypatch.setenv("DYN_TPU_SLO_FAST_S", "0.4")
+        monkeypatch.setenv("DYN_TPU_SLO_MID_S", "0.4")
+        monkeypatch.setenv("DYN_TPU_SLO_SLOW_S", "1.6")
+        monkeypatch.setenv("DYN_TPU_SLO_BURN_FAST", "4")
+        monkeypatch.setenv("DYN_TPU_SLO_BURN_SLOW", "2")
+        telemetry.configure()
+
+        async def go():
+            ss = StateStoreServer(port=0)
+            bus = MessageBusServer(port=0)
+            await ss.start()
+            await bus.start()
+            drt = await DistributedRuntime.create(ss.url, bus.url)
+            pub = await DistributedRuntime.create(ss.url, bus.url)
+            ns = pub.namespace("dynamo")
+
+            ready = asyncio.Event()
+            ports: list = []
+            agg_task = asyncio.create_task(run_telemetry_aggregator(
+                drt, "dynamo", port=0, host="127.0.0.1",
+                ready=ready, bound_port=ports,
+            ))
+            await asyncio.wait_for(ready.wait(), 10)
+
+            frontend = HttpService(ModelManager(), host="127.0.0.1", port=0)
+            fe_port = await frontend.start()
+
+            workers = [MockWorkerStats(seed=i, ttft_ms=100.0) for i in range(3)]
+
+            async def publish_round(regressed: bool):
+                for i, w in enumerate(workers):
+                    w.ttft_ms = 30000.0 if (regressed and i == 2) else 100.0
+                    w.tick(requests=10)
+                    await ns.publish(KV_METRICS_SUBJECT, {
+                        "worker_id": f"w{i}",
+                        "metrics": w.metrics("tiny-llama").to_dict(),
+                    })
+
+            def ttft_status():
+                cluster = telemetry.cluster()
+                assert cluster is not None
+                return next(
+                    s for s in cluster.slo_report()
+                    if s["slo"] == "ttft_p95"
+                    and s["labels"].get("model") == "tiny-llama"
+                )
+
+            try:
+                # healthy baseline
+                for _ in range(4):
+                    await publish_round(regressed=False)
+                    await asyncio.sleep(0.05)
+                assert ttft_status()["state"] == "ok"
+
+                # induced regression on w2: page within one fast window of
+                # bad data (wall-clock budget is looser — a loaded CI box
+                # must not flake the assertion)
+                deadline = asyncio.get_running_loop().time() + 2.0
+                state = "ok"
+                while asyncio.get_running_loop().time() < deadline:
+                    await publish_round(regressed=True)
+                    await asyncio.sleep(0.05)
+                    state = ttft_status()["state"]
+                    if state == "alert":
+                        break
+                assert state == "alert", "no page within one fast window"
+
+                # both surfaces report the violation with the model
+                async with aiohttp.ClientSession() as session:
+                    async with session.get(
+                        f"http://127.0.0.1:{fe_port}/debug/slo"
+                    ) as resp:
+                        assert resp.status == 200
+                        body = await resp.json()
+                violated = [
+                    s for s in body["cluster"]["slo"]
+                    if s["slo"] == "ttft_p95" and s["state"] == "alert"
+                ]
+                assert violated and violated[0]["labels"]["model"] == "tiny-llama"
+                # the rollup names the offending worker as the worst
+                roll = body["cluster"]["rollup"]
+                assert roll["workers"] == 3
+
+                from dynamo_tpu.cli.llmctl import amain
+
+                rc = await amain([
+                    "--statestore", ss.url, "slo", "status",
+                    "dyn://dynamo.telemetry.status",
+                ])
+                cli_out = capsys.readouterr().out
+                assert rc == 2  # active page ⇒ scriptable non-zero exit
+                assert "ttft_p95" in cli_out
+                assert "tiny-llama" in cli_out
+                assert "ALERT" in cli_out
+
+                rc = await amain([
+                    "--statestore", ss.url, "cluster", "status",
+                    "dyn://dynamo.telemetry.status",
+                ])
+                cli_out = capsys.readouterr().out
+                assert rc == 0
+                assert "tiny-llama" in cli_out and "workers=3" in cli_out
+
+                # recovery: healthy publishes until the slow window drains
+                deadline = asyncio.get_running_loop().time() + 4.0
+                state = "alert"
+                while asyncio.get_running_loop().time() < deadline:
+                    await publish_round(regressed=False)
+                    await asyncio.sleep(0.05)
+                    state = ttft_status()["state"]
+                    if state == "ok":
+                        break
+                assert state == "ok", f"alert never cleared (stuck {state})"
+            finally:
+                agg_task.cancel()
+                try:
+                    await agg_task
+                except (asyncio.CancelledError, Exception):
+                    pass
+                await frontend.stop()
+                await drt.shutdown()
+                await pub.shutdown()
+                await bus.stop()
+                await ss.stop()
+
+        run(go())

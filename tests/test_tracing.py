@@ -233,6 +233,126 @@ class TestPhaseHistograms:
         assert st["p50_ms"] <= st["p95_ms"] <= st["p99_ms"]
         assert st["count"] == 5
 
+    def test_summary_carries_raw_buckets(self):
+        """The cluster telemetry aggregator diffs the raw cumulative bucket
+        vector — quantiles alone can't be merged across workers/windows."""
+        tracing.observe_phase("ttft", 0.1)
+        tracing.observe_phase("ttft", 10.0)
+        st = tracing.phase_summary()["ttft"]
+        buckets = st["buckets"]
+        assert len(buckets) == len(tracing.PHASE_BUCKETS) + 1  # +Inf slot
+        # cumulative → monotone nondecreasing, total mass in the last slot
+        assert all(a <= b for a, b in zip(buckets, buckets[1:]))
+        assert buckets[-1] == st["count"] == 2
+
+
+class TestPhaseSummaryInterpolation:
+    """phase_summary() percentile edge cases (ISSUE-6 satellite): the
+    bucket-interpolated estimator must stay sane with degenerate mass."""
+
+    def test_empty_histogram_absent_from_summary(self):
+        assert tracing.phase_summary() == {}
+
+    def test_single_bucket_mass(self):
+        # all mass in one bucket: every quantile interpolates inside it
+        # and never escapes its bounds (bucket (0.001, 0.0025] here)
+        for _ in range(100):
+            tracing.observe_phase("decode", 0.002)
+        st = tracing.phase_summary()["decode"]
+        lo, hi = 1.0, 2.5  # ms bounds of the straddling bucket
+        for q in ("p50_ms", "p95_ms", "p99_ms"):
+            assert lo <= st[q] <= hi, f"{q}={st[q]} outside ({lo}, {hi}]"
+        assert st["p50_ms"] <= st["p95_ms"] <= st["p99_ms"]
+
+    def test_all_overflow_bucket_mass(self):
+        # every sample past the last finite bound: the estimator clamps to
+        # the last finite bound instead of reporting infinity
+        for _ in range(10):
+            tracing.observe_phase("prefill", 120.0)  # > 60 s top bound
+        st = tracing.phase_summary()["prefill"]
+        top = tracing.PHASE_BUCKETS[-1] * 1e3
+        for q in ("p50_ms", "p95_ms", "p99_ms"):
+            assert st[q] == top
+
+    def test_monotonicity_across_spread_mass(self):
+        # heavy bimodal spread: p50 ≤ p95 ≤ p99 must always hold
+        for s in [0.001] * 50 + [0.3] * 30 + [20.0] * 20:
+            tracing.observe_phase("inter_token", s)
+        st = tracing.phase_summary()["inter_token"]
+        assert st["p50_ms"] <= st["p95_ms"] <= st["p99_ms"]
+        assert st["count"] == 100
+
+    def test_single_sample(self):
+        tracing.observe_phase("kv_transfer", 0.04)
+        st = tracing.phase_summary()["kv_transfer"]
+        assert st["count"] == 1
+        assert st["p50_ms"] <= st["p95_ms"] <= st["p99_ms"]
+        # one sample in (25, 50] ms: all quantiles inside that bucket
+        assert 25.0 <= st["p50_ms"] <= 50.0
+
+
+class TestErroredFilter:
+    """/debug/traces?errored=1 (ISSUE-6 satellite): only traces containing
+    a non-ok span; slow-but-successful pinned traces don't match."""
+
+    def _span(self, status="ok"):
+        s = tracing.start_span("s")
+        s.end(status)
+        return s
+
+    def test_recorder_filter(self, monkeypatch):
+        monkeypatch.setenv("DYN_TPU_TRACE_SLOW_MS", "0.0001")
+        tracing.configure()
+        ok = self._span("ok")  # slow (pinned) but successful
+        bad = self._span("error")
+        got = {t["trace_id"] for t in tracing.recorder().traces(errored=True)}
+        assert bad.trace_id in got
+        assert ok.trace_id not in got
+        # limit composes with the filter
+        for _ in range(5):
+            self._span("deadline")
+        assert len(tracing.recorder().traces(limit=2, errored=True)) == 2
+
+    def test_http_query_param(self, run):
+        import aiohttp
+
+        from dynamo_tpu.llm.http.service import HttpService, ModelManager
+
+        ok = self._span("ok")
+        bad = self._span("reaped")
+        svc = HttpService(ModelManager(), host="127.0.0.1", port=0)
+
+        async def go():
+            port = await svc.start()
+            try:
+                async with aiohttp.ClientSession() as session:
+                    async with session.get(
+                        f"http://127.0.0.1:{port}/debug/traces",
+                        params={"errored": "1"},
+                    ) as resp:
+                        assert resp.status == 200
+                        errored_body = await resp.text()
+                    async with session.get(
+                        f"http://127.0.0.1:{port}/debug/traces"
+                    ) as resp:
+                        full_body = await resp.text()
+            finally:
+                await svc.stop()
+            return errored_body, full_body
+
+        errored_body, full_body = run(go())
+        errored_ids = {
+            json.loads(line)["trace_id"]
+            for line in errored_body.splitlines() if line
+        }
+        assert bad.trace_id in errored_ids
+        assert ok.trace_id not in errored_ids
+        full_ids = {
+            json.loads(line)["trace_id"]
+            for line in full_body.splitlines() if line
+        }
+        assert {ok.trace_id, bad.trace_id} <= full_ids
+
 
 # -- RPC propagation ---------------------------------------------------------
 
