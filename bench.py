@@ -645,6 +645,153 @@ def bench_alt_mode(quantize: str) -> dict:
         engine.close()
 
 
+def _spec_leg(cfg, params, prompts, spec_k: int) -> dict:
+    """One speculative-decoding measurement: an engine at the given spec_k
+    over the workload; returns decode rate, ITL percentiles (from the
+    tracing plane, reset per leg), and the engine's own draft counters."""
+    from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+    from dynamo_tpu.runtime import tracing as _tracing
+
+    engine = JaxServingEngine(
+        cfg, params,
+        EngineConfig(
+            max_slots=MAX_SLOTS, kv_block_size=16,
+            max_model_len=max(256, PROMPT_LEN + GEN_TOKENS + 8),
+            decode_steps=DECODE_STEPS, prefill_chunk=min(256, PROMPT_LEN),
+            quantize=QUANTIZE or None, spec_k=spec_k,
+        ),
+    )
+    try:
+        engine.warmup()
+        drive_wave(engine, prompts[:2], GEN_TOKENS)  # warm
+        _tracing.configure()  # ITL percentiles cover only the timed wave
+        out_toks, elapsed, _, decode_tok_s = drive_wave(
+            engine, prompts, GEN_TOKENS
+        )
+        snap = engine.metrics_snapshot()
+        phases = _tracing.phase_summary()
+        itl = phases.get("inter_token", {}) if phases else {}
+        drafted = snap.get("spec_drafted_tokens", 0)
+        accepted = snap.get("spec_accepted_tokens", 0)
+        return {
+            "spec_k": spec_k,
+            "tok_s": round(out_toks / elapsed, 1),
+            "decode_tok_s": round(decode_tok_s, 1),
+            "itl_p50_ms": itl.get("p50_ms"),
+            "itl_p95_ms": itl.get("p95_ms"),
+            "spec_drafted_tokens": drafted,
+            "spec_accepted_tokens": accepted,
+            "spec_accept_rate": round(accepted / drafted, 4) if drafted else 0.0,
+        }
+    finally:
+        engine.close()
+
+
+def bench_spec_decode() -> dict:
+    """Speculative decoding (r06): drafted-vs-accepted counters plus decode
+    tok/s and ITL deltas against the non-speculative baseline, on two
+    workloads — repetition-heavy (a short motif tiled through the prompt,
+    the shape prompt-lookup drafting exists for: multi-turn quoting, code
+    edits, extraction) and adversarial (i.i.d. random prompts, where the
+    drafter should go dormant and cost ~nothing). The acceptance gate is
+    spec/base decode tok/s ≥ 1.5 on repetition and ≥ 0.95 on adversarial."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.models.llama import LLAMA_PRESETS
+
+    spec_k = int(os.environ.get("BENCH_SPEC_K", "4"))
+    cfg = dataclasses.replace(LLAMA_PRESETS[PRESET], dtype=jnp.bfloat16)
+    params = _init_params_fast(cfg)
+    rng = np.random.default_rng(11)
+    motif = rng.integers(0, cfg.vocab_size, 24).tolist()
+    rep_prompts = [
+        # per-request offset so waves don't all prefix-hit one another
+        (motif[i % len(motif):] + motif * (PROMPT_LEN // len(motif) + 1))[:PROMPT_LEN]
+        for i in range(N_REQUESTS)
+    ]
+    adv_prompts = [
+        rng.integers(0, cfg.vocab_size, PROMPT_LEN).tolist()
+        for _ in range(N_REQUESTS)
+    ]
+    out: dict = {"spec_k": spec_k}
+    for name, prompts in (("repetition", rep_prompts), ("adversarial", adv_prompts)):
+        base = _spec_leg(cfg, params, prompts, 0)
+        _release_device_memory()
+        spec = _spec_leg(cfg, params, prompts, spec_k)
+        _release_device_memory()
+        ratio = (
+            spec["decode_tok_s"] / base["decode_tok_s"]
+            if base["decode_tok_s"] else None
+        )
+        itl_delta = (
+            round(spec["itl_p50_ms"] - base["itl_p50_ms"], 3)
+            if spec["itl_p50_ms"] is not None and base["itl_p50_ms"] is not None
+            else None
+        )
+        out[name] = {
+            "baseline": base,
+            "speculative": spec,
+            "decode_speedup": round(ratio, 3) if ratio else None,
+            "itl_p50_delta_ms": itl_delta,
+        }
+    return out
+
+
+def bench_kv_int8() -> dict:
+    """int8-KV vs bf16-KV sweep leg (r06): same workload, same weights, the
+    only difference is the page layout — int8 pages + per-token scale
+    tables halve the KV half of the decode stream. The win grows with
+    context; at short ISL the quantize/dequantize ops can eat the saving."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+    from dynamo_tpu.models.llama import LLAMA_PRESETS
+
+    cfg = dataclasses.replace(LLAMA_PRESETS[PRESET], dtype=jnp.bfloat16)
+    params = _init_params_fast(cfg)
+    rng = np.random.default_rng(13)
+    prompt_len = int(os.environ.get("BENCH_KV_PROMPT_LEN", str(max(PROMPT_LEN, 1024))))
+    prompts = [
+        rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+        for _ in range(N_REQUESTS)
+    ]
+    legs = {}
+    for kv_dtype in ("bf16", "int8"):
+        engine = JaxServingEngine(
+            cfg, params,
+            EngineConfig(
+                max_slots=MAX_SLOTS, kv_block_size=16,
+                max_model_len=max(256, prompt_len + GEN_TOKENS + 8),
+                decode_steps=DECODE_STEPS, prefill_chunk=256,
+                quantize=QUANTIZE or None, kv_dtype=kv_dtype,
+            ),
+        )
+        try:
+            drive_wave(engine, prompts[:2], GEN_TOKENS)  # warm
+            out_toks, elapsed, ttfts, decode_tok_s = drive_wave(
+                engine, prompts, GEN_TOKENS
+            )
+            legs[kv_dtype] = {
+                "tok_s": round(out_toks / elapsed, 1),
+                "decode_tok_s": round(decode_tok_s, 1),
+                "ttft_p50_ms": (
+                    round(ttfts[len(ttfts) // 2] * 1e3, 1) if ttfts else None
+                ),
+            }
+        finally:
+            engine.close()
+        _release_device_memory()
+    b, q = legs["bf16"]["decode_tok_s"], legs["int8"]["decode_tok_s"]
+    return {
+        "prompt_len": prompt_len,
+        "bf16": legs["bf16"],
+        "int8": legs["int8"],
+        "decode_speedup": round(q / b, 3) if b else None,
+    }
+
+
 def bench_frontend() -> dict:
     """Frontend hot-path saturation (VERDICT r3 item 8): echo engine at zero
     delay behind the real OpenAI HTTP service, N concurrent SSE streams.
@@ -816,7 +963,9 @@ def main() -> None:
     engine_perf = {
         k: v for k, v in engine.metrics_snapshot().items()
         if k in ("decode_tokens_per_s", "step_time_ms", "batch_slot_util",
-                 "jit_recompiles", "kv_peak_occupancy_perc")
+                 "jit_recompiles", "kv_peak_occupancy_perc",
+                 "spec_accept_rate", "spec_drafted_tokens",
+                 "spec_accepted_tokens", "kv_quantized")
     }
     engine.close()
     del engine  # free the primary engine's HBM before the sections
@@ -912,6 +1061,18 @@ def main() -> None:
             out["pallas_d128"] = bench_pallas_d128()
         except Exception as e:  # secondary measurement must never kill the bench
             out["pallas_d128"] = {"error": str(e)[:200]}
+        _release_device_memory()
+    if os.environ.get("BENCH_SPEC", "1") == "1":
+        try:
+            out["spec_decode"] = bench_spec_decode()
+        except Exception as e:  # secondary measurement must never kill the bench
+            out["spec_decode"] = {"error": str(e)[:200]}
+        _release_device_memory()
+    if os.environ.get("BENCH_KV_INT8", "1") == "1":
+        try:
+            out["kv_int8"] = bench_kv_int8()
+        except Exception as e:
+            out["kv_int8"] = {"error": str(e)[:200]}
         _release_device_memory()
     if os.environ.get("BENCH_FRONTEND", "1") == "1":
         try:

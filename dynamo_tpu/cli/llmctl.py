@@ -363,6 +363,12 @@ async def _telemetry_cmd(args, store) -> int:
     print(f'namespace={roll.get("namespace", "?")} '
           f'workers={roll.get("workers", 0)}')
     for model, e in sorted((roll.get("models") or {}).items()):
+        # speculation column only when the fleet actually drafts (a wall of
+        # spec=0.00 on non-speculative fleets is noise)
+        spec = (
+            f' spec={e.get("spec_accept_rate", 0.0):.2f}'
+            if e.get("spec_drafted_tokens") else ""
+        )
         print(
             f'{model:20s} workers={e.get("workers", 0)} '
             f'(unhealthy={e.get("workers_unhealthy", 0)}) '
@@ -371,6 +377,7 @@ async def _telemetry_cmd(args, store) -> int:
             f'kv_free {e.get("kv_blocks_free", 0)}/{e.get("kv_blocks_total", 0)} '
             f'headroom={e.get("headroom_frac", 0.0):.2f} '
             f'decode={e.get("decode_tokens_per_s", 0.0):.0f} tok/s'
+            f'{spec}'
         )
     worst = roll.get("worst_worker")
     if worst:

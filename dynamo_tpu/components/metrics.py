@@ -57,6 +57,12 @@ GAUGES = [
     ("batch_slot_util", "Batch-slot utilization EMA (0..1)"),
     ("jit_recompiles", "Jitted step-function compilations since boot"),
     ("kv_peak_occupancy_perc", "Peak KV pool occupancy since boot (0..1)"),
+    # speculative decoding + quantized KV (PR7, docs/decode_performance.md):
+    # acceptance-rate EMA, cumulative draft counters, int8-KV flag
+    ("spec_accept_rate", "Speculative-draft acceptance-rate EMA (0..1)"),
+    ("spec_drafted_tokens", "Draft tokens handed to verify dispatches (cumulative)"),
+    ("spec_accepted_tokens", "Draft tokens accepted by verify dispatches (cumulative)"),
+    ("kv_quantized", "1 when the KV pool stores int8 pages with scale tables"),
     # request outcome counters (cumulative; the cluster SLO engine diffs)
     ("requests_total", "Requests served by the RPC plane (cumulative)"),
     ("requests_errored", "Requests finished in error (cumulative)"),

@@ -46,6 +46,8 @@ class MockWorkerStats:
         itl_ms: float = 20.0,
         slots_total: int = 16,
         blocks_total: int = 1024,
+        spec_accept_rate: float = 0.0,
+        kv_quantized: bool = False,
     ):
         from dynamo_tpu.runtime.tracing import PHASE_BUCKETS
 
@@ -62,6 +64,13 @@ class MockWorkerStats:
         self.requests_errored = 0
         self.active = 0
         self.started = time.monotonic()
+        # speculative decoding (PR7): an engine with speculation off reports
+        # 0.0 and zero counters — the mock defaults match that; set a rate
+        # to exercise the dashboard columns + cluster rollup
+        self.spec_accept_rate = max(0.0, min(1.0, spec_accept_rate))
+        self.kv_quantized = bool(kv_quantized)
+        self.spec_drafted = 0
+        self.spec_accepted = 0
 
     def _observe(self, phase: str, seconds: float) -> None:
         counts = self._counts.setdefault(phase, [0] * len(self.bounds))
@@ -88,6 +97,19 @@ class MockWorkerStats:
             self._observe("ttft", self._jitter(self.ttft_ms))
             for _ in range(16):
                 self._observe("inter_token", self._jitter(self.itl_ms))
+            if self.spec_accept_rate > 0.0:
+                # synthetic drafting: ~4 drafts per emitted token batch,
+                # accepted at the configured rate (deterministic-seeded);
+                # per-request rate feeds the spec_accept phase histogram
+                # exactly like a real engine's _record_phase_spans
+                drafted = 4 * 16
+                accepted = sum(
+                    1 for _ in range(drafted)
+                    if self.rng.random() < self.spec_accept_rate
+                )
+                self.spec_drafted += drafted
+                self.spec_accepted += accepted
+                self._observe("spec_accept", accepted / drafted)
         self.active = max(
             0, min(self.slots_total, self.active + self.rng.randint(-3, 3))
         )
@@ -145,6 +167,11 @@ class MockWorkerStats:
             ),
             requests_total=self.requests_total,
             requests_errored=self.requests_errored,
+            # speculative decoding + KV layout (PR7)
+            spec_accept_rate=round(self.spec_accept_rate, 4),
+            spec_drafted_tokens=self.spec_drafted,
+            spec_accepted_tokens=self.spec_accepted,
+            kv_quantized=int(self.kv_quantized),
             uptime_s=round(time.monotonic() - self.started, 3),
             model=model,
         )
@@ -158,13 +185,16 @@ async def run_mock_worker(
     model: str = "mock-model",
     ttft_ms: float = 250.0,
     itl_ms: float = 20.0,
+    spec_accept_rate: float = 0.0,
+    kv_quantized: bool = False,
 ) -> None:
     from dynamo_tpu.runtime.distributed import KV_METRICS_SUBJECT
 
     ns = drt.namespace(namespace)
     wid = worker_id or f"mock-{drt.worker_id}"
     stats = MockWorkerStats(
-        seed=hash(wid) & 0xFFFF, ttft_ms=ttft_ms, itl_ms=itl_ms
+        seed=hash(wid) & 0xFFFF, ttft_ms=ttft_ms, itl_ms=itl_ms,
+        spec_accept_rate=spec_accept_rate, kv_quantized=kv_quantized,
     )
     while True:
         stats.tick()
@@ -186,6 +216,12 @@ def main() -> None:
     p.add_argument("--ttft-ms", type=float, default=250.0,
                    help="synthetic TTFT center (regression drills: raise it)")
     p.add_argument("--itl-ms", type=float, default=20.0)
+    p.add_argument("--spec-accept-rate", type=float, default=0.0,
+                   help="synthetic speculative-draft acceptance rate (0..1; "
+                        "0 = speculation off, like a real default engine)")
+    p.add_argument("--kv-quantized", action="store_true",
+                   help="report the int8-KV flag (exercises the dashboard "
+                        "column without a real quantized pool)")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -199,6 +235,8 @@ def main() -> None:
             drt, args.namespace, interval=args.interval,
             worker_id=args.worker_id, model=args.model,
             ttft_ms=args.ttft_ms, itl_ms=args.itl_ms,
+            spec_accept_rate=args.spec_accept_rate,
+            kv_quantized=args.kv_quantized,
         )
 
     asyncio.run(run())

@@ -60,6 +60,11 @@ CLUSTER_GAUGES = [
     ("kv_blocks_free", "Free KV pool blocks across the fleet"),
     ("headroom_frac", "min(free slots, free KV) fraction of fleet capacity"),
     ("decode_tokens_per_s", "Fleet decode throughput (sum of worker EMAs)"),
+    # speculative decoding (PR7): fleet draft counters + acceptance rate
+    # recomputed from the summed counters (not a mean of worker EMAs)
+    ("spec_drafted_tokens", "Draft tokens handed to verify dispatches (fleet sum)"),
+    ("spec_accepted_tokens", "Draft tokens accepted (fleet sum)"),
+    ("spec_accept_rate", "Fleet speculative acceptance rate (accepted/drafted)"),
     ("worst_worker_load", "Highest per-worker load score"),
     ("median_worker_load", "Median per-worker load score"),
 ]
@@ -262,6 +267,8 @@ class ClusterTelemetry:
                 "slots_total": 0, "slots_free": 0,
                 "kv_blocks_total": 0, "kv_blocks_free": 0,
                 "decode_tokens_per_s": 0.0,
+                "spec_drafted_tokens": 0, "spec_accepted_tokens": 0,
+                "spec_accept_rate": 0.0,
             })
             entry["workers"] += 1
             if getattr(m, "health_state", "healthy") == "unhealthy":
@@ -278,6 +285,15 @@ class ClusterTelemetry:
                 entry["decode_tokens_per_s"]
                 + float(getattr(m, "decode_tokens_per_s", 0.0) or 0.0), 3,
             )
+            # speculation: cumulative counters sum; the fleet acceptance
+            # rate is recomputed below from the summed counters (a mean of
+            # per-worker EMAs would overweight idle workers)
+            entry["spec_drafted_tokens"] += int(
+                getattr(m, "spec_drafted_tokens", 0) or 0
+            )
+            entry["spec_accepted_tokens"] += int(
+                getattr(m, "spec_accepted_tokens", 0) or 0
+            )
             scores.append((wid, self._load_score(m)))
         for entry in models.values():
             slot_frac = (
@@ -291,6 +307,11 @@ class ClusterTelemetry:
             # headroom is the BINDING constraint: whichever of slots or KV
             # runs out first caps admission (runtime/admission.py)
             entry["headroom_frac"] = round(min(slot_frac, kv_frac), 4)
+            if entry["spec_drafted_tokens"]:
+                entry["spec_accept_rate"] = round(
+                    entry["spec_accepted_tokens"] / entry["spec_drafted_tokens"],
+                    4,
+                )
         worst = max(scores, key=lambda t: t[1]) if scores else None
         med = (
             round(statistics.median(s for _, s in scores), 4) if scores else None

@@ -40,6 +40,7 @@ from dynamo_tpu.disagg.protocols import (
     RemotePrefillRequest,
 )
 from dynamo_tpu.disagg.transfer import KvTransferClient, _engine_call
+from dynamo_tpu.engine_jax.allocator import KvDtypeMismatch
 from dynamo_tpu.runtime import tracing
 
 logger = logging.getLogger(__name__)
@@ -91,16 +92,17 @@ class PrefillEngine:
         sampling: dict,
         prefix_kv: Optional[Tuple] = None,
         as_device: bool = False,
-    ) -> Tuple[int, object, object, int]:
+    ) -> Tuple[int, object, object, Optional[Tuple], int]:
         """Compute the prompt KV; return (first_token, k_pages, v_pages,
-        computed_tokens) covering blocks from ``cached_tokens // block_size``
-        onward.
+        scales, computed_tokens) covering blocks from
+        ``cached_tokens // block_size`` onward.
 
-        ``prefix_kv`` = (k, v) pages for the full blocks of
-        ``token_ids[:cached_tokens]`` read from the decode worker: they are
+        ``prefix_kv`` = (k, v, scales) pages for the full blocks of
+        ``token_ids[:cached_tokens]`` read from the decode worker (scales is
+        None for native pools, (k_scale, v_scale) for int8 pools): they are
         seeded into the engine's prefix cache first, so the engine computes
         only the suffix. ``as_device=True`` returns jax arrays (same-host
-        device path)."""
+        device path). Returns (first_token, k, v, scales, computed)."""
         from dynamo_tpu.llm.protocols.common import (
             PreprocessedRequest,
             SamplingOptions,
@@ -114,13 +116,28 @@ class PrefillEngine:
                 f"prompt {n} exceeds prefill max_model_len {self.max_model_len}"
             )
         if prefix_kv is not None and cached_tokens % self.block_size == 0:
-            k_pre, v_pre = prefix_kv
-            seeded = await _engine_call(
-                self.engine,
-                lambda: self.engine.seed_external_prefix(
-                    token_ids[:cached_tokens], k_pre, v_pre
-                ),
-            )
+            k_pre, v_pre, pre_scales = prefix_kv
+            try:
+                seeded = await _engine_call(
+                    self.engine,
+                    lambda: self.engine.seed_external_prefix(
+                        token_ids[:cached_tokens], k_pre, v_pre,
+                        pre_scales[0] if pre_scales else None,
+                        pre_scales[1] if pre_scales else None,
+                    ),
+                )
+            except KvDtypeMismatch as e:
+                # decode and prefill pools disagree on the page layout
+                # (rolling upgrade / per-process DYN_TPU_KV_DTYPE skew): the
+                # read-back pages are unusable HERE, but the prompt is not —
+                # recompute it in full, exactly like a stale prefix read.
+                # Failing the whole remote prefill would silently disable
+                # disaggregation for every prefix-hit request.
+                logger.warning(
+                    "decode-worker prefix pages unusable (%s); "
+                    "recomputing full prompt", e,
+                )
+                seeded = 0
             if seeded:
                 logger.debug("seeded %d prefix blocks from decode worker", seeded)
 
@@ -165,8 +182,9 @@ class PrefillEngine:
                     ctx.id, first_block, n_blocks, as_device=as_device
                 )
 
-            k, v = await _engine_call(self.engine, extract)
-            return first_token, k, v, self._computed.pop(ctx.id, -1)
+            k, v, ks, vs = await _engine_call(self.engine, extract)
+            scales = (ks, vs) if ks is not None else None
+            return first_token, k, v, scales, self._computed.pop(ctx.id, -1)
         except BaseException:
             self.engine.post(lambda: self.engine.release_held(ctx.id))
             raise
@@ -175,22 +193,22 @@ class PrefillEngine:
         self, token_ids: List[int], cached_tokens: int, sampling: dict,
         as_device: bool = False,
     ) -> Tuple[int, np.ndarray, np.ndarray]:
-        """Synchronous convenience wrapper (no prefix read-back). Safe to
-        call with or without a running event loop — inside one, the request
-        runs on a private loop in a worker thread (and blocks the caller,
-        like any sync compute would)."""
+        """Synchronous convenience wrapper (no prefix read-back, native-pool
+        page set). Safe to call with or without a running event loop —
+        inside one, the request runs on a private loop in a worker thread
+        (and blocks the caller, like any sync compute would)."""
         coro = self.prefill_request(
             token_ids, cached_tokens, sampling, as_device=as_device
         )
         try:
             asyncio.get_running_loop()
         except RuntimeError:
-            tok, k, v, _ = asyncio.run(coro)
+            tok, k, v, _, _ = asyncio.run(coro)
             return tok, k, v
         import concurrent.futures
 
         with concurrent.futures.ThreadPoolExecutor(1) as ex:
-            tok, k, v, _ = ex.submit(asyncio.run, coro).result()
+            tok, k, v, _, _ = ex.submit(asyncio.run, coro).result()
             return tok, k, v
 
 
@@ -310,7 +328,7 @@ async def run_prefill_worker(
             prefix_kv = None
             if req.cached_tokens > 0 and req.prefix_block_ids:
                 try:
-                    k_pre, v_pre, got_hashes = await transfer.read_blocks(
+                    k_pre, v_pre, pre_scales, got_hashes = await transfer.read_blocks(
                         addr, req.prefix_block_ids
                     )
                     from dynamo_tpu.kv.tokens import compute_block_hashes_for_seq
@@ -324,7 +342,7 @@ async def run_prefill_worker(
                         salt=bytes.fromhex(req.salt_hex) if req.salt_hex else None,
                     )
                     if list(got_hashes) == list(expect):
-                        prefix_kv = (k_pre, v_pre)
+                        prefix_kv = (k_pre, v_pre, pre_scales)
                     else:
                         logger.warning(
                             "prefix pages for %s changed since enqueue "
@@ -336,7 +354,7 @@ async def run_prefill_worker(
                         "prefix read_blocks failed for %s; recomputing full "
                         "prompt", req.request_id, exc_info=True,
                     )
-            tok, k, v, computed = await engine.prefill_request(
+            tok, k, v, scales, computed = await engine.prefill_request(
                 req.token_ids, req.cached_tokens, req.sampling,
                 prefix_kv=prefix_kv, as_device=local_engine is not None,
             )
@@ -349,7 +367,8 @@ async def run_prefill_worker(
             for attempt in range(1, policy.max_attempts + 1):
                 try:
                     await transfer.send_blocks(
-                        addr, req.request_id, tok, req.block_ids, k, v
+                        addr, req.request_id, tok, req.block_ids, k, v,
+                        scales=scales,
                     )
                     break
                 except (ConnectionError, OSError, asyncio.IncompleteReadError):

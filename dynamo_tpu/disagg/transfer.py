@@ -18,6 +18,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from dynamo_tpu.engine_jax.allocator import KvDtypeMismatch
 from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.codec import TwoPartMessage, read_frame, write_frame
 
@@ -26,6 +27,46 @@ logger = logging.getLogger(__name__)
 
 class _NoDevicePeer(Exception):
     """Peer has no device plane: fall back to the host-staged path."""
+
+
+def _pack_pages(k, v, scales) -> tuple:
+    """Frame header fields + body for a page set that may carry int8 scale
+    tables. Body layout: k | v | k_scale | v_scale (k and v are always the
+    same dtype+shape, as are the two scale tables, so two byte lengths
+    describe all four segments). Headers WITHOUT ``kv_dtype`` are exactly
+    the pre-int8 wire form — old peers reading a native-pool frame see no
+    difference, and a new reader treats their frames as scale-less."""
+    k_raw, v_raw = _pack(k), _pack(v)
+    header = {
+        "dtype": k.dtype.name, "shape": list(k.shape), "k_bytes": len(k_raw),
+    }
+    body = k_raw + v_raw
+    if scales is not None:
+        ks, vs = scales
+        ks_raw, vs_raw = _pack(ks), _pack(vs)
+        header["kv_dtype"] = "int8"
+        header["scale_dtype"] = ks.dtype.name
+        header["scale_shape"] = list(ks.shape)
+        header["ks_bytes"] = len(ks_raw)
+        body += ks_raw + vs_raw
+    return header, body
+
+
+def _unpack_pages(h: dict, body: bytes) -> tuple:
+    """Inverse of :func:`_pack_pages`: returns (k, v, scales) where scales
+    is None for native-dtype frames (including frames from pre-int8 peers)
+    or an (k_scale, v_scale) pair."""
+    k_len = h["k_bytes"]
+    k = _unpack(body[:k_len], h["dtype"], h["shape"])
+    v = _unpack(body[k_len : 2 * k_len], h["dtype"], h["shape"])
+    if h.get("kv_dtype") != "int8":
+        return k, v, None
+    ks_len = h["ks_bytes"]
+    off = 2 * k_len
+    ks = _unpack(body[off : off + ks_len], h["scale_dtype"], h["scale_shape"])
+    vs = _unpack(body[off + ks_len : off + 2 * ks_len], h["scale_dtype"],
+                 h["scale_shape"])
+    return k, v, (ks, vs)
 
 
 def _engine_call(engine, fn):
@@ -94,11 +135,15 @@ class KvTransferServer:
                     return
                 h = json.loads(frame.header)
                 if h.get("op") == "kv_blocks":
-                    k_len = h["k_bytes"]
-                    k = _unpack(frame.body[:k_len], h["dtype"], h["shape"])
-                    v = _unpack(frame.body[k_len:], h["dtype"], h["shape"])
+                    k, v, scales = _unpack_pages(h, frame.body)
+                    # dtype skew (an int8 frame into a native pool, or a
+                    # pre-int8 peer's frame into an int8 pool) surfaces as a
+                    # typed fallback inside complete_remote_prefill — never
+                    # as corrupt pages
                     self.engine.complete_remote_prefill(
-                        h["request_id"], h["first_token"], h["block_ids"], k, v
+                        h["request_id"], h["first_token"], h["block_ids"], k, v,
+                        scales[0] if scales else None,
+                        scales[1] if scales else None,
                     )
                 elif h.get("op") == "read_blocks":
                     # prefill worker reading this decode worker's cached
@@ -108,21 +153,33 @@ class KvTransferServer:
                     # since the request was enqueued — stale reads would
                     # otherwise poison its prefix cache with wrong KV.
                     def _extract(ids=h["block_ids"]):
-                        k, v = self.engine.extract_blocks(ids)
-                        return k, v, self.engine.block_hashes_of(ids)
+                        k, v, ks, vs = self.engine.extract_blocks(ids)
+                        return k, v, ks, vs, self.engine.block_hashes_of(ids)
 
-                    k, v, hashes = await _engine_call(self.engine, _extract)
-                    k_raw, v_raw = _pack(k), _pack(v)
-                    await write_frame(
-                        writer,
-                        TwoPartMessage(
+                    k, v, ks, vs, hashes = await _engine_call(
+                        self.engine, _extract
+                    )
+                    if ks is not None and not h.get("int8_ok"):
+                        # pre-int8 peer reading an int8 pool: its fixed
+                        # two-segment unpack would misparse the 4-segment
+                        # body — refuse with a typed error instead
+                        await write_frame(writer, TwoPartMessage(
                             json.dumps({
-                                "id": h.get("id"), "ok": True,
-                                "dtype": k.dtype.name, "shape": list(k.shape),
-                                "k_bytes": len(k_raw), "hashes": hashes,
-                            }).encode(),
-                            k_raw + v_raw,
-                        ),
+                                "id": h.get("id"), "ok": False, "int8": True,
+                                "error": "kv_dtype int8: peer lacks scale-"
+                                         "table support",
+                            }).encode(), b""))
+                        continue
+                    hdr, body = _pack_pages(
+                        k, v, (ks, vs) if ks is not None else None
+                    )
+                    # "int8" advertises THIS binary's capability (not the
+                    # pool's dtype): clients cache it per address so int8
+                    # sends can take the device path on later transfers
+                    hdr.update({"id": h.get("id"), "ok": True, "int8": True,
+                                "hashes": hashes})
+                    await write_frame(
+                        writer, TwoPartMessage(json.dumps(hdr).encode(), body)
                     )
                     continue
                 elif h.get("op") == "read_blocks_dev":
@@ -135,16 +192,34 @@ class KvTransferServer:
                         continue
 
                     def _extract_dev(ids=h["block_ids"]):
-                        k, v = self.engine.extract_blocks(ids, as_device=True)
-                        return k, v, self.engine.block_hashes_of(ids)
+                        k, v, ks, vs = self.engine.extract_blocks(
+                            ids, as_device=True
+                        )
+                        return k, v, ks, vs, self.engine.block_hashes_of(ids)
 
-                    k, v, hashes = await _engine_call(self.engine, _extract_dev)
-                    uid, specs = self.device_plane.stage([k, v])
+                    k, v, ks, vs, hashes = await _engine_call(
+                        self.engine, _extract_dev
+                    )
+                    if ks is not None and not h.get("int8_ok"):
+                        # pre-int8 peer: it would pull the 4-array stage,
+                        # keep [k, v], and inject raw int8 values as native
+                        # KV — silent corruption. Refuse instead; its TCP
+                        # fallback then fails loudly.
+                        await write_frame(writer, TwoPartMessage(
+                            json.dumps({
+                                "id": h.get("id"), "ok": False, "int8": True,
+                                "error": "kv_dtype int8: peer lacks scale-"
+                                         "table support",
+                            }).encode(), b""))
+                        continue
+                    staged = [k, v] if ks is None else [k, v, ks, vs]
+                    uid, specs = self.device_plane.stage(staged)
                     await write_frame(writer, TwoPartMessage(
                         json.dumps({
-                            "id": h.get("id"), "ok": True, "uuid": uid,
-                            "specs": specs, "hashes": hashes,
+                            "id": h.get("id"), "ok": True, "int8": True,
+                            "uuid": uid, "specs": specs, "hashes": hashes,
                             "dev_addr": self.device_plane.address(),
+                            **({"kv_dtype": "int8"} if ks is not None else {}),
                         }).encode(), b""))
                     continue
                 elif h.get("op") == "kv_blocks_dev":
@@ -159,9 +234,11 @@ class KvTransferServer:
                         self.device_plane.pull,
                         h["dev_addr"], h["uuid"], h["specs"],
                     )
-                    k, v = pulled[0], pulled[1]
                     self.engine.complete_remote_prefill(
-                        h["request_id"], h["first_token"], h["block_ids"], k, v
+                        h["request_id"], h["first_token"], h["block_ids"],
+                        pulled[0], pulled[1],
+                        pulled[2] if len(pulled) > 2 else None,
+                        pulled[3] if len(pulled) > 3 else None,
                     )
                 elif h.get("op") == "release_dev":
                     # client pulled: free the staged device arrays now
@@ -172,7 +249,9 @@ class KvTransferServer:
                     self.engine.fail_remote_prefill(h["request_id"], h.get("message", ""))
                 await write_frame(
                     writer,
-                    TwoPartMessage(json.dumps({"id": h.get("id"), "ok": True}).encode(), b""),
+                    TwoPartMessage(json.dumps(
+                        {"id": h.get("id"), "ok": True, "int8": True}
+                    ).encode(), b""),
                 )
         finally:
             writer.close()
@@ -194,7 +273,8 @@ class LocalKvTransfer:
         self.decode = decode_engine
 
     async def send_blocks(
-        self, address: str, request_id: str, first_token: int, block_ids, k, v
+        self, address: str, request_id: str, first_token: int, block_ids, k, v,
+        scales=None,
     ) -> None:
         # address ignored: the target is in-process
         tracing.record_event_span(
@@ -204,20 +284,25 @@ class LocalKvTransfer:
                         "pages": len(list(block_ids)),
                         "request_id": request_id},
         )
-        self.decode.complete_remote_prefill(request_id, first_token, list(block_ids), k, v)
+        self.decode.complete_remote_prefill(
+            request_id, first_token, list(block_ids), k, v,
+            scales[0] if scales else None, scales[1] if scales else None,
+        )
 
     async def send_failure(self, address: str, request_id: str, message: str) -> None:
         self.decode.fail_remote_prefill(request_id, message)
 
     async def read_blocks(self, address: str, block_ids) -> tuple:
         """Device path: pages come back as jax arrays, never touching host.
-        Hashes ride along for the same staleness validation as the TCP
-        path."""
+        Returns (k, v, scales, hashes) — scales is None for native pools,
+        (k_scale, v_scale) for int8 pools; hashes ride along for the same
+        staleness validation as the TCP path."""
         ids = list(block_ids)
 
         def _extract():
-            k, v = self.decode.extract_blocks(ids, as_device=True)
-            return k, v, self.decode.block_hashes_of(ids)
+            k, v, ks, vs = self.decode.extract_blocks(ids, as_device=True)
+            scales = (ks, vs) if ks is not None else None
+            return k, v, scales, self.decode.block_hashes_of(ids)
 
         return await _engine_call(self.decode, _extract)
 
@@ -236,6 +321,10 @@ class KvTransferClient:
     def __init__(self, device_plane=None):
         self.device_plane = device_plane
         self._dev_peers: Dict[str, bool] = {}  # addr → peer has a plane
+        # addr → peer's binary speaks the int8 scale layout (learned from
+        # the "int8" marker new servers stamp on every reply); int8 page
+        # sets avoid the device plane until proven — see send_blocks
+        self._int8_peers: Dict[str, bool] = {}
         self._conns: Dict[str, tuple] = {}
         self._locks: Dict[str, asyncio.Lock] = {}
 
@@ -269,6 +358,10 @@ class KvTransferClient:
     def _use_dev(self, address: str) -> bool:
         return self.device_plane is not None and self._dev_peers.get(address, True)
 
+    def _note_caps(self, address: str, h: dict) -> None:
+        if h.get("int8"):
+            self._int8_peers[address] = True
+
     async def send_blocks(
         self,
         address: str,
@@ -277,10 +370,14 @@ class KvTransferClient:
         block_ids,
         k,
         v,
+        scales=None,
     ) -> None:
         # kv_transfer span: the wire (or device-fabric) time of shipping the
         # computed pages — nests under the prefill worker's request span via
-        # the ambient contextvar
+        # the ambient contextvar. ``scales`` = (k_scale, v_scale) per-token
+        # tables when the pages come from an int8 pool; the header then
+        # carries kv_dtype so the receiver can refuse a layout it doesn't
+        # speak instead of writing corrupt pages.
         with tracing.span(
             "disagg.kv_transfer",
             parent=tracing.current_span(),
@@ -288,10 +385,19 @@ class KvTransferClient:
             attributes={"op": "send_blocks", "pages": len(list(block_ids)),
                         "address": address, "request_id": request_id},
         ) as tspan:
-            if self._use_dev(address):
+            # int8 pages ride the device plane only once the peer has PROVEN
+            # it speaks the scale layout: a pre-int8 peer pulling a 4-array
+            # stage would keep [k, v] and inject raw int8 values as native
+            # KV — silent corruption. The TCP form is safe against old peers
+            # (their fixed two-segment unpack fails loudly, never injects),
+            # and its ack teaches us the capability for later transfers.
+            if self._use_dev(address) and (
+                scales is None or self._int8_peers.get(address, False)
+            ):
                 try:
                     await self._send_blocks_dev(
-                        address, request_id, first_token, block_ids, k, v
+                        address, request_id, first_token, block_ids, k, v,
+                        scales,
                     )
                     if tspan is not None:
                         tspan.set_attribute("path", "device")
@@ -299,26 +405,26 @@ class KvTransferClient:
                 except _NoDevicePeer:
                     self._dev_peers[address] = False  # fall through to TCP
             k, v = np.asarray(k), np.asarray(v)
+            if scales is not None:
+                scales = (np.asarray(scales[0]), np.asarray(scales[1]))
             reader, writer = await self._conn(address)
-            k_raw, v_raw = _pack(k), _pack(v)
+            header, body = _pack_pages(k, v, scales)
             if tspan is not None:
                 tspan.set_attribute("path", "tcp")
-                tspan.set_attribute("bytes", len(k_raw) + len(v_raw))
-            header = {
+                tspan.set_attribute("bytes", len(body))
+            header.update({
                 "op": "kv_blocks",
                 "request_id": request_id,
                 "first_token": int(first_token),
                 "block_ids": list(map(int, block_ids)),
-                "dtype": k.dtype.name,
-                "shape": list(k.shape),
-                "k_bytes": len(k_raw),
-            }
+            })
             try:
                 async with self._locks[address]:
                     await write_frame(
-                        writer, TwoPartMessage(json.dumps(header).encode(), k_raw + v_raw)
+                        writer, TwoPartMessage(json.dumps(header).encode(), body)
                     )
-                    await read_frame(reader)  # ack
+                    ack = await read_frame(reader)
+                self._note_caps(address, json.loads(ack.header))
             except (ConnectionError, OSError, asyncio.IncompleteReadError):
                 # evict exactly the conn that failed (identity-guarded), so
                 # retries dial fresh without racing concurrent senders
@@ -326,11 +432,14 @@ class KvTransferClient:
                 raise
 
     async def _send_blocks_dev(
-        self, address, request_id, first_token, block_ids, k, v
+        self, address, request_id, first_token, block_ids, k, v, scales=None
     ) -> None:
         import jax.numpy as jnp
 
-        uid, specs = self.device_plane.stage([jnp.asarray(k), jnp.asarray(v)])
+        arrs = [jnp.asarray(k), jnp.asarray(v)]
+        if scales is not None:
+            arrs += [jnp.asarray(scales[0]), jnp.asarray(scales[1])]
+        uid, specs = self.device_plane.stage(arrs)
         try:
             reader, writer = await self._conn(address)
             header = {
@@ -347,14 +456,18 @@ class KvTransferClient:
                     writer, TwoPartMessage(json.dumps(header).encode(), b"")
                 )
                 frame = await read_frame(reader)  # ack AFTER the peer pulled
-            if not json.loads(frame.header).get("ok"):
+            ack = json.loads(frame.header)
+            self._note_caps(address, ack)
+            if not ack.get("ok"):
                 raise _NoDevicePeer()
         finally:
             self.device_plane.release(uid)
 
     async def read_blocks(self, address: str, block_ids) -> tuple:
         """Pull KV pages from a decode worker's pool by physical id.
-        Returns (k, v, hashes): [L, n, bs, KVH, D] pages plus each page's
+        Returns (k, v, scales, hashes): [L, n, bs, KVH, D] pages, the
+        (k_scale, v_scale) per-token tables when the peer's pool is int8
+        (None otherwise — including pre-int8 peers), plus each page's
         registered content hash (-1 = no longer registered). Device-path
         when both ends have a plane, host-staged TCP otherwise."""
         with tracing.span(
@@ -378,20 +491,22 @@ class KvTransferClient:
                     writer,
                     TwoPartMessage(
                         json.dumps(
-                            {"op": "read_blocks", "block_ids": list(map(int, block_ids))}
+                            {"op": "read_blocks", "int8_ok": True,
+                             "block_ids": list(map(int, block_ids))}
                         ).encode(),
                         b"",
                     ),
                 )
                 frame = await read_frame(reader)
             h = json.loads(frame.header)
-            k_len = h["k_bytes"]
-            k = _unpack(frame.body[:k_len], h["dtype"], h["shape"])
-            v = _unpack(frame.body[k_len:], h["dtype"], h["shape"])
+            self._note_caps(address, h)
+            if h.get("ok") is False:
+                raise KvDtypeMismatch(h.get("error", "peer refused page read"))
+            k, v, scales = _unpack_pages(h, frame.body)
             if tspan is not None:
                 tspan.set_attribute("path", "tcp")
                 tspan.set_attribute("bytes", len(frame.body))
-            return k, v, h.get("hashes") or [-1] * k.shape[1]
+            return k, v, scales, h.get("hashes") or [-1] * k.shape[1]
 
     async def _read_blocks_dev(self, address: str, block_ids) -> tuple:
         reader, writer = await self._conn(address)
@@ -400,13 +515,15 @@ class KvTransferClient:
                 writer,
                 TwoPartMessage(
                     json.dumps(
-                        {"op": "read_blocks_dev", "block_ids": list(map(int, block_ids))}
+                        {"op": "read_blocks_dev", "int8_ok": True,
+                         "block_ids": list(map(int, block_ids))}
                     ).encode(),
                     b"",
                 ),
             )
             frame = await read_frame(reader)
         h = json.loads(frame.header)
+        self._note_caps(address, h)
         if not h.get("ok"):
             raise _NoDevicePeer()
         try:
@@ -422,7 +539,11 @@ class KvTransferClient:
                     b"",
                 ))
                 await read_frame(reader)
-        return pulled[0], pulled[1], h.get("hashes") or [-1] * len(block_ids)
+        scales = (pulled[2], pulled[3]) if len(pulled) > 3 else None
+        return (
+            pulled[0], pulled[1], scales,
+            h.get("hashes") or [-1] * len(block_ids),
+        )
 
     async def send_failure(self, address: str, request_id: str, message: str) -> None:
         reader, writer = await self._conn(address)

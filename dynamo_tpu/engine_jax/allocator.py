@@ -26,6 +26,14 @@ from typing import Any, Callable, Dict, List, Optional, Protocol, Sequence, Tupl
 from dynamo_tpu.kv.tokens import TokenBlockSequence, compute_block_hashes_for_seq
 
 
+class KvDtypeMismatch(TypeError):
+    """KV pages and the target pool disagree on the storage layout (int8
+    pages+scales vs native dtype). Raised instead of writing mismatched
+    bytes into the pool — a dtype skew must surface as a clean typed error,
+    never as silently corrupt pages. The disagg transfer plane maps it to a
+    prefill-failure reply so the decode side falls back to local prefill."""
+
+
 class KvEventSink(Protocol):
     """Receiver for KV cache events (worker → router)."""
 
@@ -45,11 +53,14 @@ class SequenceAllocation:
     token_blocks: TokenBlockSequence  # hashing state (tracks sealed blocks)
     cached_tokens: int  # prompt tokens served from prefix cache (any tier)
     sealed_blocks: int = 0  # how many full blocks have been hashed+registered
-    # host-tier prefix hits: (logical block index, sequence hash, k, v) with
-    # the content captured at probe time (a later offload into the LRU pool
-    # can't invalidate them). The engine must inject each into
-    # block_ids[index] before any compute touches the sequence.
-    host_hits: List[Tuple[int, int, Any, Any]] = field(default_factory=list)
+    # host-tier prefix hits: (logical block index, sequence hash, k, v,
+    # k_scale, v_scale) with the content captured at probe time (a later
+    # offload into the LRU pool can't invalidate them). The scale entries
+    # are None for native-dtype pools and [L, bs] float32 tables for int8
+    # pools — scales travel WITH their pages through every tier. The engine
+    # must inject each into block_ids[index] before any compute touches the
+    # sequence.
+    host_hits: List[Tuple[int, int, Any, Any, Any, Any]] = field(default_factory=list)
     # full-prompt block hashes this sequence advertised as in-flight (it will
     # compute + seal them); unregistered on free if still unsealed
     pending_hashes: List[int] = field(default_factory=list)
@@ -80,7 +91,10 @@ class HostKvPool:
 
     def __init__(self, max_blocks: int):
         self.max_blocks = max_blocks
-        self._data: "OrderedDict[int, Tuple[Any, Any]]" = OrderedDict()
+        # hash → (k, v, k_scale, v_scale); scales are None for native-dtype
+        # pools and per-token tables for int8 pools — the pool is payload-
+        # agnostic so both layouts ride the same LRU
+        self._data: "OrderedDict[int, Tuple[Any, Any, Any, Any]]" = OrderedDict()
         self.hits = 0
         self.offloaded = 0
 
@@ -90,16 +104,16 @@ class HostKvPool:
     def __len__(self) -> int:
         return len(self._data)
 
-    def put(self, h: int, k, v) -> None:
+    def put(self, h: int, k, v, k_scale=None, v_scale=None) -> None:
         if h in self._data:
             self._data.move_to_end(h)
             return
         while len(self._data) >= self.max_blocks:
             self._data.popitem(last=False)
-        self._data[h] = (k, v)
+        self._data[h] = (k, v, k_scale, v_scale)
         self.offloaded += 1
 
-    def get(self, h: int) -> Optional[Tuple[Any, Any]]:
+    def get(self, h: int) -> Optional[Tuple[Any, Any, Any, Any]]:
         item = self._data.get(h)
         if item is not None:
             self._data.move_to_end(h)
@@ -226,14 +240,14 @@ class BlockAllocator:
 
         # host tier continues the chain where the device tier missed; content
         # is captured now so later evictions from the pool can't invalidate it
-        host_hits: List[Tuple[int, int, Any, Any]] = []
+        host_hits: List[Tuple[int, int, Any, Any, Any, Any]] = []
         if self.host_pool is not None:
             j = len(reused)
             while j < max_cacheable:
                 item = self.host_pool.get(seq_hashes[j])
                 if item is None:
                     break
-                host_hits.append((j, seq_hashes[j], item[0], item[1]))
+                host_hits.append((j, seq_hashes[j]) + tuple(item))
                 j += 1
 
         # shared in-flight prefill: if the next missing block is being
@@ -260,7 +274,7 @@ class BlockAllocator:
         # host-hit blocks become valid device content once the engine injects
         # them; register their hashes so the next request hits the device tier
         stored: List[Tuple[int, List[int]]] = []
-        for idx, h, _, _ in host_hits:
+        for idx, h, *_ in host_hits:
             bid = block_ids[idx]
             prior = self._hash_of.get(bid)
             if prior is not None and prior != h:

@@ -43,12 +43,21 @@ from dynamo_tpu.engine_jax.allocator import (
     BlockAllocator,
     HostKvPool,
     InflightPrefix,
+    KvDtypeMismatch,
     KvEventSink,
     SequenceAllocation,
+)
+from dynamo_tpu.engine_jax.drafter import (
+    MAX_SPEC_K,
+    NgramDrafter,
+    env_kv_dtype,
+    env_spec_k,
+    env_spec_ngram,
 )
 from dynamo_tpu.engine_jax.sampling import (
     apply_penalties,
     sample_tokens,
+    speculative_targets,
     token_logprobs,
     update_counts,
 )
@@ -59,6 +68,7 @@ from dynamo_tpu.llm.protocols.common import (
 )
 from dynamo_tpu.models.llama import (
     LlamaConfig,
+    dequantize_kv,
     flush_window,
     forward,
     forward_chunk,
@@ -66,6 +76,7 @@ from dynamo_tpu.models.llama import (
     gather_history,
     lm_head,
     make_kv_cache,
+    quantize_kv,
 )
 from dynamo_tpu.engine_jax.compile_cache import compile_count, record_compile
 from dynamo_tpu.runtime import telemetry, tracing
@@ -93,12 +104,18 @@ class _EnginePerf:
     reflects its last busy period instead of decaying toward zero.
     """
 
-    __slots__ = ("decode_tps", "step_time_ms", "slot_util", "_last_t", "_alpha")
+    __slots__ = (
+        "decode_tps", "step_time_ms", "slot_util", "spec_accept_rate",
+        "_last_t", "_alpha",
+    )
 
     def __init__(self, alpha: float = 0.2):
         self.decode_tps = 0.0
         self.step_time_ms = 0.0
         self.slot_util = 0.0
+        # acceptance-rate EMA over verify dispatches (accepted drafts /
+        # drafted); 0.0 with speculation off or before the first draft
+        self.spec_accept_rate = 0.0
         self._last_t: Optional[float] = None
         self._alpha = alpha
 
@@ -122,6 +139,12 @@ class _EnginePerf:
     def note_slots(self, active: int, total: int) -> None:
         if total > 0:
             self.slot_util = self._ema(self.slot_util, active / total)
+
+    def note_spec(self, drafted: int, accepted: int) -> None:
+        if drafted > 0:
+            self.spec_accept_rate = self._ema(
+                self.spec_accept_rate, accepted / drafted
+            )
 
     def note_idle(self) -> None:
         self._last_t = None
@@ -171,6 +194,21 @@ class EngineConfig:
     # (per-output-channel absmax, models/llama.py quantize_params_int8).
     # Single-chip path; mesh-sharded configs keep bf16.
     quantize: Optional[str] = None
+    # self-draft speculative decoding: number of n-gram-drafted tokens
+    # verified per decode dispatch (engine_jax/drafter.py). None = read
+    # DYN_TPU_SPEC_K (default 0 = off); values clamp to [0, MAX_SPEC_K].
+    # Every accepted draft amortizes one full decode weight stream.
+    spec_k: Optional[int] = None
+    # longest trailing n-gram the drafter probes (None = DYN_TPU_SPEC_NGRAM,
+    # default 3)
+    spec_ngram: Optional[int] = None
+    # KV page storage dtype: "bf16" (native — actually the cache_dtype /
+    # model dtype) or "int8" (quantized pages + per-block scale tables,
+    # halving the KV half of the decode stream at long context). None =
+    # read DYN_TPU_KV_DTYPE. int8 KV is single-chip (mesh=None) for now and
+    # pins the dense decode-history tier (the Pallas kernel has no fused
+    # dequant yet — ROADMAP item 2 pairs them).
+    kv_dtype: Optional[str] = None
 
     def resolve_num_blocks(self) -> int:
         if self.num_kv_blocks is not None:
@@ -192,6 +230,7 @@ class _Seq:
         "temperature", "top_k", "top_p", "seed", "logprobs", "enqueue_t",
         "first_token_t", "admit_t", "remote", "remote_deadline", "prefill_pos",
         "freq_pen", "pres_pen", "out_tokens", "joined_inflight", "wait_hash",
+        "drafter", "spec_drafted", "spec_accepted",
     )
 
     def __init__(self, ctx: Context, request: PreprocessedRequest, loop) -> None:
@@ -233,6 +272,14 @@ class _Seq:
         self.wait_hash: Optional[int] = None  # the in-flight hash it's parked on
         # next prompt position to compute while prefilling; None = decoding
         self.prefill_pos: Optional[int] = None
+        # self-draft speculation (engine_jax/drafter.py): the engine attaches
+        # a per-sequence NgramDrafter only when spec_k > 0 — None keeps the
+        # spec-off step loop allocation-free (the same None-check pattern as
+        # _EnginePerf). Counters feed the per-request acceptance attributes
+        # on the engine.decode span and the spec_accept phase histogram.
+        self.drafter = None
+        self.spec_drafted = 0
+        self.spec_accepted = 0
 
     @property
     def total_len(self) -> int:
@@ -364,6 +411,38 @@ class JaxServingEngine(AsyncEngine):
             self.params_decode = params
         self.params = params
         self.mesh = mesh
+        # self-draft speculative decoding knobs (engine_jax/drafter.py):
+        # config wins when set, else the clamped env parsers. spec_k = 0 is
+        # the off default — the decode path then never touches a drafter.
+        sk = (
+            engine_config.spec_k if engine_config.spec_k is not None
+            else env_spec_k()
+        )
+        self._spec_k = max(0, min(int(sk), MAX_SPEC_K))
+        self._spec_ngram = (
+            engine_config.spec_ngram if engine_config.spec_ngram is not None
+            else env_spec_ngram()
+        )
+        # KV page storage dtype: int8 pages + per-token scale tables halve
+        # the KV half of the decode stream. Single-chip only for now — the
+        # sharded cache path and the Pallas kernel have no dequant tier yet
+        # (ROADMAP item 2 pairs them).
+        if engine_config.kv_dtype not in (None, "bf16", "int8"):
+            # the env parser deliberately degrades typos to the native
+            # layout (a typo must never silently quantize a fleet), but an
+            # explicit config value is a programming error: "INT8" silently
+            # measuring bf16 would invalidate a whole benchmark run
+            raise ValueError(
+                f"kv_dtype={engine_config.kv_dtype!r} not in "
+                "{None, 'bf16', 'int8'}"
+            )
+        kd = engine_config.kv_dtype or env_kv_dtype()
+        self._kv_quantized = kd == "int8"
+        if self._kv_quantized and mesh is not None:
+            raise ValueError(
+                "kv_dtype='int8' requires an unsharded cache (mesh=None); "
+                "sharded engines keep the native KV dtype"
+            )
         # multihost lockstep: every host array entering a global-mesh jit is
         # built as a replicated global array (jnp.asarray cannot span
         # processes); single-host configs take the plain path
@@ -394,6 +473,10 @@ class JaxServingEngine(AsyncEngine):
             model_config.head_dim,
         )
         cdtype = cache_dtype or model_config.dtype
+        # compute dtype of attention inputs: int8 pages dequantize into this
+        # (and the decode window buffers are allocated in it — never in the
+        # pool's storage dtype)
+        self._compute_dtype = cdtype
         if mesh is not None:
             from dynamo_tpu.parallel.mesh import kv_cache_sharding
 
@@ -406,7 +489,7 @@ class JaxServingEngine(AsyncEngine):
         else:
             self.cache = make_kv_cache(
                 model_config, self.num_blocks, engine_config.kv_block_size,
-                dtype=cdtype,
+                dtype=cdtype, quantized=self._kv_quantized,
             )
 
         S = engine_config.max_slots
@@ -445,6 +528,7 @@ class JaxServingEngine(AsyncEngine):
         self._m_fpack = _DevMirror(self._put)
         self._counts_lanes: List[Optional[_Seq]] = [None] * S
         self._counts_sync_fns: Dict[Tuple[int, int], Any] = {}
+        self._counts_fix_fns: Dict[int, Any] = {}
 
         self._step_counter = 0
 
@@ -476,15 +560,22 @@ class JaxServingEngine(AsyncEngine):
         self._hold_ids: set = set()
         self._held_allocs: Dict[str, SequenceAllocation] = {}
 
-        # host-tier spills in flight: (pairs, k_dev, v_dev) whose async host
-        # copies haven't been harvested into the host pool yet
-        self._pending_spills: Deque[Tuple[List[Tuple[int, int]], Any, Any]] = deque()
+        # host-tier spills in flight: (pairs, k_dev, v_dev, k_scale_dev,
+        # v_scale_dev) whose async host copies haven't been harvested into
+        # the host pool yet (scale entries None for native-dtype pools)
+        self._pending_spills: Deque[
+            Tuple[List[Tuple[int, int]], Any, Any, Any, Any]
+        ] = deque()
 
         # stats
         self.total_requests = 0
         self.total_generated_tokens = 0
         self.total_prompt_tokens = 0
         self.preemptions = 0
+        # speculative decoding (cumulative): drafts handed to verify
+        # dispatches and how many matched their sampled targets
+        self.spec_drafted_total = 0
+        self.spec_accepted_total = 0
 
         # health plane: the step loop beats this once per iteration; a busy
         # engine whose beats stop is a wedged engine thread (device hang,
@@ -502,6 +593,9 @@ class JaxServingEngine(AsyncEngine):
         # lazily per need
         self._decode_fns: Dict[Tuple[bool, bool, bool], Any] = {}
         self._chunk_fns: Dict[Tuple[bool, bool, bool], Any] = {}
+        # speculative-verify variants (same key space); never built with
+        # spec_k == 0 — asserted by the zero-overhead guard test
+        self._verify_fns: Dict[Tuple[bool, bool, bool], Any] = {}
 
         # decode history tier, fixed at build time (the attention policy env
         # vars are read here rather than per-trace). Both tiers are window-
@@ -519,6 +613,12 @@ class JaxServingEngine(AsyncEngine):
             dense_history_bytes=hist_bytes,
             dense_history_budget=ec.dense_history_max_bytes,
         )
+        if self._kv_quantized:
+            # the Pallas kernel has no fused dequant: int8 pools pin the
+            # dense decode-history tier (gather_history dequantizes). The
+            # dense buffer is transient compute-dtype working set the
+            # einsums needed anyway; the HBM *read* is the halved int8 one.
+            self._decode_dense = True
 
         # pipeline parallelism: when the mesh has a pp axis > 1, step fns
         # route through parallel/pipeline.py's GPipe schedule (layer stages
@@ -668,10 +768,15 @@ class JaxServingEngine(AsyncEngine):
                 cfg.num_layers, self.config.max_slots, k_steps,
                 cfg.num_kv_heads, cfg.head_dim,
             )
-            wk0 = jnp.zeros(wshape, cache["k"].dtype)
-            wv0 = jnp.zeros(wshape, cache["v"].dtype)
+            # window buffers hold COMPUTE-dtype values even over an int8
+            # pool (they are attended directly; flush_window quantizes them
+            # on the way into the pages)
+            wk0 = jnp.zeros(wshape, self._compute_dtype)
+            wv0 = jnp.zeros(wshape, self._compute_dtype)
             if dense:
-                hist_k, hist_v = gather_history(cache, tables)
+                hist_k, hist_v = gather_history(
+                    cache, tables, out_dtype=self._compute_dtype
+                )
                 history = ("dense", hist_k, hist_v)
             else:
                 interpret = jax.devices()[0].platform == "cpu"
@@ -824,6 +929,65 @@ class JaxServingEngine(AsyncEngine):
             return jax.jit(chunk, donate_argnums=(1, 2), out_shardings=out_sh)
         return jax.jit(chunk, donate_argnums=(1, 2))
 
+    def _verify(self, want_lp: bool, want_pen: bool = False,
+                want_sample: bool = True):
+        """The speculative-verify variant (drafted tokens scored in one
+        weight stream; engine_jax/drafter.py). Compiled lazily like the
+        decode/chunk variants — and never at all while spec_k == 0."""
+        key = (want_lp, want_pen, want_sample)
+        fn = self._verify_fns.get(key)
+        if fn is None:
+            record_compile("verify")
+            fn = self._verify_fns[key] = self._build_verify_fn(
+                want_lp, want_pen, want_sample
+            )
+        return fn
+
+    def _build_verify_fn(self, with_lp: bool = False, with_pen: bool = False,
+                         with_sample: bool = True):
+        """One speculative-verify dispatch: feed ``[last_token, draft_0, ..,
+        draft_{k-1}]`` per lane ([S, K1] with -1-position padding), compute
+        logits at EVERY fed position in one forward pass, and sample the
+        engine's own target token per position (sampling.speculative_targets
+        — the point-mass rejection-sampling rule). The host keeps the
+        drafted prefix that matches the targets plus the first non-matching
+        target as the bonus token, so one weight stream emits up to k+1
+        tokens. Unlike the chunk fn, the LM head runs on all K1 positions —
+        at K1 ≤ MAX_SPEC_K+1 that head matmul is the price of admission for
+        the amortized stream, and it is a fraction of the full chunk head
+        this path replaces."""
+        cfg = self.model_config
+        n_top = self.config.top_logprobs
+
+        def verify(params, cache, counts, tokens, positions, tables, step_ctr,
+                   ipack, fpack):
+            step_key = jax.random.fold_in(jax.random.PRNGKey(0), step_ctr)
+            seeds, topk = ipack[0], ipack[1]
+            temp, topp, freqp, presp = fpack[0], fpack[1], fpack[2], fpack[3]
+            # KV for every fed position is written by the forward pass;
+            # positions past the accepted prefix hold garbage that later
+            # dispatches overwrite before any mask lets it be attended
+            # (history masks are position-based: pool reads stop below each
+            # lane's current position).
+            h, cache = forward_chunk(
+                params, cfg, tokens, positions, cache, tables,
+                hidden_only=True, with_history=True,
+            )
+            logits_all = lm_head(params, cfg, h)  # [S, K1, V] f32
+            outs = speculative_targets(
+                logits_all, counts, positions >= 0, step_key, seeds,
+                temp, topk, topp, freqp, presp,
+                with_pen=with_pen, with_sample=with_sample, with_lp=with_lp,
+                n_top=n_top,
+            )
+            if with_lp:
+                tgt, lp, tids, tlps, counts = outs
+                return tgt, lp, tids, tlps, cache, counts
+            tgt, counts = outs
+            return tgt, cache, counts
+
+        return jax.jit(verify, donate_argnums=(1, 2))
+
     # -- penalty-count buffer -------------------------------------------------
 
     def _counts_sync_fn(self, rbucket: int, pbucket: int):
@@ -840,6 +1004,25 @@ class JaxServingEngine(AsyncEngine):
 
             fn = self._counts_sync_fns[(rbucket, pbucket)] = jax.jit(
                 sync, donate_argnums=(0,)
+            )
+        return fn
+
+    def _counts_fix_fn(self, pbucket: int):
+        """Tiny jitted subtraction of over-added penalty counts. The verify
+        scan adds EVERY active position's target into the count buffer
+        (sequential exactness up to the first draft mismatch costs pollution
+        past it); the host knows exactly which targets were kept, so the
+        correction is ≤ spec_k entries per lane per dispatch — never a full
+        out_tokens rebuild. Padded entries use row index S, dropped."""
+        fn = self._counts_fix_fns.get(pbucket)
+        if fn is None:
+            record_compile("counts_fix")
+
+            def fix(counts, rows, toks):
+                return counts.at[rows, toks].add(-1, mode="drop")
+
+            fn = self._counts_fix_fns[pbucket] = jax.jit(
+                fix, donate_argnums=(0,)
             )
         return fn
 
@@ -1013,6 +1196,14 @@ class JaxServingEngine(AsyncEngine):
                 (pd_sd, cache_sd, counts_sd, svec, svec, tbl, ctr, ip, fp),
                 ("decode", False, False, want_sample),
             ))
+            if self._spec_k > 0:
+                sk1 = sd((S, self._spec_k + 1), jnp.int32)
+                jobs.append((
+                    f"verify(sample={want_sample})",
+                    self._verify(False, False, want_sample),
+                    (pd_sd, cache_sd, counts_sd, sk1, sk1, tbl, ctr, ip, fp),
+                    ("verify", False, False, want_sample),
+                ))
 
         def compile_one(job):
             name, fn, args, key = job
@@ -1028,6 +1219,8 @@ class JaxServingEngine(AsyncEngine):
                 # serve straight off the compiled executable
                 if key[0] == "chunk":
                     self._chunk_fns[key[1:]] = compiled
+                elif key[0] == "verify":
+                    self._verify_fns[key[1:]] = compiled
                 else:
                     self._decode_fns[key[1:]] = compiled
         return timings
@@ -1048,6 +1241,15 @@ class JaxServingEngine(AsyncEngine):
             return
         self._ensure_thread()
         seq = _Seq(request, req, asyncio.get_running_loop())
+        if self._spec_k > 0 and not self._multihost:
+            # one suffix index per request (prompt indexed up front, emitted
+            # tokens appended as they stream); spec off ⇒ stays None and the
+            # step loop never allocates drafter state. Multihost never
+            # dispatches verify (followers only replay chunk/decode
+            # opcodes), so it must not pay the index either.
+            seq.drafter = NgramDrafter(
+                seq.prompt, self._spec_k, self._spec_ngram
+            )
         with self._cond:
             self._pending.append(seq)
             self._cond.notify()
@@ -1371,6 +1573,24 @@ class JaxServingEngine(AsyncEngine):
             # chunk prefill needs each decode lane's true last token host-side
             self._drain_inflight()
             self._chunk_step()
+        elif (
+            self._spec_k > 0
+            and self._dispatch_hook is None
+            and not self._multihost
+            and any(
+                s.drafter is not None and s.drafter.would_draft()
+                for s in active
+            )
+        ):
+            # all lanes decoding and at least one drafter's index holds a
+            # usable match (would_draft: dormancy + a pre-drain probe of
+            # the suffix index — a verify dispatch costs a pipeline drain,
+            # so lanes that can't possibly propose must not pay it): try a
+            # verify dispatch (it still falls back to the plain pipelined
+            # decode step when, after draining, no lane actually drafts).
+            # Multihost followers only replay chunk/decode opcodes, so the
+            # leader keeps speculation off on a process-spanning mesh.
+            self._verify_step()
         else:
             self._decode_step()
 
@@ -1662,6 +1882,80 @@ class JaxServingEngine(AsyncEngine):
         if prev is not None:
             self._process_chunk(prev, defer_free=True)
 
+    def _emit_token_run(
+        self,
+        seq: "_Seq",
+        cand: List[int],
+        lp_rows,  # None, or (lps_row [k], tids_row [k, n_top], tlps_row)
+        *,
+        defer_free: bool = False,
+    ) -> int:
+        """Emit one multi-token run for a lane — the shared tail of the
+        pipelined chunk and the speculative verify dispatch. Cuts the
+        candidate run at max_tokens / max_model_len / first EOS, registers
+        fed-token KV, assembles logprobs, emits ONE item (per-token emission
+        costs a dict build + a call_soon_threadsafe wakeup each — at 32
+        lanes × 64-step chunks that Python overhead rivals the decode step's
+        device time), and finishes the lane on a terminal cut. Returns the
+        number of tokens actually emitted."""
+        cfg = self.config
+        n_take = min(
+            len(cand),
+            seq.max_tokens - seq.emitted,
+            cfg.max_model_len - seq.total_len,
+        )
+        finish: Optional[FinishReason] = None
+        if n_take < len(cand):
+            finish = FinishReason.LENGTH
+        toks = cand[:n_take]
+        if seq.eos_ids and not seq.ignore_eos:
+            for j, t in enumerate(toks):
+                if t in seq.eos_ids:
+                    toks = toks[: j + 1]
+                    finish = FinishReason.EOS
+                    break
+        if not toks:
+            if finish is not None:
+                self._finish(seq, finish, defer_free=defer_free)
+            return 0
+        if finish is None and seq.emitted + len(toks) >= seq.max_tokens:
+            finish = FinishReason.LENGTH
+        elif finish is None and seq.total_len + len(toks) >= cfg.max_model_len:
+            finish = FinishReason.LENGTH
+        # fed tokens whose KV is valid AND part of the sequence: the carried
+        # last token plus every emitted token bar the final one (in the
+        # verify dispatch, matched drafts ARE the emitted prefix)
+        fed0 = seq.generated[-1] if seq.generated else seq.prompt[-1]
+        self.allocator.note_tokens_computed(seq.alloc, [fed0] + toks[:-1])
+
+        log_probs = top_logprobs = None
+        if lp_rows is not None and seq.logprobs is not None:
+            lps_row, tids_row, tlps_row = lp_rows
+            n = len(toks)
+            log_probs = [float(x) for x in lps_row[:n]]
+            if seq.logprobs > 0:
+                kk = min(seq.logprobs, tids_row.shape[1])
+                top_logprobs = [
+                    {int(tids_row[j, p]): float(tlps_row[j, p])
+                     for p in range(kk)}
+                    for j in range(n)
+                ]
+        seq.generated.extend(toks)
+        seq.out_tokens.extend(toks)
+        if seq.drafter is not None:
+            seq.drafter.extend(toks)
+        seq.emitted += len(toks)
+        self.total_generated_tokens += len(toks)
+        seq.emit(Annotated.from_data(
+            LLMEngineOutput(
+                token_ids=toks, log_probs=log_probs, top_logprobs=top_logprobs
+            ).to_dict(),
+            id=seq.ctx.id,
+        ))
+        if finish is not None:
+            self._finish(seq, finish, defer_free=defer_free)
+        return len(toks)
+
     def _process_chunk(self, chunk: _Inflight, defer_free: bool) -> None:
         if self._perf is not None:
             # gap between consecutive processed chunks ≈ chunk wall time in
@@ -1685,68 +1979,214 @@ class JaxServingEngine(AsyncEngine):
         for i, seq in enumerate(chunk.lanes):
             if seq is None or seq.slot != i:
                 continue  # empty lane, or finished in an earlier chunk
-            # accepted run for this lane: cut at max_tokens / max_model_len /
-            # first EOS, then emit ONE multi-token item. Per-token emission
-            # costs a dict build + a call_soon_threadsafe wakeup each — at
-            # 32 lanes × 64-step chunks that Python overhead (~1 ms/step,
-            # measured) rivals the decode step's own device time.
-            row = out[i]
-            k = row.shape[0]
-            n_take = min(
-                k,
-                seq.max_tokens - seq.emitted,
-                self.config.max_model_len - seq.total_len,
+            self._emit_token_run(
+                seq,
+                [int(t) for t in out[i]],
+                (lps[i], tids[i], tlps[i]) if lps is not None else None,
+                defer_free=defer_free,
             )
-            finish: Optional[FinishReason] = None
-            if n_take < k:
-                finish = FinishReason.LENGTH
-            toks = [int(t) for t in row[:n_take]]
-            if seq.eos_ids and not seq.ignore_eos:
-                for j, t in enumerate(toks):
-                    if t in seq.eos_ids:
-                        toks = toks[: j + 1]
-                        finish = FinishReason.EOS
-                        break
-            if not toks:
-                if finish is not None:
-                    self._finish(seq, finish, defer_free=defer_free)
-                continue
-            if finish is None and seq.emitted + len(toks) >= seq.max_tokens:
-                finish = FinishReason.LENGTH
-            elif finish is None and seq.total_len + len(toks) >= self.config.max_model_len:
-                finish = FinishReason.LENGTH
-            # fed tokens this chunk: last accepted token, then each accepted
-            # output fed back. KV is registered only for fed tokens.
-            fed0 = seq.generated[-1] if seq.generated else seq.prompt[-1]
-            self.allocator.note_tokens_computed(seq.alloc, [fed0] + toks[:-1])
-
-            log_probs = top_logprobs = None
-            if lps is not None and seq.logprobs is not None:
-                n = len(toks)
-                log_probs = [float(x) for x in lps[i, :n]]
-                if seq.logprobs > 0:
-                    kk = min(seq.logprobs, tids.shape[2])
-                    top_logprobs = [
-                        {int(tids[i, j, p]): float(tlps[i, j, p]) for p in range(kk)}
-                        for j in range(n)
-                    ]
-            seq.generated.extend(toks)
-            seq.out_tokens.extend(toks)
-            seq.emitted += len(toks)
-            self.total_generated_tokens += len(toks)
-            seq.emit(Annotated.from_data(
-                LLMEngineOutput(
-                    token_ids=toks, log_probs=log_probs, top_logprobs=top_logprobs
-                ).to_dict(),
-                id=seq.ctx.id,
-            ))
-            if finish is not None:
-                self._finish(seq, finish, defer_free=defer_free)
         if self._perf is not None:
             self._perf.note_decode(
                 self.total_generated_tokens - tokens_before,
                 self.config.decode_steps,
             )
+
+    def _verify_step(self) -> None:
+        """One speculative-verify dispatch (self-draft, engine_jax/drafter.py).
+
+        Probes every decode lane's n-gram drafter, feeds ``[last_token,
+        draft_0..draft_{k-1}]`` through the jit verify variant (one weight
+        stream for all K1 positions), and accepts the longest drafted prefix
+        matching the in-jit sampled targets plus the first non-matching
+        target as the bonus token. Greedy output is bitwise identical to the
+        sequential decode path; sampled output follows the exact
+        autoregressive distribution (speculative_targets docstring).
+
+        Not pipelined: the next dispatch's fed tokens depend on this one's
+        acceptance, so the chunk is fetched synchronously — the amortized
+        weight stream is what pays for the lost overlap. When no lane
+        drafts (cold drafters, dormant after sustained rejection), control
+        falls through to the plain pipelined decode step, so adversarial
+        workloads keep the non-speculative fast path."""
+        cfg = self.config
+        S = cfg.max_slots
+        # host needs every lane's true last token and the drafters need the
+        # emitted suffix up to date before proposing
+        self._drain_inflight()
+        for seq in [
+            s for s in self._slots
+            if s is not None and s.ctx.context.is_stopped
+        ]:
+            self._finish(seq, FinishReason.CANCELLED)
+        if not any(self._slots):
+            return
+
+        drafts: List[Optional[List[int]]] = [None] * S
+        n_drafted = 0
+        for i, seq in enumerate(self._slots):
+            if seq is None or seq.drafter is None:
+                continue
+            # cap: fed positions must stay under max_model_len, and drafts
+            # past the request's remaining token budget are dead weight
+            cap = min(
+                self._spec_k,
+                cfg.max_model_len - seq.total_len,
+                seq.max_tokens - seq.emitted,
+            )
+            if cap <= 0:
+                continue
+            d = seq.drafter.draft()
+            if d:
+                drafts[i] = d[:cap]
+                n_drafted += len(drafts[i])
+        if n_drafted == 0:
+            self._decode_step()
+            return
+
+        # capacity for the drafted positions (non-pipelined: preemption here
+        # has no zombie-chunk complication)
+        for i, seq in enumerate(self._slots):
+            if seq is None:
+                continue
+            need = min(seq.total_len + len(drafts[i] or []), cfg.max_model_len)
+            if not self.allocator.grow(seq.alloc, need):
+                drafts[i] = None
+                self._preempt(seq)
+        if not any(self._slots):
+            return
+        if not any(
+            drafts[i] for i in range(S) if self._slots[i] is not None
+        ):
+            self._decode_step()
+            return
+
+        k1 = self._spec_k + 1
+        tokens = np.zeros((S, k1), np.int32)
+        positions = np.full((S, k1), -1, np.int32)
+        for i in range(S):
+            seq = self._slots[i]
+            self._tables[i, :] = 0
+            self._temp[i] = 0.0
+            self._topk[i] = 0
+            self._topp[i] = 1.0
+            self._seeds[i] = 0
+            self._freqp[i] = 0.0
+            self._presp[i] = 0.0
+            if seq is None:
+                continue
+            fed = [seq.generated[-1] if seq.generated else seq.prompt[-1]]
+            fed += drafts[i] or []
+            n = len(fed)
+            tokens[i, :n] = fed
+            positions[i, :n] = np.arange(seq.total_len - 1, seq.total_len - 1 + n)
+            self._tables[i, : len(seq.alloc.block_ids)] = seq.alloc.block_ids
+            self._temp[i] = seq.temperature
+            self._topk[i] = seq.top_k
+            self._topp[i] = seq.top_p
+            self._seeds[i] = seq.seed & 0x7FFFFFFF
+            self._freqp[i] = seq.freq_pen
+            self._presp[i] = seq.pres_pen
+
+        self._step_counter += 1
+        lanes = list(self._slots)
+        want_lp = any(s is not None and s.logprobs is not None for s in lanes)
+        want_pen = any(s is not None and s.penalized for s in lanes)
+        want_sample = any(s is not None and s.temperature > 0.0 for s in lanes)
+        if want_pen:
+            self._sync_counts(lanes)
+        counts_in = self._counts if want_pen else self._dummy_counts
+        ipack_np = np.stack([self._seeds, self._topk])
+        fpack_np = np.stack([self._temp, self._topp, self._freqp, self._presp])
+        args = (
+            self.params_decode, self.cache, counts_in, self._put(tokens),
+            self._put(positions), self._m_tables.get(self._tables),
+            self._put(np.int32(self._step_counter)),
+            self._m_ipack.get(ipack_np), self._m_fpack.get(fpack_np),
+        )
+        if want_lp:
+            tgt, lps, tids, tlps, self.cache, counts_out = self._verify(
+                True, want_pen, want_sample
+            )(*args)
+            for arr in (tgt, lps, tids, tlps):
+                arr.copy_to_host_async()
+            # dynlint: allow-host-sync(leader sync: one fetch per verify
+            # dispatch — acceptance decides the next dispatch's inputs, so
+            # this path is deliberately not pipelined)
+            tgt_np, lp_np, tids_np, tlps_np = jax.device_get(
+                (tgt, lps, tids, tlps)
+            )
+        else:
+            tgt, self.cache, counts_out = self._verify(
+                False, want_pen, want_sample
+            )(*args)
+            tgt.copy_to_host_async()
+            # dynlint: allow-host-sync(leader sync: one fetch per verify dispatch)
+            tgt_np = np.asarray(jax.device_get(tgt))
+            lp_np = tids_np = tlps_np = None
+        if want_pen:
+            self._counts = counts_out
+        else:
+            self._dummy_counts = counts_out
+            self._release_counts()
+
+        if self._perf is not None:
+            tokens_before = self.total_generated_tokens
+            self._perf.note_slots(
+                sum(1 for s in self._slots if s is not None), S
+            )
+        drafted_total = accepted_total = 0
+        fix_pairs: List[Tuple[int, int]] = []
+        for i in range(S):
+            seq = self._slots[i]
+            if seq is None:
+                continue
+            d = drafts[i] or []
+            row = tgt_np[i]
+            a = 0
+            while a < len(d) and int(row[a]) == d[a]:
+                a += 1
+            if d:
+                seq.drafter.note_result(len(d), a)
+                seq.spec_drafted += len(d)
+                seq.spec_accepted += a
+                self.spec_drafted_total += len(d)
+                self.spec_accepted_total += a
+                drafted_total += len(d)
+                accepted_total += a
+            penalized = seq.penalized
+            # emitted run: matched drafts + the bonus target, then the same
+            # cut rules as _process_chunk (shared _emit_token_run tail)
+            n_emitted = self._emit_token_run(
+                seq,
+                [int(t) for t in row[: a + 1]],
+                (lp_np[i], tids_np[i], tlps_np[i])
+                if lp_np is not None else None,
+            )
+            if want_pen and penalized:
+                # the scan added EVERY active position's target into this
+                # lane's count row (sequential exactness up to the first
+                # mismatch costs pollution past it); subtract the targets
+                # that were NOT emitted — rejected positions plus any cut
+                # by max_tokens / max_model_len / EOS
+                for j in range(n_emitted, 1 + len(d)):
+                    fix_pairs.append((i, int(row[j])))
+        if fix_pairs and self._counts is not None:
+            pb = 1
+            while pb < len(fix_pairs):
+                pb *= 2
+            rows = np.full((pb,), S, np.int32)
+            toks_np = np.zeros((pb,), np.int32)
+            for j, (r, t) in enumerate(fix_pairs):
+                rows[j] = r
+                toks_np[j] = t
+            self._counts = self._counts_fix_fn(pb)(
+                self._counts, self._put(rows), self._put(toks_np)
+            )
+        if self._perf is not None:
+            self._perf.note_decode(
+                self.total_generated_tokens - tokens_before, 1
+            )
+            self._perf.note_spec(drafted_total, accepted_total)
 
     def _drain_inflight(self) -> None:
         """Fetch + process any in-flight chunk, then release zombie blocks
@@ -1763,6 +2203,8 @@ class JaxServingEngine(AsyncEngine):
     ) -> None:
         seq.generated.append(tok)
         seq.out_tokens.append(tok)
+        if seq.drafter is not None:
+            seq.drafter.extend((tok,))
         seq.emitted += 1
         self.total_generated_tokens += 1
         finish: Optional[FinishReason] = None
@@ -1834,9 +2276,20 @@ class JaxServingEngine(AsyncEngine):
                 phase="prefill",
                 attributes={"remote": True} if seq.remote else None,
             )
+            decode_attrs: Dict[str, Any] = {"tokens": seq.emitted}
+            if seq.spec_drafted:
+                # per-request speculation outcome on the decode span, plus a
+                # dimensionless acceptance-rate observation (0..1) on the
+                # spec_accept phase histogram — p50/p95 of per-request
+                # acceptance through the same pipeline as the latencies
+                decode_attrs["spec_drafted"] = seq.spec_drafted
+                decode_attrs["spec_accepted"] = seq.spec_accepted
+                tracing.observe_phase(
+                    "spec_accept", seq.spec_accepted / seq.spec_drafted
+                )
             tracing.record_span(
                 "engine.decode", first, now, parent=parent, phase="decode",
-                attributes={"tokens": seq.emitted},
+                attributes=decode_attrs,
             )
 
     def _finish(self, seq: _Seq, reason: FinishReason, defer_free: bool = False) -> None:
@@ -1893,20 +2346,29 @@ class JaxServingEngine(AsyncEngine):
         self._remote_policy = policy
 
     def extract_blocks(self, block_ids: List[int], as_device: bool = False):
-        """Copy KV pages out of the pool ([L, n, bs, KVH, D] ×2): host numpy,
-        or device arrays with ``as_device`` (same-host transfers keep pages
-        on-device and let XLA reshard at the destination's inject boundary).
+        """Copy KV pages out of the pool: ``(k, v, k_scale, v_scale)`` with
+        pages [L, n, bs, KVH, D] ×2 and, for int8 pools, the per-token scale
+        tables [L, n, bs] ×2 (None on native-dtype pools — scales travel
+        WITH their pages through every transfer tier). Host numpy, or device
+        arrays with ``as_device`` (same-host transfers keep pages on-device
+        and let XLA reshard at the destination's inject boundary).
         MUST run on the engine thread (e.g. via post())."""
         idx = jnp.asarray(block_ids, jnp.int32)
+        arrs = [self.cache["k"][:, idx], self.cache["v"][:, idx]]
+        if self._kv_quantized:
+            arrs.append(self.cache["k_scale"][:, idx])
+            arrs.append(self.cache["v_scale"][:, idx])
         if as_device:
-            return self.cache["k"][:, idx], self.cache["v"][:, idx]
-        k_dev = self.cache["k"][:, idx]
-        v_dev = self.cache["v"][:, idx]
-        k_dev.copy_to_host_async()
-        v_dev.copy_to_host_async()
-        # dynlint: allow-host-sync(page extraction for KV transfer; off the
-        # decode loop, copies started async above)
-        return np.asarray(jax.device_get(k_dev)), np.asarray(jax.device_get(v_dev))
+            out = list(arrs)
+        else:
+            for a in arrs:
+                a.copy_to_host_async()
+            # dynlint: allow-host-sync(page extraction for KV transfer; off
+            # the decode loop, copies started async above)
+            out = [np.asarray(x) for x in jax.device_get(arrs)]
+        while len(out) < 4:
+            out.append(None)
+        return tuple(out)
 
     def block_hashes_of(self, block_ids: List[int]) -> List[int]:
         """The allocator-registered content hash per physical page (-1 for a
@@ -1915,23 +2377,38 @@ class JaxServingEngine(AsyncEngine):
         run on the engine thread."""
         return [self.allocator.hash_of_block(bid) for bid in block_ids]
 
-    def seed_external_prefix(self, token_ids: List[int], k_pages, v_pages) -> int:
+    def seed_external_prefix(
+        self, token_ids: List[int], k_pages, v_pages,
+        k_scale=None, v_scale=None,
+    ) -> int:
         """Register externally-computed prefix KV (pages read from another
         worker) into this engine's prefix cache: allocator registration +
         page injection, atomically on the engine thread. ``k_pages`` covers
         ALL full blocks of ``token_ids`` ([L, n_full, bs, KVH, D]); already-
-        cached blocks are skipped. Returns the number of blocks seeded.
+        cached blocks are skipped. int8 pools require the matching per-token
+        scale tables ([L, n_full, bs]). Returns the number of blocks seeded.
         MUST run on the engine thread (via post())."""
+        if self._kv_quantized != (k_scale is not None):
+            # check BEFORE touching the allocator: a mismatch must not leave
+            # seeded-but-never-injected hashes in the prefix cache
+            raise KvDtypeMismatch(
+                "pool kv_dtype is %s but pages %s scale tables" % (
+                    "int8" if self._kv_quantized else "native",
+                    "lack" if k_scale is None else "carry",
+                )
+            )
         pairs = self.allocator.seed_cached(token_ids)
         if not pairs:
             return 0
         block_ids = [bid for _, bid in pairs]
         sel = [i for i, _ in pairs]
         if isinstance(k_pages, jax.Array):
-            idx = jnp.asarray(sel, jnp.int32)
-            self.inject_blocks(block_ids, k_pages[:, idx], v_pages[:, idx])
-        else:
-            self.inject_blocks(block_ids, k_pages[:, sel], v_pages[:, sel])
+            sel = jnp.asarray(sel, jnp.int32)
+        self.inject_blocks(
+            block_ids, k_pages[:, sel], v_pages[:, sel],
+            k_scale[:, sel] if k_scale is not None else None,
+            v_scale[:, sel] if v_scale is not None else None,
+        )
         return len(pairs)
 
     # -- held allocations (prefill-worker page extraction) --------------------
@@ -1977,7 +2454,9 @@ class JaxServingEngine(AsyncEngine):
             self._inject_jit = jax.jit(inject, donate_argnums=(0,))
         return self._inject_jit
 
-    def inject_blocks(self, block_ids: List[int], k_np, v_np) -> None:
+    def inject_blocks(
+        self, block_ids: List[int], k_np, v_np, k_scale=None, v_scale=None
+    ) -> None:
         """Write transferred KV pages into HBM at the given physical pages.
         MUST run on the engine thread. Donated update (no cache-sized copy);
         the page count is padded to a power of two so at most log2(max_blocks)
@@ -1986,7 +2465,18 @@ class JaxServingEngine(AsyncEngine):
 
         Accepts host numpy (staged transfers) or jax arrays (the same-host
         device path: pages flow device→device, resharding across meshes —
-        including differing tp — handled by XLA at the jit boundary)."""
+        including differing tp — handled by XLA at the jit boundary).
+
+        int8 pools require matching per-token scale tables ([L, n, bs] ×2);
+        a layout mismatch raises :class:`KvDtypeMismatch` before any byte
+        lands — corrupt pages are strictly worse than a failed transfer."""
+        if self._kv_quantized != (k_scale is not None):
+            raise KvDtypeMismatch(
+                "pool kv_dtype is %s but injected pages %s scale tables" % (
+                    "int8" if self._kv_quantized else "native",
+                    "lack" if k_scale is None else "carry",
+                )
+            )
         n = len(block_ids)
         bucket = 1
         while bucket < n:
@@ -2015,6 +2505,16 @@ class JaxServingEngine(AsyncEngine):
         idx_dev = jnp.asarray(idx)
         self.cache["k"] = fn(self.cache["k"], idx_dev, jnp.asarray(pad(k_np), dt))
         self.cache["v"] = fn(self.cache["v"], idx_dev, jnp.asarray(pad(v_np), dt))
+        if k_scale is not None:
+            # scale tables ride the same padded scatter ([L, n, bs] slots in
+            # place of [L, n, bs, KVH, D] pages — pad() is rank-agnostic)
+            sdt = self.cache["k_scale"].dtype
+            self.cache["k_scale"] = fn(
+                self.cache["k_scale"], idx_dev, jnp.asarray(pad(k_scale), sdt)
+            )
+            self.cache["v_scale"] = fn(
+                self.cache["v_scale"], idx_dev, jnp.asarray(pad(v_scale), sdt)
+            )
 
     # -- host KV tier ---------------------------------------------------------
 
@@ -2035,7 +2535,13 @@ class JaxServingEngine(AsyncEngine):
         v = self.cache["v"][:, idx]
         k.copy_to_host_async()
         v.copy_to_host_async()
-        self._pending_spills.append((pairs, k, v))
+        ks = vs = None
+        if self._kv_quantized:
+            ks = self.cache["k_scale"][:, idx]
+            vs = self.cache["v_scale"][:, idx]
+            ks.copy_to_host_async()
+            vs.copy_to_host_async()
+        self._pending_spills.append((pairs, k, v, ks, vs))
 
     def _harvest_spills(self, force: bool = False) -> None:
         """Move completed async spills into the host pool (engine thread).
@@ -2047,7 +2553,7 @@ class JaxServingEngine(AsyncEngine):
         if len(self._pending_spills) > 8:
             force = True
         while self._pending_spills:
-            pairs, k, v = self._pending_spills[0]
+            pairs, k, v, ks, vs = self._pending_spills[0]
             if not force:
                 try:
                     if not (k.is_ready() and v.is_ready()):
@@ -2059,6 +2565,10 @@ class JaxServingEngine(AsyncEngine):
             # once is_ready(), or force-drained while the engine is idle)
             k_np = np.asarray(jax.device_get(k))
             v_np = np.asarray(jax.device_get(v))  # dynlint: allow-host-sync(ditto)
+            if ks is not None:
+                # dynlint: allow-host-sync(scale tables ride the same spill)
+                ks_np = np.asarray(jax.device_get(ks))
+                vs_np = np.asarray(jax.device_get(vs))  # dynlint: allow-host-sync(ditto)
             for i, (h, _) in enumerate(pairs):
                 # copies, not views: a view would pin the whole batch array
                 # in host RAM for as long as any one entry stays in the pool
@@ -2066,23 +2576,36 @@ class JaxServingEngine(AsyncEngine):
                     h,
                     np.ascontiguousarray(k_np[:, i]),
                     np.ascontiguousarray(v_np[:, i]),
+                    np.ascontiguousarray(ks_np[:, i]) if ks is not None else None,
+                    np.ascontiguousarray(vs_np[:, i]) if ks is not None else None,
                 )
 
     def _inject_host_hits(self, alloc: SequenceAllocation) -> None:
         """Load host-tier prefix hits back into the sequence's device pages
-        (engine thread only). Runs before any compute touches the sequence."""
-        block_ids = [alloc.block_ids[idx] for idx, _, _, _ in alloc.host_hits]
-        k = np.stack([k for _, _, k, _ in alloc.host_hits], axis=1)
-        v = np.stack([v for _, _, _, v in alloc.host_hits], axis=1)
+        (engine thread only). Runs before any compute touches the sequence.
+        int8 pools carry their per-token scale tables through the same hop
+        (allocator host_hits 6-tuples)."""
+        hits = alloc.host_hits
+        block_ids = [alloc.block_ids[h[0]] for h in hits]
+        k = np.stack([h[2] for h in hits], axis=1)
+        v = np.stack([h[3] for h in hits], axis=1)
+        ks = vs = None
+        if hits[0][4] is not None:
+            ks = np.stack([h[4] for h in hits], axis=1)
+            vs = np.stack([h[5] for h in hits], axis=1)
         alloc.host_hits = []
-        self.inject_blocks(block_ids, k, v)
+        self.inject_blocks(block_ids, k, v, ks, vs)
 
     def complete_remote_prefill(
-        self, request_id: str, first_token: int, block_ids: List[int], k_np, v_np
+        self, request_id: str, first_token: int, block_ids: List[int],
+        k_np, v_np, k_scale=None, v_scale=None,
     ) -> None:
         """Called (any thread) when a prefill worker's KV lands for a waiting
         sequence: injects pages, registers the prompt KV, emits the first
-        token, and queues the sequence for a decode slot."""
+        token, and queues the sequence for a decode slot. int8 pools expect
+        the per-token scale tables; a layout mismatch (peer without dtype
+        support, or a native peer shipping into an int8 pool) falls the
+        request back to local prefill instead of writing corrupt pages."""
 
         def apply():
             seq = self._awaiting.pop(request_id, None)
@@ -2102,7 +2625,16 @@ class JaxServingEngine(AsyncEngine):
                     self._awaiting[request_id] = seq
                     self.fail_remote_prefill(request_id, "block_size mismatch")
                     return
-                self.inject_blocks(block_ids, k_np, v_np)
+                try:
+                    self.inject_blocks(block_ids, k_np, v_np, k_scale, v_scale)
+                except KvDtypeMismatch as e:
+                    logger.error(
+                        "remote prefill for %s: %s — falling back to local "
+                        "prefill", request_id, e,
+                    )
+                    self._awaiting[request_id] = seq
+                    self.fail_remote_prefill(request_id, f"kv_dtype mismatch: {e}")
+                    return
             self.allocator.note_tokens_computed(seq.alloc, seq.prompt[seq.alloc.cached_tokens:])
             seq.first_token_t = time.perf_counter()
             self._emit_token(seq, int(first_token))
@@ -2184,11 +2716,18 @@ class JaxServingEngine(AsyncEngine):
             # inputs as gauges; zeros with sampling off (DYN_TPU_SLO=0)
             "jit_recompiles": compile_count(),
             "kv_peak_occupancy_perc": round(self.allocator.peak_occupancy(), 4),
+            # speculative decoding + KV layout (PR7): cumulative draft
+            # counters are host-side truth (live with or without telemetry);
+            # the EMA acceptance gauge needs perf sampling
+            "spec_drafted_tokens": self.spec_drafted_total,
+            "spec_accepted_tokens": self.spec_accepted_total,
+            "kv_quantized": int(self._kv_quantized),
         }
         if self._perf is not None:
             m["decode_tokens_per_s"] = round(self._perf.decode_tps, 3)
             m["step_time_ms"] = round(self._perf.step_time_ms, 3)
             m["batch_slot_util"] = round(self._perf.slot_util, 4)
+            m["spec_accept_rate"] = round(self._perf.spec_accept_rate, 4)
         if self.host_pool is not None:
             m["host_cache_blocks"] = len(self.host_pool)
             m["host_cache_hits"] = self.host_pool.hits

@@ -102,6 +102,85 @@ def sample_tokens(
     return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
 
 
+def speculative_targets(
+    logits_all: jax.Array,  # [B, K1, V] f32 — one row per fed position
+    counts: jax.Array,  # [B, V] int32 penalty counts (dummy when unused)
+    active: jax.Array,  # [B, K1] bool — position actually fed (not padding)
+    step_key: jax.Array,  # dispatch-level PRNG key
+    seeds: jax.Array,  # [B] per-request seeds
+    temperature: jax.Array,  # [B]
+    top_k: jax.Array,  # [B]
+    top_p: jax.Array,  # [B]
+    frequency_penalty: jax.Array,  # [B]
+    presence_penalty: jax.Array,  # [B]
+    *,
+    with_pen: bool,
+    with_sample: bool,
+    with_lp: bool,
+    n_top: int = 0,
+) -> tuple:
+    """Target tokens for a speculative-verify dispatch, position by position.
+
+    The verify step feeds ``[last_token, draft_0, .., draft_{k-1}]`` through
+    one forward pass; this samples the engine's OWN next token at every fed
+    position — exactly the token the sequential sampler would have produced
+    given the same prefix and the same per-position key. The engine then
+    keeps the drafted prefix that MATCHES these targets plus the first
+    non-matching target as the bonus token. That acceptance rule is the
+    rejection-sampling scheme specialized to point-mass (deterministic)
+    proposals: every emitted token was drawn from the model's conditional at
+    its position, so the emitted stream follows the exact autoregressive
+    distribution — and greedy (temperature 0) output is bitwise identical to
+    non-speculative greedy decode.
+
+    Penalties are sequentially exact along the chunk: the scan carries the
+    count buffer, adding each position's target before scoring the next —
+    identical to one-token-at-a-time decoding for every position up to and
+    including the first draft mismatch (positions past it are discarded by
+    the engine, and their garbage-fed logits never leave the device as
+    emitted tokens). Because rejected positions DO pollute the returned
+    count buffer, the engine subtracts exactly the non-emitted targets from
+    each penalized row after every verify dispatch (``_counts_fix_fn`` —
+    O(spec_k) per lane, never a full out_tokens rebuild).
+
+    Returns ``(targets [B, K1], counts)`` plus, with ``with_lp``,
+    ``(chosen_lp [B, K1], top_ids [B, K1, n_top], top_lps [B, K1, n_top])``
+    inserted before ``counts`` — mirroring the decode scan's layout.
+    """
+    k1 = logits_all.shape[1]
+
+    def body(carry, j):
+        cnt = carry
+        sel = logits_all[:, j]
+        if with_sample:
+            kk = jax.random.fold_in(step_key, j)
+            keys = jax.vmap(lambda s: jax.random.fold_in(kk, s))(seeds)
+        else:
+            keys = None
+        sampled_from = (
+            apply_penalties(sel, cnt, frequency_penalty, presence_penalty)
+            if with_pen else sel
+        )
+        nxt = sample_tokens(sampled_from, keys, temperature, top_k, top_p,
+                            greedy_only=not with_sample)
+        if with_pen:
+            cnt = update_counts(cnt, nxt, active[:, j])
+        if with_lp:
+            lp, tids, tlps = token_logprobs(sel, nxt, n_top)
+            return cnt, (nxt, lp, tids, tlps)
+        return cnt, nxt
+
+    counts, out = jax.lax.scan(body, counts, jnp.arange(k1))
+    # scan stacks position-major [K1, B, ...] → slot-major
+    if with_lp:
+        nxt, lp, tids, tlps = out
+        return (
+            nxt.T, lp.T, tids.transpose(1, 0, 2), tlps.transpose(1, 0, 2),
+            counts,
+        )
+    return out.T, counts
+
+
 def token_logprobs(
     logits: jax.Array,  # [B, V] float32 (raw, temperature-unscaled)
     tokens: jax.Array,  # [B] int32 sampled tokens

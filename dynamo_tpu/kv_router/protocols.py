@@ -185,6 +185,13 @@ class ForwardPassMetrics:
     batch_slot_util: float = 0.0
     jit_recompiles: int = 0
     kv_peak_occupancy_perc: float = 0.0
+    # speculative decoding + KV layout (PR7): acceptance-rate EMA over
+    # verify dispatches (0 with speculation off), cumulative drafted/
+    # accepted token counters, and whether the KV pool stores int8 pages
+    spec_accept_rate: float = 0.0
+    spec_drafted_tokens: int = 0
+    spec_accepted_tokens: int = 0
+    kv_quantized: int = 0
     # request outcome counters from the RPC server (cumulative): the
     # cluster SLO engine diffs them for error-rate / overload-share
     requests_total: int = 0

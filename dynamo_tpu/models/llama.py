@@ -197,13 +197,57 @@ def param_shardings(config: LlamaConfig, mesh) -> Params:
 
 
 def make_kv_cache(
-    config: LlamaConfig, num_blocks: int, block_size: int, dtype: Any = None
+    config: LlamaConfig, num_blocks: int, block_size: int, dtype: Any = None,
+    quantized: bool = False,
 ) -> KVCache:
-    """Allocate the paged KV pool: [layers, blocks, block_size, kv_heads, head_dim]."""
+    """Allocate the paged KV pool: [layers, blocks, block_size, kv_heads, head_dim].
+
+    ``quantized=True`` builds the int8 page layout: pages store int8 values
+    and the dict carries per-block scale tables ``k_scale``/``v_scale``
+    ([L, num_blocks, block_size] float32 — one absmax scale per token row
+    per layer, grouped by physical block so scales travel WITH their pages
+    through prefix reuse, the host tier, and the disagg transfer plane).
+    Per-token granularity is what makes incremental decode writes exact:
+    each new token quantizes independently, so a partially-written block
+    never needs re-scaling. Overhead is 4 bytes per (layer, token) vs
+    ``2*kv_heads*head_dim`` page bytes — < 2% at every preset."""
     c = config
     shape = (c.num_layers, num_blocks, block_size, c.num_kv_heads, c.head_dim)
+    if quantized:
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(shape[:3], jnp.float32),
+            "v_scale": jnp.zeros(shape[:3], jnp.float32),
+        }
     dt = dtype or c.dtype
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def kv_cache_quantized(kv_cache: KVCache) -> bool:
+    """Is this pool the int8 page layout? (Static at trace time — the key
+    set of the cache dict decides which code path compiles.)"""
+    return "k_scale" in kv_cache
+
+
+def quantize_kv(k: jax.Array, v: jax.Array):
+    """Per-token absmax int8 quantization of fresh K/V ([..., KVH, D] →
+    int8 values + float32 scales over the last two axes). The scale floor
+    keeps all-zero rows (padding lanes) exact: 0/eps quantizes to 0."""
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    ks = jnp.maximum(jnp.max(jnp.abs(kf), axis=(-2, -1)), 1e-12) / 127.0
+    vs = jnp.maximum(jnp.max(jnp.abs(vf), axis=(-2, -1)), 1e-12) / 127.0
+    kq = jnp.clip(jnp.round(kf / ks[..., None, None]), -127, 127).astype(jnp.int8)
+    vq = jnp.clip(jnp.round(vf / vs[..., None, None]), -127, 127).astype(jnp.int8)
+    return kq, vq, ks, vs
+
+
+def dequantize_kv(kq: jax.Array, scale: jax.Array, dtype: Any) -> jax.Array:
+    """int8 pages + per-token scales → compute-dtype values. The scale
+    multiply runs in f32 (it carries the quantization precision) and drops
+    to the compute dtype afterwards — same contract as :func:`matw`."""
+    return (kq.astype(jnp.float32) * scale[..., None, None]).astype(dtype)
 
 
 # -- int8 weight-only quantization -------------------------------------------
@@ -487,14 +531,27 @@ def _window_attention(
     return out.reshape(b, 1, h_, d).astype(q.dtype)
 
 
-def gather_history(kv_cache: KVCache, block_tables: jax.Array) -> Tuple[jax.Array, jax.Array]:
+def gather_history(
+    kv_cache: KVCache, block_tables: jax.Array, out_dtype: Any = None
+) -> Tuple[jax.Array, jax.Array]:
     """Gather every lane's pages into dense [L, B, Smax, KVH, D] buffers —
-    once per decode dispatch, so the in-scan attention never gathers."""
+    once per decode dispatch, so the in-scan attention never gathers.
+
+    An int8 pool dequantizes here (pages × their per-token scale tables into
+    ``out_dtype``): the HBM read of the gather — the decode-roofline half
+    that int8 KV halves — moves int8 bytes; the dequantized dense buffer is
+    the transient working set the in-scan einsums already needed."""
     l, _, bs = kv_cache["k"].shape[:3]
     b, mb = block_tables.shape
     hk = kv_cache["k"][:, block_tables]  # [L, B, MB, bs, KVH, D]
     hv = kv_cache["v"][:, block_tables]
     shape = (l, b, mb * bs) + hk.shape[4:]
+    if kv_cache_quantized(kv_cache):
+        dt = out_dtype or jnp.bfloat16
+        ks = kv_cache["k_scale"][:, block_tables]  # [L, B, MB, bs]
+        vs = kv_cache["v_scale"][:, block_tables]
+        hk = dequantize_kv(hk, ks, dt)
+        hv = dequantize_kv(hv, vs, dt)
     return hk.reshape(shape), hv.reshape(shape)
 
 
@@ -772,16 +829,32 @@ def forward_chunk(
     scale = c.head_dim ** -0.5
     h = embed_lookup(params, tokens, c.dtype)  # [B, C, E]
     chunk_start = jnp.where(positions[:, 0] >= 0, positions[:, 0], 0)  # [B]
+    quantized = kv_cache_quantized(kv_cache)
 
     def layer_body(carry, xs):
-        lp, k_page, v_page = xs
+        if quantized:
+            lp, k_page, v_page, ks_page, vs_page = xs
+        else:
+            lp, k_page, v_page = xs
         hidden = carry
         b, t = positions.shape
 
         q, k, v = project_qkv(lp, c, hidden, positions)
-        new_k, new_v = write_kv_to_pages(
-            k_page, v_page, k, v, positions, block_tables
-        )
+        if quantized:
+            # the chunk's fresh K/V quantize per token before the scatter;
+            # the in-chunk causal partial below still attends the exact
+            # pre-quantization values (they're in hand — no reason to round)
+            kq, vq, kss, vss = quantize_kv(k, v)
+            new_k, new_v = write_kv_to_pages(
+                k_page, v_page, kq, vq, positions, block_tables
+            )
+            new_ks, new_vs = write_kv_to_pages(
+                ks_page, vs_page, kss, vss, positions, block_tables
+            )
+        else:
+            new_k, new_v = write_kv_to_pages(
+                k_page, v_page, k, v, positions, block_tables
+            )
         num_s, m_s, l_s = _chunk_self_partial(c, q, k, v, positions, scale)
         if with_history:
             # history partial reads the PRE-SCATTER pool: masked to
@@ -789,6 +862,14 @@ def forward_chunk(
             # the old buffers keeps the gather independent of the scatter
             gk = gather_pages(k_page, block_tables)
             gv = gather_pages(v_page, block_tables)
+            if quantized:
+                # dequant on the GATHERED lanes only (O(context), never
+                # O(pool)); gather_pages is trailing-dim agnostic so the
+                # [N, bs] scale tables gather like [B, Smax] vectors
+                gks = gather_pages(ks_page, block_tables)
+                gvs = gather_pages(vs_page, block_tables)
+                gk = dequantize_kv(gk, gks, hidden.dtype)
+                gv = dequantize_kv(gv, gvs, hidden.dtype)
             num_h, m_h, l_h = _history_partial(
                 c, q, gk, gv, chunk_start, positions, scale
             )
@@ -810,13 +891,24 @@ def forward_chunk(
         ).astype(hidden.dtype)
 
         hidden = hidden + matw(attn.reshape(b, t, c.q_dim), lp["wo"])
-        return mlp_block(lp, c, hidden, positions), (new_k, new_v)
+        out = mlp_block(lp, c, hidden, positions)
+        if quantized:
+            return out, (new_k, new_v, new_ks, new_vs)
+        return out, (new_k, new_v)
 
-    h, (new_k, new_v) = jax.lax.scan(
-        layer_body, h, (params["layers"], kv_cache["k"], kv_cache["v"])
-    )
+    if quantized:
+        h, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+            layer_body, h,
+            (params["layers"], kv_cache["k"], kv_cache["v"],
+             kv_cache["k_scale"], kv_cache["v_scale"]),
+        )
+        cache = {"k": new_k, "v": new_v, "k_scale": new_ks, "v_scale": new_vs}
+    else:
+        h, (new_k, new_v) = jax.lax.scan(
+            layer_body, h, (params["layers"], kv_cache["k"], kv_cache["v"])
+        )
+        cache = {"k": new_k, "v": new_v}
     h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
-    cache = {"k": new_k, "v": new_v}
     if hidden_only:
         return h, cache
     return lm_head(params, c, h), cache
@@ -927,6 +1019,28 @@ def flush_window(
     fpos = base[:, None] + jnp.arange(w)[None, :]  # [B, W]
     valid = (base[:, None] >= 0) & (fpos <= max_pos)
     fpos = jnp.where(valid, fpos, -1)
+
+    if kv_cache_quantized(kv_cache):
+        # quantize the whole window once (per-token scales), then scatter
+        # values and scales with the same index math — write_kv_to_pages is
+        # trailing-dim agnostic, so the [L, N, bs] scale tables ride the
+        # [B, W] scale vectors through the identical drop-masked scatter
+        wkq, wvq, wks, wvs = quantize_kv(window_k, window_v)
+
+        def layer_flush_q(carry, xs):
+            kl, vl, ksl, vsl, wkl, wvl, wksl, wvsl = xs
+            kl, vl = write_kv_to_pages(kl, vl, wkl, wvl, fpos, block_tables)
+            ksl, vsl = write_kv_to_pages(
+                ksl, vsl, wksl, wvsl, fpos, block_tables
+            )
+            return carry, (kl, vl, ksl, vsl)
+
+        _, (nk, nv, nks, nvs) = jax.lax.scan(
+            layer_flush_q, 0,
+            (kv_cache["k"], kv_cache["v"], kv_cache["k_scale"],
+             kv_cache["v_scale"], wkq, wvq, wks, wvs),
+        )
+        return {"k": nk, "v": nv, "k_scale": nks, "v_scale": nvs}
 
     def layer_flush(carry, xs):
         kl, vl, wkl, wvl = xs

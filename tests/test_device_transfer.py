@@ -61,7 +61,8 @@ class FakeEngine:
     def post(self, fn):
         fn()
 
-    def complete_remote_prefill(self, request_id, first_token, block_ids, k, v):
+    def complete_remote_prefill(self, request_id, first_token, block_ids, k, v,
+                                k_scale=None, v_scale=None):
         self.completed.append((request_id, first_token, block_ids,
                               np.asarray(k).copy(), np.asarray(v).copy()))
 
@@ -69,7 +70,7 @@ class FakeEngine:
         self.completed.append(("FAIL", request_id, message))
 
     def extract_blocks(self, ids, as_device=False):
-        return self.pages_k, self.pages_v
+        return self.pages_k, self.pages_v, None, None
 
     def block_hashes_of(self, ids):
         return [7] * len(ids)
@@ -109,7 +110,8 @@ def test_device_path_send_and_read():
         assert (rid, tok, ids) == ("req-1", 42, [5, 6])
         assert np.array_equal(got_k, k) and np.array_equal(got_v, v)
 
-        rk, rv, hashes = await client.read_blocks(addr, [1, 2, 3])
+        rk, rv, scales, hashes = await client.read_blocks(addr, [1, 2, 3])
+        assert scales is None
         assert np.array_equal(np.asarray(rk), eng.pages_k)
         assert hashes == [7, 7, 7]
         assert reg.pulls == 2  # one per direction — the bulk used the fabric
@@ -139,7 +141,8 @@ def test_mixed_fleet_falls_back_to_tcp():
         assert reg.pulls == 0  # fabric never used
         assert client._dev_peers[addr] is False  # remembered: no retry storm
 
-        rk, rv, hashes = await client.read_blocks(addr, [1, 2, 3])
+        rk, rv, scales, hashes = await client.read_blocks(addr, [1, 2, 3])
+        assert scales is None
         assert np.array_equal(rk, eng.pages_k)
 
         await client.close()

@@ -950,3 +950,62 @@ class TestSloEndToEnd:
                 await ss.stop()
 
         run(go())
+
+
+class TestSpecDecodingGauges:
+    """PR7: speculative-decoding + KV-dtype gauges flow mock → aggregator →
+    cluster exposition (the satellites that let `llmctl` and dashboards see
+    the speedup without real TPUs)."""
+
+    def test_mock_worker_emits_spec_gauges(self):
+        stats = MockWorkerStats(seed=1, spec_accept_rate=0.6, kv_quantized=True)
+        stats.tick(requests=4)
+        m = stats.metrics("m1")
+        assert m.spec_accept_rate == 0.6
+        assert m.spec_drafted_tokens > 0
+        assert 0 < m.spec_accepted_tokens <= m.spec_drafted_tokens
+        assert m.kv_quantized == 1
+        # per-request acceptance rides the phase summary like real engines
+        assert "spec_accept" in stats.phase_latency()
+        # defaults mirror a speculation-off engine
+        off = MockWorkerStats(seed=2)
+        off.tick()
+        m0 = off.metrics("m1")
+        assert m0.spec_accept_rate == 0.0 and m0.spec_drafted_tokens == 0
+        assert m0.kv_quantized == 0
+
+    def test_cluster_rollup_recomputes_fleet_accept_rate(self):
+        clk = _Clock()
+        ct = ClusterTelemetry(
+            "ns",
+            policy=TelemetryPolicy(fast_window=10, mid_window=20, slow_window=40),
+            clock=clk,
+        )
+        # fleet rate must come from the summed counters: a worker with 10x
+        # the drafting volume dominates, regardless of per-worker EMAs
+        ct.ingest("w1", ForwardPassMetrics(
+            model="m1", spec_drafted_tokens=1000, spec_accepted_tokens=800,
+            spec_accept_rate=0.8,
+        ))
+        ct.ingest("w2", ForwardPassMetrics(
+            model="m1", spec_drafted_tokens=100, spec_accepted_tokens=0,
+            spec_accept_rate=0.0,
+        ))
+        m = ct.rollup()["models"]["m1"]
+        assert m["spec_drafted_tokens"] == 1100
+        assert m["spec_accepted_tokens"] == 800
+        assert m["spec_accept_rate"] == round(800 / 1100, 4)
+        text = ct.render_prometheus()
+        assert 'dynamo_cluster_spec_accept_rate{' in text
+        assert 'dynamo_cluster_spec_drafted_tokens{' in text
+
+    def test_worker_aggregator_renders_spec_gauges(self):
+        from dynamo_tpu.components.metrics import MetricsAggregator
+
+        agg = MetricsAggregator("ns")
+        stats = MockWorkerStats(seed=3, spec_accept_rate=0.4, kv_quantized=True)
+        stats.tick()
+        agg.update("w1", stats.metrics("m1"))
+        text = agg.render()
+        assert "dynamo_worker_spec_accept_rate" in text
+        assert "dynamo_worker_kv_quantized" in text
