@@ -867,6 +867,44 @@ def bench_frontend() -> dict:
     return asyncio.run(go())
 
 
+def bench_planner_sim() -> dict:
+    """SLA-driven planner under the million-user traffic simulator
+    (tools/traffic_sim.py, virtual time — milliseconds of wall clock, no
+    TPU): the 5× flash-crowd burst scenario with the planner closed-loop,
+    plus a frozen-topology control leg quantifying what the loop buys.
+
+    Reports SLO page→clear time, peak/final pool sizes, decision counts,
+    and the control leg's failure count — the ROADMAP item 4 acceptance
+    ("SLO recovery after a 5x burst with zero failed requests") as a bench
+    number the perf trajectory can track."""
+    from tools.traffic_sim import run_burst_scenario
+
+    res = asyncio.run(run_burst_scenario())
+    ctrl = asyncio.run(run_burst_scenario(planner_enabled=False))
+    scale_decisions = [d for d in res.decisions if d["kind"] == "scale"]
+    ups = sum(
+        1 for d in scale_decisions if d["to_replicas"] > d["from_replicas"]
+    )
+    return {
+        "scenario": "diurnal-base + 5x flash crowd, r05 isl_sweep heavy-tail mix",
+        "offered_requests": res.offered_total,
+        "failed_requests": res.failed_total,
+        "first_page_t_s": res.first_page_t,
+        # to_dict maps inf -> "never" (json.dumps would emit Infinity)
+        "slo_recovery_s": res.to_dict()["recovery_s"],
+        "page_episodes": len(res.episodes),
+        "pool_initial": res.pool_initial,
+        "pool_peak": res.pool_peak,
+        "pool_final": res.pool_final,
+        "scale_decisions": len(scale_decisions),
+        "scale_up_decisions": ups,
+        "control_no_planner": {
+            "failed_requests": ctrl.failed_total,
+            "slo_recovery_s": ctrl.to_dict()["recovery_s"],
+        },
+    }
+
+
 def main() -> None:
     from dynamo_tpu.engine_jax.compile_cache import enable_compile_cache
 
@@ -1092,6 +1130,11 @@ def main() -> None:
         except Exception as e:
             out["concurrency"] = {"error": str(e)[:200]}
         _release_device_memory()
+    if os.environ.get("BENCH_PLANNER_SIM", "1") == "1":
+        try:
+            out["planner_sim"] = bench_planner_sim()
+        except Exception as e:
+            out["planner_sim"] = {"error": str(e)[:200]}
     # LAST: pays minutes of first-boot remote compilation on the tunneled
     # runtime — must not eat the other sections' budget if it times out
     if os.environ.get("BENCH_MODEL_8B", "1") == "1":

@@ -14,6 +14,7 @@
     python -m dynamo_tpu.cli.llmctl trace show <dyn://ns.comp.ep> <trace_id>
     python -m dynamo_tpu.cli.llmctl slo status [--json] [dyn://ns.telemetry.status]
     python -m dynamo_tpu.cli.llmctl cluster status [--json] [dyn://ns.telemetry.status]
+    python -m dynamo_tpu.cli.llmctl planner status [--json] [dyn://ns.planner.plan]
 
 ``worker drain`` writes a drain control key the target worker watches
 (``.../endpoints/{ep}/drain/{worker_id}``): routers stop sending it new
@@ -23,6 +24,12 @@ failed requests (docs/overload.md has the rolling-restart runbook).
 its draining flag and last load snapshot. ``worker health`` reads the same
 instance keys and shows the health plane's view: state, last heartbeat age,
 and the stall/reap counters (docs/health.md has the stuck-worker runbook).
+
+``planner status`` dials the planner component (``components/planner.py``)
+and renders its decision ring — who reshaped the fleet and why — plus the
+active cooldowns; it exits 2 while any decision is failing to actuate, so
+a cron probe catches a planner that wants to scale but can't
+(docs/planner.md has the runbook).
 
 ``trace dump`` dials every live instance's RPC port and drains its
 in-process flight recorder as JSONL (one trace per line, same-trace spans
@@ -92,6 +99,19 @@ def build_parser() -> argparse.ArgumentParser:
         )
         st.add_argument("--json", action="store_true", dest="as_json")
 
+    plan = sub.add_parser(
+        "planner", help="SLA-driven planner decision ring + cooldowns"
+    )
+    pverbs = plan.add_subparsers(dest="verb", required=True)
+    pst = pverbs.add_parser("status")
+    pst.add_argument(
+        "endpoint", nargs="?", default="dyn://dynamo.planner.plan",
+        help="planner endpoint (default dyn://dynamo.planner.plan)",
+    )
+    pst.add_argument("--json", action="store_true", dest="as_json")
+    pst.add_argument("--limit", type=int, default=20,
+                     help="newest N ring decisions to show (0 = all)")
+
     trace = sub.add_parser("trace", help="dump/show worker request traces")
     tverbs = trace.add_subparsers(dest="verb", required=True)
     tdump = tverbs.add_parser("dump", help="flight-recorder traces as JSONL")
@@ -133,6 +153,8 @@ async def amain(argv: list) -> int:
             return await _trace_cmd(args, store)
         if args.plane in ("slo", "cluster"):
             return await _telemetry_cmd(args, store)
+        if args.plane == "planner":
+            return await _planner_cmd(args, store)
         if args.plane == "worker":
             ns, comp, ep = parse_endpoint_path(args.endpoint)
             base = f"{ns}/components/{comp}/endpoints/{ep}"
@@ -300,18 +322,11 @@ async def _telemetry_cmd(args, store) -> int:
     RPC port (found through ordinary instance discovery) and render its
     ``telemetry_dump`` — per-model SLO compliance + burn rates, or the
     cluster capacity rollup (docs/observability.md runbook)."""
-    from dynamo_tpu.runtime.distributed import InstanceInfo, parse_endpoint_path
+    from dynamo_tpu.runtime.distributed import live_instance_infos
     from dynamo_tpu.runtime.rpc import RpcClient
 
-    ns, comp, ep = parse_endpoint_path(args.endpoint)
-    base = f"{ns}/components/{comp}/endpoints/{ep}"
-    entries = await store.get_prefix(f"{base}/instances/")
     dump = None
-    for key in sorted(entries):
-        try:
-            info = InstanceInfo.from_json(entries[key])
-        except (ValueError, KeyError):
-            continue
+    for info in await live_instance_infos(store, args.endpoint):
         try:
             client = await RpcClient.connect(info.address, timeout=5.0)
         except (ConnectionError, OSError) as e:
@@ -384,6 +399,83 @@ async def _telemetry_cmd(args, store) -> int:
         print(f'worst worker: {worst.get("worker_id")} '
               f'load={worst.get("load")} '
               f'(median {roll.get("median_worker_load")})')
+    return 0
+
+
+async def _planner_cmd(args, store) -> int:
+    """``planner status``: dial the planner component's ``plan`` endpoint
+    (found through ordinary instance discovery) and render its decision
+    ring, active cooldowns, and currently-failing decisions. Exit 2 while
+    any decision is failing to actuate — a cron probe catches a planner
+    that wants to scale but can't (docs/planner.md runbook)."""
+    from dynamo_tpu.runtime.distributed import (
+        live_instance_infos,
+        parse_endpoint_path,
+    )
+    from dynamo_tpu.runtime.rpc import RpcClient
+
+    ns, comp, ep = parse_endpoint_path(args.endpoint)
+    status = None
+    for info in await live_instance_infos(store, args.endpoint):
+        try:
+            client = await RpcClient.connect(info.address, timeout=5.0)
+        except (ConnectionError, OSError) as e:
+            print(f"(planner {info.worker_id} at {info.address} "
+                  f"unreachable: {e})", file=sys.stderr)
+            continue
+        try:
+            # inter_item_timeout: a wedged planner must not hang the CLI —
+            # the cron-probe contract needs a bounded exit
+            async for item in client.generate(
+                f"{ns}.{comp}.{ep}", {}, inter_item_timeout=5.0
+            ):
+                data = getattr(item, "data", None)
+                if isinstance(data, dict):
+                    status = data
+                    break
+            if status is not None:
+                break  # one live planner is authoritative
+        except (ConnectionError, OSError) as e:
+            print(f"(planner status from {info.worker_id} failed: {e})",
+                  file=sys.stderr)
+        finally:
+            await client.close()
+    if status is None:
+        print(f"(no reachable planner at {args.endpoint})", file=sys.stderr)
+        return 1
+    failing = status.get("failing") or []
+    if args.as_json:
+        print(json.dumps(status, indent=2))
+        return 2 if failing else 0
+    decisions = status.get("decisions") or []
+    limit = getattr(args, "limit", 20)
+    shown = decisions[-limit:] if limit else decisions
+    if not decisions:
+        print("(no decisions yet — the fleet is holding position)")
+    for d in shown:
+        target = (
+            f'{d.get("model", "?")}/{d.get("pool", "?")} '
+            f'{d.get("from_replicas", 0)}->{d.get("to_replicas", 0)}'
+            if d.get("kind") == "scale"
+            else f'{d.get("worker_id", "?")} ({d.get("model", "?")})'
+        )
+        print(
+            f't={d.get("ts", 0.0):>10.1f} {d.get("kind", "?"):7s} '
+            f'{target:32s} [{d.get("urgency", "?"):8s}] '
+            f'{d.get("status", "?").upper():8s} {d.get("reason", "")}'
+            + (f' error={d.get("error")}' if d.get("error") else "")
+        )
+    cooldowns = status.get("cooldowns") or {}
+    if cooldowns:
+        print("cooldowns: " + "  ".join(
+            f"{k}={v:.0f}s" for k, v in sorted(cooldowns.items())
+        ))
+    if failing:
+        print(f"FAILING: {len(failing)} decision(s) not actuating:")
+        for d in failing:
+            print(f'  {d.get("kind")} {d.get("model")}/{d.get("pool") or d.get("worker_id")} '
+                  f'status={d.get("status")} error={d.get("error", "")}')
+        return 2
     return 0
 
 

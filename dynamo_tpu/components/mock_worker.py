@@ -29,6 +29,56 @@ from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
 logger = logging.getLogger(__name__)
 
 
+class LoadProfile:
+    """Time-varying replay schedule for a mock worker (``--load-profile``).
+
+    The schedule is a JSON list of segments, each a dict with a ``t`` (seconds
+    from start) plus any of the knobs it overrides from that point on::
+
+        [{"t": 0,  "ttft_ms": 100, "itl_ms": 20},
+         {"t": 30, "ttft_ms": 9000, "queue_depth": 40, "error_rate": 0.2},
+         {"t": 60, "ttft_ms": 100, "queue_depth": 0, "error_rate": 0}]
+
+    Segments apply as a step function (the last segment with ``t`` ≤ elapsed
+    wins); unknown keys are ignored so schedules stay forward-compatible.
+    Elapsed time is supplied by the caller (tick index × interval in
+    ``run_mock_worker``) so a replay is deterministic — the same schedule
+    and seed produce byte-identical metric streams, which is what planner
+    drills and the traffic simulator's regression legs need.
+    """
+
+    KEYS = ("ttft_ms", "itl_ms", "queue_depth", "error_rate", "requests")
+
+    def __init__(self, segments: List[dict]):
+        cleaned = []
+        for seg in segments:
+            if not isinstance(seg, dict):
+                raise ValueError("load profile segments must be dicts")
+            cleaned.append(dict(seg, t=float(seg.get("t", 0.0))))
+        self.segments = sorted(cleaned, key=lambda s: s["t"])
+        if not self.segments:
+            raise ValueError("load profile needs at least one segment")
+
+    @classmethod
+    def from_file(cls, path: str) -> "LoadProfile":
+        import json
+
+        with open(path) as f:
+            return cls(json.load(f))
+
+    def at(self, elapsed: float) -> dict:
+        """Merged knob dict in effect at ``elapsed`` seconds (each knob keeps
+        the value from the latest segment that set it)."""
+        state: Dict[str, float] = {}
+        for seg in self.segments:
+            if seg["t"] > elapsed:
+                break
+            for k in self.KEYS:
+                if k in seg:
+                    state[k] = seg[k]
+        return state
+
+
 class MockWorkerStats:
     """Synthetic per-worker telemetry state.
 
@@ -48,6 +98,7 @@ class MockWorkerStats:
         blocks_total: int = 1024,
         spec_accept_rate: float = 0.0,
         kv_quantized: bool = False,
+        role: str = "decode",
     ):
         from dynamo_tpu.runtime.tracing import PHASE_BUCKETS
 
@@ -56,6 +107,13 @@ class MockWorkerStats:
         self.itl_ms = itl_ms
         self.slots_total = slots_total
         self.blocks_total = blocks_total
+        # pool role for the cluster rollup's per-pool breakdown (what the
+        # planner resizes); queue_depth overrides num_requests_waiting and
+        # kv_occupancy overrides the jittered KV fill when a load profile
+        # (or the traffic simulator) drives the worker shape exactly
+        self.role = role
+        self.queue_depth: Optional[int] = None
+        self.kv_occupancy: Optional[float] = None
         self.bounds = PHASE_BUCKETS + (float("inf"),)
         self._counts: Dict[str, List[int]] = {}
         self._sums: Dict[str, float] = {}
@@ -114,6 +172,32 @@ class MockWorkerStats:
             0, min(self.slots_total, self.active + self.rng.randint(-3, 3))
         )
 
+    def observe_request(
+        self,
+        ttft_ms: Optional[float] = None,
+        itl_ms: Optional[float] = None,
+        n_itl: int = 8,
+        errored: bool = False,
+        count: bool = True,
+    ) -> None:
+        """One finished request with *exact* latencies — no jitter. The
+        traffic simulator (tools/traffic_sim.py) computes per-request TTFT
+        from its queue model and needs the published histograms to reflect
+        it deterministically; ``tick`` stays the jittered path for
+        dashboard-shaped traffic. ``count=False`` records latency samples
+        without bumping the request counters (the simulator books each
+        request's TTFT on a prefill worker and its ITL on a decode worker —
+        the request must count once, not twice)."""
+        if count:
+            self.requests_total += 1
+        if errored:
+            self.requests_errored += 1
+        if ttft_ms is not None:
+            self._observe("ttft", max(ttft_ms, 0.0) / 1e3)
+        if itl_ms is not None:
+            for _ in range(max(n_itl, 0)):
+                self._observe("inter_token", max(itl_ms, 0.0) / 1e3)
+
     def phase_latency(self) -> dict:
         from dynamo_tpu.runtime.tracing import _bucket_quantile
 
@@ -133,11 +217,15 @@ class MockWorkerStats:
         return out
 
     def metrics(self, model: str = "mock-model") -> ForwardPassMetrics:
-        blocks = int(
-            self.blocks_total
-            * min(1.0, self.active / self.slots_total + self.rng.random() * 0.2)
+        kv_fill = (
+            self.kv_occupancy if self.kv_occupancy is not None
+            else self.active / self.slots_total + self.rng.random() * 0.2
         )
-        waiting = self.rng.randint(0, 4)
+        blocks = int(self.blocks_total * min(max(kv_fill, 0.0), 1.0))
+        waiting = (
+            int(self.queue_depth) if self.queue_depth is not None
+            else self.rng.randint(0, 4)
+        )
         itl_s = max(self.itl_ms, 1e-3) / 1e3
         return ForwardPassMetrics(
             request_active_slots=self.active,
@@ -174,7 +262,19 @@ class MockWorkerStats:
             kv_quantized=int(self.kv_quantized),
             uptime_s=round(time.monotonic() - self.started, 3),
             model=model,
+            role=self.role,
         )
+
+    def apply_profile(self, state: dict) -> int:
+        """Apply a :class:`LoadProfile` state dict; returns the per-tick
+        request count (default 8) so the caller drives ``tick`` with it."""
+        if "ttft_ms" in state:
+            self.ttft_ms = float(state["ttft_ms"])
+        if "itl_ms" in state:
+            self.itl_ms = float(state["itl_ms"])
+        if "queue_depth" in state:
+            self.queue_depth = max(int(state["queue_depth"]), 0)
+        return max(int(state.get("requests", 8)), 0)
 
 
 async def run_mock_worker(
@@ -187,6 +287,8 @@ async def run_mock_worker(
     itl_ms: float = 20.0,
     spec_accept_rate: float = 0.0,
     kv_quantized: bool = False,
+    role: str = "decode",
+    profile: Optional[LoadProfile] = None,
 ) -> None:
     from dynamo_tpu.runtime.distributed import KV_METRICS_SUBJECT
 
@@ -195,9 +297,19 @@ async def run_mock_worker(
     stats = MockWorkerStats(
         seed=hash(wid) & 0xFFFF, ttft_ms=ttft_ms, itl_ms=itl_ms,
         spec_accept_rate=spec_accept_rate, kv_quantized=kv_quantized,
+        role=role,
     )
+    tick_no = 0
     while True:
-        stats.tick()
+        requests, error_rate = 8, 0.0
+        if profile is not None:
+            # elapsed from the tick index, NOT the wall clock: a loaded CI
+            # box must replay the same schedule the same way every run
+            state = profile.at(tick_no * interval)
+            requests = stats.apply_profile(state)
+            error_rate = float(state.get("error_rate", 0.0))
+        stats.tick(requests=requests, error_rate=error_rate)
+        tick_no += 1
         await ns.publish(
             KV_METRICS_SUBJECT,
             {"worker_id": wid, "metrics": stats.metrics(model).to_dict()},
@@ -222,8 +334,20 @@ def main() -> None:
     p.add_argument("--kv-quantized", action="store_true",
                    help="report the int8-KV flag (exercises the dashboard "
                         "column without a real quantized pool)")
+    p.add_argument("--role", default="decode",
+                   choices=("decode", "prefill", "frontend"),
+                   help="pool role for the cluster rollup's per-pool "
+                        "breakdown (what the planner resizes)")
+    p.add_argument("--load-profile", default=None,
+                   help="JSON schedule replaying time-varying TTFT/ITL/"
+                        "queue/error-rate (planner drills without a TPU; "
+                        "see LoadProfile docstring for the format)")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
+    profile = (
+        LoadProfile.from_file(args.load_profile)
+        if args.load_profile else None
+    )
 
     async def run():
         from dynamo_tpu.runtime.distributed import DistributedRuntime
@@ -237,6 +361,7 @@ def main() -> None:
             ttft_ms=args.ttft_ms, itl_ms=args.itl_ms,
             spec_accept_rate=args.spec_accept_rate,
             kv_quantized=args.kv_quantized,
+            role=args.role, profile=profile,
         )
 
     asyncio.run(run())

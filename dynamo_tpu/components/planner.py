@@ -1,0 +1,843 @@
+"""SLA-driven planner: close the loop from SLO telemetry to cluster topology.
+
+The telemetry plane (PR6) answers "is the service meeting its objectives
+and how fast is it failing"; the drain machinery (PR3) and the operator's
+reconcile loop (PR4-era ``operator/controller.py``) can reshape the fleet
+with zero downtime. This component is the missing loop between them — the
+reference survey's planner/operator tier: a long-running policy engine
+that watches the cluster rollup + SLO burn rates and emits **typed scaling
+decisions**, executed through pluggable actuators:
+
+- **observe** — either an embedded :class:`ClusterTelemetry` ingesting the
+  ``kv_metrics`` stream directly, or a poll of a remote aggregator through
+  the ``telemetry_dump`` RPC verb (``--aggregator dyn://ns.telemetry.status``).
+  Evaluation is pure over the rollup + SLO report dicts, so the traffic
+  simulator (``tools/traffic_sim.py``) and tests drive it deterministically
+  with injected clocks.
+- **decide** — per model × pool role (``decode`` | ``prefill`` |
+  ``frontend``): scale up on a paging SLO mapped to that pool, low pool
+  headroom, or deep queues; scale down one worker at a time only after a
+  sustained calm stretch (time hysteresis) — plus a threshold gap between
+  the up and down triggers (level hysteresis) and per-direction cooldowns,
+  so a noisy signal cannot oscillate the fleet. Persistently unhealthy
+  workers get drain decisions; recovered ones get undrained.
+- **actuate** — :class:`DrainActuator` writes the PR3 drain control keys
+  (zero-downtime: routers stop dispatching, in-flight streams finish);
+  :class:`GraphActuator` patches the DynamoGraph CR's replica counts and
+  lets ``operator/controller.py`` reconcile the Deployments;
+  :class:`ProcessActuator` is the in-process/dry-run actuator tests and
+  the traffic simulator use. A decision that fails to actuate is retried
+  every interval and surfaces through ``llmctl planner status`` (exit 2).
+
+Every decision lands in a bounded ring served by the ``{ns}.planner.plan``
+endpoint (wire type :class:`PlannerStatus`) — the audit trail of who
+reshaped the fleet and why. Knobs: ``DYN_TPU_PLAN_*`` (PR3-style clamping;
+docs/planner.md has the full table + runbook).
+
+Run:  python -m dynamo_tpu.components.planner --namespace dynamo --actuate drain
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import math
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# decision kinds
+SCALE = "scale"
+DRAIN = "drain"
+UNDRAIN = "undrain"
+
+# pool roles the planner knows how to resize
+POOLS = ("decode", "prefill", "frontend")
+
+
+class PlannerPolicy:
+    """The ``DYN_TPU_PLAN_*`` knob bundle (PR3-style clamping: malformed,
+    zero, or negative values fall back to defaults).
+
+    The asymmetry is deliberate: scale-up is fast (short cooldown, paging
+    SLOs bypass nothing but the cooldown) because an underprovisioned pool
+    burns error budget every second; scale-down is slow (one worker at a
+    time, a sustained-calm requirement, a longer cooldown) because flapping
+    capacity *causes* the pages it reacts to. ``headroom_high`` is forced
+    above ``headroom_low`` so the up and down triggers can never overlap.
+    """
+
+    __slots__ = (
+        "enabled", "interval", "headroom_low", "headroom_high",
+        "queue_high", "up_step", "cooldown_up", "cooldown_down",
+        "down_stable", "min_workers", "max_workers",
+        "drain_after", "undrain_after", "ring",
+    )
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        interval: float = 15.0,
+        headroom_low: float = 0.15,
+        headroom_high: float = 0.50,
+        queue_high: float = 4.0,
+        up_step: float = 0.5,
+        cooldown_up: float = 60.0,
+        cooldown_down: float = 300.0,
+        down_stable: float = 180.0,
+        min_workers: int = 1,
+        max_workers: int = 64,
+        drain_after: float = 60.0,
+        undrain_after: float = 30.0,
+        ring: int = 256,
+    ):
+        self.enabled = bool(enabled)
+        self.interval = max(float(interval), 1e-3)
+        self.headroom_low = min(max(float(headroom_low), 0.0), 1.0)
+        # the down trigger must sit strictly above the up trigger: an
+        # overlapping band would let one noisy sample alternate directions
+        self.headroom_high = min(
+            max(float(headroom_high), self.headroom_low + 0.05), 1.0
+        )
+        self.queue_high = max(float(queue_high), 1e-3)
+        self.up_step = max(float(up_step), 1e-3)
+        self.cooldown_up = max(float(cooldown_up), 0.0)
+        self.cooldown_down = max(float(cooldown_down), self.cooldown_up)
+        self.down_stable = max(float(down_stable), 0.0)
+        self.min_workers = max(int(min_workers), 1)
+        self.max_workers = max(int(max_workers), self.min_workers)
+        self.drain_after = max(float(drain_after), 0.0)
+        self.undrain_after = max(float(undrain_after), 0.0)
+        self.ring = max(int(ring), 8)
+
+    @classmethod
+    def from_env(cls, prefix: str = "DYN_TPU_PLAN") -> "PlannerPolicy":
+        from dynamo_tpu.runtime.admission import _env_pos_float, _env_pos_int
+        from dynamo_tpu.runtime.tracing import _env_flag
+
+        d = cls()
+        return cls(
+            enabled=_env_flag(prefix, d.enabled),
+            interval=_env_pos_float(prefix + "_INTERVAL_S", d.interval),
+            headroom_low=_env_pos_float(
+                prefix + "_HEADROOM_LOW", d.headroom_low
+            ),
+            headroom_high=_env_pos_float(
+                prefix + "_HEADROOM_HIGH", d.headroom_high
+            ),
+            queue_high=_env_pos_float(prefix + "_QUEUE_HIGH", d.queue_high),
+            up_step=_env_pos_float(prefix + "_UP_STEP", d.up_step),
+            cooldown_up=_env_pos_float(
+                prefix + "_COOLDOWN_UP_S", d.cooldown_up
+            ),
+            cooldown_down=_env_pos_float(
+                prefix + "_COOLDOWN_DOWN_S", d.cooldown_down
+            ),
+            down_stable=_env_pos_float(
+                prefix + "_DOWN_STABLE_S", d.down_stable
+            ),
+            min_workers=_env_pos_int(prefix + "_MIN_WORKERS", d.min_workers),
+            max_workers=_env_pos_int(prefix + "_MAX_WORKERS", d.max_workers),
+            drain_after=_env_pos_float(
+                prefix + "_DRAIN_AFTER_S", d.drain_after
+            ),
+            undrain_after=_env_pos_float(
+                prefix + "_UNDRAIN_AFTER_S", d.undrain_after
+            ),
+            ring=_env_pos_int(prefix + "_RING", d.ring),
+        )
+
+    def to_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+@dataclass
+class Decision:
+    """One typed planner decision, as recorded in the ring.
+
+    ``kind`` is :data:`SCALE` (pool resize; ``pool`` + ``from_replicas`` →
+    ``to_replicas``), :data:`DRAIN`, or :data:`UNDRAIN` (``worker_id``).
+    ``urgency``: ``page`` (an SLO is paging), ``capacity`` (headroom/queue
+    pressure), ``trim`` (calm scale-down), ``health`` (drain plane).
+    ``status``: ``pending`` → ``actuated`` | ``failed`` (actuator raised;
+    retried next interval while the condition holds) | ``dropped`` (no
+    actuator handles this kind — a config error worth surfacing).
+    """
+
+    kind: str
+    model: str
+    ts: float
+    pool: str = ""
+    worker_id: str = ""
+    from_replicas: int = 0
+    to_replicas: int = 0
+    reason: str = ""
+    urgency: str = "capacity"
+    status: str = "pending"
+    error: str = ""
+
+    def target_key(self) -> str:
+        """What this decision acts on — ring entries for the same target
+        supersede each other when computing "currently failing"."""
+        if self.kind == SCALE:
+            return f"{self.model}/{self.pool}"
+        return f"worker/{self.worker_id}"
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind, "model": self.model, "pool": self.pool,
+            "worker_id": self.worker_id,
+            "from_replicas": self.from_replicas,
+            "to_replicas": self.to_replicas,
+            "reason": self.reason, "urgency": self.urgency,
+            "ts": round(self.ts, 3), "status": self.status,
+            "error": self.error,
+        }
+
+
+@dataclass
+class PlannerStatus:
+    """Wire type of the planner's ``plan`` endpoint (payload-less request;
+    registered in ``llm/protocols`` ENDPOINT_PROTOCOLS — this is the reply):
+    the decision ring (oldest first), active cooldowns as remaining
+    seconds, currently-failing decisions, and the live policy knobs."""
+
+    decisions: List[dict] = field(default_factory=list)
+    cooldowns: Dict[str, float] = field(default_factory=dict)
+    failing: List[dict] = field(default_factory=list)
+    policy: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "decisions": list(self.decisions),
+            "cooldowns": dict(self.cooldowns),
+            "failing": list(self.failing),
+            "policy": dict(self.policy),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PlannerStatus":
+        return cls(
+            decisions=list(d.get("decisions") or []),
+            cooldowns=dict(d.get("cooldowns") or {}),
+            failing=list(d.get("failing") or []),
+            policy=dict(d.get("policy") or {}),
+        )
+
+
+# ---------------------------------------------------------------------------
+# actuators
+# ---------------------------------------------------------------------------
+
+
+class Actuator:
+    """One way of executing a :class:`Decision`. ``apply`` raises on
+    failure — the planner marks the decision ``failed`` and retries on the
+    next interval while the triggering condition persists."""
+
+    name = "actuator"
+
+    def handles(self, decision: Decision) -> bool:
+        raise NotImplementedError
+
+    async def apply(self, decision: Decision) -> None:
+        raise NotImplementedError
+
+
+class ProcessActuator(Actuator):
+    """In-process / dry-run actuator: records every decision it applies and
+    invokes optional callbacks — how the traffic simulator grows its mock
+    fleet, and the observe-only mode ``run_planner`` defaults to (decisions
+    are logged + ringed, nothing is touched)."""
+
+    name = "process"
+
+    def __init__(
+        self,
+        on_scale: Optional[Callable[[Decision], Any]] = None,
+        on_drain: Optional[Callable[[Decision], Any]] = None,
+    ):
+        self.on_scale = on_scale
+        self.on_drain = on_drain
+        self.applied: List[Decision] = []
+
+    def handles(self, decision: Decision) -> bool:
+        return True
+
+    async def apply(self, decision: Decision) -> None:
+        cb = self.on_scale if decision.kind == SCALE else self.on_drain
+        if cb is not None:
+            out = cb(decision)
+            if asyncio.iscoroutine(out):
+                await out
+        self.applied.append(decision)
+
+
+class DrainActuator(Actuator):
+    """Execute drain/undrain through the PR3 drain control keys: a put
+    under ``{ns}/components/{comp}/endpoints/{ep}/drain/{worker_id}`` makes
+    the target worker stop taking new work (in-flight streams finish) and
+    routers route around it; deleting the key undrains. Same channel as
+    ``llmctl worker drain`` — zero-downtime by construction."""
+
+    name = "drain"
+
+    def __init__(self, store, namespace: str, component: str = "worker",
+                 endpoint_name: str = "generate"):
+        self.store = store
+        self.namespace = namespace
+        self.component = component
+        self.endpoint_name = endpoint_name
+
+    def _key(self, worker_id: str) -> str:
+        return (
+            f"{self.namespace}/components/{self.component}/endpoints/"
+            f"{self.endpoint_name}/drain/{worker_id}"
+        )
+
+    def handles(self, decision: Decision) -> bool:
+        return decision.kind in (DRAIN, UNDRAIN)
+
+    async def apply(self, decision: Decision) -> None:
+        key = self._key(decision.worker_id)
+        if decision.kind == DRAIN:
+            # no lease: the drain order outlives the planner process (the
+            # undrain decision is the explicit reversal)
+            await self.store.put(key, b"planner")
+        else:
+            await self.store.delete(key)
+
+
+class GraphActuator(Actuator):
+    """Execute pool resizes by patching the DynamoGraph CR's replica counts
+    and letting ``operator/controller.py`` reconcile the Deployments — the
+    planner never touches Deployments directly, so the operator remains the
+    single writer and a planner crash mid-change leaves a consistent CR."""
+
+    name = "graph"
+
+    # pool role → path into the CR spec holding that pool's config
+    _SPEC_PATH = {
+        "decode": ("workers", "decode"),
+        "prefill": ("workers", "prefill"),
+        "frontend": ("frontend",),
+    }
+
+    def __init__(self, kube, graph: str, namespace: str = "default"):
+        self.kube = kube
+        self.graph = graph
+        self.namespace = namespace
+
+    def handles(self, decision: Decision) -> bool:
+        return decision.kind == SCALE and decision.pool in self._SPEC_PATH
+
+    async def apply(self, decision: Decision) -> None:
+        from dynamo_tpu.operator.controller import GRAPH_PLURAL, GROUP_API
+
+        cr = await self.kube.get(
+            GROUP_API, GRAPH_PLURAL, self.namespace, self.graph
+        )
+        if cr is None:
+            raise RuntimeError(f"DynamoGraph {self.graph!r} not found")
+        section: Any = cr.get("spec", {})
+        for part in self._SPEC_PATH[decision.pool]:
+            section = section.get(part) if isinstance(section, dict) else None
+            if section is None:
+                raise RuntimeError(
+                    f"graph {self.graph!r} has no {decision.pool!r} pool"
+                )
+        if section.get("autoscale"):
+            # an HPA owns this pool's replica count; fighting it would make
+            # the deployment ping-pong (controller.py carries the live count
+            # through replaces for the same reason)
+            raise RuntimeError(
+                f"pool {decision.pool!r} is HPA-owned (autoscale set)"
+            )
+        # the decision's replica counts come from OBSERVED workers, which
+        # lag the spec while pods come up: an up decision must never lower
+        # the spec (cancelling an in-flight scale-up mid-incident), and a
+        # trim must never raise it
+        current = section.get("replicas")
+        target = int(decision.to_replicas)
+        if isinstance(current, int):
+            if decision.to_replicas > decision.from_replicas:
+                target = max(target, current)
+            else:
+                target = min(target, current)
+            if target == current:
+                return  # the spec is already there; nothing to write
+        section["replicas"] = target
+        await self.kube.replace(
+            GROUP_API, GRAPH_PLURAL, self.namespace, self.graph, cr
+        )
+
+
+# ---------------------------------------------------------------------------
+# the planner core
+# ---------------------------------------------------------------------------
+
+# pool role → SLO names whose *page* means "this pool is undersized".
+# decode additionally owns ttft_p95 when the model has no prefill pool
+# (aggregated serving: prefill runs on the decode workers).
+_POOL_SLOS = {
+    "decode": {"itl_p95"},
+    "prefill": {"ttft_p95"},
+    "frontend": {"overload_share"},
+}
+
+
+class Planner:
+    """Pure policy over (rollup, slo_report) snapshots; transport-free and
+    deterministic under an injected clock — the simulator's virtual-time
+    legs and the chaos tests both rely on that."""
+
+    def __init__(
+        self,
+        policy: Optional[PlannerPolicy] = None,
+        actuators: Optional[List[Actuator]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.policy = policy or PlannerPolicy.from_env()
+        self.actuators: List[Actuator] = list(actuators or [])
+        self.clock = clock
+        self.decisions: deque = deque(maxlen=self.policy.ring)
+        # (model, pool, direction) → cooldown expiry
+        self._cooldowns: Dict[Tuple[str, str, str], float] = {}
+        # (model, pool) → when the calm stretch started
+        self._calm_since: Dict[Tuple[str, str], float] = {}
+        # worker_id → when it was first seen unhealthy / healthy-again
+        self._unhealthy_since: Dict[str, float] = {}
+        self._healthy_since: Dict[str, float] = {}
+        # workers this planner ordered drained (only those get undrained)
+        self._drained: Dict[str, str] = {}
+
+    # -- evaluation ----------------------------------------------------------
+
+    @staticmethod
+    def _slo_states(slo: Optional[List[dict]]) -> Tuple[dict, dict]:
+        """Per-model sets of paging / burning-or-paging SLO names."""
+        alerts: Dict[str, set] = {}
+        burning: Dict[str, set] = {}
+        for s in slo or []:
+            model = (s.get("labels") or {}).get("model")
+            if not model:
+                continue
+            if s.get("state") == "alert":
+                alerts.setdefault(model, set()).add(s.get("slo"))
+            if s.get("state") in ("alert", "burning"):
+                burning.setdefault(model, set()).add(s.get("slo"))
+        return alerts, burning
+
+    @staticmethod
+    def _pools_of(entry: dict) -> Dict[str, dict]:
+        """The per-role pool breakdown; a pre-planner aggregator without it
+        degrades to one ``decode`` pool built from the model totals."""
+        pools = entry.get("pools")
+        if isinstance(pools, dict) and pools:
+            return pools
+        return {"decode": {
+            "workers": entry.get("workers", 0),
+            "workers_unhealthy": entry.get("workers_unhealthy", 0),
+            "slots_total": entry.get("slots_total", 0),
+            "slots_free": entry.get("slots_free", 0),
+            "queue_depth": entry.get("queue_depth", 0),
+            "headroom_frac": entry.get("headroom_frac", 0.0),
+        }}
+
+    def _pool_slo_names(self, role: str, pools: Dict[str, dict]) -> set:
+        names = set(_POOL_SLOS.get(role, ()))
+        if role == "decode" and "prefill" not in pools:
+            names.add("ttft_p95")  # aggregated serving: decode owns TTFT
+        return names
+
+    def evaluate(
+        self, rollup: dict, slo: Optional[List[dict]] = None
+    ) -> List[Decision]:
+        """One pure planning pass → the decisions due *now* (hysteresis and
+        cooldown state advances; actuation status is the caller's job)."""
+        p = self.policy
+        now = self.clock()
+        out: List[Decision] = []
+        if not p.enabled:
+            return out
+        alerts, burning = self._slo_states(slo)
+        models = rollup.get("models") or {}
+        unhealthy_now: set = set()
+
+        for model, entry in sorted(models.items()):
+            pools = self._pools_of(entry)
+            for role, pool in sorted(pools.items()):
+                cur = int(pool.get("workers", 0) or 0)
+                if cur <= 0:
+                    continue
+                slo_names = self._pool_slo_names(role, pools)
+                paging = sorted(alerts.get(model, set()) & slo_names)
+                burn = bool(burning.get(model, set()) & slo_names)
+                headroom = float(pool.get("headroom_frac", 0.0) or 0.0)
+                queue_per = float(pool.get("queue_depth", 0) or 0) / cur
+                key = (model, role)
+
+                up_reasons: List[str] = []
+                if paging:
+                    up_reasons.append("slo_page:" + ",".join(paging))
+                if headroom < p.headroom_low:
+                    up_reasons.append(
+                        f"headroom {headroom:.2f} < {p.headroom_low:.2f}"
+                    )
+                if queue_per > p.queue_high:
+                    up_reasons.append(
+                        f"queue/worker {queue_per:.1f} > {p.queue_high:.1f}"
+                    )
+
+                if up_reasons:
+                    # any pressure resets the calm clock: scale-down needs a
+                    # FRESH uninterrupted stretch of calm
+                    self._calm_since.pop(key, None)
+                    if cur < p.max_workers and now >= self._cooldowns.get(
+                        key + ("up",), 0.0
+                    ):
+                        target = min(
+                            cur + max(1, math.ceil(cur * p.up_step)),
+                            p.max_workers,
+                        )
+                        out.append(Decision(
+                            kind=SCALE, model=model, pool=role, ts=now,
+                            from_replicas=cur, to_replicas=target,
+                            reason="; ".join(up_reasons),
+                            urgency="page" if paging else "capacity",
+                        ))
+                    continue
+
+                calm = (
+                    not burn
+                    and headroom >= p.headroom_high
+                    and queue_per <= p.queue_high / 4.0
+                )
+                if not calm:
+                    # the hysteresis band between the triggers: neither
+                    # pressed nor provably oversized — hold position
+                    self._calm_since.pop(key, None)
+                    continue
+                since = self._calm_since.setdefault(key, now)
+                if (
+                    cur > p.min_workers
+                    and now - since >= p.down_stable
+                    and now >= self._cooldowns.get(key + ("down",), 0.0)
+                ):
+                    out.append(Decision(
+                        kind=SCALE, model=model, pool=role, ts=now,
+                        from_replicas=cur,
+                        to_replicas=max(cur - 1, p.min_workers),
+                        reason=(
+                            f"calm {now - since:.0f}s: headroom "
+                            f"{headroom:.2f} >= {p.headroom_high:.2f}, "
+                            f"queue/worker {queue_per:.1f}"
+                        ),
+                        urgency="trim",
+                    ))
+
+            # drain plane: persistently unhealthy workers get routed around
+            for wid in entry.get("unhealthy_worker_ids") or []:
+                unhealthy_now.add(wid)
+                self._healthy_since.pop(wid, None)
+                since = self._unhealthy_since.setdefault(wid, now)
+                if wid not in self._drained and now - since >= p.drain_after:
+                    out.append(Decision(
+                        kind=DRAIN, model=model, worker_id=wid, ts=now,
+                        reason=f"unhealthy for {now - since:.0f}s",
+                        urgency="health",
+                    ))
+
+        # recovery: only workers THIS planner drained get undrained (an
+        # operator's manual drain through the same keys is not ours to undo),
+        # and only on POSITIVE evidence — the worker must still be publishing
+        # (present in the rollup's draining_workers map) and report healthy.
+        # A crashed worker simply disappears from the rollup; absence must
+        # hold the drain, not clear it.
+        for wid, model in list(self._drained.items()):
+            state = (
+                (models.get(model) or {}).get("draining_workers") or {}
+            ).get(wid)
+            # "healthy" exactly: degraded (observably impaired, e.g. event
+            # loop lag — runtime/health.py) is not recovered, and undraining
+            # it would restart the drain/undrain flap this gate prevents
+            if state != "healthy" or wid in unhealthy_now:
+                self._healthy_since.pop(wid, None)
+                continue
+            since = self._healthy_since.setdefault(wid, now)
+            if now - since >= p.undrain_after:
+                out.append(Decision(
+                    kind=UNDRAIN, model=model, worker_id=wid, ts=now,
+                    reason=f"healthy again for {now - since:.0f}s",
+                    urgency="health",
+                ))
+        for wid in list(self._unhealthy_since):
+            if wid not in unhealthy_now:
+                del self._unhealthy_since[wid]
+        return out
+
+    # -- actuation -----------------------------------------------------------
+
+    async def _actuate(self, d: Decision) -> None:
+        actuator = next(
+            (a for a in self.actuators if a.handles(d)), None
+        )
+        if actuator is None:
+            d.status = "dropped"
+            d.error = "no actuator handles this decision kind"
+            logger.warning("planner decision dropped (no actuator): %s",
+                           d.to_dict())
+            return
+        try:
+            await actuator.apply(d)
+        except Exception as e:  # actuation failures are data, not crashes
+            d.status = "failed"
+            d.error = f"{type(e).__name__}: {e}"[:200]
+            logger.warning("planner actuation failed via %s: %s",
+                           actuator.name, d.to_dict())
+            return
+        d.status = "actuated"
+        now = self.clock()
+        p = self.policy
+        if d.kind == SCALE:
+            direction = "up" if d.to_replicas > d.from_replicas else "down"
+            cooldown = p.cooldown_up if direction == "up" else p.cooldown_down
+            self._cooldowns[(d.model, d.pool, direction)] = now + cooldown
+            # each completed resize restarts the calm requirement
+            self._calm_since.pop((d.model, d.pool), None)
+        elif d.kind == DRAIN:
+            self._drained[d.worker_id] = d.model
+        elif d.kind == UNDRAIN:
+            self._drained.pop(d.worker_id, None)
+            self._healthy_since.pop(d.worker_id, None)
+        logger.info("planner actuated via %s: %s", actuator.name, d.to_dict())
+
+    async def step(
+        self, rollup: dict, slo: Optional[List[dict]] = None
+    ) -> List[Decision]:
+        """One evaluate→actuate pass; every decision lands in the ring."""
+        decisions = self.evaluate(rollup, slo)
+        for d in decisions:
+            await self._actuate(d)
+            self.decisions.append(d)
+        return decisions
+
+    # -- status --------------------------------------------------------------
+
+    def failing(self) -> List[Decision]:
+        """Decisions currently failing to actuate: the *latest* ring entry
+        per target, when that entry is failed/dropped. Superseded failures
+        (a later success for the same target) don't count."""
+        latest: Dict[str, Decision] = {}
+        for d in self.decisions:
+            latest[d.target_key()] = d
+        return [
+            d for d in latest.values() if d.status in ("failed", "dropped")
+        ]
+
+    def dump(self) -> dict:
+        now = self.clock()
+        cooldowns = {
+            f"{model}/{pool}/{direction}": round(expires - now, 3)
+            for (model, pool, direction), expires in self._cooldowns.items()
+            if expires > now
+        }
+        return PlannerStatus(
+            decisions=[d.to_dict() for d in self.decisions],
+            cooldowns=cooldowns,
+            failing=[d.to_dict() for d in self.failing()],
+            policy=self.policy.to_dict(),
+        ).to_dict()
+
+
+# ---------------------------------------------------------------------------
+# telemetry sources
+# ---------------------------------------------------------------------------
+
+
+class AggregatorSource:
+    """Observation via a remote aggregator's ``telemetry_dump`` RPC verb,
+    found through ordinary instance discovery (same dial path as ``llmctl
+    slo status``). Returns (rollup, slo) or (None, None) when unreachable —
+    the planner holds position rather than acting on stale data."""
+
+    def __init__(self, store, endpoint: str, timeout: float = 5.0):
+        self.store = store
+        self.endpoint = endpoint
+        self.timeout = timeout
+
+    async def fetch(self) -> Tuple[Optional[dict], Optional[list]]:
+        from dynamo_tpu.runtime.distributed import live_instance_infos
+        from dynamo_tpu.runtime.rpc import RpcClient
+
+        for info in await live_instance_infos(self.store, self.endpoint):
+            try:
+                client = await RpcClient.connect(
+                    info.address, timeout=self.timeout
+                )
+            except (ConnectionError, OSError):
+                continue
+            try:
+                dump = await client.telemetry_dump(timeout=self.timeout)
+            except (ConnectionError, OSError):
+                continue
+            finally:
+                await client.close()
+            cluster = dump.get("cluster") or {}
+            return cluster.get("rollup"), cluster.get("slo")
+        return None, None
+
+
+class EmbeddedSource:
+    """Observation via an in-process :class:`ClusterTelemetry` ingesting the
+    ``kv_metrics`` stream directly — no aggregator dependency; the planner
+    is then a self-contained control loop on the bus."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    async def fetch(self) -> Tuple[Optional[dict], Optional[list]]:
+        return self.cluster.rollup(), self.cluster.slo_report()
+
+
+async def run_planner(
+    drt,
+    namespace: str,
+    actuators: Optional[List[Actuator]] = None,
+    aggregator: Optional[str] = None,
+    policy: Optional[PlannerPolicy] = None,
+    register: bool = True,
+    ready: Optional[asyncio.Event] = None,
+    planner_out: Optional[List[Planner]] = None,
+) -> None:
+    """The long-running planner component. Observes through ``aggregator``
+    (a ``dyn://ns.telemetry.status`` endpoint, polled via ``telemetry_dump``)
+    or, when absent, an embedded :class:`ClusterTelemetry` subscribed to the
+    worker metrics stream. Registers ``{ns}.planner.plan`` so ``llmctl
+    planner status`` finds the decision ring through ordinary discovery.
+    With no actuators configured it runs in observe mode: decisions are
+    evaluated, logged, and ringed, but nothing is touched."""
+    from dynamo_tpu.runtime.annotated import Annotated
+    from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+    planner = Planner(
+        policy or PlannerPolicy.from_env(),
+        actuators=actuators if actuators is not None else [ProcessActuator()],
+    )
+    if planner_out is not None:
+        planner_out.append(planner)
+    ns = drt.namespace(namespace)
+
+    consumer: Optional[asyncio.Task] = None
+    if aggregator:
+        source: Any = AggregatorSource(drt.store, aggregator)
+    else:
+        from dynamo_tpu.components.telemetry_aggregator import ClusterTelemetry
+        from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+        from dynamo_tpu.runtime.distributed import (
+            KV_METRICS_SUBJECT,
+            resubscribe_forever,
+        )
+
+        cluster = ClusterTelemetry(namespace)
+        source = EmbeddedSource(cluster)
+        consumer = asyncio.create_task(resubscribe_forever(
+            ns, KV_METRICS_SUBJECT,
+            lambda d: cluster.ingest(
+                d["worker_id"], ForwardPassMetrics.from_dict(d["metrics"])
+            ),
+        ))
+
+    if register:
+        class _PlanEngine(AsyncEngine):
+            """RPC-facing view: one item with the planner status dump."""
+
+            async def generate(self, request: Context):
+                yield Annotated.from_data(planner.dump())
+
+        await ns.component("planner").endpoint("plan").serve(_PlanEngine())
+
+    if ready is not None:
+        ready.set()
+    logger.info(
+        "planner for %r: interval=%.1fs actuators=%s source=%s",
+        namespace, planner.policy.interval,
+        [a.name for a in planner.actuators],
+        "aggregator" if aggregator else "embedded",
+    )
+    try:
+        while True:
+            await asyncio.sleep(planner.policy.interval)
+            try:
+                rollup, slo = await source.fetch()
+            except Exception:
+                logger.warning("planner observation failed", exc_info=True)
+                continue
+            if not rollup:
+                continue
+            try:
+                await planner.step(rollup, slo)
+            except Exception:
+                logger.exception("planner step failed")
+    finally:
+        if consumer is not None:
+            consumer.cancel()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="dynamo_tpu SLA-driven planner")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--statestore", default=None)
+    p.add_argument("--bus", default=None)
+    p.add_argument("--aggregator", default=None,
+                   help="poll this dyn://ns.telemetry.status endpoint "
+                        "instead of ingesting kv_metrics directly")
+    p.add_argument("--actuate", action="append", default=[],
+                   choices=("drain", "graph"),
+                   help="enable an actuator (repeatable); none = observe "
+                        "mode (decisions logged, nothing touched)")
+    p.add_argument("--component", default="worker",
+                   help="component whose endpoint the drain actuator keys")
+    p.add_argument("--endpoint", default="generate",
+                   help="endpoint name the drain actuator keys")
+    p.add_argument("--graph", default=None,
+                   help="DynamoGraph CR name for the graph actuator")
+    p.add_argument("--kube-namespace", default="default")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+        drt = await DistributedRuntime.create(
+            statestore_url=args.statestore, bus_url=args.bus
+        )
+        actuators: List[Actuator] = []
+        if "drain" in args.actuate:
+            actuators.append(DrainActuator(
+                drt.store, args.namespace,
+                component=args.component, endpoint_name=args.endpoint,
+            ))
+        if "graph" in args.actuate:
+            if not args.graph:
+                raise SystemExit("--actuate graph requires --graph NAME")
+            from dynamo_tpu.operator.kube import RealKube
+
+            actuators.append(GraphActuator(
+                RealKube(), args.graph, args.kube_namespace
+            ))
+        await run_planner(
+            drt, args.namespace,
+            actuators=actuators or None,
+            aggregator=args.aggregator,
+        )
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

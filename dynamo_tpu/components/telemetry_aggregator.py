@@ -59,6 +59,7 @@ CLUSTER_GAUGES = [
     ("kv_blocks_total", "KV pool blocks across the fleet"),
     ("kv_blocks_free", "Free KV pool blocks across the fleet"),
     ("headroom_frac", "min(free slots, free KV) fraction of fleet capacity"),
+    ("queue_depth", "Requests waiting beyond engine slots (fleet sum)"),
     ("decode_tokens_per_s", "Fleet decode throughput (sum of worker EMAs)"),
     # speculative decoding (PR7): fleet draft counters + acceptance rate
     # recomputed from the summed counters (not a mean of worker EMAs)
@@ -254,7 +255,15 @@ class ClusterTelemetry:
         return round(score, 4)
 
     def rollup(self) -> dict:
-        """Instantaneous cluster capacity/health view, per model + total."""
+        """Instantaneous cluster capacity/health view, per model + total.
+
+        Per model: fleet capacity sums, aggregate ``queue_depth`` (requests
+        waiting beyond engine slots), a ``pools`` breakdown keyed by worker
+        role (``decode`` | ``prefill`` | ``frontend``; pre-planner workers
+        without a role bucket as ``decode``), and a bounded
+        ``unhealthy_worker_ids`` list — together the observation the planner
+        (``components/planner.py``) resizes pools and drains workers from.
+        """
         live = self.live_workers()
         models: Dict[str, dict] = {}
         scores: List[Tuple[str, float]] = []
@@ -266,17 +275,30 @@ class ClusterTelemetry:
                 "workers": 0, "workers_unhealthy": 0,
                 "slots_total": 0, "slots_free": 0,
                 "kv_blocks_total": 0, "kv_blocks_free": 0,
+                "queue_depth": 0,
                 "decode_tokens_per_s": 0.0,
                 "spec_drafted_tokens": 0, "spec_accepted_tokens": 0,
                 "spec_accept_rate": 0.0,
+                "pools": {},
+                "unhealthy_worker_ids": [],
+                "draining_workers": {},
             })
             entry["workers"] += 1
-            if getattr(m, "health_state", "healthy") == "unhealthy":
+            unhealthy = getattr(m, "health_state", "healthy") == "unhealthy"
+            if unhealthy:
                 entry["workers_unhealthy"] += 1
-            entry["slots_total"] += int(m.request_total_slots or 0)
-            entry["slots_free"] += max(
-                int(m.request_total_slots or 0) - int(m.request_active_slots or 0), 0
+                # bounded: the planner needs names to drain, but a mass
+                # outage must not balloon the rollup payload
+                if len(entry["unhealthy_worker_ids"]) < 16:
+                    entry["unhealthy_worker_ids"].append(wid)
+            slots_total = int(m.request_total_slots or 0)
+            slots_free = max(
+                slots_total - int(m.request_active_slots or 0), 0
             )
+            waiting = max(int(m.num_requests_waiting or 0), 0)
+            entry["slots_total"] += slots_total
+            entry["slots_free"] += slots_free
+            entry["queue_depth"] += waiting
             entry["kv_blocks_total"] += int(m.kv_total_blocks or 0)
             entry["kv_blocks_free"] += max(
                 int(m.kv_total_blocks or 0) - int(m.kv_active_blocks or 0), 0
@@ -294,6 +316,32 @@ class ClusterTelemetry:
             entry["spec_accepted_tokens"] += int(
                 getattr(m, "spec_accepted_tokens", 0) or 0
             )
+            # pool-role breakdown: what the planner actually resizes
+            role = getattr(m, "role", "") or "decode"
+            pool = entry["pools"].setdefault(role, {
+                "workers": 0, "workers_unhealthy": 0,
+                "slots_total": 0, "slots_free": 0, "queue_depth": 0,
+                "kv_blocks_total": 0, "kv_blocks_free": 0,
+            })
+            pool["workers"] += 1
+            if unhealthy:
+                pool["workers_unhealthy"] += 1
+            pool["slots_total"] += slots_total
+            pool["slots_free"] += slots_free
+            pool["queue_depth"] += waiting
+            pool["kv_blocks_total"] += int(m.kv_total_blocks or 0)
+            pool["kv_blocks_free"] += max(
+                int(m.kv_total_blocks or 0) - int(m.kv_active_blocks or 0), 0
+            )
+            # positive-evidence map for the planner's undrain path: a
+            # drained worker that crashed simply STOPS publishing — its
+            # absence here must read as "unknown", never as "recovered"
+            if getattr(m, "draining", 0) and len(
+                entry["draining_workers"]
+            ) < 32:
+                entry["draining_workers"][wid] = getattr(
+                    m, "health_state", "healthy"
+                )
             scores.append((wid, self._load_score(m)))
         for entry in models.values():
             slot_frac = (
@@ -307,6 +355,18 @@ class ClusterTelemetry:
             # headroom is the BINDING constraint: whichever of slots or KV
             # runs out first caps admission (runtime/admission.py)
             entry["headroom_frac"] = round(min(slot_frac, kv_frac), 4)
+            for pool in entry["pools"].values():
+                p_slot = (
+                    pool["slots_free"] / pool["slots_total"]
+                    if pool["slots_total"] else 0.0
+                )
+                # same binding-constraint rule as the model level; pools
+                # with no KV pool at all (frontends) are slot-bound only
+                p_kv = (
+                    pool["kv_blocks_free"] / pool["kv_blocks_total"]
+                    if pool["kv_blocks_total"] else p_slot
+                )
+                pool["headroom_frac"] = round(min(p_slot, p_kv), 4)
             if entry["spec_drafted_tokens"]:
                 entry["spec_accept_rate"] = round(
                     entry["spec_accepted_tokens"] / entry["spec_drafted_tokens"],

@@ -199,6 +199,12 @@ class ForwardPassMetrics:
     # process identity for cluster attribution + dashboards
     uptime_s: float = 0.0
     model: Optional[str] = None
+    # pool role for topology-aware rollups ("decode" | "prefill" |
+    # "frontend" | ""): the planner resizes pools independently, so the
+    # cluster rollup must break capacity down by role, not just by model.
+    # Empty from pre-planner workers — the aggregator buckets those as
+    # "decode" (the only role that existed before the field)
+    role: str = ""
 
     def to_dict(self) -> dict:
         from dataclasses import asdict

@@ -26,4 +26,7 @@ ENDPOINT_PROTOCOLS = {
     # (components/telemetry_aggregator.py): payload-less request, entry
     # anchors the REPLY type (the telemetry_dump state)
     "status": "dynamo_tpu.runtime.telemetry:TelemetryDump",
+    # planner's decision-ring endpoint (components/planner.py): payload-less
+    # request, entry anchors the REPLY type (`llmctl planner status` reads it)
+    "plan": "dynamo_tpu.components.planner:PlannerStatus",
 }

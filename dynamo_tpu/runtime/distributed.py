@@ -153,6 +153,25 @@ class InstanceInfo:
         )
 
 
+async def live_instance_infos(store, endpoint: str) -> List["InstanceInfo"]:
+    """Parsed instance entries registered under a ``dyn://ns.comp.ep``
+    endpoint, unparseable entries skipped, in stable (key-sorted) dial
+    order — the shared front half of every "dial the first reachable
+    instance" loop (`llmctl` status commands, the planner's
+    AggregatorSource)."""
+    ns, comp, ep = parse_endpoint_path(endpoint)
+    entries = await store.get_prefix(
+        f"{ns}/components/{comp}/endpoints/{ep}/instances/"
+    )
+    infos = []
+    for key in sorted(entries):
+        try:
+            infos.append(InstanceInfo.from_json(entries[key]))
+        except (ValueError, KeyError):
+            continue
+    return infos
+
+
 class DistributedRuntime:
     """Per-process handle on the distributed planes.
 
@@ -1319,7 +1338,7 @@ async def serve_stats_endpoint(endpoint: "Endpoint", engine) -> "InstanceInfo":
 
 
 async def attach_kv_publishing(
-    endpoint: Endpoint, engine, interval: float = 1.0
+    endpoint: Endpoint, engine, interval: float = 1.0, role: str = "decode"
 ) -> KvPublishBridge:
     """Wire a serving engine's KV events + load metrics onto the event plane.
 
@@ -1327,6 +1346,9 @@ async def attach_kv_publishing(
     instance id, which changes when a lost lease forces re-registration;
     clients map worker_id → live instance via InstanceInfo. Reference
     analogue: KvEventPublisher + KvMetricsPublisher (SURVEY.md §3.5).
+    ``role`` tags the snapshots with the worker's pool role ("decode" |
+    "prefill" | "frontend") so the cluster rollup's per-pool breakdown —
+    what the planner resizes — attributes this worker's capacity correctly.
     """
     ns = endpoint.component.namespace
     worker_id = ns.runtime.worker_id
@@ -1351,6 +1373,7 @@ async def attach_kv_publishing(
                     getattr(engine, "model_name", None)
                     or endpoint.component.name,
                 )
+                snap.setdefault("role", role)
                 snap["uptime_s"] = round(telemetry.uptime_seconds(), 3)
                 if server is not None:
                     # overload observability rides the same metrics stream

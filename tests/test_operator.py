@@ -163,6 +163,152 @@ class TestReconcile:
         run(go())
 
 
+def _plain_cr(**spec_overrides):
+    """Minimal inline CR: the table rows must not depend on the example
+    YAML shipping alongside (its spec drifting would silently change what
+    these rows assert)."""
+    spec = {
+        "image": "dynamo-tpu:test",
+        "model": {"path": "/models/tiny", "name": "tiny"},
+        "frontend": {"replicas": 1, "port": 8080},
+        "workers": {
+            "decode": {"replicas": 2},
+            "prefill": {"replicas": 1},
+        },
+    }
+    spec.update(spec_overrides)
+    return {
+        "apiVersion": "dynamo.tpu/v1",
+        "kind": "DynamoGraph",
+        "metadata": {"name": "tbl", "namespace": "default"},
+        "spec": spec,
+    }
+
+
+async def _reconciled(kube, cr):
+    """Create the CR and run one direct single-CR reconcile pass."""
+    cr = await kube.create(GROUP_API, GRAPH_PLURAL, "default", cr)
+    await GraphController(kube, "default").reconcile(cr)
+    return cr
+
+
+async def _generations(kube):
+    return {
+        d["metadata"]["name"]: d["metadata"]["generation"]
+        for d in await kube.list(APPS_API, "deployments", "default")
+    }
+
+
+class TestReconcileTable:
+    """Direct ``reconcile()`` contract, row by row (the scenario tests
+    above exercise the loop via ``reconcile_all``; these pin the per-CR
+    behaviors the planner's GraphActuator now leans on)."""
+
+    def test_spec_hash_noop_second_pass_rewrites_nothing(self):
+        async def go():
+            kube = FakeKube()
+            cr = await _reconciled(kube, _plain_cr())
+            before = await _generations(kube)
+            assert before  # the pass materialized deployments
+            await GraphController(kube, "default").reconcile(cr)
+            assert await _generations(kube) == before
+
+        run(go())
+
+    def test_replica_change_updates_only_that_deployment(self):
+        async def go():
+            kube = FakeKube()
+            cr = await _reconciled(kube, _plain_cr())
+            before = await _generations(kube)
+            cr2 = copy.deepcopy(cr)
+            cr2["spec"]["workers"]["decode"]["replicas"] = 4
+            await GraphController(kube, "default").reconcile(cr2)
+            dec = await kube.get(APPS_API, "deployments", "default", "tbl-decode")
+            assert dec["spec"]["replicas"] == 4
+            after = await _generations(kube)
+            assert after["tbl-decode"] == before["tbl-decode"] + 1
+            # untouched siblings are not rewritten (spec-hash short-circuit)
+            for name in set(before) - {"tbl-decode"}:
+                assert after[name] == before[name], name
+
+        run(go())
+
+    def test_every_live_child_carries_the_owner_ref(self):
+        async def go():
+            kube = FakeKube()
+            cr = await _reconciled(kube, _plain_cr())
+            from dynamo_tpu.operator.controller import KIND_MAP
+
+            checked = 0
+            for api, plural in KIND_MAP.values():
+                for obj in await kube.list(api, plural, "default"):
+                    refs = obj["metadata"]["ownerReferences"]
+                    assert refs[0]["kind"] == "DynamoGraph"
+                    assert refs[0]["uid"] == cr["metadata"]["uid"]
+                    assert refs[0]["controller"] is True
+                    checked += 1
+            assert checked >= 7  # planes + frontend + 2 worker pools
+
+        run(go())
+
+    def test_autoscaled_name_excluded_from_replica_drift(self):
+        async def go():
+            kube = FakeKube()
+            cr = await _reconciled(kube, _plain_cr(workers={
+                "decode": {"replicas": 2, "autoscale": {"maxReplicas": 16}},
+                "prefill": {"replicas": 1},
+            }))
+            # the "HPA" scales the deployment; a spec replica change on the
+            # HPA-owned pool must be INVISIBLE to the hash — no rewrite,
+            # live count preserved
+            dec = await kube.get(APPS_API, "deployments", "default", "tbl-decode")
+            dec["spec"]["replicas"] = 7
+            await kube.replace(APPS_API, "deployments", "default", "tbl-decode", dec)
+            gen = (await _generations(kube))["tbl-decode"]
+            cr2 = copy.deepcopy(cr)
+            cr2["spec"]["workers"]["decode"]["replicas"] = 5
+            await GraphController(kube, "default").reconcile(cr2)
+            dec = await kube.get(APPS_API, "deployments", "default", "tbl-decode")
+            assert dec["spec"]["replicas"] == 7
+            assert (await _generations(kube))["tbl-decode"] == gen
+
+        run(go())
+
+    def test_status_counts_ready_deployments(self):
+        async def go():
+            kube = FakeKube()
+            cr = await _reconciled(kube, _plain_cr())
+            got = await kube.get(GROUP_API, GRAPH_PLURAL, "default", "tbl")
+            assert got["status"]["phase"] == "Progressing"
+            assert got["status"]["readyDeployments"] == 0
+            total = got["status"]["totalDeployments"]
+            for d in await kube.list(APPS_API, "deployments", "default"):
+                await kube.mark_ready("default", d["metadata"]["name"])
+            await GraphController(kube, "default").reconcile(cr)
+            got = await kube.get(GROUP_API, GRAPH_PLURAL, "default", "tbl")
+            assert got["status"]["phase"] == "Ready"
+            assert got["status"]["readyDeployments"] == total
+
+        run(go())
+
+    def test_dropped_pool_is_pruned_by_single_cr_pass(self):
+        async def go():
+            kube = FakeKube()
+            cr = await _reconciled(kube, _plain_cr())
+            cr2 = copy.deepcopy(cr)
+            del cr2["spec"]["workers"]["prefill"]
+            await GraphController(kube, "default").reconcile(cr2)
+            assert await kube.get(
+                APPS_API, "deployments", "default", "tbl-prefill"
+            ) is None
+            # the sibling pools survive the prune
+            assert await kube.get(
+                APPS_API, "deployments", "default", "tbl-decode"
+            ) is not None
+
+        run(go())
+
+
 class TestHelmChart:
     CHART = os.path.join(
         os.path.dirname(__file__), "..", "deploy", "helm", "dynamo-platform"

@@ -1,0 +1,1262 @@
+"""SLA-driven planner + million-user traffic simulator (ISSUE 8).
+
+Unit coverage for the pure policy engine (``components/planner.py``) under
+an injected clock, the three actuators, and the deterministic traffic
+simulator (``tools/traffic_sim.py``), plus the chaos acceptance gates:
+
+- **virtual time**: the 5x flash-crowd burst scenario — the planner scales
+  decode capacity, the paging SLO clears within one slow window, zero
+  failed requests, and the fleet trims back afterwards with no decision
+  oscillation (the frozen-topology control leg fails by the tens of
+  thousands and never clears its page).
+- **wall clock**: the full components-on-a-bus loop — a mock fleet
+  publishing on a real bus → telemetry aggregator → planner polling
+  ``telemetry_dump`` → ProcessActuator reshaping the fleet — with
+  ``llmctl planner status`` reading the decision ring through discovery.
+"""
+
+import asyncio
+import json
+import math
+
+import pytest
+
+from dynamo_tpu.components.mock_worker import LoadProfile, MockWorkerStats
+from dynamo_tpu.components.planner import (
+    DRAIN,
+    SCALE,
+    UNDRAIN,
+    Decision,
+    DrainActuator,
+    GraphActuator,
+    Planner,
+    PlannerPolicy,
+    PlannerStatus,
+    ProcessActuator,
+)
+from tools.traffic_sim import (
+    Burst,
+    FleetModel,
+    IslMix,
+    TrafficModel,
+    VirtualClock,
+    run_burst_scenario,
+    run_diurnal_scenario,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# rollup / slo builders (the planner's pure inputs)
+# ---------------------------------------------------------------------------
+
+
+def mk_pool(workers=2, headroom=0.3, queue=0, unhealthy=0):
+    return {
+        "workers": workers, "workers_unhealthy": unhealthy,
+        "slots_total": workers * 16,
+        "slots_free": int(workers * 16 * headroom),
+        "queue_depth": queue, "headroom_frac": headroom,
+    }
+
+
+def mk_rollup(model="m", pools=None, unhealthy_ids=(), draining=None):
+    pools = pools if pools is not None else {"decode": mk_pool()}
+    return {"models": {model: {
+        "workers": sum(p["workers"] for p in pools.values()),
+        "pools": pools,
+        "unhealthy_worker_ids": list(unhealthy_ids),
+        # {worker_id: health_state} for workers still PUBLISHING with the
+        # draining flag set — the planner's positive evidence for undrain
+        "draining_workers": dict(draining or {}),
+    }}}
+
+
+def mk_slo(model="m", name="itl_p95", state="alert"):
+    return [{"slo": name, "state": state, "labels": {"model": model}}]
+
+
+def mk_planner(clock, actuators=None, **policy_kw):
+    defaults = dict(
+        interval=1.0, headroom_low=0.15, headroom_high=0.5,
+        queue_high=4.0, up_step=0.5, cooldown_up=60.0,
+        cooldown_down=300.0, down_stable=180.0,
+        min_workers=1, max_workers=8,
+        drain_after=60.0, undrain_after=30.0,
+    )
+    defaults.update(policy_kw)
+    return Planner(
+        PlannerPolicy(**defaults),
+        actuators=actuators if actuators is not None else [ProcessActuator()],
+        clock=clock,
+    )
+
+
+# ---------------------------------------------------------------------------
+# policy knobs
+# ---------------------------------------------------------------------------
+
+
+class TestPolicyKnobs:
+    def test_defaults_are_sane(self):
+        p = PlannerPolicy()
+        assert p.enabled
+        assert p.headroom_high > p.headroom_low
+        assert p.cooldown_down >= p.cooldown_up
+        assert p.max_workers >= p.min_workers
+
+    @pytest.mark.parametrize("name,value,attr", [
+        ("DYN_TPU_PLAN_INTERVAL_S", "abc", "interval"),
+        ("DYN_TPU_PLAN_INTERVAL_S", "-5", "interval"),
+        ("DYN_TPU_PLAN_QUEUE_HIGH", "", "queue_high"),
+        ("DYN_TPU_PLAN_UP_STEP", "0", "up_step"),
+        ("DYN_TPU_PLAN_MIN_WORKERS", "nope", "min_workers"),
+        ("DYN_TPU_PLAN_RING", "-1", "ring"),
+    ])
+    def test_malformed_env_falls_back_to_default(
+        self, monkeypatch, name, value, attr
+    ):
+        monkeypatch.setenv(name, value)
+        assert getattr(PlannerPolicy.from_env(), attr) == \
+            getattr(PlannerPolicy(), attr)
+
+    def test_overlapping_hysteresis_band_is_forced_apart(self, monkeypatch):
+        # a down trigger at/below the up trigger would let one noisy sample
+        # alternate directions — the band is forced open
+        monkeypatch.setenv("DYN_TPU_PLAN_HEADROOM_LOW", "0.4")
+        monkeypatch.setenv("DYN_TPU_PLAN_HEADROOM_HIGH", "0.2")
+        p = PlannerPolicy.from_env()
+        assert p.headroom_high >= p.headroom_low + 0.05
+
+    def test_cooldown_down_forced_at_least_up(self, monkeypatch):
+        monkeypatch.setenv("DYN_TPU_PLAN_COOLDOWN_UP_S", "120")
+        monkeypatch.setenv("DYN_TPU_PLAN_COOLDOWN_DOWN_S", "5")
+        p = PlannerPolicy.from_env()
+        assert p.cooldown_down >= p.cooldown_up
+
+    def test_max_workers_forced_at_least_min(self, monkeypatch):
+        monkeypatch.setenv("DYN_TPU_PLAN_MIN_WORKERS", "5")
+        monkeypatch.setenv("DYN_TPU_PLAN_MAX_WORKERS", "2")
+        p = PlannerPolicy.from_env()
+        assert p.max_workers >= p.min_workers == 5
+
+    def test_env_disable(self, monkeypatch):
+        monkeypatch.setenv("DYN_TPU_PLAN", "0")
+        p = PlannerPolicy.from_env()
+        assert not p.enabled
+        planner = Planner(p, actuators=[], clock=VirtualClock())
+        assert planner.evaluate(
+            mk_rollup(pools={"decode": mk_pool(headroom=0.0)})
+        ) == []
+
+
+# ---------------------------------------------------------------------------
+# pure evaluation: triggers, hysteresis, cooldowns
+# ---------------------------------------------------------------------------
+
+
+class TestEvaluateScaleUp:
+    def test_low_headroom_scales_up(self):
+        clock = VirtualClock(100.0)
+        planner = mk_planner(clock)
+        out = planner.evaluate(
+            mk_rollup(pools={"decode": mk_pool(workers=2, headroom=0.05)})
+        )
+        assert len(out) == 1
+        d = out[0]
+        assert (d.kind, d.pool, d.from_replicas, d.to_replicas) == \
+            (SCALE, "decode", 2, 3)
+        assert d.urgency == "capacity"
+        assert "headroom" in d.reason
+
+    def test_deep_queue_scales_up(self):
+        planner = mk_planner(VirtualClock())
+        out = planner.evaluate(
+            mk_rollup(pools={"decode": mk_pool(workers=2, queue=20)})
+        )
+        assert len(out) == 1 and "queue/worker" in out[0].reason
+
+    def test_paging_slo_scales_its_pool(self):
+        # each SLO maps to the pool whose scaling fixes it
+        for slo_name, pool_name in (
+            ("itl_p95", "decode"),
+            ("ttft_p95", "prefill"),
+            ("overload_share", "frontend"),
+        ):
+            planner = mk_planner(VirtualClock())
+            pools = {
+                "decode": mk_pool(), "prefill": mk_pool(),
+                "frontend": mk_pool(),
+            }
+            out = planner.evaluate(
+                mk_rollup(pools=pools), mk_slo(name=slo_name)
+            )
+            assert [d.pool for d in out] == [pool_name], slo_name
+            assert out[0].urgency == "page"
+            assert "slo_page" in out[0].reason
+
+    def test_aggregated_decode_owns_ttft(self):
+        # no prefill pool (aggregated serving) → decode absorbs TTFT pages
+        planner = mk_planner(VirtualClock())
+        out = planner.evaluate(
+            mk_rollup(pools={"decode": mk_pool()}), mk_slo(name="ttft_p95")
+        )
+        assert [d.pool for d in out] == ["decode"]
+
+    def test_pre_planner_rollup_degrades_to_decode_pool(self):
+        # an old aggregator without the pools breakdown: the model totals
+        # become one decode pool instead of being ignored
+        planner = mk_planner(VirtualClock())
+        out = planner.evaluate({"models": {"m": {
+            "workers": 2, "workers_unhealthy": 0,
+            "slots_total": 32, "slots_free": 1,
+            "queue_depth": 0, "headroom_frac": 0.03,
+        }}})
+        assert len(out) == 1 and out[0].pool == "decode"
+
+    def test_up_step_is_proportional_and_capped(self):
+        planner = mk_planner(VirtualClock(), max_workers=8, up_step=0.5)
+        out = planner.evaluate(
+            mk_rollup(pools={"decode": mk_pool(workers=5, headroom=0.0)})
+        )
+        assert out[0].to_replicas == 8  # 5 + ceil(2.5) = 8, capped at max
+
+    def test_no_up_past_max_workers(self):
+        planner = mk_planner(VirtualClock(), max_workers=2)
+        out = planner.evaluate(
+            mk_rollup(pools={"decode": mk_pool(workers=2, headroom=0.0)})
+        )
+        assert out == []
+
+    def test_other_models_slo_does_not_trigger(self):
+        planner = mk_planner(VirtualClock())
+        out = planner.evaluate(
+            mk_rollup(model="a"), mk_slo(model="b", name="itl_p95")
+        )
+        assert out == []
+
+    def test_empty_pool_is_skipped(self):
+        planner = mk_planner(VirtualClock())
+        out = planner.evaluate(
+            mk_rollup(pools={"decode": mk_pool(workers=0, headroom=0.0)})
+        )
+        assert out == []
+
+
+class TestEvaluateHysteresis:
+    def test_band_between_triggers_holds_position(self):
+        clock = VirtualClock()
+        planner = mk_planner(clock)
+        pools = {"decode": mk_pool(workers=4, headroom=0.3)}  # in the band
+        for t in (0.0, 200.0, 1000.0):
+            clock.t = t
+            assert planner.evaluate(mk_rollup(pools=pools)) == []
+
+    def test_scale_down_needs_sustained_calm(self, run):
+        clock = VirtualClock()
+        planner = mk_planner(clock)
+        calm = mk_rollup(pools={"decode": mk_pool(workers=4, headroom=0.8)})
+        assert planner.evaluate(calm) == []           # calm clock starts
+        clock.t = 100.0
+        assert planner.evaluate(calm) == []           # not long enough
+        clock.t = 181.0
+        out = planner.evaluate(calm)
+        assert len(out) == 1
+        d = out[0]
+        assert (d.kind, d.from_replicas, d.to_replicas) == (SCALE, 4, 3)
+        assert d.urgency == "trim"
+
+    def test_one_worker_at_a_time_down(self, run):
+        clock = VirtualClock()
+        planner = mk_planner(clock)
+        calm = mk_rollup(pools={"decode": mk_pool(workers=8, headroom=0.9)})
+        planner.evaluate(calm)
+        clock.t = 181.0
+        out = planner.evaluate(calm)
+        assert out[0].to_replicas == 7  # never a proportional cliff
+
+    def test_pressure_resets_the_calm_clock(self):
+        clock = VirtualClock()
+        planner = mk_planner(clock)
+        calm = mk_rollup(pools={"decode": mk_pool(workers=4, headroom=0.8)})
+        planner.evaluate(calm)
+        clock.t = 170.0  # almost there…
+        # a burning (not yet paging) SLO interrupts the calm stretch
+        planner.evaluate(calm, mk_slo(state="burning"))
+        clock.t = 181.0
+        assert planner.evaluate(calm) == []  # stretch restarted fresh
+        clock.t = 181.0 + 181.0
+        assert len(planner.evaluate(calm)) == 1
+
+    def test_no_down_below_min_workers(self):
+        clock = VirtualClock()
+        planner = mk_planner(clock, min_workers=2)
+        calm = mk_rollup(pools={"decode": mk_pool(workers=2, headroom=0.9)})
+        planner.evaluate(calm)
+        clock.t = 1000.0
+        assert planner.evaluate(calm) == []
+
+    def test_up_cooldown_suppresses_then_releases(self, run):
+        clock = VirtualClock()
+        planner = mk_planner(clock)
+        hot = mk_rollup(pools={"decode": mk_pool(workers=2, headroom=0.0)})
+        assert len(run(planner.step(hot))) == 1       # actuated → cooldown
+        clock.t = 30.0
+        assert planner.evaluate(hot) == []            # inside cooldown_up=60
+        clock.t = 61.0
+        assert len(planner.evaluate(hot)) == 1
+
+    def test_down_cooldown_independent_of_up(self, run):
+        clock = VirtualClock()
+        planner = mk_planner(clock)
+        calm = mk_rollup(pools={"decode": mk_pool(workers=4, headroom=0.8)})
+        planner.evaluate(calm)
+        clock.t = 181.0
+        run(planner.step(calm))                       # down actuated
+        # calm restarts AND cooldown_down=300 applies: next trim needs both
+        clock.t = 366.0
+        assert planner.evaluate(calm) == []     # cooldown live; calm restarts
+        clock.t = 482.0
+        assert planner.evaluate(calm) == []     # cooldown expired, calm 116s
+        clock.t = 547.0
+        assert len(planner.evaluate(calm)) == 1  # both satisfied
+
+
+class TestEvaluateDrainPlane:
+    def test_drain_after_sustained_unhealthy_then_undrain(self, run):
+        clock = VirtualClock()
+        planner = mk_planner(clock)
+        sick = mk_rollup(unhealthy_ids=("w1",))
+        assert run(planner.step(sick)) == []          # not sustained yet
+        clock.t = 61.0
+        out = run(planner.step(sick))
+        assert [d.kind for d in out] == [DRAIN]
+        assert out[0].worker_id == "w1" and out[0].urgency == "health"
+        clock.t = 62.0
+        assert run(planner.step(sick)) == []          # no duplicate drain
+        # recovery: still publishing (draining flag up), healthy again —
+        # undrain after undrain_after
+        well = mk_rollup(draining={"w1": "healthy"})
+        clock.t = 70.0
+        assert run(planner.step(well)) == []
+        clock.t = 101.0
+        out = run(planner.step(well))
+        assert [d.kind for d in out] == [UNDRAIN]
+        assert out[0].worker_id == "w1"
+        clock.t = 200.0
+        assert run(planner.step(well)) == []          # drained map cleared
+
+    def test_vanished_or_still_sick_drained_worker_is_never_undrained(
+        self, run
+    ):
+        # a drained worker that CRASHED stops publishing: its absence from
+        # the rollup is not evidence of health, so the drain key must hold
+        # (a restart comes back still-drained instead of taking live
+        # traffic for drain_after seconds while broken)
+        clock = VirtualClock()
+        planner = mk_planner(clock)
+        sick = mk_rollup(unhealthy_ids=("w1",))
+        run(planner.step(sick))
+        clock.t = 61.0
+        assert [d.kind for d in run(planner.step(sick))] == [DRAIN]
+        # worker gone entirely: no draining_workers entry, hours pass
+        clock.t = 4000.0
+        assert run(planner.step(mk_rollup())) == []
+        # back, publishing, but still reporting unhealthy (e.g. pushed past
+        # the unhealthy_worker_ids cap during a mass outage): still held
+        clock.t = 4100.0
+        still_sick = mk_rollup(draining={"w1": "unhealthy"})
+        assert run(planner.step(still_sick)) == []
+        # degraded is not recovered either (observably impaired — health.py)
+        clock.t = 4150.0
+        assert run(planner.step(
+            mk_rollup(draining={"w1": "degraded"})
+        )) == []
+        # only a healthy, publishing stretch clears it
+        clock.t = 4200.0
+        run(planner.step(mk_rollup(draining={"w1": "healthy"})))
+        clock.t = 4231.0
+        out = run(planner.step(mk_rollup(draining={"w1": "healthy"})))
+        assert [d.kind for d in out] == [UNDRAIN]
+
+    def test_brief_unhealthy_blip_never_drains(self, run):
+        clock = VirtualClock()
+        planner = mk_planner(clock)
+        run(planner.step(mk_rollup(unhealthy_ids=("w1",))))
+        clock.t = 30.0
+        run(planner.step(mk_rollup()))                # recovered early
+        clock.t = 40.0
+        run(planner.step(mk_rollup(unhealthy_ids=("w1",))))
+        clock.t = 90.0  # 50s into the SECOND episode (< drain_after)
+        assert run(planner.step(mk_rollup(unhealthy_ids=("w1",)))) == []
+        clock.t = 101.0
+        out = run(planner.step(mk_rollup(unhealthy_ids=("w1",))))
+        assert [d.kind for d in out] == [DRAIN]
+
+    def test_manual_drains_are_not_undone(self, run):
+        # only workers THIS planner drained get undrain decisions; an
+        # operator's manual drain through the same keys is not ours to undo
+        clock = VirtualClock(1000.0)
+        planner = mk_planner(clock)
+        assert run(planner.step(mk_rollup())) == []
+
+
+# ---------------------------------------------------------------------------
+# actuation: status, retry, failure surfacing
+# ---------------------------------------------------------------------------
+
+
+class TestActuation:
+    def test_process_actuator_callbacks(self, run):
+        seen = []
+        act = ProcessActuator(on_scale=lambda d: seen.append(d.to_replicas))
+        planner = mk_planner(VirtualClock(), actuators=[act])
+        run(planner.step(
+            mk_rollup(pools={"decode": mk_pool(workers=2, headroom=0.0)})
+        ))
+        assert seen == [3]
+        assert [d.status for d in planner.decisions] == ["actuated"]
+        assert act.applied[0].kind == SCALE
+
+    def test_async_callback_is_awaited(self, run):
+        seen = []
+
+        async def cb(d):
+            seen.append(d.pool)
+
+        planner = mk_planner(
+            VirtualClock(), actuators=[ProcessActuator(on_scale=cb)]
+        )
+        run(planner.step(
+            mk_rollup(pools={"decode": mk_pool(headroom=0.0)})
+        ))
+        assert seen == ["decode"]
+
+    def test_failed_actuation_retries_and_is_superseded(self, run):
+        clock = VirtualClock()
+        calls = []
+
+        def flaky(d):
+            calls.append(d)
+            if len(calls) == 1:
+                raise RuntimeError("kube 503")
+
+        planner = mk_planner(
+            clock, actuators=[ProcessActuator(on_scale=flaky)]
+        )
+        hot = mk_rollup(pools={"decode": mk_pool(workers=2, headroom=0.0)})
+        run(planner.step(hot))
+        assert [d.status for d in planner.decisions] == ["failed"]
+        assert "kube 503" in planner.decisions[-1].error
+        assert [d.status for d in planner.failing()] == ["failed"]
+        # a failed scale sets NO cooldown — the retry fires next interval
+        clock.t = 1.0
+        run(planner.step(hot))
+        assert len(calls) == 2
+        assert planner.decisions[-1].status == "actuated"
+        # the later success supersedes the earlier failure for this target
+        assert planner.failing() == []
+
+    def test_no_actuator_drops_decision_and_surfaces(self, run):
+        planner = mk_planner(VirtualClock(), actuators=[])
+        run(planner.step(
+            mk_rollup(pools={"decode": mk_pool(headroom=0.0)})
+        ))
+        assert [d.status for d in planner.decisions] == ["dropped"]
+        assert [d.status for d in planner.failing()] == ["dropped"]
+
+    def test_ring_is_bounded(self, run):
+        clock = VirtualClock()
+        planner = mk_planner(clock, ring=8, cooldown_up=0.0)
+        hot = mk_rollup(pools={"decode": mk_pool(workers=2, headroom=0.0)})
+        for i in range(20):
+            clock.t = float(i)
+            run(planner.step(hot))
+        assert len(planner.decisions) == 8
+
+    def test_dump_shape_and_cooldowns(self, run):
+        clock = VirtualClock()
+        planner = mk_planner(clock)
+        run(planner.step(
+            mk_rollup(pools={"decode": mk_pool(headroom=0.0)})
+        ))
+        clock.t = 10.0
+        dump = planner.dump()
+        assert PlannerStatus.from_dict(dump).decisions  # round-trips
+        assert dump["decisions"][0]["kind"] == SCALE
+        assert dump["failing"] == []
+        assert dump["policy"]["cooldown_up"] == 60.0
+        # 60s up-cooldown set at t=0, read at t=10 → ~50s remaining
+        assert dump["cooldowns"] == {"m/decode/up": pytest.approx(50.0)}
+        clock.t = 100.0
+        assert planner.dump()["cooldowns"] == {}  # expired ones drop out
+
+
+class TestDrainActuator:
+    class _Store:
+        def __init__(self):
+            self.data = {}
+
+        async def put(self, key, value, lease=None):
+            self.data[key] = value
+
+        async def delete(self, key):
+            return self.data.pop(key, None) is not None
+
+    def test_drain_and_undrain_key_layout(self, run):
+        # the key layout must match Endpoint.drain_prefix exactly — the PR3
+        # drain watcher and llmctl worker drain speak the same channel
+        store = self._Store()
+        act = DrainActuator(store, "dynamo")
+        assert act.handles(Decision(kind=DRAIN, model="m", ts=0.0))
+        assert not act.handles(Decision(kind=SCALE, model="m", ts=0.0))
+        run(act.apply(Decision(kind=DRAIN, model="m", worker_id="w1", ts=0.0)))
+        key = "dynamo/components/worker/endpoints/generate/drain/w1"
+        assert store.data == {key: b"planner"}
+        run(act.apply(
+            Decision(kind=UNDRAIN, model="m", worker_id="w1", ts=0.0)
+        ))
+        assert store.data == {}
+
+
+class TestGraphActuator:
+    @staticmethod
+    def _cr():
+        return {
+            "metadata": {"name": "g"},
+            "spec": {
+                "frontend": {"replicas": 1},
+                "workers": {
+                    "decode": {"replicas": 2},
+                    "prefill": {"replicas": 1},
+                },
+            },
+        }
+
+    def test_scale_patches_cr_and_operator_reconciles(self, run):
+        from dynamo_tpu.operator import FakeKube, GraphController
+        from dynamo_tpu.operator.controller import (
+            APPS_API,
+            GRAPH_PLURAL,
+            GROUP_API,
+        )
+
+        async def go():
+            kube = FakeKube()
+            await kube.create(GROUP_API, GRAPH_PLURAL, "default", self._cr())
+            act = GraphActuator(kube, "g", "default")
+            d = Decision(kind=SCALE, model="m", pool="decode", ts=0.0,
+                         from_replicas=2, to_replicas=5)
+            assert act.handles(d)
+            await act.apply(d)
+            cr = await kube.get(GROUP_API, GRAPH_PLURAL, "default", "g")
+            assert cr["spec"]["workers"]["decode"]["replicas"] == 5
+            # the operator (single writer of Deployments) converges the CR
+            await GraphController(kube, "default").reconcile_all()
+            dep = await kube.get(APPS_API, "deployments", "default", "g-decode")
+            assert dep["spec"]["replicas"] == 5
+            # frontend rides its own spec path
+            await act.apply(Decision(
+                kind=SCALE, model="m", pool="frontend", ts=0.0,
+                from_replicas=1, to_replicas=3,
+            ))
+            cr = await kube.get(GROUP_API, GRAPH_PLURAL, "default", "g")
+            assert cr["spec"]["frontend"]["replicas"] == 3
+
+        run(go())
+
+    def test_missing_pool_and_missing_graph_raise(self, run):
+        from dynamo_tpu.operator import FakeKube
+        from dynamo_tpu.operator.controller import GRAPH_PLURAL, GROUP_API
+
+        async def go():
+            kube = FakeKube()
+            act = GraphActuator(kube, "g", "default")
+            d = Decision(kind=SCALE, model="m", pool="decode", ts=0.0,
+                         to_replicas=4)
+            with pytest.raises(RuntimeError, match="not found"):
+                await act.apply(d)
+            cr = self._cr()
+            del cr["spec"]["workers"]["prefill"]
+            await kube.create(GROUP_API, GRAPH_PLURAL, "default", cr)
+            with pytest.raises(RuntimeError, match="no 'prefill' pool"):
+                await act.apply(Decision(
+                    kind=SCALE, model="m", pool="prefill", ts=0.0,
+                    to_replicas=4,
+                ))
+
+        run(go())
+
+    def test_hpa_owned_pool_is_refused(self, run):
+        # fighting an HPA over the replica count would ping-pong the
+        # deployment; the planner surfaces it as a failing decision instead
+        from dynamo_tpu.operator import FakeKube
+        from dynamo_tpu.operator.controller import GRAPH_PLURAL, GROUP_API
+
+        async def go():
+            kube = FakeKube()
+            cr = self._cr()
+            cr["spec"]["workers"]["decode"]["autoscale"] = {"maxReplicas": 8}
+            await kube.create(GROUP_API, GRAPH_PLURAL, "default", cr)
+            act = GraphActuator(kube, "g", "default")
+            with pytest.raises(RuntimeError, match="HPA-owned"):
+                await act.apply(Decision(
+                    kind=SCALE, model="m", pool="decode", ts=0.0,
+                    to_replicas=5,
+                ))
+
+        run(go())
+
+    def test_unknown_pool_not_handled(self):
+        act = GraphActuator(None, "g")
+        assert not act.handles(
+            Decision(kind=SCALE, model="m", pool="mystery", ts=0.0)
+        )
+
+    def test_up_never_lowers_spec_and_trim_never_raises_it(self, run):
+        # decision counts come from OBSERVED workers, which lag the spec
+        # while pods come up: spec already at 8 (earlier scale-up pending),
+        # planner sees 4 live and asks 4->6 — writing 6 would tear down the
+        # two pods still starting, mid-incident
+        from dynamo_tpu.operator import FakeKube
+        from dynamo_tpu.operator.controller import GRAPH_PLURAL, GROUP_API
+
+        async def go():
+            kube = FakeKube()
+            cr = self._cr()
+            cr["spec"]["workers"]["decode"]["replicas"] = 8
+            await kube.create(GROUP_API, GRAPH_PLURAL, "default", cr)
+            act = GraphActuator(kube, "g", "default")
+            await act.apply(Decision(
+                kind=SCALE, model="m", pool="decode", ts=0.0,
+                from_replicas=4, to_replicas=6,
+            ))
+            got = await kube.get(GROUP_API, GRAPH_PLURAL, "default", "g")
+            assert got["spec"]["workers"]["decode"]["replicas"] == 8
+            # the symmetric trim: spec already below the trim target holds
+            await act.apply(Decision(
+                kind=SCALE, model="m", pool="prefill", ts=0.0,
+                from_replicas=3, to_replicas=2,
+            ))
+            got = await kube.get(GROUP_API, GRAPH_PLURAL, "default", "g")
+            assert got["spec"]["workers"]["prefill"]["replicas"] == 1
+            # a genuine up from the spec's own level still lands
+            await act.apply(Decision(
+                kind=SCALE, model="m", pool="decode", ts=0.0,
+                from_replicas=8, to_replicas=10,
+            ))
+            got = await kube.get(GROUP_API, GRAPH_PLURAL, "default", "g")
+            assert got["spec"]["workers"]["decode"]["replicas"] == 10
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# cluster rollup satellites: queue depth + pool-role breakdown
+# ---------------------------------------------------------------------------
+
+
+class TestRollupPools:
+    @staticmethod
+    def _cluster():
+        from dynamo_tpu.components.telemetry_aggregator import ClusterTelemetry
+
+        return ClusterTelemetry("t")
+
+    def test_per_model_queue_depth_and_role_breakdown(self):
+        cluster = self._cluster()
+        for i, role in enumerate(("decode", "decode", "prefill", "frontend")):
+            w = MockWorkerStats(seed=i, role=role)
+            w.queue_depth = 5
+            cluster.ingest(f"w{i}", w.metrics("m"))
+        entry = cluster.rollup()["models"]["m"]
+        assert entry["queue_depth"] == 20
+        assert set(entry["pools"]) == {"decode", "prefill", "frontend"}
+        assert entry["pools"]["decode"]["workers"] == 2
+        assert entry["pools"]["decode"]["queue_depth"] == 10
+        assert entry["pools"]["prefill"]["workers"] == 1
+        for pool in entry["pools"].values():
+            assert 0.0 <= pool["headroom_frac"] <= 1.0
+
+    def test_pre_planner_workers_bucket_as_decode(self):
+        from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+
+        cluster = self._cluster()
+        m = MockWorkerStats(seed=0).metrics("m").to_dict()
+        m["role"] = ""  # a pre-planner worker never stamps the field
+        cluster.ingest("old", ForwardPassMetrics.from_dict(m))
+        entry = cluster.rollup()["models"]["m"]
+        assert entry["pools"]["decode"]["workers"] == 1
+
+    def test_pool_headroom_binds_on_kv_like_model_level(self):
+        from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+
+        cluster = self._cluster()
+        # decode: plenty of slots free but the KV pool nearly exhausted —
+        # the binding constraint must carry into the POOL headroom too
+        # (otherwise the planner's early scale-up trigger never fires on
+        # long-context fleets)
+        m = MockWorkerStats(seed=0, role="decode").metrics("m").to_dict()
+        m.update(request_total_slots=16, request_active_slots=2,
+                 kv_total_blocks=1024, kv_active_blocks=1014)
+        cluster.ingest("w0", ForwardPassMetrics.from_dict(m))
+        # frontend: no KV pool at all — slot-bound only, not zeroed
+        f = MockWorkerStats(seed=1, role="frontend").metrics("m").to_dict()
+        f.update(request_total_slots=16, request_active_slots=4,
+                 kv_total_blocks=0, kv_active_blocks=0)
+        cluster.ingest("w1", ForwardPassMetrics.from_dict(f))
+        pools = cluster.rollup()["models"]["m"]["pools"]
+        assert pools["decode"]["headroom_frac"] == pytest.approx(
+            10 / 1024, abs=1e-4
+        )
+        assert pools["frontend"]["headroom_frac"] == pytest.approx(0.75)
+
+    def test_draining_workers_map_carries_health(self):
+        from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+
+        cluster = self._cluster()
+        d = MockWorkerStats(seed=0).metrics("m").to_dict()
+        d.update(draining=1, health_state="unhealthy")
+        cluster.ingest("w0", ForwardPassMetrics.from_dict(d))
+        h = MockWorkerStats(seed=1).metrics("m").to_dict()
+        h.update(draining=1)
+        cluster.ingest("w1", ForwardPassMetrics.from_dict(h))
+        cluster.ingest("w2", MockWorkerStats(seed=2).metrics("m"))
+        entry = cluster.rollup()["models"]["m"]
+        assert entry["draining_workers"] == {
+            "w0": "unhealthy", "w1": "healthy"
+        }
+
+    def test_unhealthy_worker_ids_bounded(self):
+        from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+
+        cluster = self._cluster()
+        for i in range(20):
+            m = MockWorkerStats(seed=i).metrics("m").to_dict()
+            m["health_state"] = "unhealthy"
+            cluster.ingest(f"w{i}", ForwardPassMetrics.from_dict(m))
+        entry = cluster.rollup()["models"]["m"]
+        assert entry["workers_unhealthy"] == 20
+        # names for the planner to drain, bounded so a mass outage can't
+        # balloon the rollup payload
+        assert len(entry["unhealthy_worker_ids"]) == 16
+
+
+# ---------------------------------------------------------------------------
+# mock worker load profiles (TPU-less planner drills)
+# ---------------------------------------------------------------------------
+
+
+class TestLoadProfile:
+    SCHEDULE = [
+        {"t": 0, "ttft_ms": 100, "itl_ms": 20},
+        {"t": 30, "ttft_ms": 9000, "queue_depth": 40},
+        {"t": 60, "queue_depth": 0},
+    ]
+
+    def test_step_function_with_last_wins_merge(self):
+        prof = LoadProfile(self.SCHEDULE)
+        assert prof.at(15.0) == {"ttft_ms": 100, "itl_ms": 20}
+        assert prof.at(30.0)["ttft_ms"] == 9000
+        assert prof.at(30.0)["queue_depth"] == 40
+        # each knob keeps the latest value that set it
+        late = prof.at(75.0)
+        assert late["ttft_ms"] == 9000 and late["queue_depth"] == 0
+        assert late["itl_ms"] == 20
+
+    def test_unsorted_segments_are_sorted(self):
+        prof = LoadProfile([{"t": 60, "ttft_ms": 1}, {"t": 0, "ttft_ms": 2}])
+        assert prof.at(10.0)["ttft_ms"] == 2
+
+    def test_bad_schedules_raise(self):
+        with pytest.raises(ValueError):
+            LoadProfile([])
+        with pytest.raises(ValueError):
+            LoadProfile(["not-a-dict"])
+
+    def test_from_file(self, tmp_path):
+        path = tmp_path / "profile.json"
+        path.write_text(json.dumps(self.SCHEDULE))
+        assert LoadProfile.from_file(str(path)).at(40.0)["queue_depth"] == 40
+
+    def test_apply_profile_drives_stats(self):
+        stats = MockWorkerStats(seed=1)
+        prof = LoadProfile(self.SCHEDULE)
+        n = stats.apply_profile(prof.at(35.0))
+        assert n == 8  # default per-tick request count
+        assert stats.ttft_ms == 9000.0 and stats.queue_depth == 40
+        m = stats.metrics("m")
+        assert m.num_requests_waiting == 40  # override, not the jitter path
+        assert stats.apply_profile({"requests": 3}) == 3
+
+    def test_replay_is_deterministic(self):
+        # same seed + same schedule → byte-identical metric streams (what
+        # regression drills diff against)
+        prof = LoadProfile(self.SCHEDULE)
+        dumps = []
+        for _ in range(2):
+            stats = MockWorkerStats(seed=7)
+            for tick in range(10):
+                stats.apply_profile(prof.at(tick * 10.0))
+                stats.tick()
+            d = stats.metrics("m").to_dict()
+            d.pop("uptime_s")  # the one wall-clock field
+            dumps.append(d)
+        assert dumps[0] == dumps[1]
+
+
+# ---------------------------------------------------------------------------
+# traffic simulator units
+# ---------------------------------------------------------------------------
+
+
+class TestTrafficModel:
+    def test_burst_multiplies_and_ends(self):
+        tm = TrafficModel(100.0, bursts=(Burst(10.0, 5.0, 5.0),))
+        assert tm.rate(0.0) == pytest.approx(100.0)
+        assert tm.rate(12.0) == pytest.approx(500.0)
+        assert tm.rate(15.0) == pytest.approx(100.0)  # [start, start+dur)
+
+    def test_diurnal_trough_at_zero_and_peak_mid_period(self):
+        tm = TrafficModel(100.0, diurnal_amplitude=0.5, diurnal_period=100.0)
+        assert tm.rate(0.0) == pytest.approx(50.0)
+        assert tm.rate(50.0) == pytest.approx(150.0)
+        assert tm.rate(100.0) == pytest.approx(50.0)
+
+
+class TestIslMix:
+    def test_split_is_exact_over_time(self):
+        mix = IslMix()
+        totals = [0] * 4
+        n_total = 0
+        for n in (7, 13, 1, 0, 29, 100, 3):
+            counts = mix.split(n)
+            assert sum(counts) == n
+            totals = [a + b for a, b in zip(totals, counts)]
+            n_total += n
+        # long-run proportions converge on the mix exactly (carry, no RNG)
+        for (isl, p, _), got in zip(mix.mix, totals):
+            assert abs(got - p * n_total) <= 1.0, isl
+
+    def test_mean_prefill_cost_weighted(self):
+        mix = IslMix(((100, 0.5, 100.0), (200, 0.5, 300.0)))
+        assert mix.mean_prefill_ms == pytest.approx(200.0)
+
+
+class TestFleetModel:
+    def test_under_capacity_no_failures_and_low_latency(self):
+        fleet = FleetModel(decode=4, prefill=4, frontend=1)
+        for _ in range(50):
+            fleet.tick(1.0, 100.0)  # 100 rps vs 400 capacity
+        assert fleet.failed_total == 0
+        assert fleet.offered_total == 5000
+        assert fleet.last["prefill_wait_ms"] == pytest.approx(0.0, abs=20.0)
+
+    def test_sustained_overload_fails_requests(self):
+        fleet = FleetModel(decode=1, prefill=8, frontend=1, fail_queue_s=10.0)
+        for _ in range(60):
+            fleet.tick(1.0, 500.0)  # 5x decode capacity, bound at 10s
+        assert fleet.failed_total > 0
+
+    def test_scale_changes_capacity_and_spawns_fresh_workers(self):
+        fleet = FleetModel(decode=2)
+        pool = fleet.pools["decode"]
+        first = pool.stats[0]
+        fleet.scale("decode", 4)
+        assert pool.size == 4 and pool.stats[0] is first
+        fleet.scale("decode", 1)
+        assert pool.size == 1
+        fleet.scale("decode", 2)
+        # the re-added worker is a NEW process (fresh counters), exactly
+        # like the real fleet after a scale-down/up cycle
+        assert pool.stats[1].requests_total == 0
+        with pytest.raises(ValueError):
+            fleet.scale("mystery", 3)
+
+    def test_emit_covers_every_pool_with_roles(self):
+        fleet = FleetModel(decode=2, prefill=1, frontend=1)
+        fleet.tick(1.0, 10.0)
+        emitted = fleet.emit("m")
+        assert len(emitted) == 4
+        roles = {m.role for _, m in emitted}
+        assert roles == {"decode", "prefill", "frontend"}
+
+
+# ---------------------------------------------------------------------------
+# the chaos acceptance: 5x flash crowd, virtual time (tier-1 gate)
+# ---------------------------------------------------------------------------
+
+
+def _scale_directions(decisions):
+    """Per-pool list of actuated scale directions, in decision order."""
+    seq = {}
+    for d in decisions:
+        if d["kind"] == SCALE and d["status"] == "actuated":
+            seq.setdefault(d["pool"], []).append(
+                ("up" if d["to_replicas"] > d["from_replicas"] else "down",
+                 d["ts"])
+            )
+    return seq
+
+
+class TestBurstAcceptance:
+    # shrunk from the bench-leg defaults: same shape, ~1/2 the virtual span
+    KW = dict(warm_s=60.0, burst_s=120.0, cool_s=300.0,
+              fast_s=30.0, slow_s=120.0)
+
+    def test_flash_crowd_recovery_with_zero_failures(self, run):
+        res = run(run_burst_scenario(**self.KW))
+
+        # zero failed requests while the planner reshapes the fleet
+        assert res.failed_total == 0
+        assert res.offered_total > 50_000
+
+        # the burst pages, and the planner scales decode capacity up
+        assert res.episodes, "the 5x burst never paged an SLO"
+        assert res.pool_peak["decode"] > res.pool_initial["decode"]
+        dirs = _scale_directions(res.decisions)
+        assert any(x == "up" for x, _ in dirs.get("decode", []))
+
+        # the page clears within one slow window (worst episode)
+        assert res.recovery_s is not None
+        assert res.recovery_s <= self.KW["slow_s"], res.episodes
+
+        # the fleet trims back down after the burst
+        assert res.pool_final["decode"] < res.pool_peak["decode"]
+
+        # hysteresis/cooldown: no oscillation — per pool the directions are
+        # monotone (ups, then downs), and consecutive ups sit a full
+        # cooldown apart
+        for pool, seq in dirs.items():
+            kinds = [x for x, _ in seq]
+            first_down = kinds.index("down") if "down" in kinds else len(kinds)
+            assert all(k == "down" for k in kinds[first_down:]), (pool, kinds)
+            ups = [t for k, t in seq if k == "up"]
+            for a, b in zip(ups, ups[1:]):
+                assert b - a >= 10.0 - 1e-6, (pool, ups)  # cooldown_up
+
+    def test_frozen_topology_control_leg_fails(self, run):
+        # same traffic, no planner: requests fail by the thousands and the
+        # page never clears — what the closed loop buys
+        res = run(run_burst_scenario(
+            warm_s=60.0, burst_s=120.0, cool_s=60.0, planner_enabled=False,
+        ))
+        assert res.failed_total > 1000
+        assert res.recovery_s == math.inf
+        assert res.decisions == []
+        assert res.pool_final == res.pool_initial
+
+
+class TestDiurnalSoak:
+    @pytest.mark.slow
+    def test_two_cycles_with_burst_no_oscillation(self, run):
+        # the long-horizon leg: two full diurnal cycles with a flash crowd
+        # riding the first peak; capacity follows the curve without flapping
+        res = run(run_diurnal_scenario(
+            cycles=2.0, bursts=(Burst(450.0, 180.0, 3.0),),
+        ))
+        assert res.failed_total == 0
+        # every page episode eventually clears
+        assert all(ep["end"] is not None for ep in res.episodes)
+        # bounded direction changes per pool: the diurnal curve allows one
+        # up-run and one down-run per cycle plus the burst, not a flap storm
+        for pool, seq in _scale_directions(res.decisions).items():
+            kinds = [x for x, _ in seq]
+            flips = sum(1 for a, b in zip(kinds, kinds[1:]) if a != b)
+            assert flips <= 8, (pool, kinds)
+
+
+# ---------------------------------------------------------------------------
+# wall clock: the full components-on-a-bus loop + llmctl (tier-1 gate)
+# ---------------------------------------------------------------------------
+
+
+class TestPlannerComponentE2E:
+    def test_burst_on_a_real_bus_scales_and_llmctl_reads_ring(
+        self, run, monkeypatch, capsys
+    ):
+        """The ISSUE-8 chaos acceptance, wall-clock-scaled: a 3-pool mock
+        fleet publishes on a real bus; the aggregator ingests; the 5x
+        burst pages an SLO against the frozen fleet FIRST, then the
+        planner starts, polls ``telemetry_dump`` through discovery, and
+        reshapes the fleet via a ProcessActuator until the page clears
+        and the fleet trims back; ``llmctl planner status`` renders the
+        ring (exit 0), and a planted failing decision flips it to exit 2.
+
+        Ordering is sequenced by observed state, not wall time: paging is
+        established before the planner exists (a live planner on this
+        box can absorb the burst via the queue trigger before the SLO
+        windows ever fill — the virtual-time leg pins that timeline
+        deterministically instead)."""
+        from dynamo_tpu.components.planner import run_planner
+        from dynamo_tpu.components.telemetry_aggregator import (
+            run_telemetry_aggregator,
+        )
+        from dynamo_tpu.runtime import telemetry
+        from dynamo_tpu.runtime.bus import MessageBusServer
+        from dynamo_tpu.runtime.distributed import (
+            KV_METRICS_SUBJECT,
+            DistributedRuntime,
+        )
+        from dynamo_tpu.runtime.statestore import StateStoreServer
+
+        # scale the SLO windows to fractions of a second (PR6 pattern);
+        # TTFT objective sits above the ISL mix's 4096-class base cost —
+        # the heavy tail is the workload, queueing is the violation
+        monkeypatch.setenv("DYN_TPU_SLO_FAST_S", "0.4")
+        monkeypatch.setenv("DYN_TPU_SLO_MID_S", "0.4")
+        monkeypatch.setenv("DYN_TPU_SLO_SLOW_S", "1.6")
+        monkeypatch.setenv("DYN_TPU_SLO_BURN_FAST", "4")
+        monkeypatch.setenv("DYN_TPU_SLO_BURN_SLOW", "2")
+        monkeypatch.setenv("DYN_TPU_SLO_TTFT_MS", "8000")
+        telemetry.configure()
+
+        async def go():
+            ss = StateStoreServer(port=0)
+            bus = MessageBusServer(port=0)
+            await ss.start()
+            await bus.start()
+            drt = await DistributedRuntime.create(ss.url, bus.url)
+            pub = await DistributedRuntime.create(ss.url, bus.url)
+            ns = pub.namespace("dynamo")
+
+            agg_ready = asyncio.Event()
+            agg_task = asyncio.create_task(run_telemetry_aggregator(
+                drt, "dynamo", port=0, host="127.0.0.1", ready=agg_ready,
+            ))
+            await asyncio.wait_for(agg_ready.wait(), 10)
+            cluster = telemetry.cluster()
+            assert cluster is not None
+
+            fleet = FleetModel(decode=2, prefill=2, frontend=1)
+            policy = PlannerPolicy(
+                interval=0.1, cooldown_up=1.0, cooldown_down=2.0,
+                down_stable=0.8, up_step=1.0, queue_high=4.0,
+                min_workers=1, max_workers=16,
+            )
+            plan_task = None
+            base_rps, tick_s = 150.0, 0.05
+
+            async def publish_ticks(mult, seconds):
+                t = 0.0
+                while t < seconds:
+                    fleet.tick(tick_s, base_rps * mult * tick_s)
+                    for wid, m in fleet.emit("sim-model"):
+                        await ns.publish(KV_METRICS_SUBJECT, {
+                            "worker_id": wid, "metrics": m.to_dict(),
+                        })
+                    await asyncio.sleep(tick_s)
+                    t += tick_s
+
+            def model_states():
+                return {
+                    s["slo"]: s["state"] for s in cluster.slo_report()
+                    if s["labels"].get("model") == "sim-model"
+                    and s["slo"] in ("ttft_p95", "itl_p95", "error_rate")
+                }
+
+            loop = asyncio.get_running_loop()
+            try:
+                # warm steady state: fits the initial fleet, no page
+                await publish_ticks(1.0, 1.0)
+                assert all(v == "ok" for v in model_states().values())
+
+                # 5x flash crowd against the FROZEN fleet until an SLO
+                # pages (deadline-bounded for loaded CI)
+                deadline = loop.time() + 10.0
+                paged = False
+                while loop.time() < deadline and not paged:
+                    await publish_ticks(5.0, 0.2)
+                    paged = any(
+                        v == "alert" for v in model_states().values()
+                    )
+                assert paged, "5x burst never paged an SLO"
+
+                # NOW the planner comes up and closes the loop
+                plan_ready = asyncio.Event()
+                planners = []
+                plan_task = asyncio.create_task(run_planner(
+                    drt, "dynamo",
+                    actuators=[ProcessActuator(
+                        on_scale=lambda d: fleet.scale(d.pool, d.to_replicas)
+                    )],
+                    aggregator="dyn://dynamo.telemetry.status",
+                    policy=policy, ready=plan_ready, planner_out=planners,
+                ))
+                await asyncio.wait_for(plan_ready.wait(), 10)
+                planner = planners[0]
+
+                # keep bursting until decode capacity is scaled up
+                deadline = loop.time() + 10.0
+                scaled = False
+                while loop.time() < deadline and not scaled:
+                    await publish_ticks(5.0, 0.2)
+                    scaled = fleet.sizes()["decode"] > 2
+                assert scaled, "planner never scaled the decode pool"
+                peak = dict(fleet.sizes())
+
+                def note_peak():
+                    for role, size in fleet.sizes().items():
+                        peak[role] = max(peak.get(role, 0), size)
+
+                # hysteresis is live while scaling: cooldowns in the dump
+                assert planner.dump()["cooldowns"], "no active cooldowns"
+
+                # cool down: the page clears within one scaled slow window
+                # of calm traffic (budget looser for loaded CI boxes)
+                deadline = loop.time() + 10.0
+                cleared = False
+                while loop.time() < deadline and not cleared:
+                    await publish_ticks(1.0, 0.2)
+                    note_peak()
+                    states = model_states()
+                    cleared = states and all(
+                        v == "ok" for v in states.values()
+                    )
+                assert cleared, f"page never cleared: {model_states()}"
+
+                # keep calm traffic flowing until the planner trims back
+                deadline = loop.time() + 10.0
+                trimmed = False
+                while loop.time() < deadline and not trimmed:
+                    await publish_ticks(1.0, 0.3)
+                    note_peak()
+                    trimmed = fleet.sizes()["decode"] < peak["decode"]
+                assert trimmed, "fleet never scaled back down"
+
+                # zero failed requests through the whole episode
+                assert fleet.failed_total == 0
+
+                # cooldown contract under wall-clock noise: consecutive
+                # actuated resizes of the same pool+direction sit a full
+                # cooldown apart (strict whole-run monotonicity is the
+                # deterministic virtual-time leg's assertion — real-bus
+                # timing noise at these compressed windows may legitimately
+                # re-scale a pool the trim undershot)
+                dirs = _scale_directions(
+                    [d.to_dict() for d in planner.decisions]
+                )
+                for pool, seq in dirs.items():
+                    for (ka, ta), (kb, tb) in zip(seq, seq[1:]):
+                        if ka == kb:
+                            cd = (policy.cooldown_up if ka == "up"
+                                  else policy.cooldown_down)
+                            assert tb - ta >= cd - 0.01, (pool, seq)
+
+                # llmctl reads the ring through ordinary discovery
+                from dynamo_tpu.cli.llmctl import amain
+
+                rc = await amain([
+                    "--statestore", ss.url, "planner", "status",
+                    "dyn://dynamo.planner.plan",
+                ])
+                out = capsys.readouterr().out
+                assert rc == 0
+                assert "scale" in out and "sim-model/decode" in out
+
+                # a decision stuck failing flips the exit code to 2 — the
+                # cron-probe contract for a planner that can't actuate
+                planner.decisions.append(Decision(
+                    kind=SCALE, model="sim-model", pool="decode",
+                    ts=loop.time(), from_replicas=2, to_replicas=4,
+                    status="failed", error="RuntimeError: kube 503",
+                ))
+                rc = await amain([
+                    "--statestore", ss.url, "planner", "status",
+                    "dyn://dynamo.planner.plan",
+                ])
+                out = capsys.readouterr().out
+                assert rc == 2
+                assert "FAILING" in out and "kube 503" in out
+
+                rc = await amain([
+                    "--statestore", ss.url, "planner", "status", "--json",
+                    "dyn://dynamo.planner.plan",
+                ])
+                status = json.loads(capsys.readouterr().out)
+                assert rc == 2 and status["failing"]
+            finally:
+                for task in (plan_task, agg_task):
+                    if task is None:
+                        continue
+                    task.cancel()
+                    try:
+                        await task
+                    except (asyncio.CancelledError, Exception):
+                        pass
+                await drt.shutdown()
+                await pub.shutdown()
+                await bus.stop()
+                await ss.stop()
+
+        run(go())
+
+    def test_mock_worker_load_profile_on_a_bus(self, run):
+        """The ``--load-profile`` satellite end to end: a mock worker
+        replays a JSON schedule onto a real bus; an embedded-source planner
+        (no aggregator) sees the queue spike through its own
+        ClusterTelemetry and emits a scale-up for the worker's pool."""
+        from dynamo_tpu.components.mock_worker import run_mock_worker
+        from dynamo_tpu.components.planner import run_planner
+        from dynamo_tpu.runtime.bus import MessageBusServer
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+        from dynamo_tpu.runtime.statestore import StateStoreServer
+
+        async def go():
+            ss = StateStoreServer(port=0)
+            bus = MessageBusServer(port=0)
+            await ss.start()
+            await bus.start()
+            drt = await DistributedRuntime.create(ss.url, bus.url)
+            worker_drt = await DistributedRuntime.create(ss.url, bus.url)
+
+            # calm for 0.3s, then a sustained queue spike
+            profile = LoadProfile([
+                {"t": 0, "ttft_ms": 100, "itl_ms": 20, "queue_depth": 0},
+                {"t": 0.3, "queue_depth": 64},
+            ])
+            worker_task = asyncio.create_task(run_mock_worker(
+                worker_drt, "dynamo", model="prof-model", interval=0.05,
+                role="decode", profile=profile,
+            ))
+            plan_ready = asyncio.Event()
+            planners = []
+            plan_task = asyncio.create_task(run_planner(
+                drt, "dynamo",
+                policy=PlannerPolicy(
+                    interval=0.1, cooldown_up=0.3, queue_high=4.0,
+                    max_workers=4,
+                ),
+                register=False, ready=plan_ready, planner_out=planners,
+            ))
+            await asyncio.wait_for(plan_ready.wait(), 10)
+            try:
+                deadline = asyncio.get_running_loop().time() + 8.0
+                decided = None
+                while (asyncio.get_running_loop().time() < deadline
+                       and decided is None):
+                    await asyncio.sleep(0.1)
+                    decided = next(
+                        (d for d in planners[0].decisions
+                         if d.kind == SCALE and d.model == "prof-model"),
+                        None,
+                    )
+                assert decided is not None, "queue spike never drove a decision"
+                assert decided.pool == "decode"
+                assert decided.to_replicas > decided.from_replicas
+            finally:
+                for task in (worker_task, plan_task):
+                    task.cancel()
+                    try:
+                        await task
+                    except (asyncio.CancelledError, Exception):
+                        pass
+                await worker_drt.shutdown()
+                await drt.shutdown()
+                await bus.stop()
+                await ss.stop()
+
+        run(go())
