@@ -905,6 +905,34 @@ def bench_planner_sim() -> dict:
     }
 
 
+def bench_qos() -> dict:
+    """Multi-tenant QoS under a noisy neighbor (tools/qos_sim.py, virtual
+    time — no TPU): victim-tenant ITL p95 alone, with an abusive tenant at
+    ~10-20x its rate quota under full QoS (rate gate + weighted fair
+    queuing + KV budget + prefill duty cycle), and with QoS off (the
+    control leg proving the contention is real). The tier-1 acceptance
+    (tests/test_qos.py): QoS holds the victim's ITL p95 within 10% of the
+    alone baseline with zero victim sheds."""
+    from tools.qos_sim import run_scenario
+
+    res = run_scenario()
+    return {
+        "scenario": "steady short-prompt victim vs 10-20x-quota "
+                    "long-prompt abuser, one shared worker",
+        "victim_itl_p95_ms_alone": res["victim_alone"]["itl_p95_ms"],
+        "victim_itl_p95_ms_qos": res["victim_with_abuser_qos"]["itl_p95_ms"],
+        "victim_itl_p95_ms_no_qos": res["victim_with_abuser_no_qos"]["itl_p95_ms"],
+        "victim_itl_p95_ratio_qos": res["victim_itl_p95_ratio_qos"],
+        "victim_itl_p95_ratio_no_qos": res["victim_itl_p95_ratio_no_qos"],
+        "victim_itl_max_ms_qos": res["victim_with_abuser_qos"]["itl_max_ms"],
+        "victim_shed_qos": res["victim_with_abuser_qos"]["shed"],
+        "abuser_shed_share_qos": round(
+            res["abuser_qos"]["shed"] / max(res["abuser_qos"]["offered"], 1), 4
+        ),
+        "abuser_ttft_p95_ms_qos": res["abuser_qos"]["ttft_p95_ms"],
+    }
+
+
 def main() -> None:
     from dynamo_tpu.engine_jax.compile_cache import enable_compile_cache
 
@@ -1135,6 +1163,11 @@ def main() -> None:
             out["planner_sim"] = bench_planner_sim()
         except Exception as e:
             out["planner_sim"] = {"error": str(e)[:200]}
+    if os.environ.get("BENCH_QOS", "1") == "1":
+        try:
+            out["qos"] = bench_qos()
+        except Exception as e:
+            out["qos"] = {"error": str(e)[:200]}
     # LAST: pays minutes of first-boot remote compilation on the tunneled
     # runtime — must not eat the other sections' budget if it times out
     if os.environ.get("BENCH_MODEL_8B", "1") == "1":

@@ -14,6 +14,7 @@
     python -m dynamo_tpu.cli.llmctl trace show <dyn://ns.comp.ep> <trace_id>
     python -m dynamo_tpu.cli.llmctl slo status [--json] [dyn://ns.telemetry.status]
     python -m dynamo_tpu.cli.llmctl cluster status [--json] [dyn://ns.telemetry.status]
+    python -m dynamo_tpu.cli.llmctl tenant status [--json] [dyn://ns.telemetry.status]
     python -m dynamo_tpu.cli.llmctl planner status [--json] [dyn://ns.planner.plan]
 
 ``worker drain`` writes a drain control key the target worker watches
@@ -24,6 +25,12 @@ failed requests (docs/overload.md has the rolling-restart runbook).
 its draining flag and last load snapshot. ``worker health`` reads the same
 instance keys and shows the health plane's view: state, last heartbeat age,
 and the stall/reap counters (docs/health.md has the stuck-worker runbook).
+
+``tenant status`` renders the per-tenant QoS rollup (class, slot/KV
+occupancy, admitted vs rate-limited counts) from the same aggregator; it
+exits 2 while any tenant is throttled at a sustained 100% shed share — a
+runaway client or a misconfigured quota, caught by cron like an SLO page
+(docs/qos.md has the runbook).
 
 ``planner status`` dials the planner component (``components/planner.py``)
 and renders its decision ring — who reshaped the fleet and why — plus the
@@ -88,6 +95,7 @@ def build_parser() -> argparse.ArgumentParser:
     for plane, verb_help in (
         ("slo", "SLO compliance + burn-rate alerts from the telemetry plane"),
         ("cluster", "cluster capacity/health rollup from the telemetry plane"),
+        ("tenant", "per-tenant QoS rollup (rate/shed share, KV occupancy)"),
     ):
         tp = sub.add_parser(plane, help=verb_help)
         tpv = tp.add_subparsers(dest="verb", required=True)
@@ -151,7 +159,7 @@ async def amain(argv: list) -> int:
     try:
         if args.plane == "trace":
             return await _trace_cmd(args, store)
-        if args.plane in ("slo", "cluster"):
+        if args.plane in ("slo", "cluster", "tenant"):
             return await _telemetry_cmd(args, store)
         if args.plane == "planner":
             return await _planner_cmd(args, store)
@@ -367,6 +375,46 @@ async def _telemetry_cmd(args, store) -> int:
             )
         # non-zero exit on an active page makes this scriptable in CI/cron
         return 2 if any(s.get("state") == "alert" for s in statuses) else 0
+    if args.plane == "tenant":
+        roll = cluster.get("rollup") or {}
+        rows = []
+        for model, e in sorted((roll.get("models") or {}).items()):
+            for tenant, te in sorted((e.get("tenants") or {}).items()):
+                rows.append(dict(te, model=model, tenant=tenant))
+        # "sustained 100% throttle": every request the tenant ever offered
+        # was rate-shed — a misconfigured quota or a runaway client; make
+        # it cron-visible like an SLO page
+        throttled = [
+            r for r in rows
+            if r.get("rate_limited_total", 0) > 0
+            and r.get("shed_share", 0.0) >= 0.999
+        ]
+        if args.as_json:
+            print(json.dumps(rows, indent=2))
+            return 2 if throttled else 0
+        if not rows:
+            print("(no tenant data — single-tenant fleet, or no "
+                  "DYN_TPU_TENANT_* knobs set on workers)")
+            return 0
+        for r in rows:
+            print(
+                f'{r["tenant"]:16s} model={r["model"]:16s} '
+                f'class={r.get("class", "") or "-":9s} '
+                f'slots={r.get("active_slots", 0):3d} '
+                f'queued={r.get("queue_depth", 0):3d} '
+                f'kv={r.get("kv_blocks", 0):5d} '
+                f'admitted={r.get("admitted_total", 0):6d} '
+                f'limited={r.get("rate_limited_total", 0):6d} '
+                f'shed_share={r.get("shed_share", 0.0):.3f}'
+            )
+        if throttled:
+            print(f"THROTTLED: {len(throttled)} tenant(s) at sustained "
+                  f"100% rate shed:")
+            for r in throttled:
+                print(f'  {r["tenant"]} (model {r["model"]}, '
+                      f'{r["rate_limited_total"]} sheds)')
+            return 2
+        return 0
     # cluster status
     if args.as_json:
         print(json.dumps(cluster.get("rollup") or {}, indent=2))

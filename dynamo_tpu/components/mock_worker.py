@@ -99,6 +99,7 @@ class MockWorkerStats:
         spec_accept_rate: float = 0.0,
         kv_quantized: bool = False,
         role: str = "decode",
+        tenants: Optional[Dict[str, int]] = None,
     ):
         from dynamo_tpu.runtime.tracing import PHASE_BUCKETS
 
@@ -129,6 +130,16 @@ class MockWorkerStats:
         self.kv_quantized = bool(kv_quantized)
         self.spec_drafted = 0
         self.spec_accepted = 0
+        # multi-tenant QoS drill (docs/qos.md): tenant → per-tick request
+        # share. Each tick splits its requests across tenants by share and
+        # grows per-tenant counters + occupancy splits, so aggregator /
+        # llmctl tenant views can be exercised without chips. One tenant
+        # can be marked abusive via share 0 below (all rate-limited).
+        self.tenants: Dict[str, int] = dict(tenants or {})
+        # tenant → [admitted, rate_limited] cumulative
+        self._tenant_counts: Dict[str, List[int]] = {
+            t: [0, 0] for t in self.tenants
+        }
 
     def _observe(self, phase: str, seconds: float) -> None:
         counts = self._counts.setdefault(phase, [0] * len(self.bounds))
@@ -147,7 +158,17 @@ class MockWorkerStats:
 
     def tick(self, requests: int = 8, error_rate: float = 0.0) -> None:
         """Simulate one metrics interval of traffic: ``requests`` finished
-        requests (one TTFT + ~16 inter-token gaps each)."""
+        requests (one TTFT + ~16 inter-token gaps each). With ``tenants``
+        configured, each tenant additionally books ``share`` admitted
+        requests per tick — except share-0 tenants, which model a fully
+        throttled (100% rate-limited) abuser so the `llmctl tenant
+        status` exit-2 path can be drilled without chips."""
+        for t, share in self.tenants.items():
+            counts = self._tenant_counts.setdefault(t, [0, 0])
+            if share > 0:
+                counts[0] += share
+            else:
+                counts[1] += 4  # sustained 100% throttle
         for _ in range(requests):
             self.requests_total += 1
             if self.rng.random() < error_rate:
@@ -227,6 +248,21 @@ class MockWorkerStats:
             else self.rng.randint(0, 4)
         )
         itl_s = max(self.itl_ms, 1e-3) / 1e3
+        tenants = None
+        if self.tenants:
+            total_share = sum(s for s in self.tenants.values() if s > 0) or 1
+            tenants = {}
+            for t, share in self.tenants.items():
+                frac = max(share, 0) / total_share
+                counts = self._tenant_counts.get(t, [0, 0])
+                tenants[t] = {
+                    "class": "standard",
+                    "active_slots": int(self.active * frac),
+                    "queue_depth": int(waiting * frac),
+                    "kv_blocks": int(blocks * frac),
+                    "admitted": counts[0],
+                    "rate_limited": counts[1],
+                }
         return ForwardPassMetrics(
             request_active_slots=self.active,
             request_total_slots=self.slots_total,
@@ -263,6 +299,7 @@ class MockWorkerStats:
             uptime_s=round(time.monotonic() - self.started, 3),
             model=model,
             role=self.role,
+            tenants=tenants,
         )
 
     def apply_profile(self, state: dict) -> int:
@@ -277,6 +314,30 @@ class MockWorkerStats:
         return max(int(state.get("requests", 8)), 0)
 
 
+def parse_tenant_shares(raw: Optional[str]) -> Optional[Dict[str, int]]:
+    """``--tenants "acme:6,bigco:2,crawler:0"`` → {name: share}. Malformed
+    entries are skipped; an empty result means no tenant emulation."""
+    if not raw:
+        return None
+    out: Dict[str, int] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, share = part.partition(":")
+        name = name.strip()
+        if not name:
+            continue
+        if not share.strip():
+            out[name] = 1  # bare name: one request/tick
+            continue
+        try:
+            out[name] = max(int(share), 0)
+        except ValueError:
+            continue  # malformed share: skip, as documented
+    return out or None
+
+
 async def run_mock_worker(
     drt,
     namespace: str,
@@ -289,6 +350,7 @@ async def run_mock_worker(
     kv_quantized: bool = False,
     role: str = "decode",
     profile: Optional[LoadProfile] = None,
+    tenants: Optional[Dict[str, int]] = None,
 ) -> None:
     from dynamo_tpu.runtime.distributed import KV_METRICS_SUBJECT
 
@@ -297,7 +359,7 @@ async def run_mock_worker(
     stats = MockWorkerStats(
         seed=hash(wid) & 0xFFFF, ttft_ms=ttft_ms, itl_ms=itl_ms,
         spec_accept_rate=spec_accept_rate, kv_quantized=kv_quantized,
-        role=role,
+        role=role, tenants=tenants,
     )
     tick_no = 0
     while True:
@@ -342,6 +404,11 @@ def main() -> None:
                    help="JSON schedule replaying time-varying TTFT/ITL/"
                         "queue/error-rate (planner drills without a TPU; "
                         "see LoadProfile docstring for the format)")
+    p.add_argument("--tenants", default=None,
+                   help="per-tenant request shares, e.g. 'acme:6,bigco:2,"
+                        "crawler:0' — share 0 models a fully rate-limited "
+                        "abuser (drills llmctl tenant status / the "
+                        "dynamo_tenant_* gauges without chips)")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
     profile = (
@@ -362,6 +429,7 @@ def main() -> None:
             spec_accept_rate=args.spec_accept_rate,
             kv_quantized=args.kv_quantized,
             role=args.role, profile=profile,
+            tenants=parse_tenant_shares(args.tenants),
         )
 
     asyncio.run(run())

@@ -70,6 +70,18 @@ CLUSTER_GAUGES = [
     ("median_worker_load", "Median per-worker load score"),
 ]
 
+# per-tenant cluster gauges (docs/qos.md): summed from worker `tenants`
+# dicts; labels {namespace, model, tenant}. Rendered only when at least
+# one worker reports tenants (single-tenant fleets emit no lines).
+TENANT_GAUGES = [
+    ("active_slots", "Decode slots this tenant occupies (fleet sum)"),
+    ("queue_depth", "Requests this tenant has queued/awaiting (fleet sum)"),
+    ("kv_blocks", "KV pool blocks this tenant holds (fleet sum)"),
+    ("admitted_total", "Requests admitted past the tenant rate gate (cumulative)"),
+    ("rate_limited_total", "Requests shed by the tenant rate gate (cumulative)"),
+    ("shed_share", "rate_limited / (admitted + rate_limited), cumulative"),
+]
+
 
 def _phase_bounds_ms() -> Tuple[float, ...]:
     from dynamo_tpu.runtime.tracing import PHASE_BUCKETS
@@ -280,6 +292,7 @@ class ClusterTelemetry:
                 "spec_drafted_tokens": 0, "spec_accepted_tokens": 0,
                 "spec_accept_rate": 0.0,
                 "pools": {},
+                "tenants": {},
                 "unhealthy_worker_ids": [],
                 "draining_workers": {},
             })
@@ -333,6 +346,30 @@ class ClusterTelemetry:
             pool["kv_blocks_free"] += max(
                 int(m.kv_total_blocks or 0) - int(m.kv_active_blocks or 0), 0
             )
+            # per-tenant QoS rollup (docs/qos.md): sum the numeric fields
+            # of each worker's `tenants` dict; the class label keeps the
+            # first sighting (it is policy, identical across the fleet)
+            wt = getattr(m, "tenants", None)
+            if isinstance(wt, dict):
+                for tname, tview in wt.items():
+                    if not isinstance(tview, dict):
+                        continue
+                    te = entry["tenants"].setdefault(str(tname), {
+                        "class": str(tview.get("class", "")),
+                        "active_slots": 0, "queue_depth": 0, "kv_blocks": 0,
+                        "admitted_total": 0, "rate_limited_total": 0,
+                    })
+                    for src, dst in (
+                        ("active_slots", "active_slots"),
+                        ("queue_depth", "queue_depth"),
+                        ("kv_blocks", "kv_blocks"),
+                        ("admitted", "admitted_total"),
+                        ("rate_limited", "rate_limited_total"),
+                    ):
+                        try:
+                            te[dst] += int(tview.get(src, 0) or 0)
+                        except (TypeError, ValueError):
+                            pass
             # positive-evidence map for the planner's undrain path: a
             # drained worker that crashed simply STOPS publishing — its
             # absence here must read as "unknown", never as "recovered"
@@ -372,6 +409,14 @@ class ClusterTelemetry:
                     entry["spec_accepted_tokens"] / entry["spec_drafted_tokens"],
                     4,
                 )
+            for te in entry["tenants"].values():
+                seen = te["admitted_total"] + te["rate_limited_total"]
+                # cumulative throttle share: 1.0 = every request this
+                # tenant ever offered was rate-shed (llmctl tenant status
+                # exits 2 on a sustained-100% tenant)
+                te["shed_share"] = round(
+                    te["rate_limited_total"] / seen, 4
+                ) if seen else 0.0
         worst = max(scores, key=lambda t: t[1]) if scores else None
         med = (
             round(statistics.median(s for _, s in scores), 4) if scores else None
@@ -442,6 +487,22 @@ class ClusterTelemetry:
                         "namespace": self.namespace, "model": model,
                     })
                     lines.append(f"{full}{lbl} {entry[name]}")
+        # per-tenant QoS gauges (docs/qos.md) — emitted only when some
+        # worker reports tenants, so single-tenant fleets add zero lines
+        if any(e.get("tenants") for e in roll["models"].values()):
+            for name, help_text in TENANT_GAUGES:
+                full = f"dynamo_tenant_{name}"
+                lines.append(f"# HELP {full} {help_text}")
+                lines.append(f"# TYPE {full} gauge")
+                for model, entry in sorted(roll["models"].items()):
+                    for tenant, te in sorted(
+                        (entry.get("tenants") or {}).items()
+                    ):
+                        lbl = fmt_labels({
+                            "namespace": self.namespace, "model": model,
+                            "tenant": tenant,
+                        })
+                        lines.append(f"{full}{lbl} {te.get(name, 0)}")
         # SLO state: compliance ratio over the slow window + fast burn rate
         comp = f"{prefix}_slo_compliance"
         burn = f"{prefix}_slo_burn_rate"

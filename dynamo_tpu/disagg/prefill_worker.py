@@ -249,6 +249,7 @@ async def run_prefill_worker(
     if runtime.bus is None:
         raise RuntimeError("prefill worker needs the message bus")
     from dynamo_tpu.disagg.device_transfer import make_device_plane
+    from dynamo_tpu.runtime.distributed import attach_kv_publishing
     from dynamo_tpu.runtime.resilience import ResiliencePolicy
 
     policy = policy or ResiliencePolicy.from_env()
@@ -258,6 +259,26 @@ async def run_prefill_worker(
     queue = f"{namespace}.{PREFILL_QUEUE}"
     sem = asyncio.Semaphore(engine.engine.config.max_slots)
     tasks: set = set()
+    # publish role-tagged ForwardPassMetrics (capacity, phase latencies,
+    # KV events) like every decode worker does: the cluster rollup's
+    # `prefill` pool — what the planner resizes — is fed by REAL prefill
+    # workers, not just mock fleets (ROADMAP item-4 remainder). The
+    # endpoint handle only anchors namespace + worker identity; prefill
+    # workers still consume the bus queue rather than serving RPC.
+    try:
+        if engine.model and not getattr(engine.engine, "model_name", None):
+            engine.engine.model_name = engine.model  # cluster attribution
+        # bind_admission/bind_events off: a co-hosted decode RPC server
+        # keeps its own capacity probe, and prefill-only blocks must not
+        # enter the router's prefix radix tree as routable decode hits
+        await attach_kv_publishing(
+            runtime.namespace(namespace).component("prefill").endpoint("stats"),
+            engine.engine, role="prefill", bind_admission=False,
+            bind_events=False,
+        )
+    except Exception:
+        # metrics must never keep a prefill worker from serving
+        logger.warning("prefill metrics publishing unavailable", exc_info=True)
     logger.info("prefill worker consuming %s", queue)
 
     async def handle(req: RemotePrefillRequest) -> None:

@@ -53,6 +53,13 @@ class SequenceAllocation:
     token_blocks: TokenBlockSequence  # hashing state (tracks sealed blocks)
     cached_tokens: int  # prompt tokens served from prefix cache (any tier)
     sealed_blocks: int = 0  # how many full blocks have been hashed+registered
+    # QoS attribution (runtime/qos.py): owning tenant + class level. The
+    # allocator sums hard-held blocks per tenant (KV budgets) and tags
+    # cached blocks with their owners' level so eviction under pressure
+    # reclaims the lowest class first. Both stay at their defaults on the
+    # single-tenant path — no per-tenant dict is ever touched.
+    tenant: str = ""
+    level: int = 0
     # host-tier prefix hits: (logical block index, sequence hash, k, v,
     # k_scale, v_scale) with the content captured at probe time (a later
     # offload into the LRU pool can't invalidate them). The scale entries
@@ -121,8 +128,67 @@ class HostKvPool:
         return item
 
 
+class _TieredLru:
+    """The reclaimable-block reuse pool, tiered by QoS class level.
+
+    Blocks land in the tier of their (highest) owning class; eviction
+    pops the *lowest* tier first, LRU-oldest within a tier — so under KV
+    pressure a batch tenant's warm cache is reclaimed before a premium
+    tenant's (the reference framework's priority-aware reuse, re-designed
+    for the paged pool). With QoS off every block lives in tier 0 and
+    behavior is exactly the old single-OrderedDict LRU.
+    """
+
+    __slots__ = ("_tiers", "_tier_of", "_size")
+
+    def __init__(self) -> None:
+        self._tiers: Dict[int, "OrderedDict[int, None]"] = {}
+        self._tier_of: Dict[int, int] = {}
+        self._size = 0
+
+    def __contains__(self, bid: int) -> bool:
+        return bid in self._tier_of
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add(self, bid: int, level: int = 0) -> None:
+        """Insert (or refresh) a block as most-recently-used in its tier."""
+        old = self._tier_of.get(bid)
+        if old is not None:
+            od = self._tiers[old]
+            del od[bid]
+            self._size -= 1
+        tier = self._tiers.setdefault(level, OrderedDict())
+        tier[bid] = None  # fresh insert lands most-recently-used
+        self._tier_of[bid] = level
+        self._size += 1
+
+    def discard(self, bid: int) -> bool:
+        level = self._tier_of.pop(bid, None)
+        if level is None:
+            return False
+        del self._tiers[level][bid]
+        self._size -= 1
+        return True
+
+    def pop_oldest(self) -> Optional[int]:
+        """Evict: lowest class level first, LRU-oldest within the level."""
+        if self._size == 0:
+            return None
+        for level in sorted(self._tiers):
+            od = self._tiers[level]
+            if od:
+                bid, _ = od.popitem(last=False)
+                del self._tier_of[bid]
+                self._size -= 1
+                return bid
+        return None
+
+
 class BlockAllocator:
-    """Allocates physical pages, reuses prefix-cached ones, evicts LRU.
+    """Allocates physical pages, reuses prefix-cached ones, evicts LRU
+    (class-tiered when QoS levels flow — see :class:`_TieredLru`).
 
     All methods are called from the engine's step loop (single thread).
     """
@@ -150,8 +216,16 @@ class BlockAllocator:
         # sequence_hash → block id, for every block whose contents are valid
         self._by_hash: Dict[int, int] = {}
         self._hash_of: Dict[int, int] = {}  # block id → sequence hash
-        # refcount-0 blocks with valid contents, LRU order (oldest first)
-        self._cached: "OrderedDict[int, None]" = OrderedDict()
+        # refcount-0 blocks with valid contents, eviction order = lowest
+        # class tier first, LRU within a tier (all tier 0 with QoS off)
+        self._cached = _TieredLru()
+        # QoS (runtime/qos.py): hard-held blocks per tenant (the KV-budget
+        # signal) and the class level a block carries into the reuse pool
+        # (max over owners — a premium tenant's shared prefix must not be
+        # evicted early because a batch tenant also used it). Both dicts
+        # stay empty on the single-tenant path.
+        self.tenant_blocks: Dict[str, int] = {}
+        self._block_level: Dict[int, int] = {}
         # in-flight registry: sequence hash → physical page a live sequence
         # is about to compute into. A concurrent request sharing that prefix
         # waits for the seal instead of prefilling the same content twice.
@@ -213,7 +287,8 @@ class BlockAllocator:
     # -- allocation ----------------------------------------------------------
 
     def allocate_sequence(
-        self, token_ids: Sequence[int], wait_inflight: bool = True
+        self, token_ids: Sequence[int], wait_inflight: bool = True,
+        tenant: str = "", level: int = 0,
     ) -> Optional[SequenceAllocation]:
         """Allocate pages for a prompt, reusing prefix-cached blocks.
 
@@ -298,6 +373,17 @@ class BlockAllocator:
                 self._inflight[h] = block_ids[idx]
                 pending.append(h)
 
+        # QoS attribution: budget accounting + eviction-tier tagging (both
+        # no-ops on the single-tenant path — tenant ""/level 0)
+        if tenant:
+            self.tenant_blocks[tenant] = (
+                self.tenant_blocks.get(tenant, 0) + len(block_ids)
+            )
+        if level > 0:
+            for bid in block_ids:
+                if self._block_level.get(bid, 0) < level:
+                    self._block_level[bid] = level
+
         # hashing state covers only tokens whose KV exists (the cached prefix);
         # note_tokens_computed extends it as prefill/decode computes the rest
         return SequenceAllocation(
@@ -309,6 +395,8 @@ class BlockAllocator:
             sealed_blocks=len(reused) + len(host_hits),
             host_hits=host_hits,
             pending_hashes=pending,
+            tenant=tenant,
+            level=level,
         )
 
     def seed_cached(self, token_ids: Sequence[int]) -> List[Tuple[int, int]]:
@@ -371,7 +459,14 @@ class BlockAllocator:
         while len(alloc.block_ids) < needed:
             if not self._reserve_capacity(1):
                 return False
-            alloc.block_ids.append(self._take_free())
+            bid = self._take_free()
+            alloc.block_ids.append(bid)
+            if alloc.tenant:
+                self.tenant_blocks[alloc.tenant] = (
+                    self.tenant_blocks.get(alloc.tenant, 0) + 1
+                )
+            if alloc.level > 0 and self._block_level.get(bid, 0) < alloc.level:
+                self._block_level[bid] = alloc.level
         return True
 
     def note_tokens_computed(self, alloc: SequenceAllocation, token_ids: Sequence[int]) -> None:
@@ -390,7 +485,10 @@ class BlockAllocator:
             self._inflight.pop(blk.block_hash, None)  # promise fulfilled
             prior = self._hash_of.get(bid)
             if prior is not None and prior != blk.block_hash:
-                self._unregister(bid)
+                self._unregister(bid)  # drops the stale class tag too
+                if alloc.level > 0:
+                    # the sealing owner's level governs the fresh content
+                    self._block_level[bid] = alloc.level
             if blk.block_hash not in self._by_hash:
                 self._by_hash[blk.block_hash] = bid
                 self._hash_of[bid] = blk.block_hash
@@ -409,6 +507,12 @@ class BlockAllocator:
             if self._inflight.get(h) in own:
                 self._inflight.pop(h, None)
         alloc.pending_hashes = []
+        if alloc.tenant and alloc.block_ids:
+            left = self.tenant_blocks.get(alloc.tenant, 0) - len(alloc.block_ids)
+            if left > 0:
+                self.tenant_blocks[alloc.tenant] = left
+            else:
+                self.tenant_blocks.pop(alloc.tenant, None)
         for bid in alloc.block_ids:
             self._release_one(bid)
         alloc.block_ids = []
@@ -422,14 +526,15 @@ class BlockAllocator:
             return
         self._refcount.pop(bid, None)
         if bid in self._hash_of:
-            self._cached[bid] = None
-            self._cached.move_to_end(bid)
+            # reuse pool, tiered by the owners' class level: lowest class
+            # evicted first under pressure (0 for everything with QoS off)
+            self._cached.add(bid, self._block_level.get(bid, 0))
         else:
+            self._block_level.pop(bid, None)
             self._free.append(bid)
 
     def _acquire(self, bid: int) -> None:
-        if bid in self._cached:  # revive from reuse pool
-            del self._cached[bid]
+        self._cached.discard(bid)  # revive from reuse pool
         self._refcount[bid] = self._refcount.get(bid, 0) + 1
         self._note_occupancy()
 
@@ -459,11 +564,12 @@ class BlockAllocator:
         evicted: List[int] = []
         spill: List[Tuple[int, int]] = []
         while len(self._free) < n:
-            if not self._cached:
+            bid = self._cached.pop_oldest()  # lowest class tier, then LRU
+            if bid is None:
                 return False
-            bid, _ = self._cached.popitem(last=False)  # oldest
             h = self._hash_of.pop(bid)
             del self._by_hash[h]
+            self._block_level.pop(bid, None)
             evicted.append(h)
             if self._offload is not None and self.host_pool is not None:
                 if h not in self.host_pool:
@@ -481,4 +587,9 @@ class BlockAllocator:
             self._by_hash.pop(h, None)
             if self._sink is not None:
                 self._sink.blocks_removed([h])
-        self._cached.pop(bid, None)
+        self._cached.discard(bid)
+        # the block's content is being replaced: its class tag must not
+        # survive into the new owner's tier (levels only ever go UP via
+        # allocate/grow — a stale high tag would shelter a low-class
+        # block from eviction forever)
+        self._block_level.pop(bid, None)

@@ -79,6 +79,7 @@ from dynamo_tpu.models.llama import (
     quantize_kv,
 )
 from dynamo_tpu.engine_jax.compile_cache import compile_count, record_compile
+from dynamo_tpu.runtime import qos as qos_mod
 from dynamo_tpu.runtime import telemetry, tracing
 from dynamo_tpu.runtime.annotated import Annotated
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
@@ -202,6 +203,17 @@ class EngineConfig:
     # longest trailing n-gram the drafter probes (None = DYN_TPU_SPEC_NGRAM,
     # default 3)
     spec_ngram: Optional[int] = None
+    # multi-tenant QoS (runtime/qos.py): prefill duty-cycle budget — the
+    # AVERAGE prefill tokens allowed per engine dispatch while decode
+    # lanes are live. A chunk dispatch costs full [S, C] compute and
+    # advances decode lanes only one token, so isolation works by pacing
+    # chunk-dispatch frequency: one chunk, then ~chunk/budget pure
+    # pipelined decode dispatches. Long prompts raise their OWN TTFT
+    # instead of spiking every decode lane's ITL; an engine with no
+    # decode lanes prefills at full speed. None = read
+    # DYN_TPU_PREFILL_BUDGET (clamped; default 0 = unlimited, the pre-QoS
+    # behavior).
+    prefill_budget: Optional[int] = None
     # KV page storage dtype: "bf16" (native — actually the cache_dtype /
     # model dtype) or "int8" (quantized pages + per-block scale tables,
     # halving the KV half of the decode stream at long context). None =
@@ -230,7 +242,8 @@ class _Seq:
         "temperature", "top_k", "top_p", "seed", "logprobs", "enqueue_t",
         "first_token_t", "admit_t", "remote", "remote_deadline", "prefill_pos",
         "freq_pen", "pres_pen", "out_tokens", "joined_inflight", "wait_hash",
-        "drafter", "spec_drafted", "spec_accepted",
+        "drafter", "spec_drafted", "spec_accepted", "tenant", "level",
+        "weight",
     )
 
     def __init__(self, ctx: Context, request: PreprocessedRequest, loop) -> None:
@@ -280,6 +293,13 @@ class _Seq:
         self.drafter = None
         self.spec_drafted = 0
         self.spec_accepted = 0
+        # multi-tenant QoS (runtime/qos.py): tenant id + class level/weight
+        # stamped by generate() when QoS is on (or a bare tenant id for
+        # attribution when off). Defaults keep the single-tenant step loop
+        # on the zero-bookkeeping path.
+        self.tenant = ""
+        self.level = 0
+        self.weight = 1.0
 
     @property
     def total_len(self) -> int:
@@ -588,6 +608,36 @@ class JaxServingEngine(AsyncEngine):
         self._perf: Optional[_EnginePerf] = (
             _EnginePerf() if telemetry.enabled() else None
         )
+
+        # multi-tenant QoS (runtime/qos.py, docs/qos.md): policy + weighted
+        # fair-queue bookkeeping, built ONLY when DYN_TPU_TENANT_* knobs are
+        # set — the single-tenant step loop pays one None-check (asserted by
+        # tests/test_qos.py's zero-overhead guard, the _EnginePerf pattern).
+        self._qos = qos_mod.maybe_from_env()
+        self._fair: Optional[qos_mod.FairQueue] = (
+            qos_mod.FairQueue(self._qos.max_tenants)
+            if self._qos is not None else None
+        )
+        # prefill duty-cycle budget (chunked-prefill interleaving): average
+        # prefill tokens per dispatch while decode lanes are live; config
+        # wins when set, else the clamped env knob; 0 = unlimited. The
+        # debt counter is the duty-cycle state (see _dispatch_step).
+        pb = engine_config.prefill_budget
+        self._prefill_budget = (
+            qos_mod.env_prefill_budget() if pb is None else max(int(pb), 0)
+        )
+        self._prefill_debt = 0.0
+        # per-tenant KV-block budget: binds only while other tenants are
+        # active (work-conserving — a tenant alone may use the whole pool)
+        self._tenant_kv_budget = (
+            max(1, int(self._qos.kv_frac * self.num_blocks))
+            if self._qos is not None and self._qos.kv_frac > 0
+            else 0
+        )
+        # high-water mark of prefill tokens computed in a single dispatch
+        # that also carried a decode lane — the chunked-prefill interleaving
+        # bound the ITL-isolation test asserts against the step budget
+        self.prefill_interleave_max = 0
 
         # (with_logprobs, with_penalties, with_sampling) variants, compiled
         # lazily per need
@@ -1241,6 +1291,15 @@ class JaxServingEngine(AsyncEngine):
             return
         self._ensure_thread()
         seq = _Seq(request, req, asyncio.get_running_loop())
+        tenant = getattr(request.context, "tenant", None)
+        if self._qos is not None:
+            # QoS on: anonymous requests become the shared default tenant
+            # (they must not bypass fair queuing / budgets); the class
+            # table supplies the eviction level + scheduling weight
+            seq.tenant = tenant or qos_mod.DEFAULT_TENANT
+            seq.level, seq.weight = self._qos.class_of(seq.tenant)
+        elif tenant:
+            seq.tenant = tenant  # attribution only (spans, metrics)
         if self._spec_k > 0 and not self._multihost:
             # one suffix index per request (prompt indexed up front, emitted
             # tokens appended as they stream); spec off ⇒ stays None and the
@@ -1345,6 +1404,12 @@ class JaxServingEngine(AsyncEngine):
                     if self._perf is not None:
                         # exclude the idle gap from throughput timing
                         self._perf.note_idle()
+                    if self._fair is not None:
+                        # bound fair-queue memory across tenant churn; an
+                        # idle engine has no backlog to be fair about
+                        self._fair.forget_absent(
+                            [s.tenant for s in self._awaiting.values()]
+                        )
                 self._coalesce_admission_wave()
                 self._admit()
                 self._dispatch_step()
@@ -1431,6 +1496,88 @@ class JaxServingEngine(AsyncEngine):
                     for s in reversed(deferred):
                         self._pending.appendleft(s)
 
+    def _pop_pending_locked(self) -> "_Seq":
+        """Next pending request to consider. FIFO on the single-tenant
+        path; with QoS on, weighted-fair: the request whose tenant has
+        the smallest virtual time (most starved by weighted share) wins,
+        FIFO within a tenant — a noisy neighbor's deep backlog cannot
+        starve a light tenant's next request. Caller holds ``_cond``."""
+        if self._fair is None or len(self._pending) <= 1:
+            return self._pending.popleft()
+        i = self._fair.pick([s.tenant for s in self._pending])
+        if i == 0:
+            return self._pending.popleft()
+        seq = self._pending[i]
+        del self._pending[i]
+        return seq
+
+    def _tenant_contended(self, tenant: str) -> bool:
+        """Is any OTHER tenant actively HOLDING engine resources (a slot
+        or a remote-prefill allocation)? KV budgets are work-conserving:
+        they bind only under contention — a tenant alone on the chip may
+        use the whole pool. Deliberately NOT counting merely-pending
+        tenants: two over-budget tenants whose only contention is each
+        other's queued request would otherwise defer each other forever
+        on an empty engine (each admits here; the class-aware preemption
+        path still reclaims from whichever overruns once both run)."""
+        if any(
+            s is not None and s.tenant != tenant for s in self._slots
+        ):
+            return True
+        return any(s.tenant != tenant for s in self._awaiting.values())
+
+    def _kv_budget_defers(self, seq: "_Seq") -> bool:
+        """Admission-side KV budget: defer a tenant already holding (or
+        about to exceed) its pool share while other tenants are active."""
+        if self._tenant_kv_budget <= 0 or not seq.tenant:
+            return False
+        need = self.allocator.blocks_needed(len(seq.prompt))
+        held = self.allocator.tenant_blocks.get(seq.tenant, 0)
+        if held + need <= self._tenant_kv_budget:
+            return False
+        return self._tenant_contended(seq.tenant)
+
+    def _budget_denies_grow(self, seq: "_Seq", n_tokens: int) -> bool:
+        """Decode-growth KV budget: an over-share tenant's sequence is
+        recompute-preempted (it pays with its own latency) instead of
+        squeezing other tenants out of the pool."""
+        if self._tenant_kv_budget <= 0 or not seq.tenant or seq.alloc is None:
+            return False
+        extra = self.allocator.blocks_needed(
+            min(n_tokens, self.config.max_model_len)
+        ) - len(seq.alloc.block_ids)
+        if extra <= 0:
+            return False
+        held = self.allocator.tenant_blocks.get(seq.tenant, 0)
+        if held + extra <= self._tenant_kv_budget:
+            return False
+        return self._tenant_contended(seq.tenant)
+
+    def _preempt_victim_for(self, seq: "_Seq") -> "_Seq":
+        """Class-aware preemption: when ``seq`` needs blocks the pool
+        can't yield, prefer preempting an active sequence of a LOWER
+        class (or of a tenant over its KV budget) — lowest level first,
+        most blocks held within a level. Falls back to ``seq`` itself
+        (the pre-QoS behavior) when no better victim exists. The
+        reclaimable tier is already class-ordered in the allocator; this
+        extends the same order to hard-held blocks."""
+        if self._fair is None:
+            return seq
+        best = None
+        for s in self._slots:
+            if s is None or s is seq or s.tenant == seq.tenant or s.alloc is None:
+                continue
+            over = (
+                self._tenant_kv_budget > 0
+                and self.allocator.tenant_blocks.get(s.tenant, 0)
+                > self._tenant_kv_budget
+            )
+            if s.level < seq.level or over:
+                key = (s.level, -len(s.alloc.block_ids))
+                if best is None or key < best[0]:
+                    best = (key, s)
+        return best[1] if best is not None else seq
+
     def _admit_inner(self, deferred: List["_Seq"]) -> None:
         while True:
             with self._cond:
@@ -1439,7 +1586,7 @@ class JaxServingEngine(AsyncEngine):
                 free = [i for i, s in enumerate(self._slots) if s is None]
                 if not free:
                     return
-                seq = self._pending.popleft()
+                seq = self._pop_pending_locked()
             if seq.ctx.context.is_stopped:
                 if seq.alloc is not None:
                     self.allocator.free_sequence(seq.alloc)
@@ -1472,7 +1619,15 @@ class JaxServingEngine(AsyncEngine):
                     deferred.append(seq)
                     continue
                 seq.wait_hash = None
-            alloc = self.allocator.allocate_sequence(seq.prompt)
+            if self._fair is not None and self._kv_budget_defers(seq):
+                # tenant over its KV share while others are active: park
+                # this request (its own latency pays) — the scheduler
+                # keeps admitting other tenants past it
+                deferred.append(seq)
+                continue
+            alloc = self.allocator.allocate_sequence(
+                seq.prompt, tenant=seq.tenant, level=seq.level
+            )
             if isinstance(alloc, InflightPrefix):
                 # another lane is prefilling this prompt's prefix right now:
                 # park until it seals (then these become ordinary prefix
@@ -1485,12 +1640,31 @@ class JaxServingEngine(AsyncEngine):
             if alloc is None and (self._inflight is not None or self._zombie_allocs):
                 # blocks may be parked behind the in-flight speculative chunk
                 self._drain_inflight()
-                alloc = self.allocator.allocate_sequence(seq.prompt)
+                alloc = self.allocator.allocate_sequence(
+                    seq.prompt, tenant=seq.tenant, level=seq.level
+                )
                 if isinstance(alloc, InflightPrefix):
                     seq.joined_inflight = True
                     seq.wait_hash = alloc.seq_hash
                     deferred.append(seq)
                     continue
+            if alloc is None and self._fair is not None:
+                # class-aware preemption: reclaim from a lower-class (or
+                # over-budget) tenant's active sequence before giving up.
+                # The in-flight chunk is drained first so freed pages can't
+                # still receive its speculative writes.
+                victim = self._preempt_victim_for(seq)
+                if victim is not seq:
+                    self._drain_inflight()
+                    self._preempt(victim)
+                    alloc = self.allocator.allocate_sequence(
+                        seq.prompt, tenant=seq.tenant, level=seq.level
+                    )
+                    if isinstance(alloc, InflightPrefix):
+                        seq.joined_inflight = True
+                        seq.wait_hash = alloc.seq_hash
+                        deferred.append(seq)
+                        continue
             if alloc is None:
                 if not any(self._slots) and not self._awaiting:
                     # nothing running (or awaiting remote prefill) will ever
@@ -1567,9 +1741,43 @@ class JaxServingEngine(AsyncEngine):
     def _dispatch_step(self) -> None:
         active = [s for s in self._slots if s is not None]
         if not active:
+            self._prefill_debt = 0.0  # contention episode over
             self._drain_inflight()
             return
-        if any(s.prefill_pos is not None for s in active):
+        prefilling = any(s.prefill_pos is not None for s in active)
+        if not prefilling and self._prefill_debt:
+            # debt is only meaningful WITHIN one prefill/decode contention
+            # episode: once no lane is prefilling, drop it — a prompt
+            # arriving minutes later must not inherit a finished prompt's
+            # debt as extra TTFT
+            self._prefill_debt = 0.0
+        if (
+            prefilling
+            and self._prefill_budget > 0
+            and any(s.prefill_pos is None for s in active)
+        ):
+            # duty-cycled interleave (DYN_TPU_PREFILL_BUDGET, docs/qos.md):
+            # a chunk dispatch costs full [S, C] compute no matter how few
+            # real tokens it feeds, and it advances decode lanes by ONE
+            # token where a pipelined decode dispatch advances them
+            # decode_steps — so isolation comes from dispatch FREQUENCY,
+            # not from shrinking a dispatch. Every dispatch earns `budget`
+            # tokens of prefill credit; a chunk dispatch spends what it
+            # consumed. While in debt, prefill lanes sit the dispatch out
+            # and decode runs at full pipelined speed: on average at most
+            # `budget` prefill tokens ride each dispatch, so a long prompt
+            # stretches its OWN TTFT instead of every decode lane's ITL.
+            # Idle decode ⇒ this path never taken: prefill at full speed.
+            self._prefill_debt = max(
+                self._prefill_debt - self._prefill_budget, 0.0
+            )
+            if self._prefill_debt > 0:
+                self._decode_step()
+                return
+            self._drain_inflight()
+            self._chunk_step(paced=True)
+            return
+        if prefilling:
             # chunk prefill needs each decode lane's true last token host-side
             self._drain_inflight()
             self._chunk_step()
@@ -1594,27 +1802,71 @@ class JaxServingEngine(AsyncEngine):
         else:
             self._decode_step()
 
-    def _chunk_step(self) -> None:
+    def _chunk_step(self, paced: bool = False) -> None:
         """One [slots, prefill_chunk] dispatch: prefilling lanes consume up to
         a chunk of prompt; decode lanes advance one token. A whole admission
         wave prefills in ceil(longest_suffix / chunk) dispatches instead of
-        one serial batch-1 dispatch per request (the round-1 18 s TTFT)."""
+        one serial batch-1 dispatch per request (the round-1 18 s TTFT).
+
+        ``paced`` (the prefill-budget duty cycle, _dispatch_step): decode
+        lanes are live, so total prefill consumption is capped at ONE
+        chunk, handed to the most-starved tenant's lanes first, and the
+        consumed tokens are charged to the prefill debt that keeps the
+        following dispatches pure-decode."""
         cfg = self.config
         S, C = cfg.max_slots, cfg.prefill_chunk
         for seq in [s for s in self._slots if s is not None]:
+            if seq.slot is None:
+                # an earlier lane's class-aware reclaim preempted this one
+                # mid-pass: it left the slots (alloc freed) but is still in
+                # the snapshot — touching it would grow a None alloc
+                continue
             if seq.ctx.context.is_stopped:
                 self._finish(seq, FinishReason.CANCELLED)
             elif seq.prefill_pos is None:
                 # decode lane writes KV at position total_len-1
-                if not self.allocator.grow(seq.alloc, min(seq.total_len, cfg.max_model_len)):
-                    self._preempt(seq)
+                need = min(seq.total_len, cfg.max_model_len)
+                if self._fair is not None and self._budget_denies_grow(seq, need):
+                    self._preempt(seq)  # over-share tenant pays, not others
+                elif not self.allocator.grow(seq.alloc, need):
+                    victim = self._preempt_victim_for(seq)
+                    self._preempt(victim)
+                    if victim is not seq and not self.allocator.grow(
+                        seq.alloc, need
+                    ):
+                        self._preempt(seq)
         if not any(self._slots):
             return
+
+        # paced dispatch (prefill-budget duty cycle): one chunk's worth of
+        # prefill total this dispatch, most-starved tenant's lanes first —
+        # fairness decides WHOSE long prompt advances while decode lanes
+        # ride along. allow=None is the unpaced fast path (identical to
+        # pre-budget behavior).
+        allow: Optional[Dict[int, int]] = None
+        if paced:
+            pre = [
+                i for i in range(S)
+                if self._slots[i] is not None
+                and self._slots[i].prefill_pos is not None
+            ]
+            if pre:
+                if self._fair is not None and len(pre) > 1:
+                    pre.sort(key=lambda i: self._fair.vt(self._slots[i].tenant))
+                rem = [
+                    len(self._slots[i].prompt) - self._slots[i].prefill_pos
+                    for i in pre
+                ]
+                allow = dict(zip(
+                    pre, qos_mod.split_prefill_budget(rem, C, C),
+                ))
 
         tokens = np.zeros((S, C), np.int32)
         positions = np.full((S, C), -1, np.int32)
         sample_at = np.full((S,), -1, np.int32)
         consumed: List[Optional[List[int]]] = [None] * S
+        n_prefill = 0
+        has_decode = False
         for i in range(S):
             seq = self._slots[i]
             self._tables[i, :] = 0
@@ -1635,18 +1887,32 @@ class JaxServingEngine(AsyncEngine):
             self._presp[i] = seq.pres_pen
             if seq.prefill_pos is not None:
                 n = min(C, len(seq.prompt) - seq.prefill_pos)
+                if allow is not None:
+                    n = min(n, allow.get(i, 0))
+                if n <= 0:
+                    continue  # budgeted out of this step; advances next one
                 chunk_toks = seq.prompt[seq.prefill_pos : seq.prefill_pos + n]
                 tokens[i, :n] = chunk_toks
                 positions[i, :n] = np.arange(seq.prefill_pos, seq.prefill_pos + n)
                 if seq.prefill_pos + n == len(seq.prompt):
                     sample_at[i] = n - 1
                 consumed[i] = chunk_toks
+                n_prefill += n
             else:
                 fed = seq.generated[-1] if seq.generated else seq.prompt[-1]
                 tokens[i, 0] = fed
                 positions[i, 0] = seq.total_len - 1
                 sample_at[i] = 0
                 consumed[i] = [fed]
+                has_decode = True
+        if has_decode and n_prefill > self.prefill_interleave_max:
+            # interleaving bound: the most prefill work any dispatch ever
+            # put in front of a live decode lane (the ITL-isolation tests
+            # assert it stays ≤ one chunk under pacing, vs the full prompt
+            # on the unbudgeted control leg)
+            self.prefill_interleave_max = n_prefill
+        if paced and has_decode:
+            self._prefill_debt += n_prefill
 
         self._step_counter += 1
         want_lp = any(
@@ -1725,6 +1991,10 @@ class JaxServingEngine(AsyncEngine):
                 else None
             )
             if seq.prefill_pos is not None:
+                if self._fair is not None and seq.tenant:
+                    # prefill progress bills the tenant's virtual clock
+                    # (decode tokens bill in _emit_token/_emit_token_run)
+                    self._fair.charge(seq.tenant, len(consumed[i]), seq.weight)
                 seq.prefill_pos += len(consumed[i])
                 if seq.prefill_pos >= len(seq.prompt):
                     seq.prefill_pos = None
@@ -1752,21 +2022,39 @@ class JaxServingEngine(AsyncEngine):
                     self._finish(seq, FinishReason.CANCELLED)
 
         # capacity: this chunk writes positions total_len-1 .. total_len-2+k,
-        # and the next (speculative) chunk another k past that
+        # and the next (speculative) chunk another k past that. Prefilling
+        # lanes (paced duty cycle: they sit decode dispatches out) neither
+        # grow nor dispatch here.
         while True:
             ok = True
             for seq in [s for s in self._slots if s is not None]:
+                if seq.prefill_pos is not None:
+                    continue
                 need = min(seq.total_len - 1 + 2 * k, cfg.max_model_len)
-                if not self.allocator.grow(seq.alloc, need):
+                denied = (
+                    self._fair is not None
+                    and self._budget_denies_grow(seq, need)
+                )
+                if denied or not self.allocator.grow(seq.alloc, need):
                     if self._inflight is not None or self._zombie_allocs:
                         self._drain_inflight()  # releases zombie blocks
-                    else:
+                    elif denied:
+                        # tenant over its KV share under contention: its
+                        # own sequence recompute-preempts (isolation —
+                        # the overrun pays, not the neighbors)
                         self._preempt(seq)
+                    else:
+                        # class-aware: reclaim from a lower-class or
+                        # over-budget tenant first; falls back to seq
+                        self._preempt(self._preempt_victim_for(seq))
                     ok = False
                     break
             if ok:
                 break
-        active = [s for s in self._slots if s is not None]
+        active = [
+            s for s in self._slots
+            if s is not None and s.prefill_pos is None
+        ]
         if not active:
             return
 
@@ -1798,14 +2086,19 @@ class JaxServingEngine(AsyncEngine):
                 return False
             return True
 
-        if not any(lane_needs_more(s) for s in lanes if s is not None):
+        if not any(
+            lane_needs_more(s) for s in lanes
+            if s is not None and s.prefill_pos is None
+        ):
             self._drain_inflight()
             return
 
         for i in range(S):
             seq = self._slots[i]
             self._tables[i, :] = 0
-            if seq is None:
+            if seq is None or seq.prefill_pos is not None:
+                # empty lane — or a prefilling lane sitting this paced
+                # decode dispatch out (position -1 keeps it inert in-jit)
                 self._positions[i] = -1
                 self._last_tokens[i] = 0
                 self._temp[i] = 0.0
@@ -1833,9 +2126,13 @@ class JaxServingEngine(AsyncEngine):
             pos_in = self._put(self._positions)
 
         self._step_counter += 1
-        want_lp = any(s is not None and s.logprobs is not None for s in lanes)
-        want_pen = any(s is not None and s.penalized for s in lanes)
-        want_sample = any(s is not None and s.temperature > 0.0 for s in lanes)
+        live = [
+            s if (s is not None and s.prefill_pos is None) else None
+            for s in lanes
+        ]
+        want_lp = any(s is not None and s.logprobs is not None for s in live)
+        want_pen = any(s is not None and s.penalized for s in live)
+        want_sample = any(s is not None and s.temperature > 0.0 for s in live)
         if want_pen:
             self._sync_counts(lanes)
         counts_in = self._counts if want_pen else self._dummy_counts
@@ -1946,6 +2243,8 @@ class JaxServingEngine(AsyncEngine):
             seq.drafter.extend(toks)
         seq.emitted += len(toks)
         self.total_generated_tokens += len(toks)
+        if self._fair is not None and seq.tenant:
+            self._fair.charge(seq.tenant, len(toks), seq.weight)
         seq.emit(Annotated.from_data(
             LLMEngineOutput(
                 token_ids=toks, log_probs=log_probs, top_logprobs=top_logprobs
@@ -1979,6 +2278,10 @@ class JaxServingEngine(AsyncEngine):
         for i, seq in enumerate(chunk.lanes):
             if seq is None or seq.slot != i:
                 continue  # empty lane, or finished in an earlier chunk
+            if seq.prefill_pos is not None:
+                # prefilling lane that sat a paced decode dispatch out
+                # (position -1 in-jit): its row is garbage, not tokens
+                continue
             self._emit_token_run(
                 seq,
                 [int(t) for t in out[i]],
@@ -2207,6 +2510,8 @@ class JaxServingEngine(AsyncEngine):
             seq.drafter.extend((tok,))
         seq.emitted += 1
         self.total_generated_tokens += 1
+        if self._fair is not None and seq.tenant:
+            self._fair.charge(seq.tenant, 1, seq.weight)
         finish: Optional[FinishReason] = None
         if tok in seq.eos_ids and not seq.ignore_eos:
             finish = FinishReason.EOS
@@ -2247,15 +2552,22 @@ class JaxServingEngine(AsyncEngine):
             status = "cancelled"
         elif reason == FinishReason.ERROR:
             status = "error"
+        attrs = {
+            "request_id": seq.ctx.id,
+            "prompt_tokens": len(seq.prompt),
+            "output_tokens": seq.emitted,
+            "remote_prefill": seq.remote,
+            "finish_reason": str(getattr(reason, "value", reason)),
+        }
+        if seq.tenant:
+            # per-tenant phase-latency attribution (docs/qos.md): every
+            # phase span below parents here, so a tenant filter over the
+            # flight recorder yields that tenant's queue/prefill/decode
+            # breakdown
+            attrs["tenant"] = seq.tenant
         req_span = tracing.record_span(
             "engine.request", seq.enqueue_t, now, parent=parent,
-            attributes={
-                "request_id": seq.ctx.id,
-                "prompt_tokens": len(seq.prompt),
-                "output_tokens": seq.emitted,
-                "remote_prefill": seq.remote,
-                "finish_reason": str(getattr(reason, "value", reason)),
-            },
+            attributes=attrs,
             status=status,
         )
         parent = req_span or parent
@@ -2731,6 +3043,38 @@ class JaxServingEngine(AsyncEngine):
         if self.host_pool is not None:
             m["host_cache_blocks"] = len(self.host_pool)
             m["host_cache_hits"] = self.host_pool.hits
+        if self._prefill_budget > 0 or self._fair is not None:
+            # chunked-prefill interleaving bound (docs/qos.md): the
+            # observable proving the duty cycle works — exported in the
+            # single-tenant budget-only mode too
+            m["prefill_interleave_max"] = self.prefill_interleave_max
+        if self._fair is not None:
+            # per-tenant occupancy: what llmctl tenant status and the
+            # dynamo_tenant_* cluster gauges render
+            tenants: Dict[str, Dict[str, Any]] = {}
+
+            def entry(t: str) -> Dict[str, Any]:
+                e = tenants.get(t)
+                if e is None:
+                    e = tenants[t] = {
+                        "class": self._qos.class_name_of(t),
+                        "active_slots": 0, "queue_depth": 0, "kv_blocks": 0,
+                    }
+                return e
+
+            for s in self._slots:
+                if s is not None and s.tenant:
+                    entry(s.tenant)["active_slots"] += 1
+            for s in list(self._pending) + list(self._awaiting.values()):
+                if s.tenant:
+                    entry(s.tenant)["queue_depth"] += 1
+            # .copy(): one atomic C-level op — the engine thread mutates
+            # this dict without holding _cond, so iterating it live from
+            # the metrics/admission threads could see it resize mid-walk
+            for t, n in self.allocator.tenant_blocks.copy().items():
+                entry(t)["kv_blocks"] = n
+            if tenants:
+                m["tenants"] = tenants
         return m
 
 

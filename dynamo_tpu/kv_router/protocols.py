@@ -205,6 +205,12 @@ class ForwardPassMetrics:
     # Empty from pre-planner workers — the aggregator buckets those as
     # "decode" (the only role that existed before the field)
     role: str = ""
+    # multi-tenant QoS (runtime/qos.py, docs/qos.md): per-tenant view —
+    # {tenant: {"class", "active_slots", "queue_depth", "kv_blocks",
+    # "admitted", "rate_limited"}}. None from single-tenant workers (no
+    # DYN_TPU_TENANT_* knobs); the aggregator sums the numeric fields into
+    # the dynamo_tenant_* cluster gauges.
+    tenants: Optional[dict] = None
 
     def to_dict(self) -> dict:
         from dataclasses import asdict

@@ -103,11 +103,26 @@ class HttpService:
         host: str = "0.0.0.0",
         port: int = 8080,
         metrics_prefix: str = "dynamo_frontend",
+        qos=None,
     ):
         self.manager = manager or ModelManager()
         self.host = host
         self.port = port
         self.metrics = ServiceMetrics(metrics_prefix)
+        # multi-tenant QoS (runtime/qos.py): tenant identity is extracted
+        # here (x-tenant-id header / API-key map) and rides the engine
+        # context + RPC header. The edge enforces the same token-bucket
+        # rate limits the worker admission gate does, so in-process
+        # engines (no RPC hop) get tenant isolation too. No DYN_TPU_TENANT_*
+        # knobs ⇒ both stay None and the handler pays one None-check.
+        from ...runtime import qos as qos_mod
+
+        self.qos = qos if qos is not None else qos_mod.maybe_from_env()
+        self.tenant_limiter = (
+            qos_mod.TenantRateLimiter(self.qos)
+            if self.qos is not None and self.qos.rate_rps > 0
+            else None
+        )
         self._runner: Optional[web.AppRunner] = None
         self.app = web.Application()
         self.app.add_routes(
@@ -268,16 +283,50 @@ class HttpService:
 
         streaming = bool(oai_req.stream)
         ctx = Context(oai_req)
+        # tenant identity (docs/qos.md): the AUTHENTICATED API-key binding
+        # wins over the client-supplied x-tenant-id header (a spoofed
+        # header must not bill another tenant's quota), undeclared ids
+        # optionally collapse into the default tenant
+        # (DYN_TPU_TENANT_UNMAPPED=shared), and anonymous traffic becomes
+        # the shared default tenant — it must not bypass the rate gates.
+        # With QoS off, a bare header still rides the context for tracing.
+        tenant = request.headers.get("x-tenant-id")
+        if self.qos is not None:
+            tenant = self.qos.resolve_tenant(
+                tenant, request.headers.get("authorization")
+            )
+        if tenant:
+            ctx.context.tenant = tenant
+        if self.tenant_limiter is not None:
+            wait_s = self.tenant_limiter.take(tenant)
+            if wait_s > 0:
+                # per-tenant 429 before any engine work: the Retry-After
+                # is THIS tenant's bucket refill, not a global hint
+                with self.metrics.inflight_guard(
+                    oai_req.model, endpoint,
+                    "stream" if streaming else "unary",
+                ) as g:
+                    g.mark_shed()
+                    return _overloaded_response(
+                        f"{OVERLOAD_ERROR}: tenant {tenant!r} over rate quota",
+                        # same 60 s cap as the worker gate: one policy
+                        # knob must yield one client backoff contract
+                        # wherever the request is shed
+                        retry_after_ms=min(int(wait_s * 1000) + 1, 60_000),
+                    )
         # edge span: the trace's root for locally-originated requests, or a
         # child of the caller's context when an (optional) W3C traceparent
         # header arrives — malformed headers just start a fresh root. The
         # span rides ctx.context.trace into the engine/router layers; the
         # contextvars make every log line in this handler carry the ids.
+        attrs = {"model": oai_req.model, "endpoint": endpoint,
+                 "stream": streaming, "request_id": ctx.id}
+        if tenant:
+            attrs["tenant"] = tenant
         edge = tracing.start_span(
             "http.edge",
             parent=tracing.parse_traceparent(request.headers.get("traceparent")),
-            attributes={"model": oai_req.model, "endpoint": endpoint,
-                        "stream": streaming, "request_id": ctx.id},
+            attributes=attrs,
         )
         tokens = None
         if edge is not None:

@@ -40,15 +40,21 @@ OVERLOAD_ERROR = "overloaded"
 
 class OverloadedError(RetryableRpcError):
     """A worker shed the request before doing any work (queue full / no KV
-    headroom). Retryable by design — another instance may have capacity —
-    but it must NOT trip the circuit breaker: the worker is healthy, just
-    busy, and ejecting it would amplify the overload on its siblings.
-    Soft-eject (avoid it for ``retry_after_ms``) instead."""
+    headroom / tenant over its rate quota). Retryable by design — another
+    instance may have capacity — but it must NOT trip the circuit breaker:
+    the worker is healthy, just busy, and ejecting it would amplify the
+    overload on its siblings. Soft-eject (avoid it for ``retry_after_ms``)
+    instead. ``tenant`` is set when the shed was a per-tenant rate limit
+    (``runtime/qos.py``) — that retry hint is the tenant's own bucket
+    refill, so failover to a sibling would just burn its bucket there too.
+    """
 
-    def __init__(self, message: str, queue_depth: int = 0, retry_after_ms: int = 0):
+    def __init__(self, message: str, queue_depth: int = 0,
+                 retry_after_ms: int = 0, tenant: Optional[str] = None):
         super().__init__(message)
         self.queue_depth = queue_depth
         self.retry_after_ms = retry_after_ms
+        self.tenant = tenant
         # the snapshot the gate decided on (worker side only; not wired) —
         # lets the shed reply reuse it instead of probing the engine twice
         self.load: Optional[LoadSnapshot] = None
@@ -220,12 +226,29 @@ class AdmissionController:
         self,
         policy: Optional[AdmissionPolicy] = None,
         engine_probe: Optional[Callable[[], Dict[str, Any]]] = None,
+        qos: Optional[Any] = None,
     ):
         self.policy = policy or AdmissionPolicy.from_env()
         self.engine_probe = engine_probe
         self.admitted = 0
+        # capacity sheds ONLY (queue/KV pressure): this feeds the
+        # overload_share SLO. Tenant rate sheds count separately below —
+        # a correctly-throttled abuser is the QoS plane working, and it
+        # must not page the capacity SLO on a healthy fleet.
         self.shed = 0
+        self.rate_limited = 0
         self.slow_consumer_cuts = 0
+        # multi-tenant QoS (runtime/qos.py): per-tenant token buckets.
+        # Built only when tenant knobs are set AND a rate is configured —
+        # the single-tenant hot path pays exactly one None-check.
+        from dynamo_tpu.runtime import qos as qos_mod
+
+        self.qos = qos if qos is not None else qos_mod.maybe_from_env()
+        self.tenant_limiter = (
+            qos_mod.TenantRateLimiter(self.qos)
+            if self.qos is not None and self.qos.rate_rps > 0
+            else None
+        )
 
     def _engine_state(self) -> Dict[str, Any]:
         if self.engine_probe is None:
@@ -273,10 +296,27 @@ class AdmissionController:
         over = snap.queue_depth / max(self.policy.max_pending, 1)
         return min(int(base * (1.0 + over)), 5_000)
 
-    def try_admit(self, pending: int) -> Optional[OverloadedError]:
+    def tenant_stats(self) -> Dict[str, Dict[str, int]]:
+        """Cumulative per-tenant admit/rate-limit counters (telemetry);
+        empty when tenant rate limiting is off."""
+        if self.tenant_limiter is None:
+            return {}
+        return self.tenant_limiter.stats()
+
+    def try_admit(
+        self, pending: int, tenant: Optional[str] = None
+    ) -> Optional[OverloadedError]:
         """Admit or shed one incoming request given ``pending`` already
         accepted. Returns None when admitted, or the typed error to reply
-        with when shed (the caller formats the wire reply)."""
+        with when shed (the caller formats the wire reply).
+
+        The global gates run first (they are pure reads); the per-tenant
+        token is consumed only for requests the worker could actually
+        take — a globally-shed retry storm must not burn an innocent
+        tenant's quota (or inflate its ``admitted`` stat). The isolation
+        contract still holds: a 10×-quota flood that passes the global
+        gates is rate-shed here, with the tenant's OWN bucket refill as
+        the retry hint, and never occupies the shared queue."""
         snap = self.snapshot(pending)
         err: Optional[OverloadedError] = None
         if pending >= self.policy.max_pending:
@@ -302,5 +342,21 @@ class AdmissionController:
             self.shed += 1
             err.load = snap
             return err
+        if self.tenant_limiter is not None:
+            wait_s = self.tenant_limiter.take(tenant)
+            if wait_s > 0:
+                t = tenant or "default"
+                err = OverloadedError(
+                    f"{OVERLOAD_ERROR}: tenant {t!r} over rate quota",
+                    queue_depth=snap.queue_depth,
+                    retry_after_ms=min(int(wait_s * 1000) + 1, 60_000),
+                    tenant=t,
+                )
+                # NOT self.shed: tenant throttling has its own signal
+                # (dynamo_tenant_rate_limited_total + llmctl tenant
+                # status exit 2) and must not page overload_share
+                self.rate_limited += 1
+                err.load = snap
+                return err
         self.admitted += 1
         return None

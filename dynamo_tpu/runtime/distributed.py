@@ -1191,6 +1191,14 @@ class EndpointClient(AsyncEngine):
                 if route is not None:
                     route.add_event("overloaded", instance=iid,
                                     retry_after_ms=e.retry_after_ms)
+                if getattr(e, "tenant", None):
+                    # per-TENANT rate shed (runtime/qos.py): the quota is
+                    # about the caller, not this worker — failing over
+                    # would only drain the tenant's bucket on every
+                    # sibling. Surface the 429 + per-tenant Retry-After
+                    # immediately, and do NOT avoid the instance (it is
+                    # happy to serve other tenants right now).
+                    raise
                 self._avoid_until[iid] = (
                     time.monotonic() + max(e.retry_after_ms, 1) / 1000.0
                 )
@@ -1338,7 +1346,8 @@ async def serve_stats_endpoint(endpoint: "Endpoint", engine) -> "InstanceInfo":
 
 
 async def attach_kv_publishing(
-    endpoint: Endpoint, engine, interval: float = 1.0, role: str = "decode"
+    endpoint: Endpoint, engine, interval: float = 1.0, role: str = "decode",
+    bind_admission: bool = True, bind_events: bool = True,
 ) -> KvPublishBridge:
     """Wire a serving engine's KV events + load metrics onto the event plane.
 
@@ -1349,14 +1358,27 @@ async def attach_kv_publishing(
     ``role`` tags the snapshots with the worker's pool role ("decode" |
     "prefill" | "frontend") so the cluster rollup's per-pool breakdown —
     what the planner resizes — attributes this worker's capacity correctly.
+    ``bind_admission=False`` skips pointing the process's RPC admission
+    gate at this engine — a prefill worker co-hosted with a decode RPC
+    server publishes its own metrics but must not steal the gate's
+    capacity probe from the engine actually serving requests.
+    ``bind_events=False`` additionally skips the KV event sink: this
+    engine's cached blocks then never enter the router's prefix-affinity
+    radix tree under this process's worker_id — a prefill-only pool's
+    blocks are not servable prefix hits for routed decode requests, and
+    in the co-hosted case they would inflate the decode worker's overlap
+    score with pages it doesn't hold.
     """
     ns = endpoint.component.namespace
     worker_id = ns.runtime.worker_id
     bridge = KvPublishBridge(ns, worker_id)
-    if hasattr(engine, "set_event_sink"):
+    if bind_events and hasattr(engine, "set_event_sink"):
         engine.set_event_sink(bridge)
     server = ns.runtime._rpc_server
-    if server is not None and hasattr(engine, "metrics_snapshot"):
+    if (
+        bind_admission and server is not None
+        and hasattr(engine, "metrics_snapshot")
+    ):
         # the RPC server registers the *wrapper* engine (no capacity API);
         # point its admission gate at the core engine's real capacity
         server.admission.engine_probe = engine.metrics_snapshot
@@ -1375,11 +1397,26 @@ async def attach_kv_publishing(
                 )
                 snap.setdefault("role", role)
                 snap["uptime_s"] = round(telemetry.uptime_seconds(), 3)
-                if server is not None:
+                if server is not None and bind_admission:
+                    # the co-hosted RPC server's counters belong to the
+                    # publisher that OWNS it; a bind_admission=False
+                    # publisher (prefill worker beside a decode server)
+                    # re-reporting them under its own worker_id/role would
+                    # double-count cluster request/shed/tenant counters
+                    # and attribute the decode queue to the prefill pool
                     # overload observability rides the same metrics stream
                     snap["rpc_queue_depth"] = server.inflight_count
                     snap["shed_requests"] = server.admission.shed
                     snap["draining"] = int(server.draining)
+                    # per-tenant QoS view (docs/qos.md): the engine's
+                    # occupancy split merged with the admission gate's
+                    # admit/rate-limit counters — one `tenants` dict on
+                    # the wire, empty-path free when QoS is off
+                    tstats = server.admission.tenant_stats()
+                    if tstats:
+                        tenants = snap.setdefault("tenants", {})
+                        for t, st in tstats.items():
+                            tenants.setdefault(t, {}).update(st)
                     # request outcome counters for the cluster SLO engine
                     snap["requests_total"] = server.requests_total
                     snap["requests_errored"] = server.requests_errored

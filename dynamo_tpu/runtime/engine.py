@@ -32,9 +32,13 @@ class EngineContext:
       a local Span, a ``(trace_id, span_id)`` wire context extracted from a
       ``traceparent`` header, or None. Riding the context (rather than a
       contextvar) survives engine-thread hops and async-generator plumbing.
+    - ``tenant``   QoS tenant id (``runtime/qos.py``), extracted at the
+      HTTP edge (``x-tenant-id`` / API-key map) or from the RPC header;
+      None on the single-tenant path. Rides the context so admission,
+      scheduling, KV budgets, and tracing all attribute to the same id.
     """
 
-    __slots__ = ("_id", "_stopped", "_killed", "_stop_event", "trace")
+    __slots__ = ("_id", "_stopped", "_killed", "_stop_event", "trace", "tenant")
 
     def __init__(self, request_id: Optional[str] = None):
         self._id = request_id or uuid.uuid4().hex
@@ -42,6 +46,7 @@ class EngineContext:
         self._killed = False
         self._stop_event: Optional[asyncio.Event] = None
         self.trace = None
+        self.tenant: Optional[str] = None
 
     @property
     def id(self) -> str:

@@ -1,0 +1,478 @@
+"""Multi-tenant QoS: tenant identity, classes, rate limits, fair queuing.
+
+Millions of users on shared chips is a *contention* problem: PR3's
+admission control bounds the total queue, but first-come-first-served
+admission still lets one abusive (or merely long-prompt) tenant consume
+every slot, every KV block, and every prefill dispatch — degrading every
+other tenant's TTFT/ITL while staying inside the global budget. This
+module is the policy core the QoS plane shares:
+
+- **Tenant identity** — a string id extracted at the HTTP edge
+  (``x-tenant-id`` header, or an API-key map) that rides
+  ``EngineContext.tenant`` and the RPC request header end to end. No
+  header and no knobs ⇒ the default single-tenant path, which pays one
+  None-check everywhere (asserted by the tests/test_qos.py overhead
+  guard).
+- **Tenant classes** (:class:`QosPolicy`) — named weight tiers
+  (``batch:1,standard:4,premium:16`` by default) with a tenant→class map;
+  the weight scales every other budget (rate, burst, fair-queue share).
+- **Token-bucket rate limits** (:class:`TenantRateLimiter`) — per-tenant
+  request buckets; an over-rate tenant is shed with a *per-tenant*
+  ``Retry-After`` (time until its own bucket refills) instead of a global
+  hint. The tenant table is LRU-bounded so spoofed ``x-tenant-id`` floods
+  cannot grow worker memory.
+- **Weighted fair queuing** (:class:`FairQueue`) — virtual-time
+  bookkeeping (start-time fair queuing): each tenant's virtual clock
+  advances by ``cost / weight`` as its work is served; the scheduler
+  always picks the pending tenant with the *smallest* virtual time, so a
+  starved tenant (large deficit) is preferred no matter how deep a noisy
+  neighbor's backlog is.
+- **Prefill budgeting** (:func:`split_prefill_budget`) — the per-step
+  token budget (``DYN_TPU_PREFILL_BUDGET``) that chunked prefill in the
+  aggregated engine divides across prefilling lanes so long prompts raise
+  their *own* TTFT instead of spiking every decode lane's ITL.
+
+All knobs are ``DYN_TPU_TENANT_*`` env vars with the PR3 clamping
+contract (malformed/zero/negative → defaults; see
+:meth:`QosPolicy.from_env`). ``maybe_from_env()`` returns ``None`` when no
+knob is set — the hook every hot path gates on.
+
+Reference analogue: the dynamo paper's KV block manager reuse *tiers* and
+priority-aware reuse exist for exactly this shared-chip contention;
+here the same priority notion also drives admission and scheduling.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# every knob this plane reads; maybe_from_env() gates on their presence
+ENV_PREFIX = "DYN_TPU_TENANT_"
+
+# the tenant id used when QoS is enabled but a request arrives without
+# any identity (no header, no key map hit): anonymous traffic shares one
+# bucket/queue instead of bypassing QoS entirely
+DEFAULT_TENANT = "default"
+
+
+def _env_str(name: str, default: str) -> str:
+    raw = os.environ.get(name)
+    return raw if raw else default
+
+
+def _env_pos_float(name: str, default: float) -> float:
+    """Positive-float knob: unset/malformed/zero/negative → default."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def _env_nonneg_float(name: str, default: float) -> float:
+    """Non-negative float knob (0 is a meaningful 'disabled' value)."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v >= 0 else default
+
+
+def _env_pos_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def env_prefill_budget(default: int = 0) -> int:
+    """``DYN_TPU_PREFILL_BUDGET``: max prefill tokens one engine step may
+    compute across all prefilling lanes (0 = unlimited, the pre-QoS
+    behavior). Malformed/negative values clamp to the default — a bad
+    value must degrade to "no budget", never to a budget of 0 tokens
+    that would livelock every prefill."""
+    raw = os.environ.get("DYN_TPU_PREFILL_BUDGET")
+    if raw is None or raw == "":
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v >= 0 else default
+
+
+def _parse_classes(raw: str) -> "OrderedDict[str, float]":
+    """``name:weight,name:weight`` → ordered name→weight. Malformed
+    entries are skipped (one typo must not take down the whole class
+    table); non-positive weights clamp to 1."""
+    out: "OrderedDict[str, float]" = OrderedDict()
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        name = name.strip()
+        if not name:
+            continue
+        try:
+            weight = float(w) if w.strip() else 1.0
+        except ValueError:
+            weight = 1.0
+        out[name] = weight if weight > 0 else 1.0
+    return out
+
+
+def _parse_map(raw: str) -> Dict[str, str]:
+    """``key=value,key=value`` → dict; entries without ``=`` are skipped."""
+    out: Dict[str, str] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        k, _, v = part.partition("=")
+        k, v = k.strip(), v.strip()
+        if k and v:
+            out[k] = v
+    return out
+
+
+_DEFAULT_CLASSES = "batch:1,standard:4,premium:16"
+
+
+@dataclass
+class QosPolicy:
+    """The tenant-QoS knob bundle (``QosPolicy.from_env()``).
+
+    ``classes``        ordered class name → weight (scheduling share and
+                       the multiplier on rate/burst). Levels — the
+                       KV-eviction/preemption priority — are the index in
+                       this table (first class = level 0 = evicted
+                       first), so the operator's declaration order IS the
+                       preemption order.
+    ``tenant_map``     tenant id → class name; unmapped tenants get
+                       ``default_class``.
+    ``key_map``        API key (``Authorization`` bearer value) → tenant
+                       id, for edges whose callers can't set headers.
+    ``default_class``  class for unmapped tenants (clamped to a declared
+                       class; falls back to the last — highest-weight —
+                       declared class if the named one doesn't exist,
+                       so a typo'd default never zeroes everyone's
+                       priority).
+    ``rate_rps``       token-bucket refill in requests/s *per weight
+                       unit* (a weight-16 tenant refills 16× faster).
+                       0 = rate limiting disabled.
+    ``burst``          bucket capacity per weight unit.
+    ``kv_frac``        max fraction of the KV pool one tenant may hold
+                       while other tenants are active (0 = disabled).
+    ``max_tenants``    LRU bound on tracked tenants (spoofed ids must
+                       not grow memory).
+    ``unmapped``       how to treat tenant ids the operator did NOT
+                       declare (not in ``tenant_map``, not minted by the
+                       key map): ``per-id`` (default — each gets its own
+                       default-class bucket; for trusted edges behind an
+                       authenticating gateway) or ``shared`` (they all
+                       collapse into the default tenant, so rotating a
+                       spoofed ``x-tenant-id`` per request cannot mint
+                       fresh burst tokens). Any other value degrades to
+                       ``per-id``.
+    """
+
+    classes: "OrderedDict[str, float]" = field(
+        default_factory=lambda: _parse_classes(_DEFAULT_CLASSES)
+    )
+    tenant_map: Dict[str, str] = field(default_factory=dict)
+    key_map: Dict[str, str] = field(default_factory=dict)
+    default_class: str = "standard"
+    rate_rps: float = 0.0
+    burst: float = 4.0
+    kv_frac: float = 0.0
+    max_tenants: int = 1024
+    unmapped: str = "per-id"
+
+    def __post_init__(self) -> None:
+        if not self.classes:
+            self.classes = _parse_classes(_DEFAULT_CLASSES)
+        if self.default_class not in self.classes:
+            self.default_class = next(reversed(self.classes))
+        self.kv_frac = min(max(self.kv_frac, 0.0), 1.0)
+        if self.unmapped not in ("per-id", "shared"):
+            self.unmapped = "per-id"
+        # class name → (level, weight); level = declaration order
+        self._levels: Dict[str, Tuple[int, float]] = {
+            name: (i, w) for i, (name, w) in enumerate(self.classes.items())
+        }
+
+    @classmethod
+    def from_env(cls, prefix: str = ENV_PREFIX) -> "QosPolicy":
+        d = cls()
+        return cls(
+            classes=_parse_classes(
+                _env_str(prefix + "CLASSES", _DEFAULT_CLASSES)
+            ),
+            tenant_map=_parse_map(os.environ.get(prefix + "MAP", "")),
+            key_map=_parse_map(os.environ.get(prefix + "KEYS", "")),
+            default_class=_env_str(prefix + "DEFAULT_CLASS", d.default_class),
+            rate_rps=_env_nonneg_float(prefix + "RATE", d.rate_rps),
+            burst=_env_pos_float(prefix + "BURST", d.burst),
+            kv_frac=_env_nonneg_float(prefix + "KV_FRAC", d.kv_frac),
+            max_tenants=_env_pos_int(prefix + "MAX", d.max_tenants),
+            unmapped=_env_str(prefix + "UNMAPPED", d.unmapped),
+        )
+
+    def class_of(self, tenant: Optional[str]) -> Tuple[int, float]:
+        """(level, weight) for a tenant id. Unknown tenants and the
+        default tenant get ``default_class``."""
+        cname = self.tenant_map.get(tenant or "", self.default_class)
+        got = self._levels.get(cname)
+        if got is None:  # mapped to an undeclared class: use the default
+            got = self._levels[self.default_class]
+        return got
+
+    def class_name_of(self, tenant: Optional[str]) -> str:
+        cname = self.tenant_map.get(tenant or "", self.default_class)
+        return cname if cname in self._levels else self.default_class
+
+    def tenant_of_key(self, authorization: Optional[str]) -> Optional[str]:
+        """Map an ``Authorization`` header to a tenant id. Accepts the
+        bare key or the ``Bearer <key>`` form."""
+        if not authorization or not self.key_map:
+            return None
+        key = authorization.strip()
+        if key.lower().startswith("bearer "):
+            key = key[7:].strip()
+        return self.key_map.get(key)
+
+    def resolve_tenant(
+        self,
+        header_tenant: Optional[str],
+        authorization: Optional[str] = None,
+    ) -> str:
+        """Edge identity resolution. The AUTHENTICATED binding (API-key
+        map) wins over the client-supplied ``x-tenant-id`` header — a
+        caller must not be able to bill another tenant's quota by setting
+        a header its key contradicts. Undeclared header ids are kept
+        per-id (trusted edge) or collapsed into the default tenant
+        (``unmapped="shared"``: spoofed/rotating ids cannot mint fresh
+        burst tokens). Anonymous traffic is always the default tenant."""
+        tenant = self.tenant_of_key(authorization)
+        if tenant is None:
+            tenant = header_tenant
+            if (
+                tenant
+                and self.unmapped == "shared"
+                and tenant not in self.tenant_map
+            ):
+                tenant = DEFAULT_TENANT
+        return tenant or DEFAULT_TENANT
+
+
+def qos_env_set() -> bool:
+    """Any ``DYN_TPU_TENANT_*`` knob set non-empty?"""
+    return any(
+        v for k, v in os.environ.items() if k.startswith(ENV_PREFIX)
+    )
+
+
+def maybe_from_env() -> Optional[QosPolicy]:
+    """The gate every hot path uses: ``None`` (single-tenant, zero QoS
+    bookkeeping) unless at least one ``DYN_TPU_TENANT_*`` knob is set."""
+    return QosPolicy.from_env() if qos_env_set() else None
+
+
+class TokenBucket:
+    """Monotonic-clock token bucket. ``take()`` returns 0.0 when a token
+    was consumed, else the seconds until one becomes available (the
+    per-tenant ``Retry-After``)."""
+
+    __slots__ = ("rate", "capacity", "tokens", "_t")
+
+    def __init__(self, rate: float, capacity: float,
+                 now: Optional[float] = None):
+        self.rate = max(rate, 1e-9)
+        self.capacity = max(capacity, 1.0)
+        self.tokens = self.capacity
+        self._t = time.monotonic() if now is None else now
+
+    def take(self, now: Optional[float] = None, cost: float = 1.0) -> float:
+        now = time.monotonic() if now is None else now
+        if now > self._t:
+            self.tokens = min(
+                self.capacity, self.tokens + (now - self._t) * self.rate
+            )
+        self._t = now
+        if self.tokens >= cost:
+            self.tokens -= cost
+            return 0.0
+        return (cost - self.tokens) / self.rate
+
+
+class TenantRateLimiter:
+    """Per-tenant token buckets + admit/shed counters, LRU-bounded.
+
+    Thread-safe (the HTTP edge and the RPC accept loop are async, but the
+    engine publishes stats from its own thread). Buckets refill at
+    ``rate_rps × class weight`` and hold ``burst × weight`` tokens, so a
+    premium tenant's burst headroom scales with its share.
+    """
+
+    def __init__(self, policy: QosPolicy,
+                 clock: Callable[[], float] = time.monotonic):
+        self.policy = policy
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._buckets: "OrderedDict[str, TokenBucket]" = OrderedDict()
+        # tenant → [admitted, rate_limited] cumulative counters (telemetry)
+        self._stats: "OrderedDict[str, List[int]]" = OrderedDict()
+
+    def take(self, tenant: Optional[str]) -> float:
+        """0.0 = admitted; >0 = shed, value is the tenant's retry-after
+        in seconds."""
+        t = tenant or DEFAULT_TENANT
+        now = self.clock()
+        with self._lock:
+            bucket = self._buckets.get(t)
+            if bucket is None:
+                _, weight = self.policy.class_of(t)
+                bucket = TokenBucket(
+                    self.policy.rate_rps * weight,
+                    self.policy.burst * weight,
+                    now=now,
+                )
+                self._buckets[t] = bucket
+                while len(self._buckets) > self.policy.max_tenants:
+                    self._buckets.popitem(last=False)
+            else:
+                self._buckets.move_to_end(t)
+            wait = bucket.take(now)
+            st = self._stats.get(t)
+            if st is None:
+                st = self._stats[t] = [0, 0]
+                while len(self._stats) > self.policy.max_tenants:
+                    self._stats.popitem(last=False)
+            else:
+                # true LRU like the bucket table: under tenant-id churn
+                # the entry evicted must be the stalest, never a live
+                # long-lived tenant's cumulative counters (whose reset
+                # would run dynamo_tenant_*_total backwards)
+                self._stats.move_to_end(t)
+            st[0 if wait == 0.0 else 1] += 1
+            return wait
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        """{tenant: {"admitted": n, "rate_limited": n}} (cumulative)."""
+        with self._lock:
+            return {
+                t: {"admitted": s[0], "rate_limited": s[1]}
+                for t, s in self._stats.items()
+            }
+
+
+class FairQueue:
+    """Weighted virtual-time fairness bookkeeping (start-time fair
+    queuing, minus the per-packet finish tags — request costs here are
+    only known as they stream).
+
+    Each tenant carries a virtual time that advances by ``cost/weight``
+    as its work is served. :meth:`pick` returns the candidate whose
+    tenant has the smallest virtual time — the most-starved tenant by
+    weighted share. A newly-seen (or long-idle) tenant's clock is lifted
+    to the current minimum so it gets its fair share *from now on*
+    rather than an unbounded credit for history it slept through; equal
+    virtual times break toward the tenant with the least total service
+    (so a newcomer joining at the floor still beats a backlog owner),
+    then FIFO. The table is hard-bounded at ``max_tenants`` — a busy
+    engine fed rotating spoofed tenant ids must not grow memory; past
+    the cap the MOST-served clock is dropped (it rejoins at the floor if
+    that tenant returns, a bounded fairness distortion). Engine-thread
+    only (no locking).
+    """
+
+    __slots__ = ("_vt", "_served", "max_tenants")
+
+    def __init__(self, max_tenants: int = 1024) -> None:
+        self._vt: Dict[str, float] = {}
+        self._served: Dict[str, float] = {}
+        self.max_tenants = max(int(max_tenants), 1)
+
+    def _floor(self) -> float:
+        return min(self._vt.values()) if self._vt else 0.0
+
+    def touch(self, tenant: str) -> None:
+        if tenant not in self._vt:
+            if len(self._vt) >= self.max_tenants:
+                drop = max(self._vt, key=lambda t: (self._vt[t], t))
+                del self._vt[drop]
+                self._served.pop(drop, None)
+            self._vt[tenant] = self._floor()
+            self._served.setdefault(tenant, 0.0)
+
+    def charge(self, tenant: str, cost: float, weight: float) -> None:
+        self.touch(tenant)
+        self._vt[tenant] += cost / max(weight, 1e-9)
+        self._served[tenant] += cost
+
+    def pick(self, tenants: Sequence[str]) -> int:
+        """Index of the candidate whose tenant is most starved."""
+        best_i = 0
+        best_key = None
+        for i, t in enumerate(tenants):
+            self.touch(t)
+            key = (self._vt[t], self._served[t])
+            if best_key is None or key < best_key:
+                best_i, best_key = i, key
+        return best_i
+
+    def vt(self, tenant: str) -> float:
+        """Current virtual time of a tenant (registering it if new)."""
+        self.touch(tenant)
+        return self._vt[tenant]
+
+    def virtual_times(self) -> Dict[str, float]:
+        return dict(self._vt)
+
+    def forget_absent(self, live: Sequence[str]) -> None:
+        """Drop clocks of tenants with no live work (bounded memory on
+        tenant churn); survivors keep their relative positions."""
+        keep = set(live)
+        self._vt = {t: v for t, v in self._vt.items() if t in keep}
+        self._served = {t: v for t, v in self._served.items() if t in keep}
+
+
+def split_prefill_budget(
+    remaining: Sequence[int], chunk: int, budget: int
+) -> List[int]:
+    """Divide a per-step prefill token budget across prefilling lanes.
+
+    ``remaining[i]`` = prompt tokens lane *i* still needs; lanes are
+    given in scheduling-priority order (most-starved tenant first — the
+    caller sorts). Returns per-lane allowances. ``budget <= 0`` means
+    unlimited (every lane gets up to a full chunk — the pre-QoS
+    behavior). The first lane is always allowed at least one token so a
+    budget smaller than one lane's need can never livelock prefill; a
+    lane may receive 0 (it simply doesn't advance this step)."""
+    if budget <= 0:
+        return [min(chunk, max(r, 0)) for r in remaining]
+    allow: List[int] = []
+    left = budget
+    for i, r in enumerate(remaining):
+        n = min(chunk, max(r, 0), max(left, 0))
+        if i == 0 and r > 0:
+            n = max(n, 1)  # progress guarantee: prefill can never livelock
+        allow.append(n)
+        left -= n
+    return allow

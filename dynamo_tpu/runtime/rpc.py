@@ -311,7 +311,9 @@ class RpcServer:
                                             "load": self.load_snapshot().to_wire(),
                                             }).encode(), b""))
                         continue
-                    shed = self.admission.try_admit(len(self._inflight))
+                    shed = self.admission.try_admit(
+                        len(self._inflight), tenant=h.get("tenant")
+                    )
                     if shed is not None:
                         self.requests_total += 1  # see draining note above
                         # bounded degradation: answer NOW with a typed,
@@ -319,20 +321,27 @@ class RpcServer:
                         # queueing the request toward a timeout. The gate's
                         # own snapshot rides the reply — no second engine
                         # probe at the worker's busiest moment.
-                        _record_shed_span(h, "overloaded",
-                                          queue_depth=shed.queue_depth)
+                        _record_shed_span(
+                            h, "overloaded", queue_depth=shed.queue_depth,
+                            **({"tenant": shed.tenant} if shed.tenant else {}),
+                        )
                         load = shed.load or self.load_snapshot()
                         load.draining = self._draining
+                        reply = {"id": h["id"], "op": "error",
+                                 "message": str(shed),
+                                 "code": "overloaded",
+                                 "retryable": True,
+                                 "queue_depth": shed.queue_depth,
+                                 "retry_after_ms": shed.retry_after_ms,
+                                 "load": load.to_wire()}
+                        if shed.tenant:
+                            # per-tenant rate shed: the retry hint is THIS
+                            # tenant's bucket refill — failover to a
+                            # sibling would just drain its bucket there
+                            reply["tenant"] = shed.tenant
                         async with write_lock:
                             await write_frame(writer, TwoPartMessage(
-                                json.dumps({"id": h["id"], "op": "error",
-                                            "message": str(shed),
-                                            "code": "overloaded",
-                                            "retryable": True,
-                                            "queue_depth": shed.queue_depth,
-                                            "retry_after_ms": shed.retry_after_ms,
-                                            "load": load.to_wire(),
-                                            }).encode(), b""))
+                                json.dumps(reply).encode(), b""))
                         continue
                     track = RequestTrack(h["id"])
                     task = asyncio.create_task(
@@ -566,6 +575,13 @@ class RpcServer:
                 ctx = Context(payload, request_id=h.get("request_id"))
                 # the engine parents its queue/prefill/decode spans here
                 ctx.context.trace = span
+                tenant = h.get("tenant")
+                if tenant:
+                    # QoS identity rides the context into the engine's
+                    # fair scheduler / KV budgets (runtime/qos.py)
+                    ctx.context.tenant = str(tenant)
+                    if span is not None:
+                        span.set_attribute("tenant", str(tenant))
                 contexts[req_id] = ctx
                 track.ctx = ctx
                 stream = engine.generate(ctx)
@@ -750,6 +766,7 @@ class RpcClient:
                         "retryable": bool(h.get("retryable")),
                         "queue_depth": h.get("queue_depth"),
                         "retry_after_ms": h.get("retry_after_ms"),
+                        "tenant": h.get("tenant"),
                     })
                 else:
                     continue
@@ -914,6 +931,9 @@ class RpcClient:
         header = {"id": req_id, "op": "generate", "endpoint": endpoint}
         if context is not None:
             header["request_id"] = context.id
+            tenant = getattr(context.context, "tenant", None)
+            if tenant:
+                header["tenant"] = tenant
         if tracing.enabled():
             # propagate the caller's trace context: the Context's carrier
             # wins (set by the edge/router), contextvar as fallback
@@ -988,6 +1008,7 @@ class RpcClient:
                                 msg,
                                 queue_depth=int(info.get("queue_depth") or 0),
                                 retry_after_ms=int(info.get("retry_after_ms") or 0),
+                                tenant=info.get("tenant"),
                             )
                         if info.get("retryable"):
                             raise RetryableRpcError(msg)

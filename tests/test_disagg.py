@@ -103,7 +103,20 @@ def test_disagg_round_trip_matches_local(params, run):
             register_local=False,
         )
 
-        # prefill worker on its own engine instance
+        # prefill worker on its own engine instance; subscribe to the
+        # metrics stream first — the worker must publish role-tagged
+        # ForwardPassMetrics like any decode worker (the planner's
+        # per-pool breakdown is fed by REAL prefill workers, not just
+        # mock fleets)
+        from dynamo_tpu.runtime.distributed import (
+            KV_METRICS_SUBJECT,
+            resubscribe_forever,
+        )
+
+        published: list = []
+        sub_task = asyncio.create_task(resubscribe_forever(
+            rt.namespace("dz"), KV_METRICS_SUBJECT, published.append
+        ))
         pre_engine = PrefillEngine(CFG, params, max_model_len=128, block_size=BLOCK)
         worker_task = asyncio.create_task(run_prefill_worker(rt, "dz", pre_engine))
 
@@ -113,7 +126,25 @@ def test_disagg_round_trip_matches_local(params, run):
             # the request really went remote
             m = decode.metrics_snapshot()
             assert decode.total_requests == 1
+            # role-tagged prefill metrics arrive within ~2 publish ticks
+            deadline = asyncio.get_running_loop().time() + 5.0
+            roles = set()
+            while asyncio.get_running_loop().time() < deadline:
+                roles = {
+                    d["metrics"].get("role") for d in published
+                    if isinstance(d, dict) and "metrics" in d
+                }
+                if "prefill" in roles:
+                    break
+                await asyncio.sleep(0.1)
+            assert "prefill" in roles, f"no prefill metrics (saw {roles})"
+            pre_metrics = [
+                d["metrics"] for d in published
+                if d.get("metrics", {}).get("role") == "prefill"
+            ]
+            assert pre_metrics[-1]["request_total_slots"] >= 1
         finally:
+            sub_task.cancel()
             worker_task.cancel()
             decode.close()
             await rt.shutdown()
