@@ -933,6 +933,137 @@ def bench_qos() -> dict:
     }
 
 
+def bench_resilience() -> dict:
+    """Mid-stream resume overhead (docs/resilience.md §Mid-stream resume;
+    no TPU — deterministic token engines over the real statestore + RPC +
+    EndpointClient planes). Two legs at identical load: a control with no
+    failures, and a kill leg where a fixed share of live streams is cut
+    after 10 items (the `cut` fault = worker death mid-decode). Reports
+    the resume rate and what recovery costs the caller: the added ITL gap
+    p95, and the p95 of the worst per-stream gap (the resume pause
+    itself). BENCH_RESUME=0 skips."""
+    import asyncio
+
+    import numpy as np
+
+    from dynamo_tpu.runtime import faults as faults_mod
+    from dynamo_tpu.runtime import resilience
+    from dynamo_tpu.runtime.annotated import Annotated
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.engine import AsyncEngine, Context
+    from dynamo_tpu.runtime.faults import FaultInjector, FaultRule
+    from dynamo_tpu.runtime.resilience import ResiliencePolicy
+    from dynamo_tpu.runtime.statestore import StateStoreServer
+
+    n_requests = int(os.environ.get("BENCH_RESUME_REQUESTS", "24"))
+    gen_tokens = int(os.environ.get("BENCH_RESUME_TOKENS", "40"))
+    kills = int(os.environ.get("BENCH_RESUME_KILLS", "6"))
+    token_delay = 0.002
+
+    class TokenEngine(AsyncEngine):
+        async def generate(self, request: Context):
+            req = request.data
+            toks = list(req["token_ids"])
+            for _ in range(int(req["stop_conditions"]["max_tokens"])):
+                if request.context.is_stopped:
+                    return
+                toks.append((toks[-1] * 31 + len(toks) * 7 + 13) % 50021)
+                yield Annotated.from_data({"token_ids": [toks[-1]]})
+                await asyncio.sleep(token_delay)
+            yield Annotated.from_data(
+                {"token_ids": [], "finish_reason": "length"}
+            )
+
+    async def leg(kill: bool) -> dict:
+        resilience.reset_resume_counters()
+        ss = StateStoreServer(port=0)
+        await ss.start()
+        rts = []
+        for _ in range(3):
+            rt = await DistributedRuntime.create(ss.url, "127.0.0.1:1")
+            await rt.namespace("bres").component("w").endpoint("gen").serve(
+                TokenEngine()
+            )
+            rts.append(rt)
+        fe = await DistributedRuntime.create(ss.url, "127.0.0.1:1")
+        client = await fe.namespace("bres").component("w").endpoint(
+            "gen"
+        ).client("round_robin", policy=ResiliencePolicy(
+            request_timeout=60.0, connect_timeout=2.0, max_attempts=4,
+            backoff_base=0.01, backoff_max=0.05, resume_attempts=2, seed=3,
+        ))
+        await client.wait_for_instances(3, timeout=10)
+        gaps: list = []
+        stream_max_gap: list = []
+
+        async def one(i: int) -> None:
+            ctx = Context({
+                "token_ids": [11 + i, 17 + 2 * i],
+                "stop_conditions": {"max_tokens": gen_tokens},
+                "sampling_options": {"temperature": 0.0},
+            })
+            last = None
+            worst = 0.0
+            async for item in client.generate(ctx):
+                if item.is_error:
+                    raise RuntimeError(item.error_message())
+                now = time.perf_counter()
+                if last is not None:
+                    gap = now - last
+                    gaps.append(gap)
+                    worst = max(worst, gap)
+                last = now
+            stream_max_gap.append(worst)
+
+        inj = None
+        if kill:
+            inj = FaultInjector([FaultRule(
+                plane="rpc", point="item", action="cut", after_ops=10,
+                max_fires=kills,
+            )])
+            faults_mod.install(inj)
+        try:
+            t0 = time.perf_counter()
+            await asyncio.gather(*[one(i) for i in range(n_requests)])
+            wall = time.perf_counter() - t0
+        finally:
+            if inj is not None:
+                faults_mod.uninstall()
+            await client.close()
+            for rt in rts + [fe]:
+                await rt.shutdown()
+            await ss.stop()
+        arr = np.asarray(gaps) * 1e3
+        return {
+            "wall_s": round(wall, 3),
+            "itl_p50_ms": round(float(np.percentile(arr, 50)), 3),
+            "itl_p95_ms": round(float(np.percentile(arr, 95)), 3),
+            "worst_gap_p95_ms": round(
+                float(np.percentile(np.asarray(stream_max_gap) * 1e3, 95)), 3
+            ),
+            "resumes": client.stats["resumes"],
+            "resume_failures": client.stats["resume_failures"],
+        }
+
+    control = asyncio.run(leg(kill=False))
+    killed = asyncio.run(leg(kill=True))
+    return {
+        "scenario": (
+            f"{n_requests} concurrent streams x {gen_tokens} tokens on 3 "
+            f"workers; kill leg cuts {kills} live streams after 10 items"
+        ),
+        "control": control,
+        "kill": killed,
+        "resume_rate": round(killed["resumes"] / n_requests, 4),
+        "added_itl_p95_ms": round(
+            killed["itl_p95_ms"] - control["itl_p95_ms"], 3
+        ),
+        "added_worst_gap_p95_ms": round(
+            killed["worst_gap_p95_ms"] - control["worst_gap_p95_ms"], 3
+        ),
+    }
+
+
 def main() -> None:
     from dynamo_tpu.engine_jax.compile_cache import enable_compile_cache
 
@@ -1168,6 +1299,11 @@ def main() -> None:
             out["qos"] = bench_qos()
         except Exception as e:
             out["qos"] = {"error": str(e)[:200]}
+    if os.environ.get("BENCH_RESUME", "1") == "1":
+        try:
+            out["resilience"] = bench_resilience()
+        except Exception as e:
+            out["resilience"] = {"error": str(e)[:200]}
     # LAST: pays minutes of first-boot remote compilation on the tunneled
     # runtime — must not eat the other sections' budget if it times out
     if os.environ.get("BENCH_MODEL_8B", "1") == "1":

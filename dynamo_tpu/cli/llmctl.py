@@ -28,8 +28,10 @@ and the stall/reap counters (docs/health.md has the stuck-worker runbook).
 
 ``tenant status`` renders the per-tenant QoS rollup (class, slot/KV
 occupancy, admitted vs rate-limited counts) from the same aggregator; it
-exits 2 while any tenant is throttled at a sustained 100% shed share — a
-runaway client or a misconfigured quota, caught by cron like an SLO page
+exits 2 while any tenant is *currently* throttled at 100% shed share over
+the aggregator's fast window (the rollup's ``shed_share`` is windowed, so
+a long-past abuse episode clears once the throttling stops) — a runaway
+client or a misconfigured quota, caught by cron like an SLO page
 (docs/qos.md has the runbook).
 
 ``planner status`` dials the planner component (``components/planner.py``)
@@ -381,9 +383,10 @@ async def _telemetry_cmd(args, store) -> int:
         for model, e in sorted((roll.get("models") or {}).items()):
             for tenant, te in sorted((e.get("tenants") or {}).items()):
                 rows.append(dict(te, model=model, tenant=tenant))
-        # "sustained 100% throttle": every request the tenant ever offered
-        # was rate-shed — a misconfigured quota or a runaway client; make
-        # it cron-visible like an SLO page
+        # "currently throttled at 100%": every request the tenant offered
+        # inside the aggregator's window was rate-shed (shed_share is
+        # WINDOWED — history that stopped does not page) — a misconfigured
+        # quota or a runaway client; make it cron-visible like an SLO page
         throttled = [
             r for r in rows
             if r.get("rate_limited_total", 0) > 0

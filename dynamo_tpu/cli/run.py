@@ -12,6 +12,12 @@ Output engines:
   out=jax            the JAX TPU engine (requires --model-path)
   out=dyn://ns.comp.ep  forward to a remote distributed endpoint
 
+``--wire token`` moves preprocessing to the frontend: workers serve the
+CORE token engine and PreprocessedRequest token streams cross the RPC
+wire, which is what enables mid-stream resume (a worker dying mid-decode
+is re-admitted on a sibling — docs/resilience.md §Mid-stream resume) and
+KV-prefix routing over real token ids. Both sides must pass the flag.
+
 Reference parity: launch/dynamo-run (main.rs:220, lib.rs:84-494, opt.rs, flags.rs).
 """
 
@@ -120,6 +126,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--bus", default=None, help="message bus url for distributed mode")
     p.add_argument("--wait-workers-timeout", type=float, default=60.0)
     p.add_argument("--extra-engine-args", default=None, help="JSON file of engine kwargs")
+    p.add_argument(
+        "--wire", choices=["openai", "token"], default="openai",
+        help="RPC payload level between frontend and workers: 'openai' "
+             "(worker-side preprocessing, default) or 'token' (the frontend "
+             "preprocesses and PreprocessedRequest token streams cross the "
+             "wire — KV-prefix routing sees real token ids, and a worker "
+             "dying mid-decode is absorbed by mid-stream resume, "
+             "docs/resilience.md). Both sides of a deployment must agree.")
     p.add_argument("--disagg", choices=["none", "decode"], default="none",
                    help="decode: enqueue long prefills to remote prefill workers")
     p.add_argument("--max-local-prefill-length", type=int, default=1000)
@@ -157,6 +171,24 @@ class DispatchEngine:
             request = request.transfer(model.model_validate(data))
         engine = self._chat if is_chat else self._completions
         return engine.generate(request)
+
+
+class _TokenWireEngine:
+    """Parse PreprocessedRequest wire dicts for token-level cores that
+    expect the typed request (``--wire token`` workers; the JAX engine
+    parses dicts itself and is served directly)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def generate(self, request):
+        from ..llm.protocols.common import PreprocessedRequest
+
+        if isinstance(request.data, dict):
+            request = request.transfer(
+                PreprocessedRequest.from_dict(request.data)
+            )
+        return self._inner.generate(request)
 
 
 def _token_pipelines(card: ModelDeploymentCard, make_core):
@@ -242,6 +274,11 @@ def build_engine(out_spec: str, flags: argparse.Namespace):
     if out_spec == "echo_core":
         if card is None:
             raise SystemExit("out=echo_core requires --model-path (tokenizer needed)")
+        if getattr(flags, "wire", "openai") == "token":
+            # token-wire drills without a real model: serve the core echo
+            # engine directly (same contract as out=jax --wire token)
+            core = _TokenWireEngine(EchoEngineCore())
+            return core, core, model_name, None
         chat_eng, comp_eng = _token_pipelines(card, EchoEngineCore)
         return chat_eng, comp_eng, model_name, None
 
@@ -272,6 +309,12 @@ def build_engine(out_spec: str, flags: argparse.Namespace):
             **extra,
         )
         core.warmup()  # compile the step functions off the request path
+        if getattr(flags, "wire", "openai") == "token":
+            # token wire: the CORE engine serves the endpoint directly
+            # (PreprocessedRequest dicts in, LLMEngineOutput dicts out);
+            # the frontend runs the preprocessor/detokenizer around its
+            # remote client (out=dyn:// --wire token --model-path)
+            return core, core, model_name, core
         chat_eng, comp_eng = _token_pipelines(card, lambda: core)
         return chat_eng, comp_eng, model_name, core
 
@@ -457,7 +500,13 @@ async def run_endpoint(chat_engine, completions_engine, model_name: str, in_spec
         parse_endpoint_path,
     )
 
-    engine = DispatchEngine(chat_engine, completions_engine)
+    wire = getattr(flags, "wire", "openai")
+    # token wire: the endpoint speaks PreprocessedRequest dicts directly
+    # (no OpenAI shape dispatch — the frontend already lowered the request)
+    engine = (
+        chat_engine if wire == "token"
+        else DispatchEngine(chat_engine, completions_engine)
+    )
     ns, comp, ep = parse_endpoint_path(in_spec)
     drt = await DistributedRuntime.create(
         statestore_url=flags.statestore, bus_url=flags.bus
@@ -465,9 +514,12 @@ async def run_endpoint(chat_engine, completions_engine, model_name: str, in_spec
     component = drt.namespace(ns).component(comp)
     await component.create_service()
     endpoint = component.endpoint(ep)
-    info = await endpoint.serve(
-        engine, model_entry={"name": model_name, "kinds": ["chat", "completions"]}
-    )
+    model_entry = {"name": model_name, "kinds": ["chat", "completions"]}
+    if wire != "openai":
+        # advertised so raw-dict frontends (out=discover) skip this worker
+        # instead of feeding it OpenAI dicts it cannot parse
+        model_entry["wire"] = wire
+    info = await endpoint.serve(engine, model_entry=model_entry)
     if core_engine is not None and hasattr(core_engine, "metrics_snapshot"):
         from ..runtime.distributed import serve_stats_endpoint
 
@@ -570,8 +622,24 @@ async def amain(argv: list[str]) -> None:
         return
     if out_spec.startswith("dyn://"):
         client, _drt = await build_remote_client(out_spec, flags)
-        chat_engine = completions_engine = client
-        model_name = flags.model_name or out_spec
+        if flags.wire == "token":
+            # frontend-side preprocessing: OpenAI → PreprocessedRequest →
+            # remote token engine → detokenize. Token ids cross the wire,
+            # so the routing client can journal them — a worker dying
+            # mid-decode resumes on a sibling (docs/resilience.md)
+            if not flags.model_path:
+                raise SystemExit(
+                    "--wire token requires --model-path (the frontend "
+                    "tokenizes; workers serve the core engine)"
+                )
+            card = _load_card(flags)
+            chat_engine, completions_engine = _token_pipelines(
+                card, lambda: client
+            )
+            model_name = flags.model_name or card.display_name
+        else:
+            chat_engine = completions_engine = client
+            model_name = flags.model_name or out_spec
     else:
         chat_engine, completions_engine, model_name, core_engine = build_engine(out_spec, flags)
 

@@ -66,6 +66,10 @@ GAUGES = [
     # request outcome counters (cumulative; the cluster SLO engine diffs)
     ("requests_total", "Requests served by the RPC plane (cumulative)"),
     ("requests_errored", "Requests finished in error (cumulative)"),
+    # mid-stream resume (docs/resilience.md): recoveries this process made
+    # and resumable streams that still died in-band (cumulative)
+    ("resume_total", "Streams resumed on another worker mid-decode (cumulative)"),
+    ("resume_failed_total", "Resumable streams that still failed in-band (cumulative)"),
 ]
 
 # health_state is a string on the wire; Prometheus wants a number. Unknown

@@ -100,6 +100,8 @@ class MockWorkerStats:
         kv_quantized: bool = False,
         role: str = "decode",
         tenants: Optional[Dict[str, int]] = None,
+        resume_total: int = 0,
+        resume_failed: int = 0,
     ):
         from dynamo_tpu.runtime.tracing import PHASE_BUCKETS
 
@@ -130,6 +132,11 @@ class MockWorkerStats:
         self.kv_quantized = bool(kv_quantized)
         self.spec_drafted = 0
         self.spec_accepted = 0
+        # mid-stream resume drill (docs/resilience.md): report nonzero
+        # recovery counters so the dynamo_*_resume_* gauges and the cluster
+        # rollup's resume sums can be exercised without killing workers
+        self.resume_total = max(int(resume_total), 0)
+        self.resume_failed = max(int(resume_failed), 0)
         # multi-tenant QoS drill (docs/qos.md): tenant → per-tick request
         # share. Each tick splits its requests across tenants by share and
         # grows per-tenant counters + occupancy splits, so aggregator /
@@ -296,6 +303,8 @@ class MockWorkerStats:
             spec_drafted_tokens=self.spec_drafted,
             spec_accepted_tokens=self.spec_accepted,
             kv_quantized=int(self.kv_quantized),
+            resume_total=self.resume_total,
+            resume_failed_total=self.resume_failed,
             uptime_s=round(time.monotonic() - self.started, 3),
             model=model,
             role=self.role,
@@ -351,6 +360,8 @@ async def run_mock_worker(
     role: str = "decode",
     profile: Optional[LoadProfile] = None,
     tenants: Optional[Dict[str, int]] = None,
+    resume_total: int = 0,
+    resume_failed: int = 0,
 ) -> None:
     from dynamo_tpu.runtime.distributed import KV_METRICS_SUBJECT
 
@@ -360,6 +371,7 @@ async def run_mock_worker(
         seed=hash(wid) & 0xFFFF, ttft_ms=ttft_ms, itl_ms=itl_ms,
         spec_accept_rate=spec_accept_rate, kv_quantized=kv_quantized,
         role=role, tenants=tenants,
+        resume_total=resume_total, resume_failed=resume_failed,
     )
     tick_no = 0
     while True:
@@ -409,6 +421,12 @@ def main() -> None:
                         "crawler:0' — share 0 models a fully rate-limited "
                         "abuser (drills llmctl tenant status / the "
                         "dynamo_tenant_* gauges without chips)")
+    p.add_argument("--resume-total", type=int, default=0,
+                   help="report N mid-stream resumes (drills the "
+                        "dynamo_*_resume_total gauges without killing "
+                        "workers)")
+    p.add_argument("--resume-failed", type=int, default=0,
+                   help="report N failed resume recoveries")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
     profile = (
@@ -430,6 +448,8 @@ def main() -> None:
             kv_quantized=args.kv_quantized,
             role=args.role, profile=profile,
             tenants=parse_tenant_shares(args.tenants),
+            resume_total=args.resume_total,
+            resume_failed=args.resume_failed,
         )
 
     asyncio.run(run())

@@ -66,6 +66,9 @@ CLUSTER_GAUGES = [
     ("spec_drafted_tokens", "Draft tokens handed to verify dispatches (fleet sum)"),
     ("spec_accepted_tokens", "Draft tokens accepted (fleet sum)"),
     ("spec_accept_rate", "Fleet speculative acceptance rate (accepted/drafted)"),
+    # mid-stream resume (docs/resilience.md): fleet recovery counters
+    ("resume_total", "Streams resumed on another worker mid-decode (fleet sum)"),
+    ("resume_failed_total", "Resumable streams that still failed in-band (fleet sum)"),
     ("worst_worker_load", "Highest per-worker load score"),
     ("median_worker_load", "Median per-worker load score"),
 ]
@@ -79,7 +82,8 @@ TENANT_GAUGES = [
     ("kv_blocks", "KV pool blocks this tenant holds (fleet sum)"),
     ("admitted_total", "Requests admitted past the tenant rate gate (cumulative)"),
     ("rate_limited_total", "Requests shed by the tenant rate gate (cumulative)"),
-    ("shed_share", "rate_limited / (admitted + rate_limited), cumulative"),
+    ("shed_share", "rate_limited / offered over the fast window (current throttling)"),
+    ("shed_share_cumulative", "rate_limited / offered since worker start"),
 ]
 
 
@@ -140,8 +144,22 @@ class ClusterTelemetry:
             MetricStore(self.policy, clock=clock),
             latency_bounds=_phase_bounds_ms(),
         )
+        # per-tenant rate-gate outcomes as WINDOWED counters (docs/qos.md):
+        # the rollup's shed_share reads the fast window from these, so
+        # `llmctl tenant status` exit-2 reflects *current* throttling —
+        # a tenant abused an hour ago but quiet now must read 0, not its
+        # lifetime average
+        from dynamo_tpu.runtime.telemetry import COUNTER
+
+        self.store.declare("tenant_admitted", COUNTER)
+        self.store.declare("tenant_rate_limited", COUNTER)
         self.slo_engine = SloEngine(self.store, self.policy, clock=clock)
         self._workers: Dict[str, _WorkerView] = {}
+        # (model, tenant) pairs with at least one post-baseline diff: until
+        # then the windowed series has seen nothing and the cumulative
+        # share is the only honest answer (a brand-new aggregator meeting
+        # an old fleet must not report every tenant as unthrottled)
+        self._tenant_windowed: set = set()
 
     # -- ingest --------------------------------------------------------------
 
@@ -165,6 +183,46 @@ class ClusterTelemetry:
 
         self._ingest_phases(view, metrics, model, now)
         self._ingest_counters(view, metrics, model, now)
+        self._ingest_tenants(view, metrics, model, now)
+
+    def _ingest_tenants(
+        self, view: _WorkerView, metrics: ForwardPassMetrics,
+        model: str, now: float,
+    ) -> None:
+        """Diff each worker's cumulative per-tenant rate-gate counters into
+        windowed series (same baseline/restart discipline as
+        :meth:`_ingest_counters`): the rollup's *current* shed share reads
+        these instead of lifetime totals."""
+        wt = getattr(metrics, "tenants", None)
+        if not isinstance(wt, dict):
+            return
+        for tname, tview in wt.items():
+            if not isinstance(tview, dict):
+                continue
+            for src, series_name in (
+                ("admitted", "tenant_admitted"),
+                ("rate_limited", "tenant_rate_limited"),
+            ):
+                try:
+                    cur = float(tview.get(src, 0) or 0)
+                except (TypeError, ValueError):
+                    continue
+                key = f"tenant:{tname}:{src}"
+                prev = view.counters.get(key)
+                if prev is None:
+                    view.counters[key] = cur
+                    continue
+                if cur < prev:  # worker restart: fresh counters = new events
+                    prev = 0.0
+                d = cur - prev
+                if d > 0:
+                    self.store.series(
+                        series_name, model=model, tenant=str(tname)
+                    ).inc(d, now)
+                view.counters[key] = cur
+                # a second sighting — even a zero delta — means the window
+                # is live for this tenant: quiet IS "not currently throttled"
+                self._tenant_windowed.add((model, str(tname)))
 
     def _ingest_phases(
         self, view: _WorkerView, metrics: ForwardPassMetrics,
@@ -291,6 +349,7 @@ class ClusterTelemetry:
                 "decode_tokens_per_s": 0.0,
                 "spec_drafted_tokens": 0, "spec_accepted_tokens": 0,
                 "spec_accept_rate": 0.0,
+                "resume_total": 0, "resume_failed_total": 0,
                 "pools": {},
                 "tenants": {},
                 "unhealthy_worker_ids": [],
@@ -328,6 +387,12 @@ class ClusterTelemetry:
             )
             entry["spec_accepted_tokens"] += int(
                 getattr(m, "spec_accepted_tokens", 0) or 0
+            )
+            # mid-stream resume: fleet recovery counters (cumulative sums —
+            # like the spec counters, rates come from diffing scrapes)
+            entry["resume_total"] += int(getattr(m, "resume_total", 0) or 0)
+            entry["resume_failed_total"] += int(
+                getattr(m, "resume_failed_total", 0) or 0
             )
             # pool-role breakdown: what the planner actually resizes
             role = getattr(m, "role", "") or "decode"
@@ -409,14 +474,36 @@ class ClusterTelemetry:
                     entry["spec_accepted_tokens"] / entry["spec_drafted_tokens"],
                     4,
                 )
-            for te in entry["tenants"].values():
+        # tenant shed share is computed per model AFTER the worker sweep so
+        # the windowed query runs once per (model, tenant), not per worker
+        window = self.policy.fast_window
+        for model, entry in models.items():
+            for tname, te in entry["tenants"].items():
                 seen = te["admitted_total"] + te["rate_limited_total"]
-                # cumulative throttle share: 1.0 = every request this
-                # tenant ever offered was rate-shed (llmctl tenant status
-                # exits 2 on a sustained-100% tenant)
-                te["shed_share"] = round(
+                # lifetime share kept for dashboards/history...
+                te["shed_share_cumulative"] = round(
                     te["rate_limited_total"] / seen, 4
                 ) if seen else 0.0
+                # ...but `shed_share` — what llmctl tenant status exit-2
+                # keys on — is the FAST-WINDOW share: a tenant throttled an
+                # hour ago and quiet now reads 0.0, a tenant being
+                # throttled right now reads ~1.0. Until the first
+                # post-baseline diff the cumulative share stands in (a new
+                # aggregator has no window yet).
+                if (model, tname) in self._tenant_windowed:
+                    lim = self.store.series(
+                        "tenant_rate_limited", model=model, tenant=tname
+                    ).window_sum(window)
+                    adm = self.store.series(
+                        "tenant_admitted", model=model, tenant=tname
+                    ).window_sum(window)
+                    offered = adm + lim
+                    te["shed_share"] = round(
+                        lim / offered, 4
+                    ) if offered else 0.0
+                    te["shed_share_window_s"] = round(window, 3)
+                else:
+                    te["shed_share"] = te["shed_share_cumulative"]
         worst = max(scores, key=lambda t: t[1]) if scores else None
         med = (
             round(statistics.median(s for _, s in scores), 4) if scores else None

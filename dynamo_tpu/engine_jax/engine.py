@@ -243,7 +243,7 @@ class _Seq:
         "first_token_t", "admit_t", "remote", "remote_deadline", "prefill_pos",
         "freq_pen", "pres_pen", "out_tokens", "joined_inflight", "wait_hash",
         "drafter", "spec_drafted", "spec_accepted", "tenant", "level",
-        "weight",
+        "weight", "resumed",
     )
 
     def __init__(self, ctx: Context, request: PreprocessedRequest, loop) -> None:
@@ -272,6 +272,24 @@ class _Seq:
         # all output tokens ever emitted — unlike `generated`, survives
         # preemption; rebuilds the device penalty-count row on re-admission
         self.out_tokens: List[int] = []
+        # mid-stream resume (runtime/resilience.StreamJournal wire marker):
+        # token_ids[prompt_len:] are ANOTHER worker's already-emitted output
+        # riding in as prompt. Pre-seeding out_tokens hands them to the same
+        # _sync_counts rebuild that preemption uses, so frequency/presence
+        # penalties continue exactly where the dead stream left off —
+        # identical machinery, zero new device code. Positions/KV treat the
+        # full token_ids as prompt (that IS the recompute; the prefix cache
+        # and host tier soften it like any preemption recompute).
+        self.resumed = False
+        res = getattr(request, "resume", None)
+        if isinstance(res, dict):
+            try:
+                plen = int(res.get("prompt_len", 0))
+            except (TypeError, ValueError):
+                plen = 0
+            if 0 < plen <= len(self.prompt):
+                self.resumed = True
+                self.out_tokens = list(self.prompt[plen:])
         # None = don't emit logprobs; 0 = chosen only; k = with alternatives
         self.logprobs = so.logprobs
         self.enqueue_t = time.perf_counter()
@@ -592,6 +610,9 @@ class JaxServingEngine(AsyncEngine):
         self.total_generated_tokens = 0
         self.total_prompt_tokens = 0
         self.preemptions = 0
+        # mid-stream resume (docs/resilience.md): requests admitted with a
+        # resume marker — their prompt is another worker's dead stream
+        self.resumed_requests = 0
         # speculative decoding (cumulative): drafts handed to verify
         # dispatches and how many matched their sampled targets
         self.spec_drafted_total = 0
@@ -1291,6 +1312,8 @@ class JaxServingEngine(AsyncEngine):
             return
         self._ensure_thread()
         seq = _Seq(request, req, asyncio.get_running_loop())
+        if seq.resumed:
+            self.resumed_requests += 1
         tenant = getattr(request.context, "tenant", None)
         if self._qos is not None:
             # QoS on: anonymous requests become the shared default tenant
@@ -2565,6 +2588,11 @@ class JaxServingEngine(AsyncEngine):
             # flight recorder yields that tenant's queue/prefill/decode
             # breakdown
             attrs["tenant"] = seq.tenant
+        if seq.resumed:
+            # resumed re-admission: its "prefill" is a recovery recompute of
+            # another worker's dead stream, not an admission wait — SLO
+            # consumers exclude it from TTFT (docs/resilience.md)
+            attrs["resumed"] = True
         req_span = tracing.record_span(
             "engine.request", seq.enqueue_t, now, parent=parent,
             attributes=attrs,
@@ -3034,6 +3062,9 @@ class JaxServingEngine(AsyncEngine):
             "spec_drafted_tokens": self.spec_drafted_total,
             "spec_accepted_tokens": self.spec_accepted_total,
             "kv_quantized": int(self._kv_quantized),
+            # mid-stream resume: re-admissions this engine served (the
+            # client-side resume counters live in runtime/resilience.py)
+            "resumed_requests": self.resumed_requests,
         }
         if self._perf is not None:
             m["decode_tokens_per_s"] = round(self._perf.decode_tps, 3)

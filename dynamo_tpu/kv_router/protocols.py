@@ -196,6 +196,13 @@ class ForwardPassMetrics:
     # cluster SLO engine diffs them for error-rate / overload-share
     requests_total: int = 0
     requests_errored: int = 0
+    # mid-stream resume (docs/resilience.md §Mid-stream resume): cumulative
+    # process-level recovery counters (runtime/resilience.resume_counters —
+    # streams this process re-admitted elsewhere, and resumable streams
+    # that still died in-band). The aggregator sums them into
+    # dynamo_cluster_resume_total / dynamo_cluster_resume_failed_total.
+    resume_total: int = 0
+    resume_failed_total: int = 0
     # process identity for cluster attribution + dashboards
     uptime_s: float = 0.0
     model: Optional[str] = None

@@ -139,6 +139,18 @@ class ModelWatcher:
         except (ValueError, KeyError):
             logger.warning("malformed model entry at %s", key)
             return
+        if entry.get("wire", "openai") != "openai":
+            # token-wire worker (cli/run --wire token): it speaks
+            # PreprocessedRequest dicts, and this frontend has no tokenizer
+            # to lower OpenAI requests — feeding it raw dicts would error
+            # every request. Serve those fleets with
+            # `in=http out=dyn://... --wire token --model-path ...`.
+            logger.warning(
+                "model %r at %s uses wire=%s; out=discover only routes "
+                "openai-wire workers — skipping this entry",
+                name, key, entry.get("wire"),
+            )
+            return
         if key in self._entry_model:
             return  # entry refresh for a model we already serve
 

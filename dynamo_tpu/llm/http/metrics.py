@@ -62,15 +62,25 @@ def _observe_trace_phase(phase: str, seconds: float) -> None:
         tracing.observe_phase(phase, seconds)
 
 
-def _observe_slo_latency(series: str, model: str, seconds: float) -> None:
+def _observe_slo_latency(
+    series: str, model: str, seconds: float, tenant: Optional[str] = None
+) -> None:
     """Feed an edge latency sample (TTFT / inter-token) into the telemetry
     plane's SLO store. Same lazy-import + enabled() discipline as the
-    tracing feed: ``DYN_TPU_SLO=0`` costs one boolean check."""
+    tracing feed: ``DYN_TPU_SLO=0`` costs one boolean check. With a tenant
+    class attached (QoS on, docs/qos.md) a SECOND, tenant-labeled series
+    gets the sample — the SLO engine fans out over every label set it has
+    seen, so per-tenant-class ``ttft_p95``/``itl_p95`` rows appear on
+    ``/debug/slo`` without touching the model-level objective."""
     try:
         from dynamo_tpu.runtime import telemetry
     except Exception:  # pragma: no cover - runtime tree absent
         return
     telemetry.observe_latency(series, seconds * 1e3, model=model)
+    if tenant:
+        telemetry.observe_latency(
+            series, seconds * 1e3, model=model, tenant=tenant
+        )
 
 
 def _count_slo_request(outcome: str, model: str) -> None:
@@ -254,9 +264,20 @@ class ServiceMetrics:
                 ("model",),
             )
         )
+        self.resumed = self.registry.register(
+            Counter(
+                f"{prefix}_resume_total",
+                "Streams resumed on another worker after a mid-decode death",
+                ("model",),
+            )
+        )
 
-    def inflight_guard(self, model: str, endpoint: str, request_type: str) -> "InflightGuard":
-        return InflightGuard(self, model, endpoint, request_type)
+    def inflight_guard(
+        self, model: str, endpoint: str, request_type: str,
+        tenant_class: Optional[str] = None,
+    ) -> "InflightGuard":
+        return InflightGuard(self, model, endpoint, request_type,
+                             tenant_class=tenant_class)
 
     def render(self) -> str:
         # the phase-latency histogram (runtime/tracing.py) rides the same
@@ -288,15 +309,20 @@ class InflightGuard:
     Reference: InflightGuard RAII (http/service/metrics.rs).
     """
 
-    def __init__(self, metrics: ServiceMetrics, model: str, endpoint: str, request_type: str):
+    def __init__(self, metrics: ServiceMetrics, model: str, endpoint: str,
+                 request_type: str, tenant_class: Optional[str] = None):
         self._m = metrics
         self.model = model
         self.endpoint = endpoint
         self.request_type = request_type
+        # tenant CLASS (bounded cardinality — never the raw tenant id) for
+        # per-class SLO rows; None on single-tenant edges = zero extra work
+        self.tenant_class = tenant_class
         self.status = "error"
         self._start: Optional[float] = None
         self._first_token_at: Optional[float] = None
         self._last_chunk_at: Optional[float] = None
+        self._resumed = False
 
     def __enter__(self) -> "InflightGuard":
         self._start = time.perf_counter()
@@ -313,29 +339,67 @@ class InflightGuard:
         self.status = "overloaded"
         self._m.overloaded.inc(1, model=self.model)
 
+    def sync_resumes(self, journal, seen: int) -> int:
+        """Fold any NEW recoveries recorded on the request's resume journal
+        (``EngineContext.journal``) into this guard: one :meth:`mark_resume`
+        per resume since ``seen``. Returns the new watermark; None journal
+        (non-resumable request) is a no-op. Shared by the streaming and
+        unary HTTP loops so the two can't drift."""
+        if journal is None or journal.resumes <= seen:
+            return seen
+        for _ in range(journal.resumes - seen):
+            self.mark_resume()
+        return journal.resumes
+
+    def mark_resume(self) -> None:
+        """The upstream stream was resumed on another worker
+        (``EngineContext.journal`` grew its resume count). Counts once per
+        resume into the frontend resume counter; if no content chunk has
+        been delivered yet, the eventual first-chunk latency is attributed
+        to ``inter_token``/``itl_ms`` instead of TTFT — the wait was a
+        mid-decode recovery gap, not an admission wait, and letting it into
+        ``ttft_p95`` would page admission capacity alarms for worker
+        deaths that were fully absorbed."""
+        self._resumed = True
+        self._m.resumed.inc(1, model=self.model)
+
     def mark_first_token(self) -> None:
         if self._first_token_at is None and self._start is not None:
             self._first_token_at = time.perf_counter()
-            self._m.ttft.observe(self._first_token_at - self._start, model=self.model)
+            if not self._resumed:
+                self._m.ttft.observe(
+                    self._first_token_at - self._start, model=self.model
+                )
 
     def mark_chunk(self) -> None:
         """Streaming path: called once per content-bearing SSE chunk.
         First chunk observes TTFT; every later one observes the gap since
         the previous chunk (the frontend's inter-token latency). Both also
         feed the shared phase-latency histogram (``ttft``/``inter_token``
-        phases) when tracing is enabled."""
+        phases) when tracing is enabled. A first chunk that arrived after
+        a mid-stream resume is an inter-token gap, not a TTFT (see
+        :meth:`mark_resume`) — the pause stays visible, in the right
+        series."""
         now = time.perf_counter()
         if self._first_token_at is None:
             self.mark_first_token()
             if self._first_token_at is not None and self._start is not None:
                 ttft = self._first_token_at - self._start
-                _observe_trace_phase("ttft", ttft)
-                _observe_slo_latency("ttft_ms", self.model, ttft)
+                if self._resumed:
+                    self._m.itl.observe(ttft, model=self.model)
+                    _observe_trace_phase("inter_token", ttft)
+                    _observe_slo_latency("itl_ms", self.model, ttft,
+                                         tenant=self.tenant_class)
+                else:
+                    _observe_trace_phase("ttft", ttft)
+                    _observe_slo_latency("ttft_ms", self.model, ttft,
+                                         tenant=self.tenant_class)
         elif self._last_chunk_at is not None:
             gap = now - self._last_chunk_at
             self._m.itl.observe(gap, model=self.model)
             _observe_trace_phase("inter_token", gap)
-            _observe_slo_latency("itl_ms", self.model, gap)
+            _observe_slo_latency("itl_ms", self.model, gap,
+                                 tenant=self.tenant_class)
         self._last_chunk_at = now
 
     def count_tokens(self, n: int = 1) -> None:

@@ -291,10 +291,15 @@ class HttpService:
         # the shared default tenant — it must not bypass the rate gates.
         # With QoS off, a bare header still rides the context for tracing.
         tenant = request.headers.get("x-tenant-id")
+        tenant_class = None
         if self.qos is not None:
             tenant = self.qos.resolve_tenant(
                 tenant, request.headers.get("authorization")
             )
+            if tenant:
+                # bounded-cardinality CLASS (never the raw id) labels the
+                # per-tenant SLO rows on /debug/slo (docs/qos.md)
+                tenant_class = self.qos.class_name_of(tenant)
         if tenant:
             ctx.context.tenant = tenant
         if self.tenant_limiter is not None:
@@ -305,6 +310,7 @@ class HttpService:
                 with self.metrics.inflight_guard(
                     oai_req.model, endpoint,
                     "stream" if streaming else "unary",
+                    tenant_class=tenant_class,
                 ) as g:
                     g.mark_shed()
                     return _overloaded_response(
@@ -333,7 +339,8 @@ class HttpService:
             ctx.context.trace = edge
             tokens = (tracing.set_current(edge), tracing.set_request_id(ctx.id))
         guard = self.metrics.inflight_guard(
-            oai_req.model, endpoint, "stream" if streaming else "unary"
+            oai_req.model, endpoint, "stream" if streaming else "unary",
+            tenant_class=tenant_class,
         )
         try:
             with guard:
@@ -406,8 +413,15 @@ class HttpService:
 
         tmpl = _SseTemplate()
         envelope: Optional[dict] = None  # id/object/created/model of the stream
+        # mid-stream resume visibility (docs/resilience.md): the routing
+        # client's journal rides the SAME EngineContext; when its resume
+        # count grows, attribute the next first-chunk wait to inter_token
+        # instead of TTFT. None on non-resumable paths = one check total.
+        journal = getattr(ctx.context, "journal", None)
+        seen_resumes = 0
         try:
             async for item in _rest():
+                seen_resumes = guard.sync_resumes(journal, seen_resumes)
                 if isinstance(item, Annotated):
                     if item.is_error:
                         # headers already sent: error goes in-band, followed
@@ -464,8 +478,12 @@ class HttpService:
     ) -> web.Response:
         chunks: list[dict] = []
         n_tokens = 0
+        seen_resumes = 0
         try:
             async for item in engine.generate(ctx):
+                seen_resumes = guard.sync_resumes(
+                    getattr(ctx.context, "journal", None), seen_resumes
+                )
                 if isinstance(item, Annotated):
                     if item.is_error:
                         msg = item.error_message() or "engine error"

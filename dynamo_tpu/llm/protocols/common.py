@@ -84,6 +84,13 @@ class PreprocessedRequest:
     eos_token_ids: list[int] = field(default_factory=list)
     annotations: list[str] = field(default_factory=list)
     mdc_sum: Optional[str] = None
+    # mid-stream resume marker (runtime/resilience.StreamJournal): when the
+    # routing client re-admits a broken stream as prompt+generated, this
+    # carries {"prompt_len": where the ORIGINAL prompt ended inside
+    # token_ids, "rng_offset": draws the original stream consumed}. Engines
+    # rebuild sampling state (penalty counts over token_ids[prompt_len:])
+    # from it; None (the wire default) is exactly the pre-resume request.
+    resume: Optional[dict] = None
 
     def to_dict(self) -> dict:
         from dataclasses import asdict
@@ -99,6 +106,7 @@ class PreprocessedRequest:
             eos_token_ids=list(d.get("eos_token_ids", [])),
             annotations=list(d.get("annotations", [])),
             mdc_sum=d.get("mdc_sum"),
+            resume=d.get("resume") if isinstance(d.get("resume"), dict) else None,
         )
 
 

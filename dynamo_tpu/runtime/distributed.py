@@ -43,7 +43,9 @@ from dynamo_tpu.runtime.resilience import (
     NoHealthyInstances,
     ResiliencePolicy,
     RetryableRpcError,
+    StreamJournal,
     WorkerStalled,
+    note_resume,
 )
 from dynamo_tpu.runtime.rpc import RpcClient, RpcServer
 from dynamo_tpu.runtime.statestore import Lease, StateStoreClient, WatchEvent
@@ -619,7 +621,8 @@ class EndpointClient(AsyncEngine):
         self._retry_rng = self.policy.rng()
         # observability: how often the resilience layer actually worked
         self.stats = {"failures": 0, "failovers": 0, "deadline_expired": 0,
-                      "overloaded": 0, "probes": 0, "probe_failures": 0}
+                      "overloaded": 0, "probes": 0, "probe_failures": 0,
+                      "resumes": 0, "resume_failures": 0}
         self._instances: Dict[str, InstanceInfo] = {}
         # active liveness probing (runtime/health.py): when an instance's
         # RPC plane goes silent for probe_idle, __ping__ it through the real
@@ -1065,9 +1068,15 @@ class EndpointClient(AsyncEngine):
         draining worker) fail over to the next instance within the policy's
         retry budget and deadline; repeatedly-failing instances are ejected
         by the circuit breaker until a half-open probe readmits them. After
-        the first item reaches the caller the request is pinned — later
-        failures surface in-band as error envelopes, and the total deadline
-        keeps bounding the stream.
+        the first item reaches the caller the request is pinned — but a
+        pinned TOKEN-LEVEL stream cut by a transport failure (reset, stall,
+        worker killed mid-decode) is resumed on another healthy instance:
+        the journal's ``prompt + emitted`` re-admits with a decremented
+        token budget, so the caller sees an inter-token gap instead of a
+        dead stream (``policy.resume_attempts``; 0 = exact pinned
+        behavior). Engine-semantic errors, spent deadlines, and exhausted
+        resume budgets still surface in-band as error envelopes, and the
+        total deadline keeps bounding the stream.
         """
         payload = request.data
         if hasattr(payload, "to_dict"):
@@ -1103,6 +1112,15 @@ class EndpointClient(AsyncEngine):
             if route is not None:
                 route.end(route_status)
 
+    def _note_resume_failed(self, journal) -> None:
+        """A stream the resume machinery was responsible for still died
+        in-band: count it once (the journal is disarmed so later exits on
+        the same request can't double-count)."""
+        if journal is not None and journal.viable and not journal.finished:
+            journal.viable = False
+            self.stats["resume_failures"] += 1
+            note_resume(failed=True)
+
     async def _generate_attempts(
         self, request, payload, deadline, route
     ) -> AsyncIterator[Annotated]:
@@ -1110,23 +1128,53 @@ class EndpointClient(AsyncEngine):
         tried: set = set()
         attempt = 0
         last_err: Optional[BaseException] = None
+        # mid-stream resume (docs/resilience.md §Mid-stream resume): only
+        # token-level payloads get a journal, and only when the policy asks
+        # for it — resume_attempts == 0 constructs NOTHING on this path
+        # (the zero-overhead guard tests/test_resume.py asserts).
+        journal: Optional[StreamJournal] = None
+        if (
+            policy.resume_attempts > 0
+            and isinstance(payload, dict)
+            and isinstance(payload.get("token_ids"), list)
+        ):
+            journal = StreamJournal(payload)
+            request.context.journal = journal
+        delivered = False  # any item reached the caller, across attempts
+        resume_deadline: Optional[Deadline] = None  # starts at first resume
         while True:
             if deadline.expired:
                 self.stats["deadline_expired"] += 1
-                raise DeadlineExceeded(
+                err = DeadlineExceeded(
                     f"{DEADLINE_ERROR}: request budget "
                     f"({policy.request_timeout:.1f}s) spent after "
                     f"{attempt} attempt(s)"
-                ) from last_err
+                )
+                if delivered:
+                    # the caller already holds tokens: terminate the stream
+                    # in-band instead of raising out of a live generator
+                    yield Annotated.from_error(str(err))
+                    return
+                raise err from last_err
             try:
-                iid = self._pick(payload, exclude=frozenset(tried))
-            except NoHealthyInstances:
-                if not tried:
-                    raise
-                # every live instance failed once this request: widen back
-                # to the full set for whatever budget remains
-                tried.clear()
-                iid = self._pick(payload)
+                try:
+                    iid = self._pick(payload, exclude=frozenset(tried))
+                except NoHealthyInstances:
+                    if not tried:
+                        raise
+                    # every live instance failed once this request: widen
+                    # back to the full set for whatever budget remains
+                    tried.clear()
+                    iid = self._pick(payload)
+            except NoHealthyInstances as e:
+                if delivered:
+                    self._note_resume_failed(journal)
+                    yield Annotated.from_error(
+                        f"stream lost mid-decode with no healthy instance "
+                        f"to resume on: {e}"
+                    )
+                    return
+                raise
             self._breaker.acquire(iid)
             if route is not None:
                 route.set_attribute("instance", iid)
@@ -1164,6 +1212,11 @@ class EndpointClient(AsyncEngine):
                         if not item.is_error:
                             self._breaker.record_success(iid)
                             resolved = True
+                    if journal is not None and not item.is_error:
+                        # journal BEFORE the yield: a consumer cancelling
+                        # mid-delivery must not lose the token it received
+                        journal.note(item.data)
+                    delivered = True
                     yield item
                 if not first_seen:
                     self._breaker.record_success(iid)  # clean empty stream
@@ -1172,9 +1225,11 @@ class EndpointClient(AsyncEngine):
             except asyncio.CancelledError:
                 raise
             except DeadlineExceeded as e:
-                # budget spent — not the instance's fault, no breaker penalty
+                # budget spent — not the instance's fault, no breaker
+                # penalty, and no resume either: a resumed admission would
+                # be shed with the same spent deadline
                 self.stats["deadline_expired"] += 1
-                if first_seen:
+                if first_seen or delivered:
                     yield Annotated.from_error(str(e))
                     return
                 raise
@@ -1198,6 +1253,10 @@ class EndpointClient(AsyncEngine):
                     # sibling. Surface the 429 + per-tenant Retry-After
                     # immediately, and do NOT avoid the instance (it is
                     # happy to serve other tenants right now).
+                    if delivered:
+                        self._note_resume_failed(journal)
+                        yield Annotated.from_error(str(e))
+                        return
                     raise
                 self._avoid_until[iid] = (
                     time.monotonic() + max(e.retry_after_ms, 1) / 1000.0
@@ -1206,6 +1265,13 @@ class EndpointClient(AsyncEngine):
                 attempt += 1
                 last_err = e
                 if attempt >= policy.max_attempts:
+                    if delivered:
+                        # a resumed re-admission shed everywhere: the
+                        # original stream is already flowing to the caller,
+                        # so the overload must terminate it in-band
+                        self._note_resume_failed(journal)
+                        yield Annotated.from_error(str(e))
+                        return
                     # surface the typed overload (not AllInstancesFailed) so
                     # the HTTP edge can answer 429 + Retry-After
                     raise
@@ -1220,11 +1286,15 @@ class EndpointClient(AsyncEngine):
                     # deadline expiry — no breaker penalty for a healthy
                     # instance that merely got a ~0s connect window
                     self.stats["deadline_expired"] += 1
-                    raise DeadlineExceeded(
+                    err = DeadlineExceeded(
                         f"{DEADLINE_ERROR}: request budget "
                         f"({policy.request_timeout:.1f}s) spent after "
                         f"{attempt + 1} attempt(s)"
-                    ) from e
+                    )
+                    if delivered:
+                        yield Annotated.from_error(str(err))
+                        return
+                    raise err from e
                 # refused/timed-out dial, reset, stall, draining worker
                 self._breaker.record_failure(iid)
                 resolved = True
@@ -1241,8 +1311,56 @@ class EndpointClient(AsyncEngine):
                     # Identity-guarded: only this attempt's conn is evicted
                     await self._evict_conn(iid, conn)
                 if first_seen:
-                    # tokens already delivered: failover would duplicate
-                    # them — surface the break in-band instead
+                    # tokens already delivered and THIS attempt's stream
+                    # died a transport death: re-admit elsewhere as
+                    # prompt+generated (never on engine-semantic errors —
+                    # those arrive as in-band envelopes, not exceptions)
+                    resumed = None
+                    if (
+                        journal is not None
+                        and journal.resumes < policy.resume_attempts
+                        and (resume_deadline is None
+                             or not resume_deadline.expired)
+                    ):
+                        resumed = journal.resume_request()
+                    if resumed is not None:
+                        journal.resumes += 1
+                        if resume_deadline is None:
+                            # per-request resume budget: bounds total churn
+                            # when workers keep dying under the stream
+                            resume_deadline = Deadline.after(
+                                policy.resume_budget_s
+                            )
+                        self.stats["resumes"] += 1
+                        note_resume()
+                        if route is not None:
+                            route.set_attribute("resumes", journal.resumes)
+                            route.add_event(
+                                "resume", instance=iid,
+                                emitted=len(journal.emitted),
+                                error=f"{type(e).__name__}: {e}",
+                            )
+                        logger.warning(
+                            "resuming request %s after mid-stream failure "
+                            "on %s (%d emitted tokens re-seeded as prompt): "
+                            "%s", request.id, iid, len(journal.emitted), e,
+                        )
+                        payload = resumed
+                        # the resumed admission gets a fresh pre-first-token
+                        # failover budget; only the dead instance is excluded
+                        tried = {iid}
+                        attempt = 0
+                        last_err = e
+                        delay = deadline.bound(
+                            policy.backoff(1, self._retry_rng)
+                        )
+                        if delay:
+                            await asyncio.sleep(delay)
+                        continue
+                    # not resumable (off, exhausted, non-token stream):
+                    # failover would duplicate delivered tokens — surface
+                    # the break in-band instead (exact pre-resume behavior)
+                    self._note_resume_failed(journal)
                     yield Annotated.from_error(
                         f"connection to worker lost mid-stream: {e}"
                     )
@@ -1251,6 +1369,15 @@ class EndpointClient(AsyncEngine):
                 attempt += 1
                 last_err = e
                 if attempt >= policy.max_attempts:
+                    if delivered:
+                        # a resumed re-admission burned its whole failover
+                        # budget without a first token: terminate in-band
+                        self._note_resume_failed(journal)
+                        yield Annotated.from_error(
+                            f"connection to worker lost mid-stream and "
+                            f"resume failed on {len(tried)} instance(s): {e}"
+                        )
+                        return
                     raise AllInstancesFailed(
                         f"request failed on {len(tried)} instance(s) after "
                         f"{attempt} attempt(s): {e}"
@@ -1397,6 +1524,15 @@ async def attach_kv_publishing(
                 )
                 snap.setdefault("role", role)
                 snap["uptime_s"] = round(telemetry.uptime_seconds(), 3)
+                # mid-stream resume outcomes: process-global (every
+                # EndpointClient in this process feeds the same counters),
+                # so co-hosted clients — a frontend publishing metrics, a
+                # decode worker dialing peers — report once, not per client
+                from dynamo_tpu.runtime.resilience import resume_counters
+
+                r_ok, r_bad = resume_counters()
+                snap.setdefault("resume_total", r_ok)
+                snap.setdefault("resume_failed_total", r_bad)
                 if server is not None and bind_admission:
                     # the co-hosted RPC server's counters belong to the
                     # publisher that OWNS it; a bind_admission=False

@@ -36,9 +36,16 @@ class EngineContext:
       HTTP edge (``x-tenant-id`` / API-key map) or from the RPC header;
       None on the single-tenant path. Rides the context so admission,
       scheduling, KV budgets, and tracing all attribute to the same id.
+    - ``journal``  mid-stream resume journal
+      (``runtime/resilience.StreamJournal``), attached by the routing
+      client for token-level requests when resume is enabled; None
+      otherwise (the zero-overhead off path). The HTTP edge reads its
+      ``resumes`` count to attribute a post-resume first chunk as an ITL
+      gap instead of admission TTFT.
     """
 
-    __slots__ = ("_id", "_stopped", "_killed", "_stop_event", "trace", "tenant")
+    __slots__ = ("_id", "_stopped", "_killed", "_stop_event", "trace",
+                 "tenant", "journal")
 
     def __init__(self, request_id: Optional[str] = None):
         self._id = request_id or uuid.uuid4().hex
@@ -47,6 +54,7 @@ class EngineContext:
         self._stop_event: Optional[asyncio.Event] = None
         self.trace = None
         self.tenant: Optional[str] = None
+        self.journal = None
 
     @property
     def id(self) -> str:

@@ -45,8 +45,16 @@ from typing import Dict, Iterator, List, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 PLANES = ("statestore", "bus", "rpc", "transfer")
-ACTIONS = ("refuse", "delay", "reset", "stall", "wedge")
-POINTS = ("connect", "read", "write", "serve")
+ACTIONS = ("refuse", "delay", "reset", "stall", "wedge", "cut")
+POINTS = ("connect", "read", "write", "serve", "item")
+
+
+class StreamCut(ConnectionResetError):
+    """An injected mid-stream kill (action="cut" at point="item"): the
+    serving side aborts the whole connection after the Nth response item —
+    the deterministic stand-in for a worker process dying mid-decode. The
+    client observes a connection reset with tokens already delivered,
+    which is exactly the situation mid-stream resume must absorb."""
 
 
 @dataclass
@@ -58,9 +66,14 @@ class FaultRule:
     ``point``       where it fires: "connect" (per dial), "read"/"write"
                     (per frame on an established connection), "serve"
                     (server-side dispatch gate, once per request/probe —
-                    see :func:`serve_gate`).
-    ``action``      refuse | delay | reset | stall | wedge (refuse only
-                    makes sense at connect; wedge only at serve;
+                    see :func:`serve_gate`), "item" (server-side, once per
+                    streamed response item — ``after_ops`` counts items
+                    WITHIN each stream, so "kill after the 3rd token" is
+                    one rule; see :func:`item_gate`).
+    ``action``      refuse | delay | reset | stall | wedge | cut (refuse
+                    only makes sense at connect; wedge only at serve; cut
+                    only at item — it aborts the serving connection, a
+                    deterministic mid-decode worker death;
                     reset/delay/stall anywhere).
     ``match_addr``  exact "host:port" (None = any address).
     ``after_ops``   skip the first N matching ops (per plane+addr counter
@@ -188,6 +201,8 @@ class FaultInjector:
             return
         if rule.action == "refuse":
             raise ConnectionRefusedError(f"injected refusal ({what})")
+        if rule.action == "cut":
+            raise StreamCut(f"injected mid-stream cut ({what})")
         raise ValueError(f"unknown fault action {rule.action!r}")
 
     # -- connection faulting ----------------------------------------------
@@ -207,6 +222,16 @@ class FaultInjector:
         rule = self.decide(plane, addr, "serve", op)
         if rule is not None:
             await self._apply(rule, f"serve {plane} {addr}")
+
+    async def before_item(self, plane: str, addr: str, index: int) -> None:
+        """Per-response-item gate: ``index`` is the item's position WITHIN
+        its stream (passed by the server, not counted here), so
+        ``after_ops=N`` reads "let N items through, then fire" for every
+        matching stream — deterministic regardless of request interleaving.
+        ``max_fires`` still bounds total firings across streams."""
+        rule = self.decide(plane, addr, "item", index)
+        if rule is not None:
+            await self._apply(rule, f"item {plane} {addr} #{index}")
 
 
 class _ConnFaults:
@@ -389,6 +414,19 @@ async def serve_gate(plane: str, addr: str) -> None:
     inj = current()
     if inj is not None:
         await inj.before_serve(plane, addr)
+
+
+async def item_gate(plane: str, addr: str, index: int) -> None:
+    """Server-side per-response-item gate (runtime/rpc.py item loop).
+
+    The ``cut`` action raises :class:`StreamCut`; the server aborts the
+    whole connection — every stream on it dies exactly as if the worker
+    process was killed after this stream's Nth item. The hot path pays one
+    None-check per item when no injector is installed (callers pre-check
+    :func:`current`)."""
+    inj = current()
+    if inj is not None:
+        await inj.before_item(plane, addr, index)
 
 
 async def open_connection(host: str, port: int, plane: str = "rpc"):

@@ -16,9 +16,15 @@ instance died between watch events. This module is that absorption layer:
   machine; repeatedly-failing instances are ejected from routing until a
   half-open probe proves them healthy again.
 
-Semantics contract (docs/resilience.md): failover is only legal while no
-response item has been delivered to the caller — after the first token the
-request is pinned to its instance and failures surface in-band.
+Semantics contract (docs/resilience.md): pre-first-token failures fail over
+freely. After the first token the request is *pinned* — but a pinned stream
+that dies with a TRANSPORT failure (reset, stall, worker reaped/killed) is
+no longer a dead end: :class:`StreamJournal` carries everything needed to
+rebuild the stream on another instance (prompt + every emitted token id +
+the remaining token budget), and ``EndpointClient.generate`` re-admits it
+as ``prompt+generated`` with a decremented budget. Only when resume is off
+(``DYN_TPU_RESUME=0``), exhausted, or impossible (non-token-level payload,
+engine-semantic error) does the failure surface in-band.
 
 Reference analogue: the reference leans on NATS redelivery + etcd liveness
 (SURVEY.md §5); this is the equivalent capability re-designed for the
@@ -29,9 +35,10 @@ from __future__ import annotations
 
 import os
 import random
+import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 # Canonical message prefix for deadline errors crossing process boundaries as
 # Annotated error envelopes; the HTTP edge maps it to 504 vs the generic 502.
@@ -138,6 +145,17 @@ def _env_int(name: str, default: int) -> int:
     return v if v > 0 else default
 
 
+def _env_count(name: str, default: int) -> int:
+    """Like :func:`_env_int` but ``0`` is a *policy*, not a misconfiguration
+    (``DYN_TPU_RESUME=0`` = resume off, exact pre-resume behavior); only
+    malformed or negative values clamp to the default."""
+    try:
+        v = int(os.environ.get(name, default))
+    except ValueError:
+        return default
+    return v if v >= 0 else default
+
+
 @dataclass
 class ResiliencePolicy:
     """Per-client resilience knobs. The defaults keep today's behavior for
@@ -155,6 +173,15 @@ class ResiliencePolicy:
                              jitter is a 0..jitter fraction added on top.
     ``breaker_*``            consecutive-failure threshold, open-state
                              cooldown, and half-open probe admission count.
+    ``resume_attempts``      mid-stream recoveries per request: a pinned
+                             stream cut by a *transport* failure after its
+                             first token is re-admitted on another instance
+                             as prompt+generated (docs/resilience.md
+                             §Mid-stream resume). 0 = off — exact pinned
+                             in-band-error behavior, zero journal overhead.
+    ``resume_budget_s``      total wall-clock a single request may spend on
+                             resume re-admissions before the failure
+                             surfaces in-band.
     ``seed``                 fixes the jitter RNG (tests / reproducibility).
     """
 
@@ -169,6 +196,8 @@ class ResiliencePolicy:
     breaker_threshold: int = 5
     breaker_cooldown: float = 5.0
     breaker_half_open_probes: int = 1
+    resume_attempts: int = 1
+    resume_budget_s: float = 30.0
     seed: Optional[int] = None
 
     def rng(self) -> random.Random:
@@ -208,6 +237,11 @@ class ResiliencePolicy:
                 prefix + "BREAKER_COOLDOWN", d.breaker_cooldown
             )
             or d.breaker_cooldown,
+            resume_attempts=_env_count(prefix + "RESUME", d.resume_attempts),
+            resume_budget_s=_env_float(
+                prefix + "RESUME_BUDGET", d.resume_budget_s
+            )
+            or d.resume_budget_s,
         )
 
 
@@ -330,3 +364,124 @@ class CircuitBreaker:
 
     def snapshot(self) -> Dict[str, str]:
         return {k: self.state(k) for k in self._slots}
+
+
+# ---------------------------------------------------------------------------
+# Mid-stream resume (docs/resilience.md §Mid-stream resume)
+# ---------------------------------------------------------------------------
+
+
+class StreamJournal:
+    """Per-request resume journal: everything needed to rebuild a live
+    token stream on another worker after its instance dies mid-decode.
+
+    The edge already accumulates emitted token ids for detokenization; this
+    formalizes that accumulation where the routing decision lives
+    (``EndpointClient.generate``) and rides ``EngineContext.journal`` so
+    the HTTP edge can see that a resume happened (TTFT-vs-ITL attribution).
+
+    Only token-level payloads (a ``PreprocessedRequest`` wire dict carrying
+    ``token_ids``) are journal-able; anything else — raw OpenAI dicts
+    routed to preprocessing workers, unary protocol requests — keeps the
+    exact pinned in-band-error behavior. A stream item without per-step
+    ``token_ids`` (custom engines) marks the journal non-viable the moment
+    it appears: resuming would re-emit or drop content.
+
+    ``resume_request()`` builds the re-admission payload: the new prompt is
+    ``prompt + emitted`` with the token budget decremented by what the
+    caller already received, and a ``resume`` marker
+    (``{"prompt_len", "rng_offset"}``) tells the serving engine where the
+    original prompt ended so it rebuilds sampling state — penalty counts
+    over exactly the emitted suffix — instead of treating history as
+    prompt. Greedy continuations are bitwise identical to an undisturbed
+    stream (asserted by tests/test_resume.py); sampled (temperature > 0)
+    continuations are distributionally correct but draw fresh RNG.
+    """
+
+    __slots__ = ("prompt", "emitted", "resumes", "started", "viable",
+                 "finished", "_payload")
+
+    def __init__(self, payload: dict, clock: Callable[[], float] = _monotonic):
+        self._payload = payload
+        toks = payload.get("token_ids") if isinstance(payload, dict) else None
+        self.viable = (
+            isinstance(toks, list)
+            and all(isinstance(t, int) for t in toks)
+        )
+        self.prompt: List[int] = list(toks) if self.viable else []
+        self.emitted: List[int] = []
+        self.resumes = 0
+        self.finished = False
+        self.started = clock()
+
+    def note(self, data: Any) -> None:
+        """Record one stream item's payload (an ``LLMEngineOutput`` wire
+        dict). Called once per item on the hot path: two dict probes when
+        the item is token-shaped."""
+        if not self.viable or not isinstance(data, dict):
+            return
+        toks = data.get("token_ids")
+        if isinstance(toks, list):
+            self.emitted.extend(int(t) for t in toks)
+        elif toks is not None or "finish_reason" not in data:
+            # an item that is neither token-bearing nor a bare finish frame:
+            # this stream's content is not reconstructible from token ids
+            self.viable = False
+        if data.get("finish_reason"):
+            self.finished = True
+
+    def resume_request(self) -> Optional[dict]:
+        """The re-admission payload, or None when this stream cannot be
+        resumed (non-token payload, finish already delivered, or a token
+        budget that is already spent)."""
+        if not self.viable or self.finished:
+            return None
+        p = dict(self._payload)
+        p["token_ids"] = self.prompt + self.emitted
+        sc = dict(p.get("stop_conditions") or {})
+        n = len(self.emitted)
+        max_t = sc.get("max_tokens")
+        if max_t is not None:
+            if n >= int(max_t):
+                return None  # budget spent: the finish frame died with the worker
+            sc["max_tokens"] = int(max_t) - n
+        if sc.get("min_tokens") is not None:
+            sc["min_tokens"] = max(int(sc["min_tokens"]) - n, 0)
+        p["stop_conditions"] = sc
+        # prompt_len: where sampling-state history begins on the new worker;
+        # rng_offset: how many draws the original stream already consumed
+        # (carried for engines with per-request RNG streams — the JAX
+        # engine's step-keyed RNG documents sampled resumes as fresh-draw)
+        p["resume"] = {"prompt_len": len(self.prompt), "rng_offset": n}
+        return p
+
+
+# process-global resume outcome counters: every EndpointClient in the
+# process feeds them, attach_kv_publishing / the frontend /metrics render
+# them, and the cluster aggregator sums them into dynamo_cluster_resume_*.
+_RESUME_LOCK = threading.Lock()
+_RESUME_TOTAL = 0
+_RESUME_FAILED_TOTAL = 0
+
+
+def note_resume(failed: bool = False) -> None:
+    global _RESUME_TOTAL, _RESUME_FAILED_TOTAL
+    with _RESUME_LOCK:
+        if failed:
+            _RESUME_FAILED_TOTAL += 1
+        else:
+            _RESUME_TOTAL += 1
+
+
+def resume_counters() -> tuple:
+    """(resume_total, resume_failed_total) — cumulative for this process."""
+    with _RESUME_LOCK:
+        return _RESUME_TOTAL, _RESUME_FAILED_TOTAL
+
+
+def reset_resume_counters() -> None:
+    """Test/bench hook: zero the process-global resume counters."""
+    global _RESUME_TOTAL, _RESUME_FAILED_TOTAL
+    with _RESUME_LOCK:
+        _RESUME_TOTAL = 0
+        _RESUME_FAILED_TOTAL = 0

@@ -587,6 +587,7 @@ class RpcServer:
                 stream = engine.generate(ctx)
                 if hasattr(stream, "__await__"):
                     stream = await stream
+                sent = 0
                 async for item in stream:
                     if deadline is not None and deadline.expired:
                         # nobody is waiting for these tokens anymore: stop
@@ -602,10 +603,35 @@ class RpcServer:
                         if not first_item_seen:
                             first_item_seen = True
                             span.add_event("first_item")
+                    if faults.current() is not None:
+                        # per-item fault gate: a `cut` rule here is THE
+                        # deterministic mid-decode worker kill (after N
+                        # items, abort the connection). No injector ⇒ one
+                        # call + None check per item.
+                        await faults.item_gate(
+                            "rpc", f"{self.host}:{self.port}", sent
+                        )
+                    sent += 1
                     d = item.to_dict() if isinstance(item, Annotated) else item
                     await send({"id": req_id, "op": "item"}, json.dumps(d).encode())
                 outcome = "ok"
                 await send({"id": req_id, "op": "done", "load": load_wire()})
+            except faults.StreamCut as e:
+                # injected mid-decode death: kill this request's engine
+                # context and abort the WHOLE connection — from the client
+                # this is indistinguishable from the worker process dying
+                # (every stream on the conn sees a reset), which is exactly
+                # what the chaos/resume tests need to be deterministic about
+                outcome = "cut"
+                logger.warning("injected stream cut for %s: %s", req_id, e)
+                sender.dead = e
+                if ctx is not None:
+                    ctx.context.kill()
+                transport = getattr(writer, "transport", None)
+                if transport is not None:
+                    transport.abort()
+                else:
+                    writer.close()
             except SlowConsumer as e:
                 # reader stalled with a full queue: kill the engine context
                 # and drop the stream — no reply can reach a reader that

@@ -366,3 +366,90 @@ class TestMultiProcessE2E:
                 streak = 0
                 time.sleep(0.5)
         assert streak >= 3, "survivor did not take over after worker death"
+
+
+class TestTokenWireMultiProcess:
+    """`--wire token` across real OS processes (ISSUE 11): the frontend
+    preprocesses, PreprocessedRequest token streams cross the RPC wire —
+    the composition on which mid-stream resume operates (the resume
+    semantics themselves are deterministically covered in
+    tests/test_resume.py; this proves the product wiring end to end)."""
+
+    def test_token_wire_round_trip_and_discover_skip(self, tmp_path):
+        from tests.fixtures import build_model_dir
+
+        model_dir = build_model_dir(str(tmp_path / "model"))
+        ss_port, http_port, disc_port = _free_port(), _free_port(), _free_port()
+        ss_url = f"127.0.0.1:{ss_port}"
+        procs = {}
+        try:
+            procs["statestore"] = _spawn(
+                ["-m", "dynamo_tpu.runtime.statestore",
+                 "--host", "127.0.0.1", "--port", str(ss_port)]
+            )
+            assert _wait_port(ss_port)
+            procs["worker"] = _spawn(
+                ["-m", "dynamo_tpu.cli.run", "in=dyn://tw.backend.generate",
+                 "out=echo_core", "--wire", "token",
+                 "--model-path", model_dir, "--model-name", "parrot",
+                 "--statestore", ss_url, "--bus", "127.0.0.1:1"],
+                env={"DYN_TPU_TOKEN_ECHO_DELAY_MS": "1"},
+            )
+            procs["frontend"] = _spawn(
+                ["-m", "dynamo_tpu.cli.run", "in=http",
+                 "out=dyn://tw.backend.generate", "--wire", "token",
+                 "--model-path", model_dir, "--model-name", "parrot",
+                 "--statestore", ss_url, "--bus", "127.0.0.1:1",
+                 "--port", str(http_port)]
+            )
+            assert _wait_port(http_port), "token-wire frontend didn't come up"
+
+            # completion round-trip: the frontend tokenizes, the worker echoes
+            # token ids, the frontend detokenizes
+            deadline = time.time() + 20.0
+            resp = None
+            while time.time() < deadline:
+                try:
+                    resp = _http_json(
+                        f"http://127.0.0.1:{http_port}/v1/completions",
+                        {"model": "parrot", "prompt": "hello token wire",
+                         "max_tokens": 8},
+                    )
+                    break
+                except Exception:
+                    time.sleep(0.5)
+            assert resp is not None and resp.get("choices"), resp
+            assert "hello" in (resp["choices"][0].get("text") or "")
+
+            # streaming leg rides the same wire
+            lines = _sse_lines(
+                f"http://127.0.0.1:{http_port}/v1/completions",
+                {"model": "parrot", "prompt": "hello again",
+                 "max_tokens": 6, "stream": True},
+            )
+            assert lines and lines[-1] == "[DONE]"
+            assert not any("error" in ln for ln in lines[:-1])
+
+            # a raw-dict discovery frontend must SKIP the token-wire worker
+            # (it cannot lower OpenAI requests for it) instead of serving
+            # requests that would all error
+            procs["discover"] = _spawn(
+                ["-m", "dynamo_tpu.cli.run", "in=http", "out=discover",
+                 "--namespace", "tw", "--statestore", ss_url,
+                 "--bus", "127.0.0.1:1", "--port", str(disc_port)]
+            )
+            assert _wait_port(disc_port)
+            time.sleep(2.0)  # give the watcher time to (not) adopt the model
+            listing = _http_json(f"http://127.0.0.1:{disc_port}/v1/models")
+            assert listing.get("data") == [], (
+                "out=discover adopted a token-wire worker it cannot serve"
+            )
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs.values():
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()

@@ -268,6 +268,132 @@ def test_native_frame_into_int8_pool_falls_back_cleanly(params, run, caplog):
     run(go())
 
 
+def test_prefill_death_mid_transfer_never_tears_a_page(params, run):
+    """ISSUE 11 satellite: the prefill worker dies MID-FRAME while shipping
+    pages (partial bytes on the wire, then the socket closes). The framed
+    codec makes the torn frame unparseable — complete_remote_prefill must
+    never fire with it — and the decode side recovers via its remote
+    timeout into a clean local prefill with exact greedy parity."""
+    import json
+
+    from dynamo_tpu.runtime.codec import TwoPartMessage, encode
+
+    async def go():
+        local = JaxServingEngine(CFG, params, INT8_CFG)
+        prompt = list(range(9, 49))
+        golden = await _collect(local, prompt)
+        local.close()
+
+        fast_cfg = dataclasses.replace(INT8_CFG, remote_prefill_timeout=1.5)
+        decode = JaxServingEngine(CFG, params, fast_cfg)
+        completions = []
+        real_complete = decode.complete_remote_prefill
+        decode.complete_remote_prefill = (
+            lambda *a, **kw: (completions.append(a), real_complete(*a, **kw))
+        )
+        policy = ForcedRemotePolicy()
+        decode.set_remote_prefill_policy(policy)
+        server = KvTransferServer(decode, host="127.0.0.1", port=0)
+        await server.start()
+        try:
+            task = asyncio.create_task(_collect(decode, prompt))
+            await asyncio.to_thread(policy.submitted.wait, 10.0)
+            sub = policy.request
+            assert sub is not None
+
+            # a plausible kv_blocks frame, cut mid-body: the dying worker's
+            # last TCP segment
+            header = json.dumps({
+                "op": "kv_blocks", "request_id": sub["request_id"],
+                "first_token": 1, "block_ids": sub["block_ids"],
+                "dtype": "int8", "shape": [1, 1, BLOCK, 1, 4],
+                "k_bytes": 4096, "kv_dtype": "int8",
+                "scale_dtype": "float32", "scale_shape": [1, 1, BLOCK],
+                "ks_bytes": 64,
+            }).encode()
+            frame = encode(TwoPartMessage(header, b"\x01" * (2 * 4096 + 128)))
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", server.port
+            )
+            writer.write(frame[: len(frame) // 2])
+            await writer.drain()
+            writer.close()  # worker process gone
+
+            toks = await asyncio.wait_for(task, 30)
+            assert toks == golden, "local-prefill fallback must be exact"
+            assert completions == [], (
+                "a torn frame must never reach complete_remote_prefill"
+            )
+        finally:
+            await server.stop()
+            decode.close()
+
+    run(go())
+
+
+def test_send_blocks_transport_failure_then_typed_fallback(params, run):
+    """The prefill side's send dies at the transport (injected reset on the
+    transfer plane); after its retries it reports the failure in-band via
+    send_failure, and the decode request falls back to local prefill
+    immediately — no torn page, exact output, no timeout wait."""
+    from dynamo_tpu.runtime import faults as faults_mod
+    from dynamo_tpu.runtime.faults import FaultInjector, FaultRule
+
+    async def go():
+        local = JaxServingEngine(CFG, params, INT8_CFG)
+        prompt = list(range(11, 51))
+        golden = await _collect(local, prompt)
+        local.close()
+
+        decode = JaxServingEngine(CFG, params, INT8_CFG)
+        policy = ForcedRemotePolicy()
+        decode.set_remote_prefill_policy(policy)
+        server = KvTransferServer(decode, host="127.0.0.1", port=0)
+        await server.start()
+        addr = f"127.0.0.1:{server.port}"
+        prefill = PrefillEngine(CFG, params, max_model_len=128,
+                                block_size=BLOCK)
+        prefill.engine.close()
+        prefill.engine = JaxServingEngine(
+            CFG, params,
+            EngineConfig(
+                max_slots=4, kv_block_size=BLOCK, max_model_len=128,
+                decode_steps=1, prefill_chunk=128, kv_dtype="int8",
+            ),
+        )
+        client = KvTransferClient()
+        try:
+            task = asyncio.create_task(_collect(decode, prompt))
+            await asyncio.to_thread(policy.submitted.wait, 10.0)
+            sub = policy.request
+            tok, k, v, scales, _ = await prefill.prefill_request(
+                sub["token_ids"], sub["cached_tokens"], sub["sampling"]
+            )
+            inj = FaultInjector([FaultRule(
+                plane="transfer", point="write", action="reset",
+            )])
+            with faults_mod.active(inj):
+                with pytest.raises((ConnectionError, OSError)):
+                    await client.send_blocks(
+                        addr, sub["request_id"], tok, sub["block_ids"], k, v,
+                        scales=scales,
+                    )
+            # retries exhausted: the worker reports in-band (fresh dial —
+            # the failed conn was identity-evicted by send_blocks)
+            await client.send_failure(
+                addr, sub["request_id"], "injected transport death"
+            )
+            toks = await asyncio.wait_for(task, 30)
+            assert toks == golden
+        finally:
+            await client.close()
+            await server.stop()
+            prefill.close()
+            decode.close()
+
+    run(go())
+
+
 def test_inject_blocks_dtype_mismatch_is_typed(params):
     int8_eng = JaxServingEngine(CFG, params, INT8_CFG)
     native_eng = JaxServingEngine(

@@ -28,6 +28,7 @@ Coverage map:
 
 import asyncio
 import dataclasses
+from collections import OrderedDict
 
 import pytest
 
@@ -618,6 +619,57 @@ class TestHttpEdgeTenant:
         run(go())
         assert seen == ["acme", None]
 
+    def test_per_tenant_class_slo_rows(self, run, monkeypatch):
+        """ISSUE 11 satellite (carried PR9 remainder): with QoS on, edge
+        TTFT/ITL samples are ALSO recorded under the tenant's class label,
+        so the SLO engine fans out per-class ttft_p95/itl_p95 rows onto
+        /debug/slo — without disturbing the model-level objective."""
+        import aiohttp
+
+        from dynamo_tpu.runtime import telemetry
+
+        _clear_tenant_env(monkeypatch)
+        monkeypatch.delenv("DYN_TPU_SLO", raising=False)
+        telemetry.configure()
+        qos = QosPolicy(
+            classes=OrderedDict([("standard", 1.0), ("premium", 8.0)]),
+            tenant_map={"acme": "premium"},
+        )
+        svc = self._service(qos=qos)
+
+        async def go():
+            port = await svc.start()
+            base = f"http://127.0.0.1:{port}"
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        f"{base}/v1/chat/completions",
+                        json=dict(self._body(), stream=True),
+                        headers={"x-tenant-id": "acme"},
+                    ) as r:
+                        assert r.status == 200
+                        await r.text()
+                    async with s.get(f"{base}/debug/slo") as r:
+                        return await r.json()
+            finally:
+                await svc.stop()
+
+        state = run(go())
+        try:
+            store = telemetry.store()
+            # the class-labeled series exists alongside the model-level one
+            label_sets = store.labels_of("ttft_ms")
+            assert {"model": "echo"} in label_sets
+            assert {"model": "echo", "tenant": "premium"} in label_sets
+            rows = [
+                s for s in state["slo"]
+                if s["slo"] == "ttft_p95"
+                and s["labels"].get("tenant") == "premium"
+            ]
+            assert rows, "per-tenant ttft_p95 row missing from /debug/slo"
+        finally:
+            telemetry.configure()
+
     def test_edge_rate_limit_answers_tenant_429(self, run, monkeypatch):
         import aiohttp
 
@@ -1105,10 +1157,81 @@ class TestTenantTelemetry:
         assert te["acme"]["kv_blocks"] == 15
         assert te["acme"]["admitted_total"] == 80
         assert te["acme"]["rate_limited_total"] == 10
+        # first sight = no window yet: the cumulative share stands in
         assert te["acme"]["shed_share"] == pytest.approx(10 / 90, abs=1e-3)
         # the fully-throttled crawler reads as sustained-100%
         assert te["crawler"]["shed_share"] == 1.0
         assert te["crawler"]["class"] == "batch"
+
+    def test_shed_share_is_windowed_not_cumulative(self, monkeypatch):
+        """ISSUE 11 satellite (carried PR9 remainder): a tenant throttled an
+        hour ago but clean NOW must read shed_share 0 — `llmctl tenant
+        status` exit-2 reflects *current* throttling. The lifetime average
+        stays available as shed_share_cumulative."""
+        from dynamo_tpu.components.telemetry_aggregator import ClusterTelemetry
+        from dynamo_tpu.runtime.telemetry import TelemetryPolicy
+
+        t = [100.0]
+        pol = TelemetryPolicy(fast_window=60.0, mid_window=60.0,
+                              slow_window=60.0)
+        ct = ClusterTelemetry("tq", policy=pol, clock=lambda: t[0],
+                              expiry=1e9)
+
+        def ingest(admitted, limited):
+            ct.ingest("w0", self._metrics({
+                "crawler": {"class": "batch", "active_slots": 0,
+                            "queue_depth": 0, "kv_blocks": 0,
+                            "admitted": admitted, "rate_limited": limited},
+            }))
+
+        ingest(0, 100)          # baseline
+        t[0] += 5.0
+        ingest(0, 200)          # +100 sheds inside the window: throttling NOW
+        te = ct.rollup()["models"]["m1"]["tenants"]["crawler"]
+        assert te["shed_share"] == 1.0
+        assert te["shed_share_cumulative"] == 1.0
+        assert te["shed_share_window_s"] == 60.0
+
+        # an hour later the tenant is clean: offered traffic all admitted
+        t[0] += 3600.0
+        ingest(50, 200)         # +50 admitted, zero new sheds
+        te = ct.rollup()["models"]["m1"]["tenants"]["crawler"]
+        assert te["shed_share"] == 0.0, "history must not read as current"
+        # cumulative keeps the lifetime story
+        assert te["shed_share_cumulative"] == pytest.approx(200 / 250)
+
+        # ...and a QUIET tenant (no offered traffic at all in the window)
+        # is also not currently throttled
+        t[0] += 3600.0
+        ingest(50, 200)         # zero deltas
+        te = ct.rollup()["models"]["m1"]["tenants"]["crawler"]
+        assert te["shed_share"] == 0.0
+
+    def test_windowed_shed_share_drives_tenant_status_exit(self):
+        """The llmctl exit-2 predicate over the rollup rows: a historically-
+        abused-but-now-clean tenant no longer trips it."""
+        from dynamo_tpu.components.telemetry_aggregator import ClusterTelemetry
+        from dynamo_tpu.runtime.telemetry import TelemetryPolicy
+
+        t = [0.0]
+        pol = TelemetryPolicy(fast_window=60.0, mid_window=60.0,
+                              slow_window=60.0)
+        ct = ClusterTelemetry("tq", policy=pol, clock=lambda: t[0],
+                              expiry=1e9)
+        m = {"crawler": {"class": "batch", "active_slots": 0,
+                         "queue_depth": 0, "kv_blocks": 0,
+                         "admitted": 0, "rate_limited": 500}}
+        ct.ingest("w0", self._metrics(m))
+        t[0] += 3600.0
+        ct.ingest("w0", self._metrics(m))  # zero deltas: quiet for an hour
+
+        def throttled(te):
+            # the same predicate cli/llmctl.py applies per row
+            return (te.get("rate_limited_total", 0) > 0
+                    and te.get("shed_share", 0.0) >= 0.999)
+
+        te = ct.rollup()["models"]["m1"]["tenants"]["crawler"]
+        assert not throttled(te), "stale history must not page the operator"
 
     def test_tenant_gauges_render_and_parse(self):
         from dynamo_tpu.components.telemetry_aggregator import ClusterTelemetry
