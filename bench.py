@@ -1064,6 +1064,185 @@ def bench_resilience() -> dict:
     }
 
 
+def bench_blackout() -> dict:
+    """Control-plane blackout tolerance (docs/resilience.md §Control-plane
+    blackout; no TPU — deterministic token engines over the real statestore
+    + bus + RPC planes). Two legs at identical 2x load: a control with a
+    healthy control plane, and a blackout leg where the statestore AND bus
+    are stopped mid-run for ~a third of the wall time, then restarted
+    EMPTY (worst case: every lease and key gone). Reports served tok/s and
+    ITL p95 during the outage window vs control, plus time-to-reconverge:
+    how long after the store restart until every worker re-registered
+    under a fresh lease. BENCH_BLACKOUT=0 skips."""
+    import asyncio
+
+    import numpy as np
+
+    from dynamo_tpu.runtime.annotated import Annotated
+    from dynamo_tpu.runtime.bus import MessageBusServer
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.engine import AsyncEngine, Context
+    from dynamo_tpu.runtime.resilience import ResiliencePolicy
+    from dynamo_tpu.runtime.statestore import StateStoreServer
+
+    n_requests = int(os.environ.get("BENCH_BLACKOUT_REQUESTS", "24"))
+    gen_tokens = int(os.environ.get("BENCH_BLACKOUT_TOKENS", "120"))
+    outage_s = float(os.environ.get("BENCH_BLACKOUT_OUTAGE_S", "3.0"))
+    lease_ttl = float(os.environ.get("BENCH_BLACKOUT_LEASE_TTL", "1.0"))
+    token_delay = 0.004
+    os.environ.setdefault("DYN_TPU_REJOIN_JITTER", "1.0")
+    os.environ.setdefault("DYN_TPU_STALE_GRACE", "5.0")
+
+    class TokenEngine(AsyncEngine):
+        async def generate(self, request: Context):
+            req = request.data
+            toks = list(req["token_ids"])
+            for _ in range(int(req["stop_conditions"]["max_tokens"])):
+                if request.context.is_stopped:
+                    return
+                toks.append((toks[-1] * 31 + len(toks) * 7 + 13) % 50021)
+                yield Annotated.from_data({"token_ids": [toks[-1]]})
+                await asyncio.sleep(token_delay)
+            yield Annotated.from_data(
+                {"token_ids": [], "finish_reason": "length"}
+            )
+
+    async def leg(blackout: bool) -> dict:
+        ss = StateStoreServer(port=0)
+        await ss.start()
+        bus = MessageBusServer(port=0)
+        await bus.start()
+        ss_port, bus_port = ss.port, bus.port
+        rts = []
+        for _ in range(3):
+            rt = await DistributedRuntime.create(ss.url, bus.url)
+            ep = rt.namespace("bbo").component("w").endpoint("gen")
+            await ep.serve(
+                TokenEngine(), lease=await rt.store.grant_lease(ttl=lease_ttl)
+            )
+            rts.append(rt)
+        fe = await DistributedRuntime.create(ss.url, bus.url)
+        client = await fe.namespace("bbo").component("w").endpoint(
+            "gen"
+        ).client("round_robin", policy=ResiliencePolicy(
+            request_timeout=120.0, connect_timeout=2.0, max_attempts=4,
+            backoff_base=0.01, backoff_max=0.05, seed=3,
+        ))
+        await client.wait_for_instances(3, timeout=10)
+        window: dict = {"t0": None, "t1": None}
+        gaps_out: list = []  # inter-token gaps inside the outage window
+        gaps_all: list = []
+        tokens_out = [0]
+        errors = [0]
+
+        last_token_t = [0.0]
+
+        async def one(i: int) -> None:
+            ctx = Context({
+                "token_ids": [11 + i, 17 + 2 * i],
+                "stop_conditions": {"max_tokens": gen_tokens},
+                "sampling_options": {"temperature": 0.0},
+            })
+            last = None
+            async for item in client.generate(ctx):
+                if item.is_error:
+                    errors[0] += 1
+                    continue
+                now = time.perf_counter()
+                last_token_t[0] = max(last_token_t[0], now)
+                in_window = (
+                    window["t0"] is not None
+                    and now >= window["t0"]
+                    and (window["t1"] is None or now <= window["t1"])
+                )
+                if in_window:
+                    tokens_out[0] += 1
+                if last is not None:
+                    gaps_all.append(now - last)
+                    if in_window:
+                        gaps_out.append(now - last)
+                last = now
+
+        async def chaos() -> float:
+            await asyncio.sleep(0.3)
+            window["t0"] = time.perf_counter()
+            if blackout:
+                await ss.stop()
+                await bus.stop()
+            await asyncio.sleep(outage_s)
+            # the measured window is the dark time only; reconvergence after
+            # the restart is reported separately
+            window["t1"] = time.perf_counter()
+            reconverge = 0.0
+            if blackout:
+                ss2 = StateStoreServer("127.0.0.1", ss_port)  # restart EMPTY
+                await ss2.start()
+                bus2 = MessageBusServer("127.0.0.1", bus_port)
+                await bus2.start()
+                restart_t = time.perf_counter()
+                # reconvergence: all 3 workers re-registered (fresh leases)
+                from dynamo_tpu.runtime.statestore import StateStoreClient
+
+                probe = await StateStoreClient.connect(ss2.url)
+                while len(await probe.get_prefix(
+                    "bbo/components/w/endpoints/gen/instances/"
+                )) < 3:
+                    await asyncio.sleep(0.05)
+                await probe.close()
+                reconverge = time.perf_counter() - restart_t
+                chaos.servers = (ss2, bus2)  # type: ignore[attr-defined]
+            return reconverge
+
+        t0 = time.perf_counter()
+        chaos_task = asyncio.create_task(chaos())
+        await asyncio.gather(*[one(i) for i in range(n_requests)])
+        reconverge_s = await chaos_task
+        wall = time.perf_counter() - t0
+        await client.close()
+        for rt in rts + [fe]:
+            await rt.shutdown()
+        for srv in getattr(chaos, "servers", ()):  # the restarted planes
+            await srv.stop()
+        if not blackout:
+            await ss.stop()
+            await bus.stop()
+        arr_out = np.asarray(gaps_out or [0.0]) * 1e3
+        # the throughput window is the overlap of the outage and the
+        # traffic: if the streams drained before the planes came back, the
+        # traffic-free tail must not dilute tok/s for both legs
+        w_end = min(window["t1"], max(last_token_t[0], window["t0"]))
+        return {
+            "wall_s": round(wall, 3),
+            "errors": errors[0],
+            "outage_window_s": round(window["t1"] - window["t0"], 3),
+            "outage_traffic_overlap_s": round(w_end - window["t0"], 3),
+            "outage_tok_s": round(
+                tokens_out[0] / max(w_end - window["t0"], 1e-9), 1
+            ),
+            "outage_itl_p95_ms": round(float(np.percentile(arr_out, 95)), 3),
+            "reconverge_s": round(reconverge_s, 3),
+        }
+
+    control = asyncio.run(leg(blackout=False))
+    dark = asyncio.run(leg(blackout=True))
+    return {
+        "scenario": (
+            f"{n_requests} concurrent streams x {gen_tokens} tokens on 3 "
+            f"workers; blackout leg kills statestore+bus for {outage_s}s "
+            f"mid-run and restarts them EMPTY (lease ttl {lease_ttl}s)"
+        ),
+        "control": control,
+        "blackout": dark,
+        "outage_tok_s_ratio": round(
+            dark["outage_tok_s"] / max(control["outage_tok_s"], 1e-9), 4
+        ),
+        "added_outage_itl_p95_ms": round(
+            dark["outage_itl_p95_ms"] - control["outage_itl_p95_ms"], 3
+        ),
+        "reconverge_s": dark["reconverge_s"],
+    }
+
+
 def main() -> None:
     from dynamo_tpu.engine_jax.compile_cache import enable_compile_cache
 
@@ -1304,6 +1483,11 @@ def main() -> None:
             out["resilience"] = bench_resilience()
         except Exception as e:
             out["resilience"] = {"error": str(e)[:200]}
+    if os.environ.get("BENCH_BLACKOUT", "1") == "1":
+        try:
+            out["blackout"] = bench_blackout()
+        except Exception as e:
+            out["blackout"] = {"error": str(e)[:200]}
     # LAST: pays minutes of first-boot remote compilation on the tunneled
     # runtime — must not eat the other sections' budget if it times out
     if os.environ.get("BENCH_MODEL_8B", "1") == "1":

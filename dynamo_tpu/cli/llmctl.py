@@ -15,6 +15,7 @@
     python -m dynamo_tpu.cli.llmctl slo status [--json] [dyn://ns.telemetry.status]
     python -m dynamo_tpu.cli.llmctl cluster status [--json] [dyn://ns.telemetry.status]
     python -m dynamo_tpu.cli.llmctl tenant status [--json] [dyn://ns.telemetry.status]
+    python -m dynamo_tpu.cli.llmctl control-plane status [--json] [dyn://ns.telemetry.status]
     python -m dynamo_tpu.cli.llmctl planner status [--json] [dyn://ns.planner.plan]
 
 ``worker drain`` writes a drain control key the target worker watches
@@ -33,6 +34,14 @@ the aggregator's fast window (the rollup's ``shed_share`` is windowed, so
 a long-past abuse episode clears once the throttling stops) — a runaway
 client or a misconfigured quota, caught by cron like an SLO page
 (docs/qos.md has the runbook).
+
+``control-plane status`` renders each model's worker counts by their
+self-reported statestore/bus connectivity (connected | stale |
+disconnected) plus outage-buffer drop counters from the same aggregator
+rollup; it exits 2 while *any* component reports stale/disconnected —
+including the CLI itself failing to reach the statestore — so a cron
+probe notices a fleet running on frozen discovery before the next
+incident does (docs/resilience.md §Control-plane blackout runbook).
 
 ``planner status`` dials the planner component (``components/planner.py``)
 and renders its decision ring — who reshaped the fleet and why — plus the
@@ -98,6 +107,7 @@ def build_parser() -> argparse.ArgumentParser:
         ("slo", "SLO compliance + burn-rate alerts from the telemetry plane"),
         ("cluster", "cluster capacity/health rollup from the telemetry plane"),
         ("tenant", "per-tenant QoS rollup (rate/shed share, KV occupancy)"),
+        ("control-plane", "statestore/bus connectivity as the fleet sees it"),
     ):
         tp = sub.add_parser(plane, help=verb_help)
         tpv = tp.add_subparsers(dest="verb", required=True)
@@ -157,11 +167,29 @@ async def amain(argv: list) -> int:
     from dynamo_tpu.runtime.statestore import StateStoreClient
 
     url = args.statestore or os.environ.get("DYN_TPU_STATESTORE", "127.0.0.1:37901")
-    store = await StateStoreClient.connect(url)
+    try:
+        store = await StateStoreClient.connect(url)
+    except (ConnectionError, OSError) as e:
+        if args.plane == "control-plane":
+            # the probe itself proves the outage: no discovery means no
+            # aggregator dial, but the verdict is already in. Honor --json
+            # — a cron consumer parsing stdout must not crash during the
+            # exact outage this command exists to report.
+            if getattr(args, "as_json", False):
+                # SAME envelope shape as the healthy path (an object with
+                # a rows list) — a cron consumer must parse both
+                print(json.dumps({
+                    "statestore": "disconnected", "url": url,
+                    "error": str(e), "rows": [],
+                }))
+            else:
+                print(f"statestore  DISCONNECTED  ({url}: {e})")
+            return 2
+        raise
     try:
         if args.plane == "trace":
             return await _trace_cmd(args, store)
-        if args.plane in ("slo", "cluster", "tenant"):
+        if args.plane in ("slo", "cluster", "tenant", "control-plane"):
             return await _telemetry_cmd(args, store)
         if args.plane == "planner":
             return await _planner_cmd(args, store)
@@ -416,6 +444,50 @@ async def _telemetry_cmd(args, store) -> int:
             for r in throttled:
                 print(f'  {r["tenant"]} (model {r["model"]}, '
                       f'{r["rate_limited_total"]} sheds)')
+            return 2
+        return 0
+    if args.plane == "control-plane":
+        # per-model worker counts by self-reported control-plane view
+        # (docs/resilience.md §Control-plane blackout runbook); exit 2
+        # while ANY component reports stale/disconnected so cron catches a
+        # fleet serving on stale discovery before the next incident does
+        roll = cluster.get("rollup") or {}
+        rows = []
+        impaired_total = 0
+        for model, e in sorted((roll.get("models") or {}).items()):
+            cp = e.get("control_plane") or {}
+            impaired = int(e.get("control_plane_impaired", 0) or 0)
+            impaired_total += impaired
+            rows.append({
+                "model": model,
+                "workers": e.get("workers", 0),
+                "connected": cp.get("connected", e.get("workers", 0)),
+                "stale": cp.get("stale", 0),
+                "disconnected": cp.get("disconnected", 0),
+                "bus_dropped_events": e.get("bus_dropped_events", 0),
+                "impaired_worker_ids": cp.get("impaired_worker_ids", []),
+            })
+        if args.as_json:
+            print(json.dumps({
+                "statestore": "connected", "rows": rows,
+            }, indent=2))
+            return 2 if impaired_total else 0
+        if not rows:
+            print("(no workers reporting — is the aggregator ingesting?)")
+            return 0
+        for r in rows:
+            print(
+                f'{r["model"]:20s} workers={r["workers"]:3d} '
+                f'connected={r["connected"]:3d} stale={r["stale"]:3d} '
+                f'disconnected={r["disconnected"]:3d} '
+                f'dropped_events={r["bus_dropped_events"]}'
+            )
+        if impaired_total:
+            print(f"IMPAIRED: {impaired_total} worker(s) on a stale/"
+                  f"disconnected control plane:")
+            for r in rows:
+                for wid in r["impaired_worker_ids"]:
+                    print(f'  {wid} (model {r["model"]})')
             return 2
         return 0
     # cluster status

@@ -70,11 +70,20 @@ GAUGES = [
     # and resumable streams that still died in-band (cumulative)
     ("resume_total", "Streams resumed on another worker mid-decode (cumulative)"),
     ("resume_failed_total", "Resumable streams that still failed in-band (cumulative)"),
+    # control-plane blackout tolerance (docs/resilience.md): events this
+    # worker dropped from its outage buffers while the bus was down
+    ("bus_dropped_events", "Events dropped from control-plane outage buffers (cumulative)"),
 ]
 
 # health_state is a string on the wire; Prometheus wants a number. Unknown
 # states map to the unhealthy value so a future state is never read as fine.
 HEALTH_STATE_VALUES = {"healthy": 0, "degraded": 1, "unhealthy": 2}
+
+# control_plane_state likewise ("" from pre-blackout workers = connected;
+# anything unknown renders as disconnected)
+CONTROL_PLANE_STATE_VALUES = {
+    "": 0, "connected": 0, "stale": 1, "disconnected": 2,
+}
 
 
 class MetricsAggregator:
@@ -140,6 +149,20 @@ class MetricsAggregator:
         for worker_id, m in sorted(live.items()):
             value = HEALTH_STATE_VALUES.get(
                 getattr(m, "health_state", "healthy"), 2
+            )
+            lines.append(
+                f'{full}{{namespace="{_escape_label(self.namespace)}",'
+                f'worker="{_escape_label(str(worker_id))}"}} {value}'
+            )
+        full = f"{self.prefix}_control_plane_state"
+        lines.append(
+            f"# HELP {full} Worker view of the control plane "
+            f"(0=connected, 1=stale, 2=disconnected)"
+        )
+        lines.append(f"# TYPE {full} gauge")
+        for worker_id, m in sorted(live.items()):
+            value = CONTROL_PLANE_STATE_VALUES.get(
+                getattr(m, "control_plane_state", "") or "", 2
             )
             lines.append(
                 f'{full}{{namespace="{_escape_label(self.namespace)}",'

@@ -102,6 +102,8 @@ class MockWorkerStats:
         tenants: Optional[Dict[str, int]] = None,
         resume_total: int = 0,
         resume_failed: int = 0,
+        control_plane_state: str = "connected",
+        bus_dropped_events: int = 0,
     ):
         from dynamo_tpu.runtime.tracing import PHASE_BUCKETS
 
@@ -137,6 +139,11 @@ class MockWorkerStats:
         # rollup's resume sums can be exercised without killing workers
         self.resume_total = max(int(resume_total), 0)
         self.resume_failed = max(int(resume_failed), 0)
+        # control-plane blackout drill: report a stale/disconnected view so
+        # `llmctl control-plane status` exit-2 and the dynamo_*_control_*
+        # gauges can be exercised without killing a statestore
+        self.control_plane_state = control_plane_state
+        self.bus_dropped_events = max(int(bus_dropped_events), 0)
         # multi-tenant QoS drill (docs/qos.md): tenant → per-tick request
         # share. Each tick splits its requests across tenants by share and
         # grows per-tenant counters + occupancy splits, so aggregator /
@@ -305,6 +312,8 @@ class MockWorkerStats:
             kv_quantized=int(self.kv_quantized),
             resume_total=self.resume_total,
             resume_failed_total=self.resume_failed,
+            control_plane_state=self.control_plane_state,
+            bus_dropped_events=self.bus_dropped_events,
             uptime_s=round(time.monotonic() - self.started, 3),
             model=model,
             role=self.role,
@@ -362,6 +371,7 @@ async def run_mock_worker(
     tenants: Optional[Dict[str, int]] = None,
     resume_total: int = 0,
     resume_failed: int = 0,
+    control_plane_state: str = "connected",
 ) -> None:
     from dynamo_tpu.runtime.distributed import KV_METRICS_SUBJECT
 
@@ -372,6 +382,7 @@ async def run_mock_worker(
         spec_accept_rate=spec_accept_rate, kv_quantized=kv_quantized,
         role=role, tenants=tenants,
         resume_total=resume_total, resume_failed=resume_failed,
+        control_plane_state=control_plane_state,
     )
     tick_no = 0
     while True:
@@ -427,6 +438,12 @@ def main() -> None:
                         "workers)")
     p.add_argument("--resume-failed", type=int, default=0,
                    help="report N failed resume recoveries")
+    p.add_argument("--control-plane-state", default="connected",
+                   choices=("connected", "stale", "disconnected"),
+                   help="report this control-plane view (drills `llmctl "
+                        "control-plane status` exit-2 and the "
+                        "dynamo_*_control_plane gauges without killing a "
+                        "statestore)")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
     profile = (
@@ -450,6 +467,7 @@ def main() -> None:
             tenants=parse_tenant_shares(args.tenants),
             resume_total=args.resume_total,
             resume_failed=args.resume_failed,
+            control_plane_state=args.control_plane_state,
         )
 
     asyncio.run(run())

@@ -210,6 +210,10 @@ class PlannerStatus:
     cooldowns: Dict[str, float] = field(default_factory=dict)
     failing: List[dict] = field(default_factory=list)
     policy: Dict[str, Any] = field(default_factory=dict)
+    # seconds the observation source has been failing (0.0 = fresh):
+    # hold-position on stale data is deliberate, but it must be VISIBLE
+    # (docs/resilience.md §Control-plane blackout)
+    source_stale_s: float = 0.0
 
     def to_dict(self) -> dict:
         return {
@@ -217,6 +221,7 @@ class PlannerStatus:
             "cooldowns": dict(self.cooldowns),
             "failing": list(self.failing),
             "policy": dict(self.policy),
+            "source_stale_s": round(self.source_stale_s, 1),
         }
 
     @classmethod
@@ -226,6 +231,7 @@ class PlannerStatus:
             cooldowns=dict(d.get("cooldowns") or {}),
             failing=list(d.get("failing") or []),
             policy=dict(d.get("policy") or {}),
+            source_stale_s=float(d.get("source_stale_s", 0.0) or 0.0),
         )
 
 
@@ -646,11 +652,15 @@ class Planner:
             for (model, pool, direction), expires in self._cooldowns.items()
             if expires > now
         }
+        # the run loop points this at its source's staleness_s so llmctl
+        # (and any dump reader) can see the planner's eyes are stale
+        stale_fn = getattr(self, "source_staleness", None)
         return PlannerStatus(
             decisions=[d.to_dict() for d in self.decisions],
             cooldowns=cooldowns,
             failing=[d.to_dict() for d in self.failing()],
             policy=self.policy.to_dict(),
+            source_stale_s=stale_fn() if callable(stale_fn) else 0.0,
         ).to_dict()
 
 
@@ -669,12 +679,29 @@ class AggregatorSource:
         self.store = store
         self.endpoint = endpoint
         self.timeout = timeout
+        # explicit staleness stamp (docs/resilience.md §Control-plane
+        # blackout): monotonic time of the last successful fetch, and how
+        # long the source has been failing — hold-position is silent
+        # otherwise, and an operator reading the planner status must be
+        # able to see that its eyes are stale, not merely calm
+        self._last_success: Optional[float] = None
+        self.stale_since: Optional[float] = None
+
+    def staleness_s(self) -> float:
+        """Seconds this source has been unable to observe (0.0 = fresh)."""
+        if self.stale_since is None:
+            return 0.0
+        return time.monotonic() - self.stale_since
 
     async def fetch(self) -> Tuple[Optional[dict], Optional[list]]:
         from dynamo_tpu.runtime.distributed import live_instance_infos
         from dynamo_tpu.runtime.rpc import RpcClient
 
-        for info in await live_instance_infos(self.store, self.endpoint):
+        try:
+            infos = await live_instance_infos(self.store, self.endpoint)
+        except (ConnectionError, RuntimeError, OSError):
+            infos = []  # statestore down: same hold-position as no dial
+        for info in infos:
             try:
                 client = await RpcClient.connect(
                     info.address, timeout=self.timeout
@@ -688,7 +715,11 @@ class AggregatorSource:
             finally:
                 await client.close()
             cluster = dump.get("cluster") or {}
+            self._last_success = time.monotonic()
+            self.stale_since = None
             return cluster.get("rollup"), cluster.get("slo")
+        if self.stale_since is None:
+            self.stale_since = time.monotonic()
         return None, None
 
 
@@ -735,6 +766,7 @@ async def run_planner(
     consumer: Optional[asyncio.Task] = None
     if aggregator:
         source: Any = AggregatorSource(drt.store, aggregator)
+        planner.source_staleness = source.staleness_s
     else:
         from dynamo_tpu.components.telemetry_aggregator import ClusterTelemetry
         from dynamo_tpu.kv_router.protocols import ForwardPassMetrics

@@ -69,6 +69,11 @@ CLUSTER_GAUGES = [
     # mid-stream resume (docs/resilience.md): fleet recovery counters
     ("resume_total", "Streams resumed on another worker mid-decode (fleet sum)"),
     ("resume_failed_total", "Resumable streams that still failed in-band (fleet sum)"),
+    # control-plane blackout tolerance (docs/resilience.md): workers whose
+    # own view of the statestore/bus planes is stale or disconnected, and
+    # the fleet's cumulative outage-buffer drops
+    ("control_plane_impaired", "Workers reporting a stale/disconnected control plane"),
+    ("bus_dropped_events", "Events dropped from control-plane outage buffers (fleet sum)"),
     ("worst_worker_load", "Highest per-worker load score"),
     ("median_worker_load", "Median per-worker load score"),
 ]
@@ -350,6 +355,12 @@ class ClusterTelemetry:
                 "spec_drafted_tokens": 0, "spec_accepted_tokens": 0,
                 "spec_accept_rate": 0.0,
                 "resume_total": 0, "resume_failed_total": 0,
+                "control_plane_impaired": 0,
+                "bus_dropped_events": 0,
+                "control_plane": {
+                    "connected": 0, "stale": 0, "disconnected": 0,
+                    "impaired_worker_ids": [],
+                },
                 "pools": {},
                 "tenants": {},
                 "unhealthy_worker_ids": [],
@@ -393,6 +404,21 @@ class ClusterTelemetry:
             entry["resume_total"] += int(getattr(m, "resume_total", 0) or 0)
             entry["resume_failed_total"] += int(
                 getattr(m, "resume_failed_total", 0) or 0
+            )
+            # control-plane view per worker: count by state, name the
+            # impaired ones (bounded like unhealthy_worker_ids) so `llmctl
+            # control-plane status` can say WHO is cut off, and sum the
+            # outage-buffer drops
+            cp_state = getattr(m, "control_plane_state", "") or "connected"
+            if cp_state not in ("connected", "stale", "disconnected"):
+                cp_state = "disconnected"  # unknown future state ≠ fine
+            entry["control_plane"][cp_state] += 1
+            if cp_state != "connected":
+                entry["control_plane_impaired"] += 1
+                if len(entry["control_plane"]["impaired_worker_ids"]) < 16:
+                    entry["control_plane"]["impaired_worker_ids"].append(wid)
+            entry["bus_dropped_events"] += int(
+                getattr(m, "bus_dropped_events", 0) or 0
             )
             # pool-role breakdown: what the planner actually resizes
             role = getattr(m, "role", "") or "decode"

@@ -655,6 +655,14 @@ class JaxServingEngine(AsyncEngine):
             if self._qos is not None and self._qos.kv_frac > 0
             else 0
         )
+        # per-tenant decode-slot budget: the same work-conserving contract
+        # over concurrency — a tenant at its slot share defers while other
+        # tenants are active, and alone it may fill the whole batch
+        self._tenant_slot_budget = (
+            max(1, int(self._qos.slot_frac * engine_config.max_slots))
+            if self._qos is not None and self._qos.slot_frac > 0
+            else 0
+        )
         # high-water mark of prefill tokens computed in a single dispatch
         # that also carried a decode lane — the chunked-prefill interleaving
         # bound the ITL-isolation test asserts against the step budget
@@ -1560,6 +1568,22 @@ class JaxServingEngine(AsyncEngine):
             return False
         return self._tenant_contended(seq.tenant)
 
+    def _slot_budget_defers(self, seq: "_Seq") -> bool:
+        """Admission-side slot budget (docs/qos.md): a tenant already
+        occupying its share of the decode batch defers while any OTHER
+        tenant is actively holding resources — concurrency isolation with
+        the same work-conserving contract as the KV budget (an uncontended
+        tenant may fill every slot)."""
+        if self._tenant_slot_budget <= 0 or not seq.tenant:
+            return False
+        held = sum(
+            1 for s in self._slots
+            if s is not None and s.tenant == seq.tenant
+        )
+        if held < self._tenant_slot_budget:
+            return False
+        return self._tenant_contended(seq.tenant)
+
     def _budget_denies_grow(self, seq: "_Seq", n_tokens: int) -> bool:
         """Decode-growth KV budget: an over-share tenant's sequence is
         recompute-preempted (it pays with its own latency) instead of
@@ -1642,9 +1666,11 @@ class JaxServingEngine(AsyncEngine):
                     deferred.append(seq)
                     continue
                 seq.wait_hash = None
-            if self._fair is not None and self._kv_budget_defers(seq):
-                # tenant over its KV share while others are active: park
-                # this request (its own latency pays) — the scheduler
+            if self._fair is not None and (
+                self._kv_budget_defers(seq) or self._slot_budget_defers(seq)
+            ):
+                # tenant over its KV or slot share while others are active:
+                # park this request (its own latency pays) — the scheduler
                 # keeps admitting other tenants past it
                 deferred.append(seq)
                 continue

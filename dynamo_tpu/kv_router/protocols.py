@@ -218,6 +218,15 @@ class ForwardPassMetrics:
     # DYN_TPU_TENANT_* knobs); the aggregator sums the numeric fields into
     # the dynamo_tenant_* cluster gauges.
     tenants: Optional[dict] = None
+    # control-plane blackout tolerance (runtime/control_plane.py,
+    # docs/resilience.md): this worker's view of the statestore/bus planes
+    # ("connected" | "stale" | "disconnected"; "" from pre-blackout
+    # workers, read as connected), cumulative events dropped from its
+    # outage buffers, and — on snapshots backfilled after a bus outage —
+    # how many seconds the snapshot sat buffered before it could publish.
+    control_plane_state: str = ""
+    bus_dropped_events: int = 0
+    stale_s: float = 0.0
 
     def to_dict(self) -> dict:
         from dataclasses import asdict

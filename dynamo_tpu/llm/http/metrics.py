@@ -300,6 +300,14 @@ class ServiceMetrics:
             out += telemetry.render_cluster_metrics()
         except Exception:  # telemetry unavailable must never break /metrics
             pass
+        try:
+            from dynamo_tpu.runtime import control_plane
+
+            # statestore/bus connectivity as this process sees it
+            # (docs/resilience.md §Control-plane blackout)
+            out += control_plane.render_prometheus()
+        except Exception:  # must never break /metrics
+            pass
         return out
 
 

@@ -190,7 +190,8 @@ class HttpService:
                 # SUM across a model's engines (chat vs completions may be
                 # distinct clients) — a later summary must not clobber the
                 # counts that justified an earlier engine's verdict
-                for k in ("instances", "serving", "draining", "unhealthy"):
+                for k in ("instances", "serving", "draining", "unhealthy",
+                          "stale"):
                     entry[k] = entry.get(k, 0) + int(summary.get(k, 0))
                 if summary.get("serving", 0) == 0:
                     # ANY engine with zero serving instances means some
@@ -205,8 +206,17 @@ class HttpService:
                 overall = "unhealthy"
             elif entry["status"] == "degraded" and overall == "healthy":
                 overall = "degraded"
+        # control-plane view (docs/resilience.md §Control-plane blackout):
+        # surfaced but NEVER a readiness failure by itself — a frontend
+        # serving from stale discovery is degraded observability, not a
+        # dead data plane, and load balancers must keep sending traffic
+        from dynamo_tpu.runtime import control_plane
+
+        cp = control_plane.snapshot()
+        if overall == "healthy" and cp["state"] != "connected":
+            overall = "degraded"
         return web.json_response(
-            {"status": overall, "models": models},
+            {"status": overall, "models": models, "control_plane": cp},
             status=503 if overall == "unhealthy" else 200,
         )
 

@@ -38,7 +38,7 @@ import uuid
 from collections import deque
 from typing import AsyncIterator, Deque, Dict, List, Optional, Tuple
 
-from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime import control_plane, faults
 from dynamo_tpu.runtime.codec import TwoPartMessage, read_frame, write_frame
 
 logger = logging.getLogger(__name__)
@@ -584,15 +584,24 @@ class MessageBusClient:
         self._reader_task: Optional[asyncio.Task] = None
         self._send_lock = asyncio.Lock()
         self._closed = False
+        # connectivity view for outage-aware publishers (control_plane
+        # buffering): False while the read loop is redialing a dead server
+        self._up = False
         # strong refs to fire-and-forget cleanup tasks (asyncio only weakly
         # references tasks; a GC'd cleanup would strand a queue item)
         self._bg_tasks: set = set()
+
+    @property
+    def connected(self) -> bool:
+        return self._up and not self._closed
 
     @classmethod
     async def connect(cls, url: str, reconnect: bool = True) -> "MessageBusClient":
         host, _, port = url.rpartition(":")
         c = cls(host or "127.0.0.1", int(port), reconnect=reconnect)
         c._reader, c._writer = await faults.open_connection(c.host, c.port, plane="bus")
+        c._up = True
+        control_plane.note_bus(True)
         c._reader_task = asyncio.create_task(c._read_loop())
         return c
 
@@ -641,6 +650,8 @@ class MessageBusClient:
             except (ConnectionError, OSError):
                 continue  # server bounced again mid-replay: redial
             logger.info("bus client reconnected to %s:%d", self.host, self.port)
+            self._up = True
+            control_plane.note_bus(True)
             return True
         return False
 
@@ -661,12 +672,15 @@ class MessageBusClient:
                     if fut is not None and not fut.done():
                         fut.set_result((h, frame.body))
             except asyncio.CancelledError:
+                self._up = False
                 self._fail_all()
                 return
             except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                self._up = False
                 if self._closed or not self.reconnect:
                     self._fail_all()
                     return
+                control_plane.note_bus(False)
                 try:
                     ok = await self._reconnect()
                 except asyncio.CancelledError:

@@ -1,0 +1,507 @@
+"""Control-plane blackout tolerance: the data plane outlives the statestore
+and bus.
+
+The reference architecture makes etcd the discovery authority and NATS the
+metrics plane — both single points of failure. This module holds the policy
+and shared machinery that turns a full control-plane outage into a degraded
+*observability* event instead of a serving outage (docs/resilience.md
+§Control-plane blackout):
+
+- :class:`ControlPlanePolicy` — the ``DYN_TPU_*`` knob bundle (PR3 clamping
+  contract) for stale-serve discovery, the disk discovery cache, rejoin
+  jitter, cold-start deadline, and the bus publish buffer.
+- :class:`ControlPlaneState` — process-global connected/stale/disconnected
+  tracker per plane, exposed as the ``dynamo_control_plane_state`` gauge,
+  the ``control_plane_state`` field on worker metric snapshots, the HTTP
+  ``/health`` payload, and ``llmctl control-plane status``.
+- :class:`DiscoveryCache` — an atomic on-disk snapshot of discovery
+  prefixes (instances, model registry) so a frontend restarted *during* an
+  outage cold-starts from the last-known-good view instead of hanging.
+  Only constructed when ``DYN_TPU_DISCOVERY_CACHE`` names a directory —
+  healthy fleets with the knob unset never touch disk (zero-overhead
+  guard, tests/test_control_plane.py).
+- :class:`BoundedPublishBuffer` — drop-oldest buffering for event-plane
+  publishers during a bus outage; the telemetry aggregator's diff
+  discipline absorbs the stamped backfill at recovery.
+- :func:`rejoin_delay` — deterministic per-worker jitter so a fleet
+  re-registering after a statestore recovery spreads its writes instead of
+  thundering-herding the freshly restarted store.
+- :class:`ControlPlaneUnavailable` — the typed cold-start failure: neither
+  a reachable statestore nor a usable cache within the deadline. A
+  ``ConnectionError`` subclass so pre-existing handlers keep working.
+
+Design stance (docs/architecture.md): discovery is a *cache*, not an
+authority. The statestore's word is advisory; the RPC-plane health probes
+(runtime/health.py), which never depended on the store, are the liveness
+authority whenever the two disagree.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Optional, Tuple
+
+CONNECTED = "connected"
+STALE = "stale"
+DISCONNECTED = "disconnected"
+
+# numeric form for the dynamo_control_plane_state gauge; unknown states
+# render as disconnected so a future state is never read as fine
+STATE_VALUES = {CONNECTED: 0, STALE: 1, DISCONNECTED: 2}
+
+ENV_CACHE = "DYN_TPU_DISCOVERY_CACHE"
+
+# a lease lost within this many seconds of the owning client's store
+# connection dropping is treated as OUTAGE-caused (the whole fleet lost
+# leases together → rejoin jitter applies); a plain expiry on a client
+# that was healthy throughout pays nothing
+REJOIN_OUTAGE_WINDOW_S = 60.0
+
+
+class ControlPlaneUnavailable(ConnectionError):
+    """Cold start with neither a reachable statestore nor a usable
+    discovery cache within the deadline. Typed so callers (and process
+    supervisors) can distinguish "the control plane is down and I have
+    nothing to serve from" from transient dial errors."""
+
+
+def _env_pos_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def _env_nonneg_float(name: str, default: float) -> float:
+    """0 is a policy (feature off), malformed/negative clamp to default."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v >= 0 else default
+
+
+def _env_nonneg_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v >= 0 else default
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    return raw.strip().lower() not in ("0", "false", "no", "off")
+
+
+@dataclass
+class ControlPlanePolicy:
+    """The blackout-tolerance knob bundle (``ControlPlanePolicy.from_env()``).
+
+    ``stale_serve``        keep the last-known-good discovery view when the
+                           statestore dies or restarts empty, and let the
+                           RPC health probes govern liveness
+                           (``DYN_TPU_STALE_SERVE``; 0 = the pre-blackout
+                           behavior: the live set follows the store's word,
+                           including clearing to empty).
+    ``stale_grace``        seconds a stale discovery entry survives without
+                           re-confirmation before the purge rules run
+                           (``DYN_TPU_STALE_GRACE``; superseded or
+                           probe-failed entries drop, probe-passing ones
+                           are held — probes are the authority).
+    ``rejoin_jitter``      max seconds of deterministic per-worker delay
+                           before re-registering after a store *outage*
+                           (``DYN_TPU_REJOIN_JITTER``; 0 = off). Plain
+                           single-lease expiry never pays it.
+    ``cold_start_deadline`` how long ``DistributedRuntime.create`` retries a
+                           dead statestore before falling back to the cache
+                           or raising :class:`ControlPlaneUnavailable`
+                           (``DYN_TPU_COLD_START_DEADLINE``).
+    ``bus_buffer``         entries a publisher buffers (drop-oldest) while
+                           the bus is down (``DYN_TPU_BUS_BUFFER``; 0 = no
+                           buffering, outage publishes are dropped as
+                           before).
+    ``cache_dir``          directory for the discovery snapshot
+                           (``DYN_TPU_DISCOVERY_CACHE``; empty = cache off,
+                           no file is ever opened).
+    """
+
+    stale_serve: bool = True
+    stale_grace: float = 20.0
+    rejoin_jitter: float = 5.0
+    cold_start_deadline: float = 5.0
+    bus_buffer: int = 256
+    cache_dir: str = ""
+
+    @classmethod
+    def from_env(cls, prefix: str = "DYN_TPU_") -> "ControlPlanePolicy":
+        d = cls()
+        return cls(
+            stale_serve=_env_flag(prefix + "STALE_SERVE", d.stale_serve),
+            stale_grace=_env_pos_float(prefix + "STALE_GRACE", d.stale_grace),
+            rejoin_jitter=_env_nonneg_float(
+                prefix + "REJOIN_JITTER", d.rejoin_jitter
+            ),
+            cold_start_deadline=_env_pos_float(
+                prefix + "COLD_START_DEADLINE", d.cold_start_deadline
+            ),
+            bus_buffer=_env_nonneg_int(prefix + "BUS_BUFFER", d.bus_buffer),
+            cache_dir=os.environ.get(ENV_CACHE, d.cache_dir) or "",
+        )
+
+
+def rejoin_delay(worker_id: str, window: float, seed: int = 0) -> float:
+    """Deterministic jitter in ``[0, window)`` for one worker: a stable
+    hash of ``(seed, worker_id)``, NOT process RNG — the same fleet
+    recovering from the same outage always spreads the same way, so a
+    recovery storm is replayable and testable. 100 workers re-registering
+    after a blackout land spread across the window instead of inside one
+    lease-TTL beat of each other."""
+    if window <= 0:
+        return 0.0
+    digest = hashlib.sha256(f"{seed}:{worker_id}".encode()).digest()
+    frac = int.from_bytes(digest[:8], "big") / float(1 << 64)
+    return frac * window
+
+
+# ---------------------------------------------------------------------------
+# process-global state tracker
+# ---------------------------------------------------------------------------
+
+
+class ControlPlaneState:
+    """Thread-safe connected/stale/disconnected view per plane.
+
+    The statestore/bus clients report raw connectivity; discovery layers
+    (EndpointClient, ModelWatcher) report how many entries they are
+    currently serving on stale authority; publishers report buffered and
+    dropped event counts. ``snapshot()`` folds all of it into the wire/
+    exposition form."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._connected: Dict[str, bool] = {"statestore": True, "bus": True}
+        self._since: Dict[str, float] = {}
+        self._last_disconnect: Dict[str, float] = {}  # monotonic
+        self._outages: Dict[str, int] = {"statestore": 0, "bus": 0}
+        # discovery consumer id → count of entries currently held stale
+        self._stale_entries: Dict[str, int] = {}
+        # publisher id → events currently buffered awaiting the bus
+        self._buffered: Dict[str, int] = {}
+        self._dropped = 0
+        # discovery views (instance sets, model registry) a consumer
+        # cold-started from the disk cache — counted at each load, so one
+        # frontend restart mid-outage counts once per seeded view
+        self._cache_serves = 0
+
+    def note_plane(self, plane: str, connected: bool) -> None:
+        with self._lock:
+            was = self._connected.get(plane, True)
+            self._connected[plane] = connected
+            if was and not connected:
+                self._outages[plane] = self._outages.get(plane, 0) + 1
+                self._since[plane] = time.time()
+                self._last_disconnect[plane] = time.monotonic()
+            elif connected:
+                self._since.pop(plane, None)
+
+    def seconds_since_disconnect(self, plane: str) -> float:
+        """Monotonic seconds since this plane last lost its connection
+        (``inf`` if it never has) — lets recovery paths distinguish
+        "the store just came back from an outage" from "the store was
+        healthy all along"."""
+        with self._lock:
+            t = self._last_disconnect.get(plane)
+        return float("inf") if t is None else time.monotonic() - t
+
+    def note_stale_entries(self, consumer: str, count: int) -> None:
+        with self._lock:
+            if count > 0:
+                self._stale_entries[consumer] = count
+            else:
+                self._stale_entries.pop(consumer, None)
+
+    def forget_consumer(self, consumer: str) -> None:
+        with self._lock:
+            self._stale_entries.pop(consumer, None)
+            self._buffered.pop(consumer, None)
+
+    def note_buffer(self, consumer: str, buffered: int,
+                    dropped_delta: int = 0) -> None:
+        with self._lock:
+            if buffered > 0:
+                self._buffered[consumer] = int(buffered)
+            else:
+                self._buffered.pop(consumer, None)
+            self._dropped += max(int(dropped_delta), 0)
+
+    def note_cache_serve(self) -> None:
+        with self._lock:
+            self._cache_serves += 1
+
+    def plane_state(self, plane: str) -> str:
+        with self._lock:
+            return self._plane_state_locked(plane)
+
+    def _plane_state_locked(self, plane: str) -> str:
+        if not self._connected.get(plane, True):
+            return DISCONNECTED
+        if plane == "statestore" and sum(self._stale_entries.values()):
+            # reconnected, but discovery still holds entries the store no
+            # longer vouches for — the probes are mid-reconciliation
+            return STALE
+        if plane == "bus" and sum(self._buffered.values()):
+            return STALE
+        return CONNECTED
+
+    def worst(self) -> str:
+        with self._lock:
+            states = [
+                self._plane_state_locked(p) for p in ("statestore", "bus")
+            ]
+        return max(states, key=lambda s: STATE_VALUES.get(s, 2))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {
+                "state": CONNECTED,
+                "stale_discovery_entries": sum(self._stale_entries.values()),
+                "bus_buffered_events": sum(self._buffered.values()),
+                "bus_dropped_events": self._dropped,
+                "cache_cold_starts": self._cache_serves,
+            }
+            planes = {}
+            for plane in ("statestore", "bus"):
+                st = self._plane_state_locked(plane)
+                entry = {"state": st, "outages": self._outages.get(plane, 0)}
+                since = self._since.get(plane)
+                if since is not None:
+                    entry["down_for_s"] = round(time.time() - since, 1)
+                planes[plane] = entry
+            out["planes"] = planes
+            out["state"] = max(
+                (p["state"] for p in planes.values()),
+                key=lambda s: STATE_VALUES.get(s, 2),
+            )
+            return out
+
+    def reset(self) -> None:
+        """Test hook: back to the everything-connected baseline."""
+        with self._lock:
+            self._connected = {"statestore": True, "bus": True}
+            self._since.clear()
+            self._last_disconnect.clear()
+            self._outages = {"statestore": 0, "bus": 0}
+            self._stale_entries.clear()
+            self._buffered.clear()
+            self._dropped = 0
+            self._cache_serves = 0
+
+
+_STATE = ControlPlaneState()
+
+
+def state() -> ControlPlaneState:
+    return _STATE
+
+
+def note_store(connected: bool) -> None:
+    _STATE.note_plane("statestore", connected)
+
+
+def note_bus(connected: bool) -> None:
+    _STATE.note_plane("bus", connected)
+
+
+def snapshot() -> dict:
+    return _STATE.snapshot()
+
+
+def state_name() -> str:
+    """Worst plane state, the wire form workers publish."""
+    return _STATE.worst()
+
+
+def reset_for_tests() -> None:
+    _STATE.reset()
+
+
+def render_prometheus(prefix: str = "dynamo") -> str:
+    """The ``dynamo_control_plane_state`` gauge (0=connected, 1=stale,
+    2=disconnected, labeled per plane) plus the bus buffer counters —
+    appended to whatever exposition the process already serves."""
+    snap = _STATE.snapshot()
+    full = f"{prefix}_control_plane_state"
+    lines = [
+        f"# HELP {full} Control-plane connectivity "
+        f"(0=connected, 1=stale, 2=disconnected)",
+        f"# TYPE {full} gauge",
+    ]
+    for plane, entry in sorted(snap["planes"].items()):
+        lines.append(
+            f'{full}{{plane="{plane}"}} '
+            f'{STATE_VALUES.get(entry["state"], 2)}'
+        )
+    for name, key, help_text in (
+        ("control_plane_buffered_events", "bus_buffered_events",
+         "Events buffered while the bus is unreachable"),
+        ("control_plane_dropped_events", "bus_dropped_events",
+         "Events dropped from the full outage buffer (cumulative)"),
+        ("control_plane_stale_discovery_entries", "stale_discovery_entries",
+         "Discovery entries currently served on stale authority"),
+    ):
+        full = f"{prefix}_{name}"
+        lines.append(f"# HELP {full} {help_text}")
+        lines.append(f"# TYPE {full} gauge")
+        lines.append(f"{full} {snap[key]}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# disk-persisted discovery snapshot
+# ---------------------------------------------------------------------------
+
+
+class DiscoveryCache:
+    """Atomic per-prefix JSON snapshots of discovery state.
+
+    One file per watched prefix (instances of an endpoint, the model
+    registry) so concurrent writers never contend on one file. Values are
+    the raw statestore bytes, base64-wrapped; a corrupt or unreadable file
+    reads as "no cache" — a bad snapshot must degrade to the no-cache path,
+    never crash a cold start."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, prefix: str) -> str:
+        digest = hashlib.sha256(prefix.encode()).hexdigest()[:16]
+        return os.path.join(self.root, f"discovery-{digest}.json")
+
+    def save(self, prefix: str, entries: Dict[str, bytes]) -> None:
+        """Synchronous write (call via ``asyncio.to_thread`` from async
+        code); tmp + rename so readers never see a torn file."""
+        out = {
+            "prefix": prefix,
+            "saved_at": time.time(),
+            "entries": {
+                k: base64.b64encode(v).decode() for k, v in entries.items()
+            },
+        }
+        path = self._path(prefix)
+        # unique per write: two same-process writers of one prefix (e.g.
+        # a model's chat and completions clients) must not interleave into
+        # one tmp file and install a torn snapshot
+        tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def load(self, prefix: str) -> Optional[Dict[str, bytes]]:
+        """The cached entries for a prefix, or None when absent/corrupt."""
+        path = self._path(prefix)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+            if raw.get("prefix") != prefix:
+                return None  # digest collision or hand-edited file
+            return {
+                k: base64.b64decode(v)
+                for k, v in raw.get("entries", {}).items()
+            }
+        except (json.JSONDecodeError, OSError, ValueError, TypeError):
+            return None
+
+    def saved_at(self, prefix: str) -> Optional[float]:
+        path = self._path(prefix)
+        try:
+            with open(path) as f:
+                return float(json.load(f).get("saved_at", 0.0))
+        except (OSError, json.JSONDecodeError, ValueError, TypeError):
+            return None
+
+    def has_any(self) -> bool:
+        try:
+            return any(
+                n.startswith("discovery-") and n.endswith(".json")
+                for n in os.listdir(self.root)
+            )
+        except OSError:
+            return False
+
+
+def maybe_cache(
+    policy: Optional[ControlPlanePolicy] = None,
+) -> Optional[DiscoveryCache]:
+    """The gate every discovery path uses: ``None`` (and therefore zero
+    file IO, one None-check per hot-path site) unless
+    ``DYN_TPU_DISCOVERY_CACHE`` names a directory."""
+    root = (
+        policy.cache_dir if policy is not None
+        else os.environ.get(ENV_CACHE, "")
+    )
+    return DiscoveryCache(root) if root else None
+
+
+# ---------------------------------------------------------------------------
+# bounded outage buffering for event-plane publishers
+# ---------------------------------------------------------------------------
+
+
+class BoundedPublishBuffer:
+    """Drop-oldest buffer for payloads that could not be published.
+
+    Each entry remembers when it was produced so the flush can stamp
+    ``stale_s`` — consumers (the telemetry aggregator, planner sources)
+    see exactly how old a backfilled snapshot is instead of mistaking it
+    for fresh data. ``dropped`` counts evictions cumulatively."""
+
+    def __init__(self, capacity: int):
+        self.capacity = max(int(capacity), 1)
+        self._dq: Deque[Tuple[float, object]] = deque()
+        self.dropped = 0
+
+    def __len__(self) -> int:
+        return len(self._dq)
+
+    def push(self, payload: object, age_s: float = 0.0) -> None:
+        """``age_s`` back-dates the entry — a re-buffered item that already
+        waited through a failed flush must keep its true age, not restart
+        the staleness clock."""
+        if len(self._dq) >= self.capacity:
+            self._dq.popleft()
+            self.dropped += 1
+        self._dq.append((time.monotonic() - max(age_s, 0.0), payload))
+
+    def drain(self) -> List[Tuple[float, object]]:
+        """All buffered (age_s, payload) pairs, oldest first; the buffer
+        empties. Callers re-``push`` whatever fails to flush."""
+        now = time.monotonic()
+        out = [(now - t, p) for t, p in self._dq]
+        self._dq.clear()
+        return out

@@ -5,7 +5,11 @@ Capability parity with the reference's component model
 (lib/runtime/src/component.rs:99-345, component/client.rs:52-319):
 
 - workers register endpoint *instances* in the statestore under a lease;
-  lease expiry removes them and every watching client drops them live
+  lease expiry removes them and every watching client drops them live —
+  but the store's word is a CACHE, not an authority: on a store outage
+  (or a store restarted empty) clients freeze the last-known-good set and
+  let the RPC health probes arbitrate (runtime/control_plane.py,
+  docs/resilience.md §Control-plane blackout)
 - clients watch the instance prefix and route Random / RoundRobin / Direct /
   KV-aware across live instances
 - namespaced pub/sub events (`{ns}.{subject}`) carry KV cache events and
@@ -28,8 +32,9 @@ import uuid
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional
 
-from dynamo_tpu.runtime import telemetry, tracing
+from dynamo_tpu.runtime import control_plane, telemetry, tracing
 from dynamo_tpu.runtime.admission import LoadSnapshot, OverloadedError
+from dynamo_tpu.runtime.control_plane import ControlPlaneUnavailable
 from dynamo_tpu.runtime.annotated import Annotated
 from dynamo_tpu.runtime.bus import MessageBusClient
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
@@ -212,7 +217,7 @@ class DistributedRuntime:
     ) -> "DistributedRuntime":
         store_url = statestore_url or os.environ.get("DYN_TPU_STATESTORE", "127.0.0.1:37901")
         b_url = bus_url or os.environ.get("DYN_TPU_BUS", "127.0.0.1:37902")
-        store = await StateStoreClient.connect(store_url)
+        store = await cls._connect_store(store_url)
         bus: Optional[MessageBusClient] = None
         try:
             bus = await MessageBusClient.connect(b_url)
@@ -222,6 +227,47 @@ class DistributedRuntime:
         rt._store_url = store_url
         return rt
 
+    @staticmethod
+    async def _connect_store(store_url: str) -> StateStoreClient:
+        """Dial the statestore, retrying inside the cold-start deadline.
+
+        A store that stays dead past the deadline either (a) falls back to
+        the disk discovery cache — the process cold-starts from the
+        last-known-good view, marked stale, and reconnects to the store
+        when it returns — or (b) raises the typed
+        :class:`ControlPlaneUnavailable` so supervisors see a crisp
+        failure instead of a hung or endlessly-crash-looping process
+        (docs/resilience.md §Control-plane blackout)."""
+        policy = control_plane.ControlPlanePolicy.from_env()
+        t0 = time.monotonic()
+        last: Optional[Exception] = None
+        while True:
+            try:
+                return await StateStoreClient.connect(store_url)
+            except OSError as e:
+                last = e
+            if time.monotonic() - t0 >= policy.cold_start_deadline:
+                break
+            await asyncio.sleep(min(0.25, policy.cold_start_deadline / 4))
+        cache = control_plane.maybe_cache(policy)
+        if cache is not None and await asyncio.to_thread(cache.has_any):
+            logger.warning(
+                "statestore %s unreachable for %.1fs — cold-starting from "
+                "the discovery cache at %s (stale-serve; reconnecting in "
+                "the background)", store_url, policy.cold_start_deadline,
+                cache.root,
+            )
+            # cache_cold_starts is counted by the CONSUMERS that actually
+            # load a view from disk (EndpointClient, ModelWatcher) — not
+            # here too, or one process cold start would count N+1 times
+            return await StateStoreClient.connect_lazy(store_url)
+        raise ControlPlaneUnavailable(
+            f"statestore {store_url} unreachable for "
+            f"{policy.cold_start_deadline:.1f}s and no discovery cache to "
+            f"cold-start from (set {control_plane.ENV_CACHE} on frontends "
+            f"to survive control-plane outages): {last}"
+        ) from last
+
     async def reconnect_store(self) -> None:
         try:
             await self.store.close()
@@ -230,6 +276,10 @@ class DistributedRuntime:
         except Exception:
             logger.debug("closing stale statestore client failed", exc_info=True)
         self.store = await StateStoreClient.connect(self._store_url)
+        # reconnect_store is only ever called because a connection failed:
+        # carry the outage stamp onto the replacement client so recovery
+        # heuristics (rejoin jitter) still see the loss
+        self.store.last_disconnect_at = time.monotonic()
         self._primary_lease = None
 
     # sync wrapper used by CLI code paths that build the runtime lazily
@@ -557,12 +607,46 @@ class Endpoint:
             logger.warning(
                 "lease %s lost for %s — re-registering", lease.lease_id, self.path
             )
+            # was this lease lost to a store OUTAGE rather than a plain
+            # expiry? An outage means the whole fleet lost its leases
+            # together and will re-register together — spread the writes
+            # with deterministic per-worker jitter so a recovering store
+            # isn't thundering-herded by its own fleet. A lone expiry
+            # (store healthy throughout) pays nothing. THIS runtime's own
+            # client history decides (not process-global state — another
+            # runtime's blip in the same process must not tax us): either
+            # the connection is still down, or it dropped recently (the
+            # client reconnected to a restarted-empty store and the
+            # keepalive answered "unknown lease").
+            dropped_at = getattr(rt.store, "last_disconnect_at", None)
+            outage = (
+                not getattr(rt.store, "connected", True)
+                or (
+                    dropped_at is not None
+                    and time.monotonic() - dropped_at
+                    < control_plane.REJOIN_OUTAGE_WINDOW_S
+                )
+            )
             while True:
                 try:
                     try:
                         await rt.store.get("__ping__")
                     except (ConnectionError, RuntimeError):
+                        outage = True
                         await rt.reconnect_store()
+                    if outage:
+                        jitter = control_plane.ControlPlanePolicy.from_env(
+                        ).rejoin_jitter
+                        if jitter > 0:
+                            delay = control_plane.rejoin_delay(
+                                rt.worker_id, jitter
+                            )
+                            logger.info(
+                                "store recovered; rejoining %s in %.2fs "
+                                "(seeded jitter)", self.path, delay,
+                            )
+                            await asyncio.sleep(delay)
+                        outage = False
                     lease = await rt.store.grant_lease()
                     rt._primary_lease = lease
                     self._serve_lease = lease
@@ -624,6 +708,21 @@ class EndpointClient(AsyncEngine):
                       "overloaded": 0, "probes": 0, "probe_failures": 0,
                       "resumes": 0, "resume_failures": 0}
         self._instances: Dict[str, InstanceInfo] = {}
+        # control-plane blackout tolerance (runtime/control_plane.py,
+        # docs/resilience.md §Control-plane blackout): when the statestore
+        # dies — or restarts empty and can no longer vouch for keys — the
+        # last-known-good instance set is FROZEN (held in `_stale`) instead
+        # of cleared, and the RPC health probes below become the liveness
+        # authority. `_cache`, when enabled, persists the confirmed view to
+        # disk so a frontend restarted mid-outage cold-starts from it.
+        self._cp = control_plane.ControlPlanePolicy.from_env()
+        self._cache = control_plane.maybe_cache(self._cp)
+        self._cache_dirty = False
+        # iid → monotonic time it was first marked stale: each entry gets
+        # its OWN grace window (a set-level clock would deny grace to
+        # entries marked while an older hold is still outstanding)
+        self._stale: Dict[str, float] = {}
+        self._cp_id = f"client-{uuid.uuid4().hex[:8]}"
         # active liveness probing (runtime/health.py): when an instance's
         # RPC plane goes silent for probe_idle, __ping__ it through the real
         # dispatch path. Statestore heartbeats do NOT count as liveness —
@@ -659,7 +758,20 @@ class EndpointClient(AsyncEngine):
                 f"{self.VALID_MODES} or direct:<instance_id>"
             )
         rt = self.endpoint.component.namespace.runtime
-        self._watcher = await rt.store.watch_prefix(self.endpoint.instances_prefix)
+        try:
+            if not getattr(rt.store, "connected", True):
+                # a lazily-connected store (cache-mode cold start) fails
+                # fast here; the watch loop below keeps re-dialing
+                raise ConnectionError("statestore disconnected")
+            self._watcher = await rt.store.watch_prefix(
+                self.endpoint.instances_prefix
+            )
+        except (ConnectionError, RuntimeError, OSError):
+            if not await self._load_from_cache():
+                raise ControlPlaneUnavailable(
+                    f"statestore unreachable and no discovery cache for "
+                    f"{self.endpoint.path}"
+                )
         self._watch_task = asyncio.create_task(self._watch_loop())
         self._probe_task = asyncio.create_task(self._probe_loop())
         if self.mode == "kv":
@@ -677,46 +789,66 @@ class EndpointClient(AsyncEngine):
     async def _watch_loop(self) -> None:
         """Consume watch events; if the statestore connection drops, reconnect
         and re-watch with a fresh snapshot (the worker side re-registers on
-        lease loss — this is the client half of that recovery)."""
+        lease loss — this is the client half of that recovery).
+
+        Stale-but-safe discovery (docs/resilience.md §Control-plane
+        blackout): with ``stale_serve`` on (the default), a store outage —
+        or a store that restarted empty and now disavows every key — FREEZES
+        the last-known-good instance set instead of clearing it. Held
+        entries are marked stale; the RPC health probes, which never
+        depended on the store, arbitrate liveness until the store's word is
+        trustworthy again. Purge rules run after ``stale_grace``:
+        superseded (the worker re-registered under a fresh lease) or
+        probe-failed entries drop; probe-passing ones are held."""
         backoff = 0.5
         while not self._closed:
-            async for ev in self._watcher:
-                iid = ev.key.rsplit("/", 1)[-1]
-                if ev.type == "put":
-                    try:
-                        info = InstanceInfo.from_json(ev.value)
-                    except (ValueError, KeyError):
-                        continue
-                    self._instances[iid] = info
-                    self._by_worker[info.worker_id] = iid
-                    if info.load is not None:
-                        # heartbeat re-put: adopt the worker's own load view
-                        self._loads[iid] = LoadSnapshot.from_wire(info.load)
-                    self._ready.set()
-                else:
-                    gone = self._instances.pop(iid, None)
-                    self._loads.pop(iid, None)
-                    self._avoid_until.pop(iid, None)
-                    self._last_rpc_seen.pop(iid, None)
-                    self._probe_failed.pop(iid, None)
-                    self._breaker.forget(iid)
-                    conn = self._conns.pop(iid, None)
-                    if conn is not None:
-                        await conn.close()
-                    if gone is not None and self._by_worker.get(gone.worker_id) == iid:
-                        del self._by_worker[gone.worker_id]
-                        # only purge the router when the worker has no live
-                        # instance left (a re-registration overwrites the
-                        # mapping before the old instance key is deleted)
-                        if self._router is not None:
-                            self._router.remove_worker(gone.worker_id)
-                if not self._instances:
-                    self._ready.clear()
-            if self._closed:
-                return
-            # watcher ended: the statestore connection died. Reconnect + rewatch.
+            if self._watcher is not None:
+                async for ev in self._watcher:
+                    iid = ev.key.rsplit("/", 1)[-1]
+                    if ev.type == "put":
+                        try:
+                            info = InstanceInfo.from_json(ev.value)
+                        except (ValueError, KeyError):
+                            continue
+                        self._instances[iid] = info
+                        self._note_fresh(iid)
+                        prev = self._by_worker.get(info.worker_id)
+                        self._by_worker[info.worker_id] = iid
+                        if prev not in (None, iid) and prev in self._stale:
+                            # the worker re-registered under a fresh lease:
+                            # its held pre-outage twin is positively
+                            # superseded — drop it now, not at grace
+                            await self._drop_instance(prev)
+                        if info.load is not None:
+                            # heartbeat re-put: adopt the worker's own load
+                            self._loads[iid] = LoadSnapshot.from_wire(info.load)
+                        self._ready.set()
+                        self._cache_dirty = True
+                    elif (
+                        ev.resync and self._cp.stale_serve
+                        and iid in self._instances
+                    ):
+                        # a delete the CLIENT synthesized while adopting a
+                        # post-reconnect snapshot: the (possibly restarted-
+                        # empty) store no longer vouches for this key, but
+                        # nothing observed a real deletion. Hold the
+                        # instance as stale; probes/grace arbitrate.
+                        self._mark_stale({iid})
+                    else:
+                        await self._drop_instance(iid)
+                if self._closed:
+                    return
+                # watcher ended: the statestore connection died.
+                logger.warning(
+                    "instance watch for %s lost; %s",
+                    self.endpoint.path,
+                    "serving last-known-good set (stale) while reconnecting"
+                    if self._cp.stale_serve and self._instances
+                    else "reconnecting",
+                )
+                if self._cp.stale_serve and self._instances:
+                    self._mark_stale(set(self._instances))
             rt = self.endpoint.component.namespace.runtime
-            logger.warning("instance watch for %s lost; reconnecting", self.endpoint.path)
             while not self._closed:
                 try:
                     try:
@@ -726,45 +858,192 @@ class EndpointClient(AsyncEngine):
                     self._watcher = await rt.store.watch_prefix(
                         self.endpoint.instances_prefix, include_existing=True
                     )
-                    # fresh snapshot replaces stale state as puts stream in.
-                    # Workers that died during the outage never get a delete
-                    # event, so purge the router/worker maps AND their RPC
-                    # connections (the delete-event path closes these; without
-                    # it they'd leak across outages) — live workers repopulate
-                    # from the snapshot + future events and re-dial lazily.
-                    # breaker state survives the resync: instances that are
-                    # still live (and possibly still failing) must not get a
-                    # clean slate from a statestore blip. Slots for instances
-                    # that vanished BEFORE this outage are pruned here;
-                    # current ones linger at most until the next resync
-                    # (delete events handle the common case).
-                    self._breaker.prune(self._instances)
-                    self._instances.clear()
-                    self._loads.clear()
-                    self._avoid_until.clear()
-                    self._last_rpc_seen.clear()
-                    self._probe_failed.clear()
-                    if self._router is not None:
-                        for wid in self._by_worker:
-                            self._router.remove_worker(wid)
-                    self._by_worker.clear()
-                    stale_conns = list(self._conns.values())
-                    self._conns.clear()
-                    for conn in stale_conns:
-                        try:
-                            await conn.close()
-                        except asyncio.CancelledError:
-                            raise
-                        except Exception:
-                            logger.debug(
-                                "closing stale worker conn failed", exc_info=True
-                            )
-                    self._ready.clear()
+                    if self._cp.stale_serve:
+                        # the held set stays routable: live workers
+                        # re-confirm via the snapshot's puts (clearing their
+                        # stale mark), re-registered ones supersede their
+                        # old entries, dead ones fail probes and purge at
+                        # grace. Breaker state survives — an instance that
+                        # was failing before the blip must not get a clean
+                        # slate from reconnecting to the store.
+                        self._breaker.prune(self._instances)
+                    else:
+                        # pre-blackout behavior (DYN_TPU_STALE_SERVE=0):
+                        # wholesale replacement — fresh snapshot repopulates
+                        # as puts stream in; workers that died during the
+                        # outage (no delete event ever) are purged here with
+                        # their pooled RPC connections.
+                        self._breaker.prune(self._instances)
+                        self._instances.clear()
+                        self._loads.clear()
+                        self._avoid_until.clear()
+                        self._last_rpc_seen.clear()
+                        self._probe_failed.clear()
+                        if self._router is not None:
+                            for wid in self._by_worker:
+                                self._router.remove_worker(wid)
+                        self._by_worker.clear()
+                        stale_conns = list(self._conns.values())
+                        self._conns.clear()
+                        for conn in stale_conns:
+                            try:
+                                await conn.close()
+                            except asyncio.CancelledError:
+                                raise
+                            except Exception:
+                                logger.debug(
+                                    "closing stale worker conn failed",
+                                    exc_info=True,
+                                )
+                        self._ready.clear()
                     backoff = 0.5
                     break
                 except (ConnectionError, RuntimeError, OSError):
                     await asyncio.sleep(backoff)
                     backoff = min(backoff * 2, 10.0)
+
+    async def _drop_instance(self, iid: str) -> None:
+        """Remove one instance and all its satellite state (the delete-event
+        path, also used by the stale purge)."""
+        gone = self._instances.pop(iid, None)
+        self._loads.pop(iid, None)
+        self._avoid_until.pop(iid, None)
+        self._last_rpc_seen.pop(iid, None)
+        self._probe_failed.pop(iid, None)
+        self._discard_stale(iid)
+        self._breaker.forget(iid)
+        conn = self._conns.pop(iid, None)
+        if conn is not None:
+            # a surviving instance at the SAME address inherits the pooled
+            # connection: an instance id changing hands (worker
+            # re-registered under a fresh lease — same process, same RPC
+            # server) must not cut the live streams multiplexed on it
+            new_home = None
+            if gone is not None and not conn.closed:
+                for other, info in self._instances.items():
+                    if info.address == gone.address and other not in self._conns:
+                        new_home = other
+                        break
+            if new_home is not None:
+                conn.on_load = (
+                    lambda wire, _iid=new_home: self._note_load(_iid, wire)
+                )
+                self._conns[new_home] = conn
+            else:
+                await conn.close()
+        if gone is not None and self._by_worker.get(gone.worker_id) == iid:
+            del self._by_worker[gone.worker_id]
+            # only purge the router when the worker has no live
+            # instance left (a re-registration overwrites the
+            # mapping before the old instance key is deleted)
+            if self._router is not None:
+                self._router.remove_worker(gone.worker_id)
+        if not self._instances:
+            self._ready.clear()
+        self._cache_dirty = True
+
+    # -- stale-but-safe bookkeeping (control_plane) ------------------------
+
+    @property
+    def stale_since(self) -> Optional[float]:
+        """Monotonic time of the OLDEST outstanding stale mark (None when
+        nothing is held) — observability only; purge decisions use each
+        entry's own clock."""
+        return min(self._stale.values()) if self._stale else None
+
+    def _mark_stale(self, iids: set) -> None:
+        now = time.monotonic()
+        for iid in iids:
+            # keep the original mark time on re-marks (the probe tick
+            # re-marks every held entry while the store stays down)
+            self._stale.setdefault(iid, now)
+        control_plane.state().note_stale_entries(self._cp_id, len(self._stale))
+
+    def _note_fresh(self, iid: str) -> None:
+        if iid in self._stale:
+            self._discard_stale(iid)
+
+    def _discard_stale(self, iid: str) -> None:
+        if self._stale.pop(iid, None) is not None:
+            control_plane.state().note_stale_entries(
+                self._cp_id, len(self._stale)
+            )
+
+    async def _load_from_cache(self) -> bool:
+        """Cold-start the instance set from the disk discovery cache
+        (statestore down at client start). Entries are marked stale — the
+        probes confirm or purge them. False when the cache is off/empty."""
+        if self._cache is None:
+            return False
+        entries = await asyncio.to_thread(
+            self._cache.load, self.endpoint.instances_prefix
+        )
+        if not entries:
+            return False
+        for key in sorted(entries):
+            iid = key.rsplit("/", 1)[-1]
+            try:
+                info = InstanceInfo.from_json(entries[key])
+            except (ValueError, KeyError):
+                continue
+            self._instances[iid] = info
+            self._by_worker[info.worker_id] = iid
+            if info.load is not None:
+                self._loads[iid] = LoadSnapshot.from_wire(info.load)
+        if not self._instances:
+            return False
+        self._mark_stale(set(self._instances))
+        self._ready.set()
+        control_plane.state().note_cache_serve()
+        logger.warning(
+            "cold-started %s from the discovery cache: %d instance(s), "
+            "marked stale until the store confirms them",
+            self.endpoint.path, len(self._instances),
+        )
+        return True
+
+    def _stale_purge_due(self) -> List[str]:
+        """Stale entries ripe for removal: past their OWN grace window AND
+        either superseded by a fresh registration of the same worker or
+        failing their liveness probe. Probe-passing entries are never
+        purged — a worker the data plane can still reach outranks a
+        silent store."""
+        if not self._stale:
+            return []
+        now = time.monotonic()
+        due = []
+        for iid, marked_at in list(self._stale.items()):
+            if now - marked_at < self._cp.stale_grace:
+                continue
+            info = self._instances.get(iid)
+            if info is None:
+                self._discard_stale(iid)
+                continue
+            superseded = self._by_worker.get(info.worker_id) != iid
+            if superseded or iid in self._probe_failed:
+                due.append(iid)
+        return due
+
+    async def _flush_cache(self) -> None:
+        """Persist the CONFIRMED instance view (never the stale guesses —
+        a cold start must seed from the last view the store vouched for).
+        Runs off-thread; called from the probe loop when dirty."""
+        if self._cache is None or not self._cache_dirty or self._stale:
+            return
+        self._cache_dirty = False
+        entries = {
+            self.endpoint.instances_prefix + iid: info.to_json()
+            for iid, info in self._instances.items()
+        }
+        try:
+            await asyncio.to_thread(
+                self._cache.save, self.endpoint.instances_prefix, entries
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            self._cache_dirty = True
+            logger.debug("discovery cache write failed", exc_info=True)
 
     async def _kv_feed(self) -> None:
         """Feed KV events + metrics from the namespace event plane into the router."""
@@ -932,8 +1211,23 @@ class EndpointClient(AsyncEngine):
         worker is re-admitted by its next successful pong."""
         idle = self.health_policy.probe_idle
         interval = min(max(idle / 2.0, 0.05), idle)
+        rt = self.endpoint.component.namespace.runtime
         while True:
             await asyncio.sleep(interval)
+            # stale-but-safe housekeeping rides the probe tick: while the
+            # store connection is down, every held instance is running on
+            # stale authority (the watcher may not end until the client's
+            # reconnect window expires — staleness must not wait for it);
+            # then purge entries the probes (or a fresh registration) have
+            # ruled on, and persist the confirmed view to the cache
+            if (
+                self._cp.stale_serve and self._instances
+                and not getattr(rt.store, "connected", True)
+            ):
+                self._mark_stale(set(self._instances))
+            for iid in self._stale_purge_due():
+                await self._drop_instance(iid)
+            await self._flush_cache()
             now = time.monotonic()
             due = []
             for iid, info in list(self._instances.items()):
@@ -1035,6 +1329,9 @@ class EndpointClient(AsyncEngine):
             "serving": serving,
             "draining": draining,
             "unhealthy": unhealthy,
+            # entries currently held on stale authority (store outage /
+            # restart): still routable, probes arbitrating
+            "stale": len(self._stale),
         }
 
     async def _conn(self, iid: str, timeout: Optional[float] = None) -> RpcClient:
@@ -1392,6 +1689,7 @@ class EndpointClient(AsyncEngine):
 
     async def close(self) -> None:
         self._closed = True
+        control_plane.state().forget_consumer(self._cp_id)
         if self._watch_task:
             self._watch_task.cancel()
         if self._probe_task:
@@ -1426,12 +1724,21 @@ class KvPublishBridge:
     call_soon_threadsafe into a queue drained by a publisher task.
     """
 
+    # bound on queued events: during a bus outage the publish blocks on the
+    # client's reconnect machinery, so events pool here — drop-oldest keeps
+    # worker memory flat (the router's radix view self-heals from later
+    # stored/removed events; `dropped` is exported for the control-plane
+    # status surfaces)
+    MAX_QUEUE = 2048
+
     def __init__(self, namespace: Namespace, worker_id: str):
         from dynamo_tpu.kv_router.publisher import KvEventPublisher
 
         self._ns = namespace
         self._loop = asyncio.get_running_loop()
-        self._queue: asyncio.Queue = asyncio.Queue()
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=self.MAX_QUEUE)
+        self.dropped = 0
+        self._cp_id = f"kv-events-{worker_id}"
         self._inner = KvEventPublisher(worker_id, self._enqueue)
         self._task = asyncio.create_task(self._drain())
 
@@ -1443,7 +1750,19 @@ class KvPublishBridge:
         self._inner.blocks_removed(block_hashes)
 
     def _enqueue(self, event) -> None:
-        self._loop.call_soon_threadsafe(self._queue.put_nowait, event.to_dict())
+        self._loop.call_soon_threadsafe(self._offer, event.to_dict())
+
+    def _offer(self, payload: dict) -> None:
+        while self._queue.full():
+            try:
+                self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            self.dropped += 1
+            # count the drop only — queue occupancy ebbs and flows on the
+            # hot path and is not worth a lock per event
+            control_plane.state().note_buffer(self._cp_id, 0, 1)
+        self._queue.put_nowait(payload)
 
     async def _drain(self) -> None:
         while True:
@@ -1510,6 +1829,83 @@ async def attach_kv_publishing(
         # point its admission gate at the core engine's real capacity
         server.admission.engine_probe = engine.metrics_snapshot
 
+    # bus-outage buffering (docs/resilience.md §Control-plane blackout):
+    # snapshots produced while the bus is down are buffered (drop-oldest)
+    # and flushed at recovery with an explicit `stale_s` age stamp — the
+    # aggregator's diff discipline absorbs the backfill, and nothing
+    # downstream mistakes it for fresh data. DYN_TPU_BUS_BUFFER=0 restores
+    # the old drop-on-failure behavior.
+    cp_policy = control_plane.ControlPlanePolicy.from_env()
+    buffer = (
+        control_plane.BoundedPublishBuffer(cp_policy.bus_buffer)
+        if cp_policy.bus_buffer > 0 else None
+    )
+    buffer_id = f"metrics-{worker_id}"
+    # cumulative drops attributed to THIS publisher (buffer.dropped is
+    # reported as deltas to the process tracker and reset) — stamping the
+    # process-global total instead would double-count on co-hosted
+    # prefill+decode publishers, the same class of bug bind_admission
+    # gating exists to prevent
+    dropped_total = [0]
+
+    def _note_buffer_state() -> None:
+        dropped_total[0] += buffer.dropped
+        control_plane.state().note_buffer(
+            buffer_id, len(buffer), buffer.dropped
+        )
+        buffer.dropped = 0
+
+    async def _bounded_publish(payload: dict) -> None:
+        """Publish with a time bound when buffering is on: the bus client's
+        transparent retry PARKS calls through an outage (they replay at
+        reconnect), which would wedge the metrics loop for the whole
+        outage and silently disable buffering for it. A timed-out publish
+        raises like a connection loss; the parked request still replays at
+        reconnect (a duplicate snapshot diffs to zero at the aggregator)."""
+        if buffer is None:
+            await ns.publish(KV_METRICS_SUBJECT, payload)
+            return
+        try:
+            await asyncio.wait_for(
+                ns.publish(KV_METRICS_SUBJECT, payload),
+                timeout=max(interval * 2, 2.0),
+            )
+        except asyncio.TimeoutError:
+            raise ConnectionError("bus publish timed out (outage?)") from None
+
+    async def _publish_metrics(snap: dict) -> None:
+        payload = {"worker_id": worker_id, "metrics": snap}
+        bus = ns.runtime.bus
+        if bus is None:
+            return  # no event plane configured: nothing to buffer FOR
+        if buffer is not None and not getattr(bus, "connected", True):
+            buffer.push(payload)
+            _note_buffer_state()
+            return
+        if buffer is not None and len(buffer):
+            backlog = buffer.drain()
+            for i, (age_s, old) in enumerate(backlog):
+                old["metrics"]["stale_s"] = round(age_s, 3)
+                try:
+                    await _bounded_publish(old)
+                except (ConnectionError, RuntimeError):
+                    # bus died again mid-flush: rebuffer THIS item and the
+                    # whole remaining backlog with their true ages — one
+                    # failure must cost one timeout, not one per item
+                    for a, p in backlog[i:]:
+                        buffer.push(p, age_s=a)
+                    break
+            _note_buffer_state()
+        try:
+            await _bounded_publish(payload)
+        except (ConnectionError, RuntimeError):
+            if buffer is None:
+                raise
+            # the outage began mid-publish (the connected check passed):
+            # this snapshot is buffered like any other dark-time snapshot
+            buffer.push(payload)
+            _note_buffer_state()
+
     async def metrics_loop():
         while True:
             await asyncio.sleep(interval)
@@ -1571,9 +1967,20 @@ async def attach_kv_publishing(
                     summary = tracing.phase_summary()
                     if summary:
                         snap["phase_latency"] = summary
-                await ns.publish(
-                    KV_METRICS_SUBJECT, {"worker_id": worker_id, "metrics": snap}
+                # control-plane connectivity as seen from this process —
+                # the rollup/llmctl `control-plane status` raw material
+                snap.setdefault(
+                    "control_plane_state", control_plane.state_name()
                 )
+                # per-PUBLISHER drop attribution (this buffer + the KV
+                # event bridge this call owns); the rollup sums per worker,
+                # so a process-global count here would double-count on
+                # co-hosted prefill+decode publishers
+                dropped = dropped_total[0] + bridge.dropped
+                if buffer is not None:
+                    dropped += buffer.dropped
+                snap.setdefault("bus_dropped_events", dropped)
+                await _publish_metrics(snap)
             except (ConnectionError, RuntimeError):
                 logger.warning("kv metrics publish failed", exc_info=True)
 

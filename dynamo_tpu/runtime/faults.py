@@ -45,8 +45,13 @@ from typing import Dict, Iterator, List, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 PLANES = ("statestore", "bus", "rpc", "transfer")
-ACTIONS = ("refuse", "delay", "reset", "stall", "wedge", "cut")
+ACTIONS = ("refuse", "delay", "reset", "stall", "wedge", "cut", "blackout")
 POINTS = ("connect", "read", "write", "serve", "item")
+
+# the planes a bare "blackout" kills: the whole control plane at once
+# (discovery + events), leaving the RPC/transfer data planes alive — the
+# docs/resilience.md §Control-plane blackout drill
+CONTROL_PLANES = ("statestore", "bus")
 
 
 class StreamCut(ConnectionResetError):
@@ -141,6 +146,11 @@ class FaultInjector:
         self._serve_ops: Dict[Tuple[str, str], int] = {}
         self._stall_release = asyncio.Event()
         self._wedge_release = asyncio.Event()
+        # blackout machinery: the refuse/reset rules currently simulating a
+        # dead plane, plus strong refs to timed-end tasks (asyncio only
+        # weakly references tasks)
+        self._blackout_rules: List[FaultRule] = []
+        self._blackout_tasks: set = set()
 
     def add_rule(self, rule: FaultRule) -> FaultRule:
         self.rules.append(rule)
@@ -152,8 +162,66 @@ class FaultInjector:
 
     def clear_rules(self) -> None:
         self.rules.clear()
+        self._blackout_rules.clear()
         self.release_stalls()
         self.release_wedges()
+
+    # -- blackout: kill whole planes for a while ---------------------------
+
+    def begin_blackout(self, planes: Tuple[str, ...] = CONTROL_PLANES) -> None:
+        """Simulate the named planes dying RIGHT NOW: new dials are refused
+        and every live connection's next read/write resets — exactly what a
+        crashed statestore/bus looks like from a client. Idempotent per
+        plane; :meth:`end_blackout` restores service (clients then
+        reconnect through their own recovery loops)."""
+        active = {r.plane for r in self._blackout_rules}
+        for plane in planes:
+            if plane in active:
+                continue
+            fresh = [
+                FaultRule(plane=plane, point="connect", action="refuse"),
+                FaultRule(plane=plane, point="read", action="reset"),
+                FaultRule(plane=plane, point="write", action="reset"),
+            ]
+            self._blackout_rules.extend(fresh)
+            # front of the list so a blackout wins over any later rule
+            self.rules[:0] = fresh
+
+    def end_blackout(self, planes: Optional[Tuple[str, ...]] = None) -> None:
+        """Lift the blackout for ``planes`` (default: all blacked out)."""
+        ending = [
+            r for r in self._blackout_rules
+            if planes is None or r.plane in planes
+        ]
+        for r in ending:
+            self.remove_rule(r)
+            self._blackout_rules.remove(r)
+
+    def blackout_active(self, plane: str) -> bool:
+        return any(r.plane == plane for r in self._blackout_rules)
+
+    async def blackout(
+        self,
+        duration: float,
+        planes: Tuple[str, ...] = CONTROL_PLANES,
+    ) -> None:
+        """Scripted drill: black out ``planes``, hold for ``duration``
+        seconds, restore."""
+        self.begin_blackout(planes)
+        try:
+            await asyncio.sleep(duration)
+        finally:
+            self.end_blackout(planes)
+
+    def _schedule_blackout_end(self, planes: Tuple[str, ...],
+                               duration: float) -> None:
+        async def _end() -> None:
+            await asyncio.sleep(duration)
+            self.end_blackout(planes)
+
+        task = asyncio.get_running_loop().create_task(_end())
+        self._blackout_tasks.add(task)
+        task.add_done_callback(self._blackout_tasks.discard)
 
     def release_stalls(self) -> None:
         """Wake every stalled op; each then raises ConnectionResetError
@@ -203,6 +271,21 @@ class FaultInjector:
             raise ConnectionRefusedError(f"injected refusal ({what})")
         if rule.action == "cut":
             raise StreamCut(f"injected mid-stream cut ({what})")
+        if rule.action == "blackout":
+            # env-driven control-plane blackout drill: the first matching op
+            # starts a timed outage of the rule's plane ("*" = both control
+            # planes) lasting `delay` seconds, and itself dies with a reset.
+            # The trigger rule is spent NOW — without this, the clients' own
+            # recovery redials after the timed end would re-match it and the
+            # "30s blackout" drill would repeat forever
+            rule.max_fires = rule.fired
+            planes = (
+                (rule.plane,) if rule.plane in PLANES else CONTROL_PLANES
+            )
+            self.begin_blackout(planes)
+            if rule.delay > 0:
+                self._schedule_blackout_end(planes, rule.delay)
+            raise ConnectionResetError(f"injected blackout begins ({what})")
         raise ValueError(f"unknown fault action {rule.action!r}")
 
     # -- connection faulting ----------------------------------------------
@@ -360,6 +443,7 @@ def uninstall() -> None:
     if _active is not None:
         _active.release_stalls()
         _active.release_wedges()
+        _active.end_blackout()
     _active = None
 
 

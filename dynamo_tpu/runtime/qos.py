@@ -179,6 +179,10 @@ class QosPolicy:
     ``burst``          bucket capacity per weight unit.
     ``kv_frac``        max fraction of the KV pool one tenant may hold
                        while other tenants are active (0 = disabled).
+    ``slot_frac``      max fraction of the decode slots one tenant may
+                       occupy while other tenants are active (0 =
+                       disabled). Work-conserving like ``kv_frac``: a
+                       tenant alone on the engine may use every slot.
     ``max_tenants``    LRU bound on tracked tenants (spoofed ids must
                        not grow memory).
     ``unmapped``       how to treat tenant ids the operator did NOT
@@ -201,6 +205,7 @@ class QosPolicy:
     rate_rps: float = 0.0
     burst: float = 4.0
     kv_frac: float = 0.0
+    slot_frac: float = 0.0
     max_tenants: int = 1024
     unmapped: str = "per-id"
 
@@ -210,6 +215,7 @@ class QosPolicy:
         if self.default_class not in self.classes:
             self.default_class = next(reversed(self.classes))
         self.kv_frac = min(max(self.kv_frac, 0.0), 1.0)
+        self.slot_frac = min(max(self.slot_frac, 0.0), 1.0)
         if self.unmapped not in ("per-id", "shared"):
             self.unmapped = "per-id"
         # class name → (level, weight); level = declaration order
@@ -230,6 +236,7 @@ class QosPolicy:
             rate_rps=_env_nonneg_float(prefix + "RATE", d.rate_rps),
             burst=_env_pos_float(prefix + "BURST", d.burst),
             kv_frac=_env_nonneg_float(prefix + "KV_FRAC", d.kv_frac),
+            slot_frac=_env_nonneg_float(prefix + "SLOT_FRAC", d.slot_frac),
             max_tenants=_env_pos_int(prefix + "MAX", d.max_tenants),
             unmapped=_env_str(prefix + "UNMAPPED", d.unmapped),
         )

@@ -41,7 +41,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Dict, List, Optional, Set, Tuple
 
-from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime import control_plane, faults
 from dynamo_tpu.runtime.codec import TwoPartMessage, read_frame, write_frame
 
 logger = logging.getLogger(__name__)
@@ -55,6 +55,13 @@ class WatchEvent:
     type: str  # "put" | "delete"
     key: str
     value: bytes = b""
+    # True only for deletes the CLIENT synthesized while adopting a resync
+    # snapshot after a reconnect: the key is absent from the (possibly
+    # freshly restarted, possibly empty) server, but nothing positively
+    # observed its deletion. Stale-but-safe discovery consumers
+    # (runtime/control_plane.py) treat these as "unconfirmed" and let the
+    # RPC health probes arbitrate instead of dropping live workers.
+    resync: bool = False
 
 
 # =========================================================================
@@ -592,6 +599,15 @@ class Lease:
                 except ConnectionError:
                     self.lost.set()
                     return
+                except RuntimeError:
+                    # the server ANSWERED but rejected the keepalive
+                    # ("unknown lease" — a store that restarted without our
+                    # lease, e.g. empty data dir after a blackout): the
+                    # lease is just as lost as on a dead connection, and
+                    # the owner must re-register. _call raises this, so
+                    # the not-ok branch above never fires in practice.
+                    self.lost.set()
+                    return
         except asyncio.CancelledError:
             pass
 
@@ -668,6 +684,11 @@ class StateStoreClient:
         self._send_lock = asyncio.Lock()
         self._closed = False
         self._connected = asyncio.Event()
+        self._ever_connected = False
+        # monotonic time THIS client last lost its connection (None =
+        # never): recovery paths use it to tell outage-caused lease loss
+        # from a plain expiry without consulting process-global state
+        self.last_disconnect_at: Optional[float] = None
         self._reconnect_task: Optional[asyncio.Task] = None  # strong ref
 
     @classmethod
@@ -682,12 +703,41 @@ class StateStoreClient:
         await c._dial()
         return c
 
+    @classmethod
+    async def connect_lazy(
+        cls,
+        url: str,
+        reconnect: bool = True,
+        reconnect_timeout: float = 30.0,
+    ) -> "StateStoreClient":
+        """A client for a statestore that may be DOWN right now (cache-mode
+        cold start, runtime/control_plane.py): one dial is attempted; on
+        failure the client exists in disconnected, fail-fast state — calls
+        raise ``ConnectionError`` immediately instead of blocking out the
+        reconnect window, so the runtime's own recovery loops (which
+        re-dial via ``reconnect_store``) converge as soon as the store
+        returns."""
+        host, _, port = url.rpartition(":")
+        c = cls(host or "127.0.0.1", int(port), reconnect, reconnect_timeout)
+        try:
+            await c._dial()
+        except OSError:
+            c.last_disconnect_at = time.monotonic()
+            control_plane.note_store(False)
+        return c
+
+    @property
+    def connected(self) -> bool:
+        return self._connected.is_set()
+
     async def _dial(self) -> None:
         self._reader, self._writer = await faults.open_connection(
             self.host, self.port, plane="statestore"
         )
         self._connected.set()
+        self._ever_connected = True
         self._reader_task = asyncio.create_task(self._read_loop())
+        control_plane.note_store(True)
 
     async def close(self) -> None:
         self._closed = True
@@ -717,6 +767,9 @@ class StateStoreClient:
                     fut.set_result((h, frame.body))
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             self._connected.clear()
+            if not self._closed:
+                self.last_disconnect_at = time.monotonic()
+                control_plane.note_store(False)
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError("statestore connection lost"))
@@ -742,7 +795,9 @@ class StateStoreClient:
             if w._resync is not None:
                 if not w._silent_round:
                     for k in sorted(set(w.live) - set(w._resync)):
-                        w.queue.put_nowait(WatchEvent("delete", k))
+                        w.queue.put_nowait(
+                            WatchEvent("delete", k, resync=True)
+                        )
                 w.live = dict(w._resync)
                 w._resync = None
                 w._silent_round = False
@@ -823,6 +878,14 @@ class StateStoreClient:
             if not self._connected.is_set():
                 if self._closed or not self.reconnect:
                     raise ConnectionError("statestore client closed")
+                if not self._ever_connected:
+                    # lazy client that never reached the store (cache-mode
+                    # cold start): fail fast so recovery loops re-dial via
+                    # reconnect_store instead of blocking a full reconnect
+                    # window per call
+                    raise ConnectionError(
+                        f"statestore {self.host}:{self.port} unreachable"
+                    )
                 budget = deadline - time.monotonic()
                 if budget <= 0:
                     raise ConnectionError("statestore unreachable")

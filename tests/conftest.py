@@ -52,6 +52,21 @@ def run():
 
 
 @pytest.fixture(autouse=True)
+def _reset_control_plane_state():
+    """Zero the process-global control-plane connectivity tracker after
+    each test: statestore/bus clients note outages into it, and a test
+    that legitimately bounced a server must not leave a later test's
+    /health reading 'degraded' (imported lazily — same contract as the
+    health-monitor guard below)."""
+    yield
+    import sys
+
+    cp = sys.modules.get("dynamo_tpu.runtime.control_plane")
+    if cp is not None:
+        cp.reset_for_tests()
+
+
+@pytest.fixture(autouse=True)
 def _no_leaked_health_monitors():
     """Fail any test that leaves a HealthMonitor check task running past
     teardown: a leaked monitor keeps reaping/draining state in the

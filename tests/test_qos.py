@@ -109,6 +109,15 @@ class TestQosPolicyEnv:
     def test_kv_frac_caps_at_one(self):
         assert QosPolicy(kv_frac=3.5).kv_frac == 1.0
 
+    def test_slot_frac_clamps(self, monkeypatch):
+        assert QosPolicy(slot_frac=3.5).slot_frac == 1.0
+        assert QosPolicy(slot_frac=-1.0).slot_frac == 0.0
+        _clear_tenant_env(monkeypatch)
+        monkeypatch.setenv("DYN_TPU_TENANT_SLOT_FRAC", "0.5")
+        assert QosPolicy.from_env().slot_frac == 0.5
+        monkeypatch.setenv("DYN_TPU_TENANT_SLOT_FRAC", "junk")
+        assert QosPolicy.from_env().slot_frac == 0.0  # default: disabled
+
     def test_malformed_class_entries_skipped(self, monkeypatch):
         _clear_tenant_env(monkeypatch)
         monkeypatch.setenv(
@@ -927,6 +936,88 @@ class TestEngineTenantScheduling:
             engine.close()
         assert order == ["v", "a"]
 
+    def test_slot_budget_defers_concurrency_hog(
+        self, tiny_parts, run, monkeypatch
+    ):
+        """Satellite (carried ROADMAP micro-remainder): per-tenant decode
+        SLOT budgets. On a 3-slot engine at slot_frac=0.34 (budget 1), an
+        abuser holding its slot defers its next admission while the victim
+        is active — a 2-token abuser stream submitted later still finishes
+        AFTER the abuser's own 24-token stream (without the budget it
+        would take the free slot and finish first)."""
+        import jax.numpy as jnp
+
+        from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+
+        _clear_tenant_env(monkeypatch)
+        monkeypatch.setenv("DYN_TPU_TENANT_SLOT_FRAC", "0.34")
+        cfg, params = tiny_parts
+        engine = JaxServingEngine(
+            cfg, params,
+            EngineConfig(max_slots=3, kv_block_size=8, max_model_len=128),
+            cache_dtype=jnp.float32,
+        )
+        assert engine._tenant_slot_budget == 1
+        order: list = []
+
+        async def one(tag, tenant, prompt, n):
+            await _collect(engine, prompt, n, tenant=tenant)
+            order.append(tag)
+
+        async def go():
+            v = asyncio.create_task(
+                one("v", "victim", list(range(1, 17)), 48)
+            )
+            await asyncio.sleep(0.3)
+            a1 = asyncio.create_task(
+                one("a1", "abuser", list(range(30, 38)), 24)
+            )
+            await asyncio.sleep(0.15)
+            a2 = asyncio.create_task(
+                one("a2", "abuser", list(range(50, 58)), 2)
+            )
+            await asyncio.gather(v, a1, a2)
+
+        try:
+            run(go())
+        finally:
+            engine.close()
+        assert order.index("a1") < order.index("a2"), (
+            "over-budget tenant's later stream jumped the slot budget"
+        )
+
+    def test_slot_budget_work_conserving_alone(
+        self, tiny_parts, run, monkeypatch
+    ):
+        """An uncontended tenant may fill every slot despite the budget —
+        and two budget-capped tenants on an empty engine never deadlock
+        (merely-pending tenants are not contention)."""
+        import jax.numpy as jnp
+
+        from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+
+        _clear_tenant_env(monkeypatch)
+        monkeypatch.setenv("DYN_TPU_TENANT_SLOT_FRAC", "0.34")
+        cfg, params = tiny_parts
+        engine = JaxServingEngine(
+            cfg, params,
+            EngineConfig(max_slots=3, kv_block_size=8, max_model_len=128),
+            cache_dtype=jnp.float32,
+        )
+        try:
+            async def go():
+                tasks = [
+                    _collect(engine, list(range(10 * i + 1, 10 * i + 8)), 8,
+                             tenant="solo")
+                    for i in range(3)
+                ]
+                return await asyncio.wait_for(asyncio.gather(*tasks), 120)
+
+            outs = run(go())
+            assert all(len(t) == 8 for t in outs)
+        finally:
+            engine.close()
+
     def test_two_over_budget_tenants_both_complete(
         self, tiny_parts, run, monkeypatch
     ):
@@ -1018,6 +1109,7 @@ class TestEngineTenantScheduling:
             assert engine._qos is None and engine._fair is None
             assert engine._prefill_budget == 0
             assert engine._tenant_kv_budget == 0
+            assert engine._tenant_slot_budget == 0
             toks = run(_collect(engine, list(range(1, 10)), 16))
             assert len(toks) == 16
             snap = engine.metrics_snapshot()
