@@ -1064,6 +1064,169 @@ def bench_resilience() -> dict:
     }
 
 
+def bench_migration() -> dict:
+    """Live in-flight migration vs resume-only drain (docs/resilience.md
+    §Live migration; tiny REAL engines on the host platform — the point is
+    KV pages actually moving over the transfer plane). Two legs at
+    identical load: a control where a draining worker is stopped and its
+    streams recover via the PR10 resume path (full prompt+generated
+    recompute on a sibling), and a migrate leg where the drain ships each
+    stream's KV to a sibling first. Reports recomputed prefill tokens,
+    worst per-stream gap p95, KV bytes moved, and the drain wall-clock.
+    BENCH_MIGRATE=0 skips."""
+    import asyncio
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.disagg import migration as mig_mod
+    from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+    from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+    from dynamo_tpu.runtime import resilience
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.runtime.resilience import ResiliencePolicy
+    from dynamo_tpu.runtime.statestore import StateStoreServer
+
+    n_requests = int(os.environ.get("BENCH_MIGRATE_REQUESTS", "6"))
+    gen_tokens = int(os.environ.get("BENCH_MIGRATE_TOKENS", "48"))
+    prompt_len = int(os.environ.get("BENCH_MIGRATE_PROMPT", "96"))
+
+    cfg = dataclasses.replace(LLAMA_PRESETS["tiny"], dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    block_bytes = None  # filled from the first extract
+
+    async def leg(migrate: bool) -> dict:
+        resilience.reset_resume_counters()
+        mig_mod.reset_migration_counters()
+        os.environ["DYN_TPU_MIGRATE"] = "1" if migrate else "0"
+        ss = StateStoreServer(port=0)
+        await ss.start()
+        rts, engines, coords = [], [], []
+        for _ in range(3):
+            rt = await DistributedRuntime.create(ss.url, "127.0.0.1:1")
+            eng = JaxServingEngine(cfg, params, EngineConfig(
+                max_slots=8, kv_block_size=8,
+                max_model_len=prompt_len + gen_tokens + 16,
+            ))
+            ep = rt.namespace("bmig").component("w").endpoint("gen")
+            await ep.serve(eng)
+            if migrate:
+                coords.append(await mig_mod.attach_migration(ep, eng))
+            rts.append(rt)
+            engines.append(eng)
+        fe = await DistributedRuntime.create(ss.url, "127.0.0.1:1")
+        client = await fe.namespace("bmig").component("w").endpoint(
+            "gen"
+        ).client("round_robin", policy=ResiliencePolicy(
+            request_timeout=120.0, connect_timeout=2.0, max_attempts=4,
+            backoff_base=0.01, backoff_max=0.05, resume_attempts=2, seed=3,
+        ))
+        await client.wait_for_instances(3, timeout=10)
+        stream_max_gap: list = []
+        failures: list = []
+
+        async def one(i: int) -> None:
+            ctx = Context({
+                "token_ids": [((i * 131 + j * 17) % 1000) + 3
+                              for j in range(prompt_len)],
+                "stop_conditions": {"max_tokens": gen_tokens,
+                                    "ignore_eos": True},
+                "sampling_options": {"temperature": 0.0},
+            })
+            last = None
+            worst = 0.0
+            async for item in client.generate(ctx):
+                if item.is_error:
+                    failures.append(item.error_message())
+                    return
+                now = time.perf_counter()
+                if last is not None:
+                    worst = max(worst, now - last)
+                last = now
+            stream_max_gap.append(worst)
+
+        t0 = time.perf_counter()
+        tasks = [asyncio.create_task(one(i)) for i in range(n_requests)]
+        # the moment worker 0 is mid-DECODE (tokens generated, streams
+        # live), drain it; the control leg stops it instead (bounded
+        # maintenance window → PR10 resume recovers with a full history
+        # recompute). Mid-decode matters: a pre-first-token stop would be
+        # absorbed by plain failover, which recomputes nothing to measure.
+        for _ in range(800):
+            await asyncio.sleep(0.01)
+            if (engines[0].live_request_count()
+                    and engines[0].total_generated_tokens >= 4):
+                break
+        drain_t0 = time.perf_counter()
+        rts[0].set_draining(True)
+        if migrate:
+            while engines[0].live_request_count():
+                await asyncio.sleep(0.02)
+                if time.perf_counter() - drain_t0 > 60:
+                    break
+        else:
+            await rts[0]._rpc_server.stop(drain_timeout=0.01)
+        drain_s = time.perf_counter() - drain_t0
+        await asyncio.gather(*tasks)
+        wall = time.perf_counter() - t0
+        recompute = sum(
+            e.metrics_snapshot()["resume_recompute_tokens"] for e in engines
+        )
+        m_ok, m_bad, m_blocks = mig_mod.migration_counters()
+        kv_bytes = 0
+        if m_blocks:
+            # one block = [L, bs, KVH, D] for k and v in the engine dtype
+            e = engines[1]
+            per = (
+                2 * cfg.num_layers * e.config.kv_block_size
+                * cfg.num_kv_heads * cfg.head_dim
+                * jnp.dtype(cfg.dtype).itemsize
+            )
+            kv_bytes = m_blocks * per
+        out = {
+            "wall_s": round(wall, 3),
+            "drain_s": round(drain_s, 3),
+            "failures": len(failures),
+            "recomputed_prefill_tokens": int(recompute),
+            "resumes": client.stats["resumes"],
+            "migrations": client.stats["migrations"],
+            "migrations_failed": m_bad,
+            "kv_blocks_moved": m_blocks,
+            "kv_bytes_moved": int(kv_bytes),
+            "worst_gap_p95_ms": round(float(np.percentile(
+                np.asarray(stream_max_gap or [0.0]) * 1e3, 95
+            )), 3),
+        }
+        await client.close()
+        for rt in rts + [fe]:
+            await rt.shutdown()
+        for e in engines:
+            e.close()
+        await ss.stop()
+        os.environ.pop("DYN_TPU_MIGRATE", None)
+        return out
+
+    control = asyncio.run(leg(migrate=False))
+    migrated = asyncio.run(leg(migrate=True))
+    return {
+        "scenario": (
+            f"{n_requests} streams x {prompt_len}-token prompts x "
+            f"{gen_tokens} generated on 3 tiny real engines; worker 0 "
+            f"drained mid-decode (control: stopped → resume recompute; "
+            f"migrate: KV shipped to siblings)"
+        ),
+        "control_resume": control,
+        "migrate": migrated,
+        "recompute_saved_tokens": (
+            control["recomputed_prefill_tokens"]
+            - migrated["recomputed_prefill_tokens"]
+        ),
+    }
+
+
 def bench_blackout() -> dict:
     """Control-plane blackout tolerance (docs/resilience.md §Control-plane
     blackout; no TPU — deterministic token engines over the real statestore
@@ -1488,6 +1651,11 @@ def main() -> None:
             out["blackout"] = bench_blackout()
         except Exception as e:
             out["blackout"] = {"error": str(e)[:200]}
+    if os.environ.get("BENCH_MIGRATE", "1") == "1":
+        try:
+            out["migration"] = bench_migration()
+        except Exception as e:
+            out["migration"] = {"error": str(e)[:200]}
     # LAST: pays minutes of first-boot remote compilation on the tunneled
     # runtime — must not eat the other sections' budget if it times out
     if os.environ.get("BENCH_MODEL_8B", "1") == "1":

@@ -155,7 +155,85 @@ def build_parser() -> argparse.ArgumentParser:
         wp = wverbs.add_parser(verb)
         wp.add_argument("endpoint", help="dyn://ns.comp.ep")
         wp.add_argument("worker_id", help="worker id (from `worker list`) or 'all'")
+        if verb == "drain":
+            wp.add_argument(
+                "--wait", action="store_true",
+                help="block until the drained worker(s) are idle (in-flight "
+                     "streams migrated/finished) or gone; exit 2 on timeout",
+            )
+            wp.add_argument(
+                "--timeout", type=float, default=60.0,
+                help="--wait deadline in seconds (default 60)",
+            )
+            wp.add_argument("--json", action="store_true", dest="as_json")
     return p
+
+
+async def _wait_drained(store, base: str, args) -> int:
+    """``worker drain --wait``: poll the drained worker's instance keys
+    until every matching instance is idle (draining with zero active slots
+    and zero queued requests — its in-flight streams migrated or finished)
+    or gone (process exited). Exit 0 when idle, 2 on the --timeout
+    deadline — cron/CI-scriptable like ``control-plane status``. ``--json``
+    prints ONE machine-parseable envelope on both paths."""
+    import asyncio
+    import time as _time
+
+    from dynamo_tpu.runtime.distributed import InstanceInfo
+
+    t0 = _time.monotonic()
+    rows: list = []
+    while True:
+        entries = await store.get_prefix(f"{base}/instances/")
+        rows = []
+        for k in sorted(entries):
+            try:
+                info = InstanceInfo.from_json(entries[k])
+            except (ValueError, KeyError):
+                continue
+            if args.worker_id != "all" and info.worker_id != args.worker_id:
+                continue
+            load = info.load or {}
+            idle = bool(info.draining) and not load.get("s") and not load.get("q")
+            rows.append({
+                "worker_id": info.worker_id,
+                "instance_id": info.instance_id,
+                "draining": bool(info.draining),
+                "active_slots": int(load.get("s") or 0),
+                "queue_depth": int(load.get("q") or 0),
+                "idle": idle,
+            })
+        waited = _time.monotonic() - t0
+        if all(r["idle"] for r in rows):  # vacuous truth = gone = drained
+            if args.as_json:
+                print(json.dumps({
+                    "worker_id": args.worker_id, "drained": True,
+                    "waited_s": round(waited, 2), "instances": rows,
+                }))
+            else:
+                print(
+                    f"{args.worker_id} drained idle in {waited:.1f}s "
+                    f"({len(rows)} instance(s) still registered)"
+                )
+            return 0
+        if waited >= args.timeout:
+            if args.as_json:
+                print(json.dumps({
+                    "worker_id": args.worker_id, "drained": False,
+                    "waited_s": round(waited, 2), "instances": rows,
+                }))
+            else:
+                busy = [r for r in rows if not r["idle"]]
+                print(
+                    f"timeout: {len(busy)} instance(s) of {args.worker_id} "
+                    f"still busy after {waited:.1f}s: "
+                    + ", ".join(
+                        f'{r["instance_id"]}(slots={r["active_slots"]},'
+                        f'q={r["queue_depth"]})' for r in busy
+                    )
+                )
+            return 2
+        await asyncio.sleep(min(0.25, args.timeout / 10))
 
 
 async def amain(argv: list) -> int:
@@ -280,7 +358,15 @@ async def amain(argv: list) -> int:
                 # no lease: the drain order outlives this CLI process; the
                 # worker's drain watcher applies it within one watch event
                 await store.put(key, b"1")
-                print(f"draining {args.worker_id} on {args.endpoint}")
+                if getattr(args, "wait", False):
+                    return await _wait_drained(store, base, args)
+                if getattr(args, "as_json", False):
+                    print(json.dumps({
+                        "worker_id": args.worker_id, "draining": True,
+                        "waited": False,
+                    }))
+                else:
+                    print(f"draining {args.worker_id} on {args.endpoint}")
             else:
                 ok = await store.delete(key)
                 print(
@@ -507,6 +593,14 @@ async def _telemetry_cmd(args, store) -> int:
             f' spec={e.get("spec_accept_rate", 0.0):.2f}'
             if e.get("spec_drafted_tokens") else ""
         )
+        # live-migration column only when the fleet has actually migrated
+        # (noise-free on fleets that never drain, like the spec column)
+        migr = (
+            f' migr={e.get("migrations_total", 0)}'
+            f'/{e.get("migrations_failed_total", 0)}fail'
+            if e.get("migrations_total") or e.get("migrations_failed_total")
+            else ""
+        )
         print(
             f'{model:20s} workers={e.get("workers", 0)} '
             f'(unhealthy={e.get("workers_unhealthy", 0)}) '
@@ -515,7 +609,7 @@ async def _telemetry_cmd(args, store) -> int:
             f'kv_free {e.get("kv_blocks_free", 0)}/{e.get("kv_blocks_total", 0)} '
             f'headroom={e.get("headroom_frac", 0.0):.2f} '
             f'decode={e.get("decode_tokens_per_s", 0.0):.0f} tok/s'
-            f'{spec}'
+            f'{spec}{migr}'
         )
     worst = roll.get("worst_worker")
     if worst:

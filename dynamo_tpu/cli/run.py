@@ -526,6 +526,7 @@ async def run_endpoint(chat_engine, completions_engine, model_name: str, in_spec
         await attach_kv_publishing(endpoint, core_engine)
         await serve_stats_endpoint(endpoint, core_engine)  # pull/scrape plane
         logger.info("kv events + metrics publishing enabled (worker key %s)", drt.worker_id)
+    transfer_server = None
     if flags.disagg == "decode" and core_engine is not None:
         if not hasattr(core_engine, "set_remote_prefill_policy"):
             raise SystemExit(
@@ -535,7 +536,7 @@ async def run_endpoint(chat_engine, completions_engine, model_name: str, in_spec
         from ..disagg.protocols import DisaggConfig
         from ..disagg.serving import enable_disagg_decode
 
-        await enable_disagg_decode(
+        transfer_server = await enable_disagg_decode(
             endpoint, core_engine, info.instance_id,
             config=DisaggConfig(
                 max_local_prefill_length=flags.max_local_prefill_length,
@@ -549,6 +550,22 @@ async def run_endpoint(chat_engine, completions_engine, model_name: str, in_spec
                 else ""
             ),
         )
+    if core_engine is not None and hasattr(core_engine, "stage_migration"):
+        # live in-flight migration (docs/resilience.md §Live migration):
+        # drains migrate this worker's decode streams to siblings over the
+        # transfer plane. Reuses the disagg transfer server when one exists
+        # (same rendezvous key); DYN_TPU_MIGRATE=0 ⇒ attach_migration
+        # returns None without constructing anything (old drain semantics).
+        from ..disagg.migration import attach_migration
+
+        coord = await attach_migration(
+            endpoint, core_engine, transfer_server=transfer_server
+        )
+        if coord is not None:
+            logger.info(
+                "live migration enabled for worker %s (drain deadline %.0fs)",
+                drt.worker_id, coord.policy.drain_deadline,
+            )
     logger.info("worker %s serving %s at %s", info.worker_id, in_spec, info.address)
     from ..runtime.worker import serve_until_shutdown
 

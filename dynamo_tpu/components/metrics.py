@@ -70,6 +70,12 @@ GAUGES = [
     # and resumable streams that still died in-band (cumulative)
     ("resume_total", "Streams resumed on another worker mid-decode (cumulative)"),
     ("resume_failed_total", "Resumable streams that still failed in-band (cumulative)"),
+    # live in-flight migration (docs/resilience.md §Live migration):
+    # drain-time migrate-outs from this worker, failures that degraded to
+    # the resume path, and KV blocks moved over the transfer plane
+    ("migrations_total", "Streams live-migrated to a sibling on drain (cumulative)"),
+    ("migrations_failed_total", "Drain migrations that degraded to the resume path (cumulative)"),
+    ("migrate_kv_blocks_moved_total", "KV blocks moved by live migration (cumulative)"),
     # control-plane blackout tolerance (docs/resilience.md): events this
     # worker dropped from its outage buffers while the bus was down
     ("bus_dropped_events", "Events dropped from control-plane outage buffers (cumulative)"),

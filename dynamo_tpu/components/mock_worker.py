@@ -102,6 +102,9 @@ class MockWorkerStats:
         tenants: Optional[Dict[str, int]] = None,
         resume_total: int = 0,
         resume_failed: int = 0,
+        migrations_total: int = 0,
+        migrations_failed: int = 0,
+        migrate_kv_blocks_moved: int = 0,
         control_plane_state: str = "connected",
         bus_dropped_events: int = 0,
     ):
@@ -139,6 +142,13 @@ class MockWorkerStats:
         # rollup's resume sums can be exercised without killing workers
         self.resume_total = max(int(resume_total), 0)
         self.resume_failed = max(int(resume_failed), 0)
+        # live-migration drill (docs/resilience.md §Live migration): report
+        # nonzero drain-migration counters so the dynamo_*_migrations_*
+        # gauges and the cluster rollup sums can be exercised without
+        # draining real workers
+        self.migrations_total = max(int(migrations_total), 0)
+        self.migrations_failed = max(int(migrations_failed), 0)
+        self.migrate_kv_blocks_moved = max(int(migrate_kv_blocks_moved), 0)
         # control-plane blackout drill: report a stale/disconnected view so
         # `llmctl control-plane status` exit-2 and the dynamo_*_control_*
         # gauges can be exercised without killing a statestore
@@ -312,6 +322,9 @@ class MockWorkerStats:
             kv_quantized=int(self.kv_quantized),
             resume_total=self.resume_total,
             resume_failed_total=self.resume_failed,
+            migrations_total=self.migrations_total,
+            migrations_failed_total=self.migrations_failed,
+            migrate_kv_blocks_moved_total=self.migrate_kv_blocks_moved,
             control_plane_state=self.control_plane_state,
             bus_dropped_events=self.bus_dropped_events,
             uptime_s=round(time.monotonic() - self.started, 3),
@@ -371,6 +384,8 @@ async def run_mock_worker(
     tenants: Optional[Dict[str, int]] = None,
     resume_total: int = 0,
     resume_failed: int = 0,
+    migrations_total: int = 0,
+    migrations_failed: int = 0,
     control_plane_state: str = "connected",
 ) -> None:
     from dynamo_tpu.runtime.distributed import KV_METRICS_SUBJECT
@@ -382,6 +397,9 @@ async def run_mock_worker(
         spec_accept_rate=spec_accept_rate, kv_quantized=kv_quantized,
         role=role, tenants=tenants,
         resume_total=resume_total, resume_failed=resume_failed,
+        migrations_total=migrations_total,
+        migrations_failed=migrations_failed,
+        migrate_kv_blocks_moved=migrations_total * 8,
         control_plane_state=control_plane_state,
     )
     tick_no = 0
@@ -438,6 +456,12 @@ def main() -> None:
                         "workers)")
     p.add_argument("--resume-failed", type=int, default=0,
                    help="report N failed resume recoveries")
+    p.add_argument("--migrations-total", type=int, default=0,
+                   help="report N drain-time live migrations (drills the "
+                        "dynamo_*_migrations_* gauges and llmctl cluster "
+                        "status migr= column without draining workers)")
+    p.add_argument("--migrations-failed", type=int, default=0,
+                   help="report N migrations that degraded to resume")
     p.add_argument("--control-plane-state", default="connected",
                    choices=("connected", "stale", "disconnected"),
                    help="report this control-plane view (drills `llmctl "
@@ -467,6 +491,8 @@ def main() -> None:
             tenants=parse_tenant_shares(args.tenants),
             resume_total=args.resume_total,
             resume_failed=args.resume_failed,
+            migrations_total=args.migrations_total,
+            migrations_failed=args.migrations_failed,
             control_plane_state=args.control_plane_state,
         )
 

@@ -69,6 +69,10 @@ CLUSTER_GAUGES = [
     # mid-stream resume (docs/resilience.md): fleet recovery counters
     ("resume_total", "Streams resumed on another worker mid-decode (fleet sum)"),
     ("resume_failed_total", "Resumable streams that still failed in-band (fleet sum)"),
+    # live in-flight migration (docs/resilience.md §Live migration)
+    ("migrations_total", "Streams live-migrated on drain (fleet sum)"),
+    ("migrations_failed_total", "Drain migrations degraded to resume (fleet sum)"),
+    ("migrate_kv_blocks_moved_total", "KV blocks moved by live migration (fleet sum)"),
     # control-plane blackout tolerance (docs/resilience.md): workers whose
     # own view of the statestore/bus planes is stale or disconnected, and
     # the fleet's cumulative outage-buffer drops
@@ -355,6 +359,8 @@ class ClusterTelemetry:
                 "spec_drafted_tokens": 0, "spec_accepted_tokens": 0,
                 "spec_accept_rate": 0.0,
                 "resume_total": 0, "resume_failed_total": 0,
+                "migrations_total": 0, "migrations_failed_total": 0,
+                "migrate_kv_blocks_moved_total": 0,
                 "control_plane_impaired": 0,
                 "bus_dropped_events": 0,
                 "control_plane": {
@@ -404,6 +410,17 @@ class ClusterTelemetry:
             entry["resume_total"] += int(getattr(m, "resume_total", 0) or 0)
             entry["resume_failed_total"] += int(
                 getattr(m, "resume_failed_total", 0) or 0
+            )
+            # live migration: fleet drain-migration counters (same
+            # cumulative-sum discipline as the resume counters)
+            entry["migrations_total"] += int(
+                getattr(m, "migrations_total", 0) or 0
+            )
+            entry["migrations_failed_total"] += int(
+                getattr(m, "migrations_failed_total", 0) or 0
+            )
+            entry["migrate_kv_blocks_moved_total"] += int(
+                getattr(m, "migrate_kv_blocks_moved_total", 0) or 0
             )
             # control-plane view per worker: count by state, name the
             # impaired ones (bounded like unhealthy_worker_ids) so `llmctl

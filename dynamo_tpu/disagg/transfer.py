@@ -18,7 +18,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from dynamo_tpu.engine_jax.allocator import KvDtypeMismatch
+from dynamo_tpu.engine_jax.allocator import KvDtypeMismatch, MigrationRejected
 from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.codec import TwoPartMessage, read_frame, write_frame
 
@@ -245,6 +245,38 @@ class KvTransferServer:
                     # instead of pinning HBM pages until the TTL sweep
                     if self.device_plane is not None:
                         self.device_plane.release(h["uuid"])
+                elif h.get("op") == "migrate":
+                    # live in-flight migration (docs/resilience.md §Live
+                    # migration): one atomic frame = checkpoint header +
+                    # packed history pages. The engine stages it (allocate +
+                    # inject + seal) or raises a typed rejection — the nack
+                    # below tells the source to degrade that stream to the
+                    # resume path; nothing is ever partially staged.
+                    k, v, scales = _unpack_pages(h, frame.body)
+                    meta = h.get("migrate") or {}
+                    try:
+                        res = await _engine_call(
+                            self.engine,
+                            lambda: self.engine.stage_migration(
+                                meta, k, v,
+                                scales[0] if scales else None,
+                                scales[1] if scales else None,
+                            ),
+                        )
+                    except (MigrationRejected, KvDtypeMismatch,
+                            KeyError, ValueError, TypeError) as e:
+                        await write_frame(writer, TwoPartMessage(
+                            json.dumps({
+                                "id": h.get("id"), "ok": False, "int8": True,
+                                "code": type(e).__name__, "error": str(e),
+                            }).encode(), b""))
+                        continue
+                    await write_frame(writer, TwoPartMessage(
+                        json.dumps({
+                            "id": h.get("id"), "ok": True, "int8": True,
+                            "staged": res,
+                        }).encode(), b""))
+                    continue
                 elif h.get("op") == "prefill_failed":
                     self.engine.fail_remote_prefill(h["request_id"], h.get("message", ""))
                 await write_frame(
@@ -558,6 +590,61 @@ class KvTransferClient:
                 ),
             )
             await read_frame(reader)
+
+    async def migrate(self, address: str, meta: dict, k, v,
+                      scales=None) -> dict:
+        """Ship one live-migrating stream's checkpoint + history pages to
+        ``address`` atomically (docs/resilience.md §Live migration). The
+        target stages the pages ahead of the re-homed client's admission;
+        a typed rejection (OOM, dtype/block-size skew) raises
+        :class:`MigrationRejected` / :class:`KvDtypeMismatch`, transport
+        failures raise as usual — the caller degrades the stream to the
+        resume path in every failure case. Returns the ack's ``staged``
+        summary."""
+        k, v = np.asarray(k), np.asarray(v)
+        if scales is not None:
+            scales = (np.asarray(scales[0]), np.asarray(scales[1]))
+        with tracing.span(
+            "disagg.kv_transfer",
+            parent=tracing.current_span(),
+            phase="kv_transfer",
+            attributes={"op": "migrate", "pages": int(k.shape[1]),
+                        "address": address,
+                        "request_id": meta.get("request_id", "")},
+        ) as tspan:
+            reader, writer = await self._conn(address)
+            header, body = _pack_pages(k, v, scales)
+            header.update({"op": "migrate", "migrate": meta})
+            if tspan is not None:
+                tspan.set_attribute("path", "tcp")
+                tspan.set_attribute("bytes", len(body))
+            try:
+                async with self._locks[address]:
+                    await write_frame(
+                        writer,
+                        TwoPartMessage(json.dumps(header).encode(), body),
+                    )
+                    frame = await read_frame(reader)
+            except asyncio.CancelledError:
+                # the caller's migrate timeout fired mid-protocol (possibly
+                # mid-frame): the connection's request/ack pairing can no
+                # longer be trusted — a later migrate on it would read THIS
+                # stream's stale ack and mis-credit its outcome. Evict so
+                # the next ship dials fresh.
+                self.evict(address, writer)
+                raise
+            except (ConnectionError, OSError, asyncio.IncompleteReadError):
+                self.evict(address, writer)
+                raise
+            ack = json.loads(frame.header)
+            self._note_caps(address, ack)
+            if not ack.get("ok"):
+                code = ack.get("code", "")
+                msg = ack.get("error", "peer refused migration")
+                if code == "KvDtypeMismatch":
+                    raise KvDtypeMismatch(msg)
+                raise MigrationRejected(msg)
+            return ack.get("staged") or {}
 
     async def close(self) -> None:
         for _, w in self._conns.values():

@@ -34,6 +34,14 @@ class KvDtypeMismatch(TypeError):
     prefill-failure reply so the decode side falls back to local prefill."""
 
 
+class MigrationRejected(RuntimeError):
+    """A target engine refused to stage a live-migrated stream (out of KV
+    blocks, block-size/page-count mismatch, history longer than its
+    max_model_len). Typed so the transfer plane's ``migrate`` op nacks
+    cleanly and the source degrades that stream to the ordinary resume
+    path — never a torn page set (docs/resilience.md §Live migration)."""
+
+
 class KvEventSink(Protocol):
     """Receiver for KV cache events (worker → router)."""
 
@@ -496,6 +504,36 @@ class BlockAllocator:
         alloc.sealed_blocks = len(alloc.token_blocks.blocks)
         if stored and self._sink is not None:
             self._sink.blocks_stored(parent, stored)
+
+    def retag_sequence(self, alloc: SequenceAllocation, tenant: str,
+                       level: int) -> None:
+        """Re-attribute a live allocation to a different tenant/class —
+        the receiving side of a live migration adopts staged blocks under
+        the checkpoint's tenant, then re-tags them to the attaching
+        request's identity (normally the same; a skew must not leave the
+        per-tenant budget accounting pointing at the wrong owner)."""
+        if tenant != alloc.tenant:
+            n = len(alloc.block_ids)
+            if alloc.tenant and n:
+                left = self.tenant_blocks.get(alloc.tenant, 0) - n
+                if left > 0:
+                    self.tenant_blocks[alloc.tenant] = left
+                else:
+                    self.tenant_blocks.pop(alloc.tenant, None)
+            if tenant and n:
+                self.tenant_blocks[tenant] = (
+                    self.tenant_blocks.get(tenant, 0) + n
+                )
+            alloc.tenant = tenant
+        if level != alloc.level:
+            alloc.level = level
+            # levels only ever rise here (eviction tiering is max-over-
+            # owners); a downgrade is corrected when the block's content
+            # is replaced (_unregister)
+            if level > 0:
+                for bid in alloc.block_ids:
+                    if self._block_level.get(bid, 0) < level:
+                        self._block_level[bid] = level
 
     def free_sequence(self, alloc: SequenceAllocation) -> None:
         """Release a finished sequence's pages. Hash-registered blocks become

@@ -31,6 +31,7 @@ import logging
 import math
 import threading
 import time
+import uuid
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Deque, Dict, List, Optional, Set, Tuple
@@ -45,6 +46,7 @@ from dynamo_tpu.engine_jax.allocator import (
     InflightPrefix,
     KvDtypeMismatch,
     KvEventSink,
+    MigrationRejected,
     SequenceAllocation,
 )
 from dynamo_tpu.engine_jax.drafter import (
@@ -243,7 +245,7 @@ class _Seq:
         "first_token_t", "admit_t", "remote", "remote_deadline", "prefill_pos",
         "freq_pen", "pres_pen", "out_tokens", "joined_inflight", "wait_hash",
         "drafter", "spec_drafted", "spec_accepted", "tenant", "level",
-        "weight", "resumed",
+        "weight", "resumed", "migrated",
     )
 
     def __init__(self, ctx: Context, request: PreprocessedRequest, loop) -> None:
@@ -281,6 +283,10 @@ class _Seq:
         # full token_ids as prompt (that IS the recompute; the prefix cache
         # and host tier soften it like any preemption recompute).
         self.resumed = False
+        # live migration (disagg/migration.py): set at admission when this
+        # request adopted a staged migration's allocation — its "prefill"
+        # is one fresh position, not a recompute
+        self.migrated = False
         res = getattr(request, "resume", None)
         if isinstance(res, dict):
             try:
@@ -605,6 +611,17 @@ class JaxServingEngine(AsyncEngine):
             Tuple[List[Tuple[int, int]], Any, Any, Any, Any]
         ] = deque()
 
+        # live in-flight migration (disagg/migration.py, docs/resilience.md
+        # §Live migration). Source side: sequences frozen out of their slots
+        # while the drain coordinator ships their pages. Target side: staged
+        # imports — a pre-built allocation whose cached_tokens covers every
+        # already-computed position, keyed by migration id, waiting for the
+        # re-homed client's attach (TTL-swept if it never comes). Both dicts
+        # stay empty unless a drain migration is actually in flight — the
+        # step loop pays nothing for the feature existing.
+        self._migrating_out: Dict[str, _Seq] = {}
+        self._staged_migrations: Dict[str, Tuple[SequenceAllocation, tuple, float]] = {}
+
         # stats
         self.total_requests = 0
         self.total_generated_tokens = 0
@@ -613,6 +630,15 @@ class JaxServingEngine(AsyncEngine):
         # mid-stream resume (docs/resilience.md): requests admitted with a
         # resume marker — their prompt is another worker's dead stream
         self.resumed_requests = 0
+        # live migration counters: streams this engine shipped out on drain,
+        # staged imports adopted by a re-homed client, and — the chaos-gate
+        # observable — prompt positions a RESUMED/MIGRATED admission had to
+        # recompute (a migrated stream adds 0; a plain resume adds the whole
+        # uncached history)
+        self.migrated_out_requests = 0
+        self.migrated_in_requests = 0
+        self.migrations_failed = 0
+        self.resume_recompute_tokens = 0
         # speculative decoding (cumulative): drafts handed to verify
         # dispatches and how many matched their sampled targets
         self.spec_drafted_total = 0
@@ -1387,8 +1413,9 @@ class JaxServingEngine(AsyncEngine):
                         and not self._pending_spills
                         and self._counts is None  # idle pass frees it first
                     ):
-                        if self._awaiting:
-                            # wake periodically to sweep remote-prefill timeouts
+                        if self._awaiting or self._staged_migrations:
+                            # wake periodically to sweep remote-prefill
+                            # timeouts and unclaimed staged migrations
                             self._cond.wait(timeout=1.0)
                             break
                         # parking idle: record it, or the last busy beat
@@ -1420,6 +1447,7 @@ class JaxServingEngine(AsyncEngine):
                 ))
                 self._run_posted()
                 self._sweep_remote_timeouts()
+                self._sweep_staged()
                 idle = (
                     not self._pending and not any(self._slots)
                     and self._inflight is None
@@ -1641,6 +1669,12 @@ class JaxServingEngine(AsyncEngine):
                 seq.emit(Annotated.from_data(LLMEngineOutput.final(FinishReason.CANCELLED).to_dict()))
                 seq.emit(_FINISHED)
                 continue
+            if seq.alloc is None and getattr(seq.request, "migrate", None):
+                # re-homed migrated stream: adopt the staged allocation
+                # (cached_tokens = N-1 ⇒ the prefill below computes exactly
+                # one fresh position). Miss/mismatch falls through to the
+                # ordinary resume recompute.
+                self._adopt_staged(seq)
             if seq.alloc is not None and seq.generated:
                 # remotely-prefilled sequence re-entering for a decode slot:
                 # KV + first token already landed, just start decoding
@@ -1728,6 +1762,16 @@ class JaxServingEngine(AsyncEngine):
                     self._pending.appendleft(seq)  # retry when blocks free up
                 return
             seq.alloc = alloc
+            if seq.resumed:
+                # the chaos-gate observable (docs/resilience.md §Live
+                # migration): positions of another worker's dead stream this
+                # admission recomputes. The last position is excluded — it
+                # was never computed anywhere (the source sampled its token
+                # but hadn't fed it). A migrate-adopted admission never
+                # reaches this line (its staged alloc covers everything).
+                self.resume_recompute_tokens += max(
+                    len(seq.prompt) - alloc.cached_tokens - 1, 0
+                )
             if seq.joined_inflight:
                 # telemetry: tokens this request got for free by waiting for
                 # a concurrent identical prefix instead of recomputing it
@@ -2619,6 +2663,10 @@ class JaxServingEngine(AsyncEngine):
             # another worker's dead stream, not an admission wait — SLO
             # consumers exclude it from TTFT (docs/resilience.md)
             attrs["resumed"] = True
+        if seq.migrated:
+            # migrated re-home: the staged KV made the re-admission
+            # recompute-free (docs/resilience.md §Live migration)
+            attrs["migrated"] = True
         req_span = tracing.record_span(
             "engine.request", seq.enqueue_t, now, parent=parent,
             attributes=attrs,
@@ -2808,6 +2856,295 @@ class JaxServingEngine(AsyncEngine):
         alloc = self._held_allocs.pop(request_id, None)
         if alloc is not None:
             self.allocator.free_sequence(alloc)
+
+    # -- live in-flight migration (disagg/migration.py) -----------------------
+    #
+    # Source side: export_migratable freezes mid-decode sequences; the drain
+    # coordinator extracts their pages, ships a `migrate` frame, and ends
+    # each stream with an in-band marker (finish_migrated / abort_migration).
+    # Target side: stage_migration adopts the pages into a pre-built
+    # allocation whose cached_tokens covers every already-computed position
+    # (0..N-2 of the N-token prompt+emitted history — position N-1 was never
+    # computed anywhere: the source sampled its token but hadn't fed it yet).
+    # The re-homed client's attach then rides the ORDINARY admission path for
+    # a pre-held allocation: prefill_pos = N-1, one fresh position computed,
+    # zero positions recomputed, greedy continuation bitwise identical.
+
+    def export_migratable(self) -> List[dict]:
+        """Freeze every migratable sequence (mid-decode, ≥1 generated token,
+        not remote-awaiting/cancelled) out of its slot and return one
+        checkpoint per stream. Frozen sequences stop decoding but keep
+        their allocation until finish/abort/unfreeze. MUST run on the
+        engine thread (via post())."""
+        self._drain_inflight()  # commit speculative writes; host state final
+        out: List[dict] = []
+        bs = self.config.kv_block_size
+        for i, seq in enumerate(self._slots):
+            if (
+                seq is None or seq.prefill_pos is not None
+                or not seq.generated or seq.ctx.context.is_stopped
+            ):
+                continue
+            self._slots[i] = None
+            seq.slot = None
+            self._migrating_out[seq.ctx.id] = seq
+            toks = seq.prompt + seq.generated
+            n_hist = len(toks) - 1
+            out.append({
+                "request_id": seq.ctx.id,
+                "mid": uuid.uuid4().hex[:16],
+                "token_ids": toks,
+                # caller-visible output across ALL legs of this stream
+                # (out_tokens carries resume/migrate-seeded history plus
+                # everything emitted here) — the client validates its
+                # journal against this; seq.emitted would under-count a
+                # stream that already migrated once
+                "emitted": len(seq.out_tokens),
+                "tenant": seq.tenant,
+                "level": seq.level,
+                "n_blocks": (n_hist + bs - 1) // bs,
+            })
+        return out
+
+    def extract_for_migration(self, request_id: str):
+        """Copy a frozen sequence's computed-history pages out of the pool:
+        blocks covering positions 0..N-2 (the last sampled token was never
+        fed, so its position has no KV anywhere). MUST run on the engine
+        thread."""
+        seq = self._migrating_out[request_id]  # KeyError → coordinator aborts
+        n_hist = len(seq.prompt) + len(seq.generated) - 1
+        n_blocks = (n_hist + self.config.kv_block_size - 1) // self.config.kv_block_size
+        return self.extract_blocks(seq.alloc.block_ids[:n_blocks])
+
+    def finish_migrated(self, request_id: str, target_instance: str,
+                        target_worker: str, mid: str) -> None:
+        """The target staged this stream: end it with the in-band re-home
+        marker and free the local pages (their contents were copied out).
+        MUST run on the engine thread."""
+        seq = self._migrating_out.pop(request_id, None)
+        if seq is None:
+            return
+        self.migrated_out_requests += 1
+        seq.emit(Annotated.from_data(
+            {"migrating": {
+                "instance": target_instance, "worker": target_worker,
+                "mid": mid, "emitted": len(seq.out_tokens),
+            }},
+            id=seq.ctx.id,
+        ))
+        seq.emit(_FINISHED)
+        if seq.alloc is not None:
+            self.allocator.free_sequence(seq.alloc)
+            seq.alloc = None
+
+    def abort_migration(self, request_id: str, reason: str = "") -> None:
+        """Migration of a frozen stream failed (transport, target nack, no
+        target): end the stream with a resume directive — the client
+        degrades to the ordinary resume path (re-admit anywhere, recompute
+        softened by the prefix cache). MUST run on the engine thread."""
+        seq = self._migrating_out.pop(request_id, None)
+        if seq is None:
+            return
+        self.migrations_failed += 1
+        seq.emit(Annotated.from_data(
+            {"migrating": {"resume": True, "error": reason}}, id=seq.ctx.id,
+        ))
+        seq.emit(_FINISHED)
+        if seq.alloc is not None:
+            self.allocator.free_sequence(seq.alloc)
+            seq.alloc = None
+
+    def unfreeze_migrations(self) -> int:
+        """Undrained before shipping: frozen sequences re-enter the pending
+        queue with allocation and generated history intact — the decode-
+        ready re-admission path puts them back in a slot exactly where they
+        stopped. MUST run on the engine thread."""
+        n = 0
+        with self._cond:
+            for seq in self._migrating_out.values():
+                self._pending.append(seq)
+                n += 1
+            self._migrating_out.clear()
+            if n:
+                self._cond.notify()
+        return n
+
+    def cut_for_resume(self) -> int:
+        """Drain-deadline force-cut: every remaining live stream (slots,
+        pending, remote-awaiting, still-frozen) ends with a resume
+        directive so the process can exit; clients re-admit elsewhere.
+        MUST run on the engine thread."""
+        self._drain_inflight()
+        cut: List[_Seq] = []
+        for i, seq in enumerate(self._slots):
+            if seq is not None:
+                self._slots[i] = None
+                seq.slot = None
+                cut.append(seq)
+        with self._cond:
+            cut.extend(self._pending)
+            self._pending.clear()
+        cut.extend(self._awaiting.values())
+        self._awaiting.clear()
+        cut.extend(self._migrating_out.values())
+        self._migrating_out.clear()
+        for seq in cut:
+            seq.emit(Annotated.from_data(
+                {"migrating": {"resume": True, "error": "drain deadline"}},
+                id=seq.ctx.id,
+            ))
+            seq.emit(_FINISHED)
+            if seq.alloc is not None:
+                self.allocator.free_sequence(seq.alloc)
+                seq.alloc = None
+        return len(cut)
+
+    def live_request_count(self) -> int:
+        """Streams this engine still owes an ending (thread-safe)."""
+        with self._cond:
+            return (
+                sum(1 for s in self._slots if s is not None)
+                + len(self._pending) + len(self._awaiting)
+                + len(self._migrating_out)
+            )
+
+    def _migration_ttl(self) -> float:
+        ttl = getattr(self, "_staged_ttl", None)
+        if ttl is None:
+            from dynamo_tpu.disagg.migration import MigrationPolicy
+
+            ttl = self._staged_ttl = MigrationPolicy.from_env().staged_ttl
+        return ttl
+
+    def stage_migration(self, meta: dict, k_np, v_np, k_scale=None,
+                        v_scale=None) -> dict:
+        """Target side: adopt a migrating stream's KV pages ahead of its
+        client's re-homed admission. Validates layout, allocates for the
+        full N-token history, injects the wire pages over everything the
+        local prefix cache doesn't already cover, seals the computed blocks
+        into the prefix cache (they are ordinary cluster-visible prefix
+        hits from here on), and parks the allocation keyed by migration id
+        with ``cached_tokens = N-1`` — the attach then computes exactly one
+        fresh position. Any rejection raises BEFORE pool state changes
+        beyond a rolled-back allocation: never a torn page set. MUST run on
+        the engine thread."""
+        toks = [int(t) for t in meta["token_ids"]]
+        if len(toks) < 2:
+            raise MigrationRejected("history too short to migrate")
+        if len(toks) > self.config.max_model_len - 1:
+            raise MigrationRejected(
+                f"history is {len(toks)} tokens; engine max_model_len is "
+                f"{self.config.max_model_len}"
+            )
+        bs = self.config.kv_block_size
+        if self._kv_quantized != (k_scale is not None):
+            raise KvDtypeMismatch(
+                "pool kv_dtype is %s but migrated pages %s scale tables" % (
+                    "int8" if self._kv_quantized else "native",
+                    "lack" if k_scale is None else "carry",
+                )
+            )
+        if k_np.shape[2] != bs:
+            raise MigrationRejected(
+                f"migrated pages have block_size {k_np.shape[2]}, engine "
+                f"uses {bs}"
+            )
+        n_hist = len(toks) - 1
+        n_blocks = (n_hist + bs - 1) // bs
+        if k_np.shape[1] != n_blocks:
+            raise MigrationRejected(
+                f"page set covers {k_np.shape[1]} blocks, history needs "
+                f"{n_blocks}"
+            )
+        tenant = str(meta.get("tenant") or "")
+        level = int(meta.get("level") or 0)
+        mid = str(meta["mid"])  # parse BEFORE allocating: a malformed
+        # checkpoint must not cost pool state
+        alloc = self.allocator.allocate_sequence(
+            toks, wait_inflight=False, tenant=tenant, level=level
+        )
+        if alloc is None:
+            raise MigrationRejected("target out of KV blocks")
+        try:
+            # local device hits cover the leading cached_tokens//bs blocks;
+            # the wire pages fill everything after them. Host-tier hits are
+            # dropped: their blocks are freshly-taken single-owner pages the
+            # wire content (same tokens, the source's ground-truth KV)
+            # overwrites anyway.
+            n_dev = alloc.cached_tokens // bs - len(alloc.host_hits)
+            alloc.host_hits = []
+            if n_dev < n_blocks:
+                self.inject_blocks(
+                    alloc.block_ids[n_dev:n_blocks],
+                    k_np[:, n_dev:n_blocks], v_np[:, n_dev:n_blocks],
+                    k_scale[:, n_dev:n_blocks]
+                    if k_scale is not None else None,
+                    v_scale[:, n_dev:n_blocks]
+                    if v_scale is not None else None,
+                )
+            # seal the computed history: full blocks register in the prefix
+            # cache — the migrated prefix is now a cluster-adopted cache
+            # entry other requests can hit (ROADMAP item 3's "move the KV"
+            # pipe)
+            self.allocator.note_tokens_computed(
+                alloc, toks[alloc.cached_tokens:n_hist]
+            )
+        except BaseException:
+            # injection/sealing failed past the shape checks (e.g. KV
+            # geometry skew the scatter rejects): the nack must not leak
+            # the allocation — every drain retry would otherwise bleed the
+            # target's pool dry
+            self.allocator.free_sequence(alloc)
+            raise
+        alloc.cached_tokens = n_hist
+        self._staged_migrations[mid] = (
+            alloc, tuple(toks), time.perf_counter() + self._migration_ttl(),
+        )
+        with self._cond:
+            self._cond.notify()  # wake the idle park so the TTL sweep runs
+        return {"mid": mid, "blocks": n_blocks, "cached_tokens": n_hist}
+
+    def _adopt_staged(self, seq: "_Seq") -> None:
+        """Admission-time attach: a request carrying a migrate id adopts its
+        staged allocation (cached_tokens = N-1 ⇒ prefill computes exactly
+        one fresh position). Token mismatch or a missing/expired stage
+        falls through to the ordinary resume recompute — the stage-seeded
+        blocks still serve as plain prefix hits. Engine thread only."""
+        mid = str(seq.request.migrate)
+        entry = self._staged_migrations.pop(mid, None)
+        if entry is None:
+            return
+        alloc, toks, _deadline = entry
+        if list(toks) != seq.prompt:
+            # the client's journal and the source's checkpoint disagree
+            # (undelivered tokens at cut time): the staged KV covers a
+            # different history — recompute path, blocks back to the cache
+            self.allocator.free_sequence(alloc)
+            return
+        if alloc.tenant != seq.tenant or alloc.level != seq.level:
+            self.allocator.retag_sequence(alloc, seq.tenant, seq.level)
+        seq.alloc = alloc
+        seq.migrated = True
+        self.migrated_in_requests += 1
+
+    def _sweep_staged(self) -> None:
+        """Free staged migrations whose client never attached (engine
+        thread, every loop pass; dict-empty check is the only steady-state
+        cost)."""
+        if not self._staged_migrations:
+            return
+        now = time.perf_counter()
+        for mid, (alloc, _toks, deadline) in list(
+            self._staged_migrations.items()
+        ):
+            if now > deadline:
+                del self._staged_migrations[mid]
+                n_blocks = len(alloc.block_ids)
+                self.allocator.free_sequence(alloc)
+                logger.warning(
+                    "staged migration %s expired unclaimed; freed %d blocks",
+                    mid, n_blocks,
+                )
 
     def _inject_fn(self):
         if not hasattr(self, "_inject_jit"):
@@ -3091,6 +3428,15 @@ class JaxServingEngine(AsyncEngine):
             # mid-stream resume: re-admissions this engine served (the
             # client-side resume counters live in runtime/resilience.py)
             "resumed_requests": self.resumed_requests,
+            # live migration (docs/resilience.md §Live migration): streams
+            # shipped out on drain, staged imports a re-homed client
+            # adopted, stages currently parked, and — the chaos-gate
+            # observable — positions resumed admissions had to recompute
+            # (migrated admissions add 0)
+            "migrated_out_requests": self.migrated_out_requests,
+            "migrated_in_requests": self.migrated_in_requests,
+            "migrate_staged": len(self._staged_migrations),
+            "resume_recompute_tokens": self.resume_recompute_tokens,
         }
         if self._perf is not None:
             m["decode_tokens_per_s"] = round(self._perf.decode_tps, 3)

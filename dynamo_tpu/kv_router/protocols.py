@@ -203,6 +203,14 @@ class ForwardPassMetrics:
     # dynamo_cluster_resume_total / dynamo_cluster_resume_failed_total.
     resume_total: int = 0
     resume_failed_total: int = 0
+    # live in-flight migration (docs/resilience.md §Live migration):
+    # cumulative SOURCE-side drain migrate-outs (streams shipped to a
+    # sibling with their KV), failures that degraded to the resume path,
+    # and KV blocks moved over the transfer plane. The aggregator sums
+    # them into dynamo_cluster_migrations_* / _migrate_kv_blocks_moved.
+    migrations_total: int = 0
+    migrations_failed_total: int = 0
+    migrate_kv_blocks_moved_total: int = 0
     # process identity for cluster attribution + dashboards
     uptime_s: float = 0.0
     model: Optional[str] = None

@@ -271,6 +271,13 @@ class ServiceMetrics:
                 ("model",),
             )
         )
+        self.migrated = self.registry.register(
+            Counter(
+                f"{prefix}_migrations_total",
+                "Streams live-migrated off a draining worker mid-decode",
+                ("model",),
+            )
+        )
 
     def inflight_guard(
         self, model: str, endpoint: str, request_type: str,
@@ -331,6 +338,9 @@ class InflightGuard:
         self._first_token_at: Optional[float] = None
         self._last_chunk_at: Optional[float] = None
         self._resumed = False
+        # per-kind watermarks for sync_resumes (resume vs live migration)
+        self._seen_resumes = 0
+        self._seen_migrations = 0
 
     def __enter__(self) -> "InflightGuard":
         self._start = time.perf_counter()
@@ -350,14 +360,34 @@ class InflightGuard:
     def sync_resumes(self, journal, seen: int) -> int:
         """Fold any NEW recoveries recorded on the request's resume journal
         (``EngineContext.journal``) into this guard: one :meth:`mark_resume`
-        per resume since ``seen``. Returns the new watermark; None journal
-        (non-resumable request) is a no-op. Shared by the streaming and
-        unary HTTP loops so the two can't drift."""
-        if journal is None or journal.resumes <= seen:
+        per resume — and one :meth:`mark_migration` per live migration —
+        since ``seen``. Both re-home kinds attribute the next first-chunk
+        wait to ITL, never TTFT. Returns the new watermark (resumes +
+        migrations); None journal (non-resumable request) is a no-op.
+        Shared by the streaming and unary HTTP loops so the two can't
+        drift."""
+        if journal is None:
             return seen
-        for _ in range(journal.resumes - seen):
+        resumes = journal.resumes
+        migrations = getattr(journal, "migrations", 0)
+        # the guard is per-request: each kind keeps its own internal
+        # watermark, so interleaved resume/migration sequences attribute
+        # every event to the right counter
+        while self._seen_resumes < resumes:
+            self._seen_resumes += 1
             self.mark_resume()
-        return journal.resumes
+        while self._seen_migrations < migrations:
+            self._seen_migrations += 1
+            self.mark_migration()
+        return resumes + migrations
+
+    def mark_migration(self) -> None:
+        """The upstream stream was live-migrated off a draining worker
+        (``EngineContext.journal`` grew its migration count). Same ITL
+        attribution as :meth:`mark_resume` — the gap is a planned re-home,
+        not an admission wait — with its own frontend counter."""
+        self._resumed = True
+        self._m.migrated.inc(1, model=self.model)
 
     def mark_resume(self) -> None:
         """The upstream stream was resumed on another worker

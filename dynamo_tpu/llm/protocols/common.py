@@ -91,6 +91,13 @@ class PreprocessedRequest:
     # rebuild sampling state (penalty counts over token_ids[prompt_len:])
     # from it; None (the wire default) is exactly the pre-resume request.
     resume: Optional[dict] = None
+    # live-migration attach marker (disagg/migration.py): the staged
+    # migration id a re-homed client presents to the target engine so
+    # admission adopts the pre-shipped KV (zero recomputed positions)
+    # instead of re-prefilling. None (the wire default) is exactly the
+    # pre-migration request; an unknown/expired id degrades to the resume
+    # recompute path.
+    migrate: Optional[str] = None
 
     def to_dict(self) -> dict:
         from dataclasses import asdict
@@ -107,6 +114,10 @@ class PreprocessedRequest:
             annotations=list(d.get("annotations", [])),
             mdc_sum=d.get("mdc_sum"),
             resume=d.get("resume") if isinstance(d.get("resume"), dict) else None,
+            migrate=(
+                str(d["migrate"])
+                if isinstance(d.get("migrate"), (str, int)) else None
+            ),
         )
 
 

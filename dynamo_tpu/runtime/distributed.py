@@ -207,6 +207,12 @@ class DistributedRuntime:
         # which only knows about keys — can never undo an operator's
         # signal-initiated drain, and vice versa.
         self._drain_sources: set = set()
+        # live in-flight migration (disagg/migration.py): the coordinator
+        # a serving worker attaches so a drain migrates its streams to
+        # healthy siblings instead of holding the process hostage. None
+        # (DYN_TPU_MIGRATE=0, or no attach_migration call) = exact old
+        # drain semantics.
+        self._migrator = None
 
     @classmethod
     async def create(
@@ -339,6 +345,20 @@ class DistributedRuntime:
         )
         for ev in self._drain_listeners:
             ev.set()
+        # phased drain (docs/resilience.md §Live migration): with a
+        # migration coordinator attached, entering drain kicks off the
+        # migrate-inflight phase (admission is already stopped above);
+        # undraining before the deadline cancels it and un-freezes
+        if self._migrator is not None:
+            if effective:
+                self._migrator.notify_drain()
+            else:
+                self._migrator.cancel_drain()
+
+    def set_migrator(self, coordinator) -> None:
+        """Attach a live-migration coordinator (disagg/migration.py) —
+        drains then migrate in-flight streams instead of waiting them out."""
+        self._migrator = coordinator
 
     def namespace(self, name: str) -> "Namespace":
         return Namespace(self, name)
@@ -349,6 +369,8 @@ class DistributedRuntime:
     async def shutdown(self) -> None:
         for t in self._background:
             t.cancel()
+        if self._migrator is not None:
+            await self._migrator.stop()
         if self._health_monitor is not None:
             await self._health_monitor.stop()
         if self._primary_lease is not None:
@@ -706,7 +728,11 @@ class EndpointClient(AsyncEngine):
         # observability: how often the resilience layer actually worked
         self.stats = {"failures": 0, "failovers": 0, "deadline_expired": 0,
                       "overloaded": 0, "probes": 0, "probe_failures": 0,
-                      "resumes": 0, "resume_failures": 0}
+                      "resumes": 0, "resume_failures": 0,
+                      # live migration (docs/resilience.md §Live migration):
+                      # directed re-homes onto a drain target's staged KV,
+                      # and drain directives that degraded to plain resume
+                      "migrations": 0, "migration_resumes": 0}
         self._instances: Dict[str, InstanceInfo] = {}
         # control-plane blackout tolerance (runtime/control_plane.py,
         # docs/resilience.md §Control-plane blackout): when the statestore
@@ -1439,6 +1465,11 @@ class EndpointClient(AsyncEngine):
             request.context.journal = journal
         delivered = False  # any item reached the caller, across attempts
         resume_deadline: Optional[Deadline] = None  # starts at first resume
+        # live migration (docs/resilience.md §Live migration): a draining
+        # worker ends a stream with an in-band migrating marker; the next
+        # admission is routed AT the named target (where the staged KV makes
+        # it recompute-free) before falling back to ordinary picks
+        directed: Optional[str] = None
         while True:
             if deadline.expired:
                 self.stats["deadline_expired"] += 1
@@ -1454,15 +1485,28 @@ class EndpointClient(AsyncEngine):
                     return
                 raise err from last_err
             try:
-                try:
-                    iid = self._pick(payload, exclude=frozenset(tried))
-                except NoHealthyInstances:
-                    if not tried:
-                        raise
-                    # every live instance failed once this request: widen
-                    # back to the full set for whatever budget remains
-                    tried.clear()
-                    iid = self._pick(payload)
+                iid = None
+                if directed is not None:
+                    # one directed attempt at the migration target; any
+                    # failure afterwards routes normally (the stale migrate
+                    # id is ignored by other engines — plain resume)
+                    if (
+                        directed in self._instances
+                        and directed not in tried
+                        and not self._is_unhealthy(directed)
+                    ):
+                        iid = directed
+                    directed = None
+                if iid is None:
+                    try:
+                        iid = self._pick(payload, exclude=frozenset(tried))
+                    except NoHealthyInstances:
+                        if not tried:
+                            raise
+                        # every live instance failed once this request: widen
+                        # back to the full set for whatever budget remains
+                        tried.clear()
+                        iid = self._pick(payload)
             except NoHealthyInstances as e:
                 if delivered:
                     self._note_resume_failed(journal)
@@ -1494,6 +1538,7 @@ class EndpointClient(AsyncEngine):
                     raise RetryableRpcError(
                         f"instance {iid} left the live set"
                     ) from None
+                directive: Optional[dict] = None
                 async for item in conn.generate(
                     self.endpoint.rpc_name,
                     payload,
@@ -1502,6 +1547,18 @@ class EndpointClient(AsyncEngine):
                     inter_item_timeout=policy.inter_item_timeout,
                     raise_transport=True,
                 ):
+                    if (
+                        not item.is_error
+                        and isinstance(item.data, dict)
+                        and "migrating" in item.data
+                    ):
+                        # in-band migration marker from a draining worker:
+                        # consumed HERE — never yielded, never journaled,
+                        # never counted as a first item. The stream ends
+                        # right after it; the directive is handled below.
+                        d = item.data["migrating"]
+                        directive = d if isinstance(d, dict) else {}
+                        continue
                     if not first_seen:
                         first_seen = True
                         if route is not None:
@@ -1518,7 +1575,79 @@ class EndpointClient(AsyncEngine):
                 if not first_seen:
                     self._breaker.record_success(iid)  # clean empty stream
                     resolved = True
-                return
+                if directive is None:
+                    return
+                # -- live migration re-home (never a torn stream) ---------
+                # The draining source ended the stream with an explicit
+                # directive. Re-admit: at the named target (staged KV ⇒
+                # zero recompute) or via the ordinary resume path. Neither
+                # consumes the failure-resume budget — nothing failed.
+                if journal is None or not journal.viable:
+                    self._note_resume_failed(journal)
+                    yield Annotated.from_error(
+                        "stream migrated by a draining worker but cannot "
+                        "be re-admitted (resume disabled or non-token "
+                        "stream)"
+                    )
+                    return
+                rebuilt = journal.resume_request()
+                expected = directive.get("emitted")
+                if rebuilt is None or (
+                    isinstance(expected, int)
+                    and expected != len(journal.emitted)
+                ):
+                    self._note_resume_failed(journal)
+                    yield Annotated.from_error(
+                        "stream migrated by a draining worker but the "
+                        "journal cannot rebuild it (budget spent or "
+                        "delivered tokens diverge from the checkpoint)"
+                    )
+                    return
+                journal.migrations += 1
+                payload = rebuilt
+                target = directive.get("instance")
+                mid = directive.get("mid")
+                if target and mid and not directive.get("resume"):
+                    payload = dict(rebuilt, migrate=str(mid))
+                    directed = str(target)
+                    # the source verified the target against the store
+                    # moments ago; our own watch may simply not have seen
+                    # it yet (fresh instance after a rolling restart) —
+                    # give discovery a bounded beat before falling back
+                    # to an undirected pick
+                    for _ in range(40):
+                        if directed in self._instances:
+                            break
+                        await asyncio.sleep(0.05)
+                    self.stats["migrations"] += 1
+                    if route is not None:
+                        route.set_attribute(
+                            "migrations", journal.migrations
+                        )
+                        route.add_event(
+                            "migrate", source=iid, target=str(target),
+                            emitted=len(journal.emitted),
+                        )
+                    logger.info(
+                        "request %s migrating from %s to %s "
+                        "(%d tokens of staged history)", request.id, iid,
+                        target, len(journal.emitted),
+                    )
+                else:
+                    self.stats["migration_resumes"] += 1
+                    if route is not None:
+                        route.add_event(
+                            "migrate_resume", source=iid,
+                            error=str(directive.get("error", "")),
+                        )
+                    logger.warning(
+                        "request %s cut over to resume by draining worker "
+                        "%s (%s)", request.id, iid,
+                        directive.get("error", "drain"),
+                    )
+                tried = {iid}
+                attempt = 0
+                continue
             except asyncio.CancelledError:
                 raise
             except DeadlineExceeded as e:
@@ -1929,6 +2058,18 @@ async def attach_kv_publishing(
                 r_ok, r_bad = resume_counters()
                 snap.setdefault("resume_total", r_ok)
                 snap.setdefault("resume_failed_total", r_bad)
+                # live-migration outcomes (disagg/migration.py): the SOURCE
+                # side's migrate-outs — process-global like the resume
+                # counters, imported lazily so non-migrating processes
+                # never load the module
+                import sys as _sys
+
+                mig = _sys.modules.get("dynamo_tpu.disagg.migration")
+                if mig is not None:
+                    m_ok, m_bad, m_blocks = mig.migration_counters()
+                    snap.setdefault("migrations_total", m_ok)
+                    snap.setdefault("migrations_failed_total", m_bad)
+                    snap.setdefault("migrate_kv_blocks_moved_total", m_blocks)
                 if server is not None and bind_admission:
                     # the co-hosted RPC server's counters belong to the
                     # publisher that OWNS it; a bind_admission=False

@@ -45,8 +45,9 @@ from typing import Dict, Iterator, List, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 PLANES = ("statestore", "bus", "rpc", "transfer")
-ACTIONS = ("refuse", "delay", "reset", "stall", "wedge", "cut", "blackout")
-POINTS = ("connect", "read", "write", "serve", "item")
+ACTIONS = ("refuse", "delay", "reset", "stall", "wedge", "cut", "blackout",
+           "migrate_stall")
+POINTS = ("connect", "read", "write", "serve", "item", "migrate")
 
 # the planes a bare "blackout" kills: the whole control plane at once
 # (discovery + events), leaving the RPC/transfer data planes alive — the
@@ -271,6 +272,17 @@ class FaultInjector:
             raise ConnectionRefusedError(f"injected refusal ({what})")
         if rule.action == "cut":
             raise StreamCut(f"injected mid-stream cut ({what})")
+        if rule.action == "migrate_stall":
+            # drain-migration chaos (docs/resilience.md §Live migration):
+            # the coordinator's per-stream transfer parks here until
+            # release_stalls — its migrate timeout then fires and the
+            # stream degrades to the resume path. Released stalls die as
+            # resets, like a transfer conn finally timing out.
+            release = self._stall_release
+            await release.wait()
+            raise ConnectionResetError(
+                f"injected migrate stall released ({what})"
+            )
         if rule.action == "blackout":
             # env-driven control-plane blackout drill: the first matching op
             # starts a timed outage of the rule's plane ("*" = both control
@@ -315,6 +327,18 @@ class FaultInjector:
         rule = self.decide(plane, addr, "item", index)
         if rule is not None:
             await self._apply(rule, f"item {plane} {addr} #{index}")
+
+    async def before_migrate(self, plane: str, addr: str) -> None:
+        """Per-migration gate (drain coordinator, once per stream shipped):
+        ``addr`` is the TARGET's transfer address, so a rule can fault
+        migrations toward one sibling while others succeed. Counted on the
+        serve-op counter (per plane+addr)."""
+        key = (plane, addr)
+        op = self._serve_ops.get(key, 0)
+        self._serve_ops[key] = op + 1
+        rule = self.decide(plane, addr, "migrate", op)
+        if rule is not None:
+            await self._apply(rule, f"migrate {plane} {addr}")
 
 
 class _ConnFaults:
@@ -498,6 +522,18 @@ async def serve_gate(plane: str, addr: str) -> None:
     inj = current()
     if inj is not None:
         await inj.before_serve(plane, addr)
+
+
+async def migrate_gate(plane: str, addr: str) -> None:
+    """Drain-migration gate (disagg/migration.py), consulted once per
+    stream before its pages ship to ``addr``. The ``migrate_stall`` action
+    parks the transfer until :meth:`FaultInjector.release_stalls` — the
+    coordinator's migrate timeout then degrades that stream to the resume
+    path, which is exactly the chaos scenario the fallback tests drive.
+    No injector ⇒ one None-check."""
+    inj = current()
+    if inj is not None:
+        await inj.before_migrate(plane, addr)
 
 
 async def item_gate(plane: str, addr: str, index: int) -> None:

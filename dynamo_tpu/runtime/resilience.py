@@ -398,8 +398,8 @@ class StreamJournal:
     continuations are distributionally correct but draw fresh RNG.
     """
 
-    __slots__ = ("prompt", "emitted", "resumes", "started", "viable",
-                 "finished", "_payload")
+    __slots__ = ("prompt", "emitted", "resumes", "migrations", "started",
+                 "viable", "finished", "_payload")
 
     def __init__(self, payload: dict, clock: Callable[[], float] = _monotonic):
         self._payload = payload
@@ -411,6 +411,11 @@ class StreamJournal:
         self.prompt: List[int] = list(toks) if self.viable else []
         self.emitted: List[int] = []
         self.resumes = 0
+        # live migrations followed (docs/resilience.md §Live migration):
+        # planned re-homes onto a drain target's staged KV. Counted apart
+        # from `resumes` — they consume no resume budget (nothing failed)
+        # — but the edge attributes their gap to ITL exactly like a resume.
+        self.migrations = 0
         self.finished = False
         self.started = clock()
 

@@ -67,6 +67,28 @@ def _reset_control_plane_state():
 
 
 @pytest.fixture(autouse=True)
+def _no_leaked_migrations():
+    """Fail any test that leaves a drain-migration coordinator task running
+    past teardown: a leaked drain task keeps freezing/shipping streams in
+    the background of every later test (imported lazily — the HealthMonitor
+    guard pattern). Also zero the process-global migration counters so one
+    test's drains can't bleed into another's gauge assertions."""
+    yield
+    import sys
+
+    mig = sys.modules.get("dynamo_tpu.disagg.migration")
+    if mig is None:
+        return
+    leaked = mig.live_coordinators()
+    assert not leaked, (
+        f"{len(leaked)} MigrationCoordinator drain task(s) leaked past test "
+        f"teardown — stop() the coordinator (or shutdown() its "
+        f"DistributedRuntime)"
+    )
+    mig.reset_migration_counters()
+
+
+@pytest.fixture(autouse=True)
 def _no_leaked_health_monitors():
     """Fail any test that leaves a HealthMonitor check task running past
     teardown: a leaked monitor keeps reaping/draining state in the

@@ -1260,3 +1260,114 @@ class TestPlannerComponentE2E:
                 await ss.stop()
 
         run(go())
+
+class TestDrainLiveE2E:
+    def test_drain_decision_drives_live_worker_and_operator(self, run):
+        """Carried ROADMAP remainder (ISSUE 13 satellite): planner
+        decisions against LIVE machinery end to end. A DRAIN decision
+        written through the DrainActuator reaches a REAL served worker
+        over its statestore drain watch (the worker actually enters drain
+        mode — with migration attached this is what triggers stream
+        migration); a SCALE decision patched through the GraphActuator is
+        converged by the operator's LIVE ``run()`` watch loop (not a
+        manual ``reconcile_all`` call); and the UNDRAIN decision on
+        recovery undrains the worker."""
+        from dynamo_tpu.operator import FakeKube, GraphController
+        from dynamo_tpu.operator.controller import (
+            APPS_API,
+            GRAPH_PLURAL,
+            GROUP_API,
+        )
+        from dynamo_tpu.runtime.annotated import Annotated
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+        from dynamo_tpu.runtime.engine import AsyncEngine, Context
+        from dynamo_tpu.runtime.statestore import StateStoreServer
+
+        class _Echo(AsyncEngine):
+            async def generate(self, request: Context):
+                yield Annotated.from_data({"ok": True})
+
+        async def _until(pred, timeout=8.0, what=""):
+            deadline = asyncio.get_running_loop().time() + timeout
+            while asyncio.get_running_loop().time() < deadline:
+                if pred():
+                    return
+                await asyncio.sleep(0.05)
+            raise AssertionError(f"timed out waiting for {what}")
+
+        async def go():
+            ss = StateStoreServer(port=0)
+            await ss.start()
+            rt = await DistributedRuntime.create(ss.url, "127.0.0.1:1")
+            # DrainActuator's default key layout is ns/components/worker/
+            # endpoints/generate/drain/ — serve exactly that endpoint
+            ep = rt.namespace("dplan").component("worker").endpoint("generate")
+            await ep.serve(_Echo())
+            assert not rt.draining
+
+            act = DrainActuator(rt.store, "dplan")
+            await act.apply(Decision(
+                kind=DRAIN, model="m", worker_id=rt.worker_id, ts=0.0,
+            ))
+            # the worker's own drain watch applies the key: LIVE convergence
+            await _until(lambda: rt.draining, what="worker to drain")
+
+            # operator leg: the controller's live watch loop (FakeKube
+            # watches feed it) converges a planner-patched CR on its own
+            kube = FakeKube()
+            await kube.create(GROUP_API, GRAPH_PLURAL, "default", {
+                "metadata": {"name": "g"},
+                "spec": {
+                    "frontend": {"replicas": 1},
+                    "workers": {"decode": {"replicas": 2}},
+                },
+            })
+            ctrl = GraphController(kube, "default", resync_interval=30.0)
+            ctrl_task = asyncio.create_task(ctrl.run())
+            try:
+                gact = GraphActuator(kube, "g", "default")
+                # let the controller create the initial children first
+                async def _dep_replicas():
+                    dep = await kube.get(
+                        APPS_API, "deployments", "default", "g-decode"
+                    )
+                    return dep["spec"]["replicas"] if dep else None
+
+                got = []
+
+                async def _poll(want):
+                    deadline = asyncio.get_running_loop().time() + 8.0
+                    while asyncio.get_running_loop().time() < deadline:
+                        r = await _dep_replicas()
+                        if r == want:
+                            return True
+                        await asyncio.sleep(0.05)
+                    got.append(await _dep_replicas())
+                    return False
+
+                assert await _poll(2), f"initial converge failed: {got}"
+                await gact.apply(Decision(
+                    kind=SCALE, model="m", pool="decode", ts=0.0,
+                    from_replicas=2, to_replicas=5,
+                ))
+                assert await _poll(5), (
+                    f"live operator never converged the scale: {got}"
+                )
+            finally:
+                ctrl.stop()
+                try:
+                    await asyncio.wait_for(ctrl_task, 5)
+                except (asyncio.TimeoutError, asyncio.CancelledError):
+                    ctrl_task.cancel()
+
+            # recovery: the UNDRAIN decision deletes the key; the worker's
+            # watch undrains it live
+            await act.apply(Decision(
+                kind=UNDRAIN, model="m", worker_id=rt.worker_id, ts=0.0,
+            ))
+            await _until(lambda: not rt.draining, what="worker to undrain")
+
+            await rt.shutdown()
+            await ss.stop()
+
+        run(go())
