@@ -1064,6 +1064,126 @@ def bench_resilience() -> dict:
     }
 
 
+def bench_integrity() -> dict:
+    """The integrity plane's tax and its catch (docs/resilience.md §Silent
+    corruption; tiny REAL engine on the host platform). Leg 1/2: identical
+    decode load with DYN_TPU_KV_INTEGRITY on vs off — the on/off tok/s
+    ratio IS the seal-checksum + watchdog cost. Leg 3: the corruption
+    drill — every host-tier spill bit-flipped; reports trips counted and
+    asserts the replayed prompts still produced byte-identical tokens
+    (the recompute path, never the rotten bytes). BENCH_INTEGRITY=0
+    skips."""
+    import asyncio
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+    from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+    from dynamo_tpu.runtime import faults as faults_mod
+    from dynamo_tpu.runtime import integrity as integrity_mod
+    from dynamo_tpu.runtime.engine import Context
+
+    n_requests = int(os.environ.get("BENCH_INTEGRITY_REQUESTS", "8"))
+    gen_tokens = int(os.environ.get("BENCH_INTEGRITY_TOKENS", "96"))
+    prompt_len = int(os.environ.get("BENCH_INTEGRITY_PROMPT", "64"))
+    # restore the CALLER's knob afterwards: a user benching with
+    # DYN_TPU_KV_INTEGRITY=0 must not have later sections silently pay the
+    # checksum tax because this one popped the var
+    prior_knob = os.environ.get("DYN_TPU_KV_INTEGRITY")
+
+    cfg = dataclasses.replace(LLAMA_PRESETS["tiny"], dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompts = [
+        [(7 * i + 3 + j) % 101 for j in range(prompt_len)]
+        for i in range(n_requests)
+    ]
+
+    async def collect(eng, toks):
+        out = []
+        async for item in eng.generate(Context({
+            "token_ids": list(toks),
+            "stop_conditions": {"max_tokens": gen_tokens,
+                                "ignore_eos": True},
+            "sampling_options": {"temperature": 0.0},
+        })):
+            if item.is_error:
+                raise RuntimeError(item.error_message())
+            out.extend((item.data or {}).get("token_ids", []))
+        return out
+
+    def leg(enabled: bool, host_blocks: int = 0) -> tuple:
+        os.environ["DYN_TPU_KV_INTEGRITY"] = "1" if enabled else "0"
+        integrity_mod.reset_for_tests()
+        eng = JaxServingEngine(cfg, params, EngineConfig(
+            max_slots=4, kv_block_size=8,
+            max_model_len=prompt_len + gen_tokens + 16,
+            host_cache_blocks=host_blocks,
+        ))
+
+        async def run_all():
+            # warm the compiles out of the timed window
+            await collect(eng, prompts[0])
+            t0 = time.perf_counter()
+            outs = await asyncio.gather(
+                *[collect(eng, p) for p in prompts]
+            )
+            return outs, time.perf_counter() - t0
+
+        outs, wall = asyncio.run(run_all())
+        eng.close()
+        toks = sum(len(o) for o in outs)
+        return outs, round(toks / wall, 1), round(wall, 3)
+
+    try:
+        _, tps_on, wall_on = leg(True)
+        _, tps_off, wall_off = leg(False)
+
+        # corruption drill: host-tier spills rot; replays must recompute
+        os.environ["DYN_TPU_KV_INTEGRITY"] = "1"
+        integrity_mod.reset_for_tests()
+        inj = faults_mod.FaultInjector([faults_mod.FaultRule(
+            plane="engine", point="pages", action="corrupt",
+        )])
+        eng = JaxServingEngine(cfg, params, EngineConfig(
+            max_slots=4, kv_block_size=8,
+            max_model_len=prompt_len + gen_tokens + 16,
+            host_cache_blocks=256,
+        ))
+
+        async def drill():
+            with faults_mod.active(inj):
+                first = [await collect(eng, p) for p in prompts[:4]]
+                # evict the first wave into the (corrupted) host tier
+                for p in prompts[4:]:
+                    await collect(eng, p)
+                replay = [await collect(eng, p) for p in prompts[:4]]
+            return first, replay
+
+        first, replay = asyncio.run(drill())
+        eng.close()
+        wrong = sum(1 for a, b in zip(first, replay) if a != b)
+        trips = integrity_mod.counters()["kv_integrity_failures_total"]
+        return {
+            "decode_tps_integrity_on": tps_on,
+            "decode_tps_integrity_off": tps_off,
+            "overhead_ratio": round(tps_off / max(tps_on, 1e-9), 3),
+            "wall_on_s": wall_on, "wall_off_s": wall_off,
+            "corrupt_drill": {
+                "replayed_streams": len(replay),
+                "wrong_streams": wrong,  # MUST be 0
+                "integrity_trips": trips,
+            },
+        }
+    finally:
+        if prior_knob is None:
+            os.environ.pop("DYN_TPU_KV_INTEGRITY", None)
+        else:
+            os.environ["DYN_TPU_KV_INTEGRITY"] = prior_knob
+        integrity_mod.reset_for_tests()
+
+
 def bench_migration() -> dict:
     """Live in-flight migration vs resume-only drain (docs/resilience.md
     §Live migration; tiny REAL engines on the host platform — the point is
@@ -1656,6 +1776,11 @@ def main() -> None:
             out["migration"] = bench_migration()
         except Exception as e:
             out["migration"] = {"error": str(e)[:200]}
+    if os.environ.get("BENCH_INTEGRITY", "1") == "1":
+        try:
+            out["integrity"] = bench_integrity()
+        except Exception as e:
+            out["integrity"] = {"error": str(e)[:200]}
     # LAST: pays minutes of first-boot remote compilation on the tunneled
     # runtime — must not eat the other sections' budget if it times out
     if os.environ.get("BENCH_MODEL_8B", "1") == "1":

@@ -151,6 +151,31 @@ def build_parser() -> argparse.ArgumentParser:
     wh = wverbs.add_parser("health", help="per-instance health state")
     wh.add_argument("endpoint", help="dyn://ns.comp.ep")
     wh.add_argument("--json", action="store_true", dest="as_json")
+    for verb in ("quarantine", "unquarantine"):
+        wq = wverbs.add_parser(
+            verb,
+            help=(
+                "latch/clear the integrity quarantine for a worker "
+                "(docs/resilience.md §Silent corruption): quarantined "
+                "workers stop admitting, are excluded by routers, and "
+                "drain WITHOUT migrating their untrusted KV pages; "
+                "unquarantine clears self-tripped latches too and resets "
+                "the trip window"
+            ),
+        )
+        wq.add_argument("endpoint", help="dyn://ns.comp.ep")
+        wq.add_argument("worker_id", help="worker id (from `worker list`) or 'all'")
+        if verb == "quarantine":
+            wq.add_argument(
+                "--wait", action="store_true",
+                help="block until every matching instance reports health "
+                     "'quarantined'; exit 2 on --timeout",
+            )
+            wq.add_argument(
+                "--timeout", type=float, default=30.0,
+                help="--wait deadline in seconds (default 30)",
+            )
+            wq.add_argument("--json", action="store_true", dest="as_json")
     for verb in ("drain", "undrain"):
         wp = wverbs.add_parser(verb)
         wp.add_argument("endpoint", help="dyn://ns.comp.ep")
@@ -230,6 +255,78 @@ async def _wait_drained(store, base: str, args) -> int:
                     + ", ".join(
                         f'{r["instance_id"]}(slots={r["active_slots"]},'
                         f'q={r["queue_depth"]})' for r in busy
+                    )
+                )
+            return 2
+        await asyncio.sleep(min(0.25, args.timeout / 10))
+
+
+async def _wait_quarantined(store, base: str, args) -> int:
+    """``worker quarantine --wait``: poll the worker's instance keys until
+    every matching instance self-reports health ``quarantined`` (the store
+    key was applied, the health monitor latched, the heartbeat published)
+    or the worker is gone. Exit 0 when latched, 2 on the --timeout
+    deadline — cron/CI-scriptable like ``worker drain --wait``; ``--json``
+    prints ONE machine-parseable envelope on both paths."""
+    import asyncio
+    import time as _time
+
+    from dynamo_tpu.runtime.distributed import InstanceInfo
+
+    t0 = _time.monotonic()
+    rows: list = []
+    while True:
+        entries = await store.get_prefix(f"{base}/instances/")
+        rows = []
+        for k in sorted(entries):
+            try:
+                info = InstanceInfo.from_json(entries[k])
+            except (ValueError, KeyError):
+                continue
+            if args.worker_id != "all" and info.worker_id != args.worker_id:
+                continue
+            rows.append({
+                "worker_id": info.worker_id,
+                "instance_id": info.instance_id,
+                "health": info.health,
+                "quarantined": info.health == "quarantined",
+            })
+        waited = _time.monotonic() - t0
+        # NO vacuous truth here (unlike drain --wait, where gone implies
+        # drained): zero matching instances means the id is wrong or the
+        # worker is invisible — reporting "quarantined" would tell the
+        # operator a corrupt worker is fenced while it keeps serving
+        if rows and all(r["quarantined"] for r in rows):
+            if args.as_json:
+                print(json.dumps({
+                    "worker_id": args.worker_id, "quarantined": True,
+                    "waited_s": round(waited, 2), "instances": rows,
+                }))
+            else:
+                print(
+                    f"{args.worker_id} quarantined in {waited:.1f}s "
+                    f"({len(rows)} instance(s))"
+                )
+            return 0
+        if waited >= args.timeout:
+            if args.as_json:
+                print(json.dumps({
+                    "worker_id": args.worker_id, "quarantined": False,
+                    "waited_s": round(waited, 2), "instances": rows,
+                }))
+            elif not rows:
+                print(
+                    f"timeout: no live instances match {args.worker_id!r} "
+                    f"after {waited:.1f}s (typo'd worker id? the key was "
+                    f"written and will latch if the worker appears)"
+                )
+            else:
+                busy = [r for r in rows if not r["quarantined"]]
+                print(
+                    f"timeout: {len(busy)} instance(s) of {args.worker_id} "
+                    f"not quarantined after {waited:.1f}s: "
+                    + ", ".join(
+                        f'{r["instance_id"]}({r["health"]})' for r in busy
                     )
                 )
             return 2
@@ -352,6 +449,35 @@ async def amain(argv: list) -> int:
                     )
                 if not rows:
                     print(f"(no live instances for {args.endpoint})")
+                return 0
+            if args.verb in ("quarantine", "unquarantine"):
+                qkey = f"{base}/quarantine/{args.worker_id}"
+                if args.verb == "quarantine":
+                    # no lease: the quarantine order outlives this CLI
+                    # process; the worker's quarantine watcher latches it
+                    # within one watch event and the health plane reports
+                    # "quarantined" on the next check tick
+                    await store.put(qkey, b"1")
+                    if getattr(args, "wait", False):
+                        return await _wait_quarantined(store, base, args)
+                    if getattr(args, "as_json", False):
+                        print(json.dumps({
+                            "worker_id": args.worker_id, "quarantined": True,
+                            "waited": False,
+                        }))
+                    else:
+                        print(
+                            f"quarantining {args.worker_id} on "
+                            f"{args.endpoint} (drain will resume, not "
+                            f"migrate — its pages are untrusted)"
+                        )
+                else:
+                    ok = await store.delete(qkey)
+                    print(
+                        f"unquarantined {args.worker_id} (trip window "
+                        f"reset)" if ok
+                        else f"{args.worker_id} was not quarantined"
+                    )
                 return 0
             key = f"{base}/drain/{args.worker_id}"
             if args.verb == "drain":
@@ -601,6 +727,20 @@ async def _telemetry_cmd(args, store) -> int:
             if e.get("migrations_total") or e.get("migrations_failed_total")
             else ""
         )
+        # quarantine column only when the integrity plane has anything to
+        # say (no noise on clean fleets, the spec=/migr= pattern); named
+        # quarantined workers print below the table
+        # trips = checksum failures + watchdog trips: both count toward
+        # the quarantine window, so both belong in the operator's number
+        quar_trips = (
+            e.get("kv_integrity_failures_total", 0)
+            + e.get("watchdog_trips_total", 0)
+        )
+        quar = (
+            f' quar={e.get("workers_quarantined", 0)}/{quar_trips}trips'
+            if e.get("workers_quarantined") or quar_trips
+            else ""
+        )
         print(
             f'{model:20s} workers={e.get("workers", 0)} '
             f'(unhealthy={e.get("workers_unhealthy", 0)}) '
@@ -609,8 +749,11 @@ async def _telemetry_cmd(args, store) -> int:
             f'kv_free {e.get("kv_blocks_free", 0)}/{e.get("kv_blocks_total", 0)} '
             f'headroom={e.get("headroom_frac", 0.0):.2f} '
             f'decode={e.get("decode_tokens_per_s", 0.0):.0f} tok/s'
-            f'{spec}{migr}'
+            f'{spec}{migr}{quar}'
         )
+        for wid in e.get("quarantined_worker_ids") or []:
+            print(f'  QUARANTINED: {wid} (model {model}) — unquarantine '
+                  f'after hardware repair/replacement')
     worst = roll.get("worst_worker")
     if worst:
         print(f'worst worker: {worst.get("worker_id")} '

@@ -79,11 +79,19 @@ GAUGES = [
     # control-plane blackout tolerance (docs/resilience.md): events this
     # worker dropped from its outage buffers while the bus was down
     ("bus_dropped_events", "Events dropped from control-plane outage buffers (cumulative)"),
+    # silent-corruption defense (docs/resilience.md §Silent corruption):
+    # self-attributable KV checksum failures and output-watchdog trips —
+    # the quarantine plane's raw signal
+    ("kv_integrity_failures_total", "KV blocks that failed content checksums, attributable to this worker (cumulative)"),
+    ("watchdog_trips_total", "Lanes ended by the output watchdog for non-finite/exploding logits (cumulative)"),
 ]
 
 # health_state is a string on the wire; Prometheus wants a number. Unknown
 # states map to the unhealthy value so a future state is never read as fine.
-HEALTH_STATE_VALUES = {"healthy": 0, "degraded": 1, "unhealthy": 2}
+# quarantined (integrity plane) is graver than unhealthy: outputs untrusted.
+HEALTH_STATE_VALUES = {
+    "healthy": 0, "degraded": 1, "unhealthy": 2, "quarantined": 3,
+}
 
 # control_plane_state likewise ("" from pre-blackout workers = connected;
 # anything unknown renders as disconnected)
@@ -149,7 +157,7 @@ class MetricsAggregator:
         full = f"{self.prefix}_health_state"
         lines.append(
             f"# HELP {full} Worker health state "
-            f"(0=healthy, 1=degraded, 2=unhealthy)"
+            f"(0=healthy, 1=degraded, 2=unhealthy, 3=quarantined)"
         )
         lines.append(f"# TYPE {full} gauge")
         for worker_id, m in sorted(live.items()):

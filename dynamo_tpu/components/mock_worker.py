@@ -107,6 +107,9 @@ class MockWorkerStats:
         migrate_kv_blocks_moved: int = 0,
         control_plane_state: str = "connected",
         bus_dropped_events: int = 0,
+        integrity_failures: int = 0,
+        watchdog_trips: int = 0,
+        health_state: str = "healthy",
     ):
         from dynamo_tpu.runtime.tracing import PHASE_BUCKETS
 
@@ -154,6 +157,19 @@ class MockWorkerStats:
         # gauges can be exercised without killing a statestore
         self.control_plane_state = control_plane_state
         self.bus_dropped_events = max(int(bus_dropped_events), 0)
+        # silent-corruption drill (docs/resilience.md §Silent corruption):
+        # report integrity trip counters and/or a quarantined health state
+        # so the dynamo_*_kv_integrity_* gauges, the rollup's quarantine
+        # counts, and the llmctl quar= column render without corrupting a
+        # real worker
+        self.integrity_failures = max(int(integrity_failures), 0)
+        self.watchdog_trips = max(int(watchdog_trips), 0)
+        self.health_state = (
+            health_state
+            if health_state in ("healthy", "degraded", "unhealthy",
+                                "quarantined")
+            else "healthy"
+        )
         # multi-tenant QoS drill (docs/qos.md): tenant → per-tick request
         # share. Each tick splits its requests across tenants by share and
         # grows per-tenant counters + occupancy splits, so aggregator /
@@ -299,9 +315,10 @@ class MockWorkerStats:
             rpc_queue_depth=self.active + waiting,
             shed_requests=0,
             draining=0,
-            # health plane columns (deterministically healthy: the mock
-            # exists so dashboards render the fields, not to flap)
-            health_state="healthy",
+            # health plane columns (deterministic: the mock exists so
+            # dashboards render the fields, not to flap; --health-state
+            # quarantined drills the integrity plane's rendering)
+            health_state=self.health_state,
             stalls_total=0,
             reaped_requests_total=0,
             # tracing + telemetry planes (PR5/PR6)
@@ -325,6 +342,8 @@ class MockWorkerStats:
             migrations_total=self.migrations_total,
             migrations_failed_total=self.migrations_failed,
             migrate_kv_blocks_moved_total=self.migrate_kv_blocks_moved,
+            kv_integrity_failures_total=self.integrity_failures,
+            watchdog_trips_total=self.watchdog_trips,
             control_plane_state=self.control_plane_state,
             bus_dropped_events=self.bus_dropped_events,
             uptime_s=round(time.monotonic() - self.started, 3),
@@ -387,6 +406,9 @@ async def run_mock_worker(
     migrations_total: int = 0,
     migrations_failed: int = 0,
     control_plane_state: str = "connected",
+    integrity_failures: int = 0,
+    watchdog_trips: int = 0,
+    health_state: str = "healthy",
 ) -> None:
     from dynamo_tpu.runtime.distributed import KV_METRICS_SUBJECT
 
@@ -401,6 +423,9 @@ async def run_mock_worker(
         migrations_failed=migrations_failed,
         migrate_kv_blocks_moved=migrations_total * 8,
         control_plane_state=control_plane_state,
+        integrity_failures=integrity_failures,
+        watchdog_trips=watchdog_trips,
+        health_state=health_state,
     )
     tick_no = 0
     while True:
@@ -462,6 +487,18 @@ def main() -> None:
                         "status migr= column without draining workers)")
     p.add_argument("--migrations-failed", type=int, default=0,
                    help="report N migrations that degraded to resume")
+    p.add_argument("--integrity-failures", type=int, default=0,
+                   help="report N KV integrity checksum failures (drills "
+                        "the dynamo_*_kv_integrity_* gauges and the llmctl "
+                        "quar= column without corrupting a worker)")
+    p.add_argument("--watchdog-trips", type=int, default=0,
+                   help="report N output-watchdog lane trips")
+    p.add_argument("--health-state", default="healthy",
+                   choices=("healthy", "degraded", "unhealthy",
+                            "quarantined"),
+                   help="report this health state (quarantined drills the "
+                        "rollup's quarantine counts + planner drain "
+                        "decisions TPU-lessly)")
     p.add_argument("--control-plane-state", default="connected",
                    choices=("connected", "stale", "disconnected"),
                    help="report this control-plane view (drills `llmctl "
@@ -494,6 +531,9 @@ def main() -> None:
             migrations_total=args.migrations_total,
             migrations_failed=args.migrations_failed,
             control_plane_state=args.control_plane_state,
+            integrity_failures=args.integrity_failures,
+            watchdog_trips=args.watchdog_trips,
+            health_state=args.health_state,
         )
 
     asyncio.run(run())

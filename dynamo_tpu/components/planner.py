@@ -557,6 +557,24 @@ class Planner:
                         reason=f"unhealthy for {now - since:.0f}s",
                         urgency="health",
                     ))
+            # quarantined workers (integrity plane, docs/resilience.md
+            # §Silent corruption) drain IMMEDIATELY — no drain_after
+            # patience: the worker is producing corrupt bytes, not merely
+            # lagging. Their drain never migrates (the worker's own
+            # coordinator sees the quarantine latch and degrades to resume
+            # directives), and the undrain gate below can never fire for
+            # them: recovery requires state EXACTLY "healthy", which a
+            # quarantined worker never reports until an operator clears it.
+            for wid in entry.get("quarantined_worker_ids") or []:
+                unhealthy_now.add(wid)
+                self._healthy_since.pop(wid, None)
+                self._unhealthy_since.setdefault(wid, now)
+                if wid not in self._drained:
+                    out.append(Decision(
+                        kind=DRAIN, model=model, worker_id=wid, ts=now,
+                        reason="quarantined by the integrity plane",
+                        urgency="health",
+                    ))
 
         # recovery: only workers THIS planner drained get undrained (an
         # operator's manual drain through the same keys is not ours to undo),

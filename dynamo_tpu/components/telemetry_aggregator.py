@@ -78,6 +78,11 @@ CLUSTER_GAUGES = [
     # the fleet's cumulative outage-buffer drops
     ("control_plane_impaired", "Workers reporting a stale/disconnected control plane"),
     ("bus_dropped_events", "Events dropped from control-plane outage buffers (fleet sum)"),
+    # silent-corruption defense (docs/resilience.md §Silent corruption):
+    # fleet integrity trip counters + workers currently quarantined
+    ("kv_integrity_failures_total", "KV blocks that failed content checksums (fleet sum)"),
+    ("watchdog_trips_total", "Lanes ended by the output watchdog (fleet sum)"),
+    ("workers_quarantined", "Workers quarantined by the integrity plane"),
     ("worst_worker_load", "Highest per-worker load score"),
     ("median_worker_load", "Median per-worker load score"),
 ]
@@ -185,7 +190,8 @@ class ClusterTelemetry:
         # availability: one 0/1 sample per heartbeat per worker, pooled into
         # the model's gauge series — the window average IS the healthy share
         available = 1.0 if (
-            getattr(metrics, "health_state", "healthy") != "unhealthy"
+            getattr(metrics, "health_state", "healthy")
+            not in ("unhealthy", "quarantined")
             and not getattr(metrics, "draining", 0)
         ) else 0.0
         self.store.series("worker_available", model=model).set(available, now)
@@ -361,6 +367,10 @@ class ClusterTelemetry:
                 "resume_total": 0, "resume_failed_total": 0,
                 "migrations_total": 0, "migrations_failed_total": 0,
                 "migrate_kv_blocks_moved_total": 0,
+                "kv_integrity_failures_total": 0,
+                "watchdog_trips_total": 0,
+                "workers_quarantined": 0,
+                "quarantined_worker_ids": [],
                 "control_plane_impaired": 0,
                 "bus_dropped_events": 0,
                 "control_plane": {
@@ -380,6 +390,14 @@ class ClusterTelemetry:
                 # outage must not balloon the rollup payload
                 if len(entry["unhealthy_worker_ids"]) < 16:
                     entry["unhealthy_worker_ids"].append(wid)
+            # quarantine (docs/resilience.md §Silent corruption): counted
+            # and named separately — the planner drains these too, but a
+            # quarantined worker must never auto-undrain (recovery requires
+            # state EXACTLY healthy, which quarantine never reports)
+            if getattr(m, "health_state", "healthy") == "quarantined":
+                entry["workers_quarantined"] += 1
+                if len(entry["quarantined_worker_ids"]) < 16:
+                    entry["quarantined_worker_ids"].append(wid)
             slots_total = int(m.request_total_slots or 0)
             slots_free = max(
                 slots_total - int(m.request_active_slots or 0), 0
@@ -421,6 +439,14 @@ class ClusterTelemetry:
             )
             entry["migrate_kv_blocks_moved_total"] += int(
                 getattr(m, "migrate_kv_blocks_moved_total", 0) or 0
+            )
+            # integrity plane: cumulative trip counters (same cumulative-
+            # sum discipline as the resume/migration counters)
+            entry["kv_integrity_failures_total"] += int(
+                getattr(m, "kv_integrity_failures_total", 0) or 0
+            )
+            entry["watchdog_trips_total"] += int(
+                getattr(m, "watchdog_trips_total", 0) or 0
             )
             # control-plane view per worker: count by state, name the
             # impaired ones (bounded like unhealthy_worker_ids) so `llmctl

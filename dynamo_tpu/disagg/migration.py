@@ -52,6 +52,7 @@ from typing import List, Optional
 # the engine-thread trampoline is the transfer plane's (one implementation
 # to fix when the post/loop semantics evolve)
 from dynamo_tpu.disagg.transfer import _engine_call
+from dynamo_tpu.runtime import integrity
 
 logger = logging.getLogger(__name__)
 
@@ -274,7 +275,7 @@ class MigrationCoordinator:
                 continue
             if info.worker_id == rt.worker_id:
                 continue
-            if info.draining or info.health == "unhealthy":
+            if info.draining or info.health in ("unhealthy", "quarantined"):
                 continue
             taddr = by_worker.get(info.worker_id)
             if not taddr or taddr == self.address:
@@ -305,7 +306,16 @@ class MigrationCoordinator:
                     self.engine, self.engine.live_request_count
                 ):
                     break  # nothing left in flight
-                targets = await self._eligible_targets()
+                # a QUARANTINED worker's pages are untrusted by definition
+                # (docs/resilience.md §Silent corruption): its drain must
+                # NOT replicate them into healthy siblings' caches. Zero
+                # targets ⇒ every stream gets a resume directive — exactly
+                # the store-outage degradation path, clients recompute from
+                # their journals with bytes a healthy worker produces.
+                if integrity.quarantined():
+                    targets = []
+                else:
+                    targets = await self._eligible_targets()
                 for cp in cps:
                     rid = cp["request_id"]
                     if not targets:
@@ -397,6 +407,10 @@ class MigrationCoordinator:
                     "tenant": cp["tenant"],
                     "level": cp["level"],
                 }
+                if len(pages) > 4 and pages[4] is not None:
+                    # per-block content checksums ride the checkpoint: the
+                    # target verifies the page set BEFORE staging a byte
+                    meta["crcs"] = pages[4]
                 await self.client.migrate(
                     taddr, meta, pages[0], pages[1],
                     (pages[2], pages[3]) if pages[2] is not None else None,
@@ -413,10 +427,17 @@ class MigrationCoordinator:
             except asyncio.CancelledError:
                 raise
             except Exception as e:
-                # typed nack (MigrationRejected/KvDtypeMismatch), transport
-                # reset, timeout, engine export race: degrade THIS stream to
-                # the client resume path; the pages stay untouched on the
-                # target (the frame is atomic — a nack stages nothing)
+                # typed nack (MigrationRejected/KvDtypeMismatch/
+                # KvIntegrityError), transport reset, timeout, engine export
+                # race: degrade THIS stream to the client resume path; the
+                # pages stay untouched on the target (the frame is atomic —
+                # a nack stages nothing)
+                if isinstance(e, integrity.KvIntegrityError):
+                    # the target rejected OUR pages as corrupt: count the
+                    # trip against this worker — enough of these within the
+                    # window and the quarantine latch flips, after which
+                    # this drain stops shipping pages entirely
+                    integrity.note_trip("kv", where="migrate_nack")
                 logger.warning(
                     "migration of %s to %s failed (%s: %s); degrading to "
                     "resume", rid, wid, type(e).__name__, e,
@@ -472,8 +493,16 @@ async def attach_migration(
     else:
         await rt.store.put(key, address.encode(),
                            lease=await rt.primary_lease())
+    server.fault_addr = address  # corrupt-drill targeting by worker address
+    client = KvTransferClient()
+    # outbound migrate frames are labelled with the SOURCE's own address:
+    # the corrupt drill models a rotten sender, so its rule must match this
+    # worker regardless of which sibling it ships to
+    client.fault_addr = address
+    if hasattr(engine, "_fault_addr"):
+        engine._fault_addr = address  # host-tier/poison drills, same label
     coord = MigrationCoordinator(
-        rt, endpoint, engine, KvTransferClient(), address, policy=policy
+        rt, endpoint, engine, client, address, policy=policy
     )
     coord._owned_server = server if transfer_server is None else None
     rt.set_migrator(coord)

@@ -19,8 +19,10 @@ from typing import Dict, Optional
 import numpy as np
 
 from dynamo_tpu.engine_jax.allocator import KvDtypeMismatch, MigrationRejected
-from dynamo_tpu.runtime import tracing
+from dynamo_tpu.runtime import faults as _FAULTS
+from dynamo_tpu.runtime import integrity, tracing
 from dynamo_tpu.runtime.codec import TwoPartMessage, read_frame, write_frame
+from dynamo_tpu.runtime.integrity import KvIntegrityError
 
 logger = logging.getLogger(__name__)
 
@@ -29,13 +31,17 @@ class _NoDevicePeer(Exception):
     """Peer has no device plane: fall back to the host-staged path."""
 
 
-def _pack_pages(k, v, scales) -> tuple:
+def _pack_pages(k, v, scales, crcs=None) -> tuple:
     """Frame header fields + body for a page set that may carry int8 scale
     tables. Body layout: k | v | k_scale | v_scale (k and v are always the
     same dtype+shape, as are the two scale tables, so two byte lengths
     describe all four segments). Headers WITHOUT ``kv_dtype`` are exactly
     the pre-int8 wire form — old peers reading a native-pool frame see no
-    difference, and a new reader treats their frames as scale-less."""
+    difference, and a new reader treats their frames as scale-less.
+    ``crcs`` (per-block content checksums, docs/resilience.md §Silent
+    corruption) is the same kind of optional header extension: frames
+    without it — pre-integrity peers, DYN_TPU_KV_INTEGRITY=0 senders —
+    still parse everywhere; receivers simply cannot verify them."""
     k_raw, v_raw = _pack(k), _pack(v)
     header = {
         "dtype": k.dtype.name, "shape": list(k.shape), "k_bytes": len(k_raw),
@@ -49,6 +55,8 @@ def _pack_pages(k, v, scales) -> tuple:
         header["scale_shape"] = list(ks.shape)
         header["ks_bytes"] = len(ks_raw)
         body += ks_raw + vs_raw
+    if crcs is not None:
+        header["crcs"] = [int(c) for c in crcs]
     return header, body
 
 
@@ -67,6 +75,32 @@ def _unpack_pages(h: dict, body: bytes) -> tuple:
     vs = _unpack(body[off + ks_len : off + 2 * ks_len], h["scale_dtype"],
                  h["scale_shape"])
     return k, v, (ks, vs)
+
+
+def _sender_crcs(engine, ids, k, v, ks, vs):
+    """Per-block content checksums a sender ships next to its pages:
+    seal-registry values where the block is sealed (those catch storage
+    rot between seal and send), extract-time values otherwise (wire-scope
+    protection only). ``None`` with the integrity plane off — the header
+    then omits ``crcs`` entirely (pre-integrity wire form). MUST run on
+    the engine thread when ``engine`` has a crc registry."""
+    if not integrity.enabled():
+        return None
+    ids = list(ids)
+    regs = (
+        engine.block_crcs_of(ids)
+        if hasattr(engine, "block_crcs_of") else [-1] * len(ids)
+    )
+    out = []
+    for i, c in enumerate(regs):
+        if c is None or c < 0:
+            c = integrity.entry_checksum(
+                k[:, i], v[:, i],
+                ks[:, i] if ks is not None else None,
+                vs[:, i] if vs is not None else None,
+            )
+        out.append(int(c))
+    return out
 
 
 def _engine_call(engine, fn):
@@ -114,11 +148,17 @@ class KvTransferServer:
         self.port = port
         self.device_plane = device_plane
         self._server: Optional[asyncio.AbstractServer] = None
+        # label the corrupt-fault gate matches on (a drill targets ONE
+        # worker's outbound pages); attach points override it with the
+        # advertised transfer address
+        self.fault_addr = ""
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
+        if not self.fault_addr:
+            self.fault_addr = f"{self.host}:{self.port}"
         logger.info("kv transfer server on %s:%d", self.host, self.port)
 
     async def stop(self) -> None:
@@ -136,6 +176,30 @@ class KvTransferServer:
                 h = json.loads(frame.header)
                 if h.get("op") == "kv_blocks":
                     k, v, scales = _unpack_pages(h, frame.body)
+                    # content verification BEFORE the engine sees a byte
+                    # (docs/resilience.md §Silent corruption): a frame that
+                    # fails its travelling checksums nacks typed — the
+                    # SENDER learns its pages are rotten and counts the
+                    # trip; this side falls the request back to local
+                    # prefill, corrupt pages never land in the pool
+                    if h.get("crcs") is not None and integrity.enabled():
+                        try:
+                            integrity.verify_pages(
+                                k, v, scales, h["crcs"], where="kv_blocks",
+                            )
+                        except KvIntegrityError as e:
+                            integrity.note_remote_failure("kv_blocks")
+                            self.engine.fail_remote_prefill(
+                                h["request_id"], f"kv integrity: {e}"
+                            )
+                            await write_frame(writer, TwoPartMessage(
+                                json.dumps({
+                                    "id": h.get("id"), "ok": False,
+                                    "int8": True,
+                                    "code": "KvIntegrityError",
+                                    "error": str(e),
+                                }).encode(), b""))
+                            continue
                     # dtype skew (an int8 frame into a native pool, or a
                     # pre-int8 peer's frame into an int8 pool) surfaces as a
                     # typed fallback inside complete_remote_prefill — never
@@ -154,9 +218,12 @@ class KvTransferServer:
                     # otherwise poison its prefix cache with wrong KV.
                     def _extract(ids=h["block_ids"]):
                         k, v, ks, vs = self.engine.extract_blocks(ids)
-                        return k, v, ks, vs, self.engine.block_hashes_of(ids)
+                        return (
+                            k, v, ks, vs, self.engine.block_hashes_of(ids),
+                            _sender_crcs(self.engine, ids, k, v, ks, vs),
+                        )
 
-                    k, v, ks, vs, hashes = await _engine_call(
+                    k, v, ks, vs, hashes, crcs = await _engine_call(
                         self.engine, _extract
                     )
                     if ks is not None and not h.get("int8_ok"):
@@ -171,8 +238,15 @@ class KvTransferServer:
                             }).encode(), b""))
                         continue
                     hdr, body = _pack_pages(
-                        k, v, (ks, vs) if ks is not None else None
+                        k, v, (ks, vs) if ks is not None else None, crcs=crcs,
                     )
+                    if _FAULTS.current() is not None:
+                        # wire leg of the silent-corruption drill: a rotten
+                        # worker SERVING its cached pages — the flip is
+                        # post-checksum, the reader's verify must catch it
+                        body = _FAULTS.corrupt_pages(
+                            "transfer", self.fault_addr, body
+                        )
                     # "int8" advertises THIS binary's capability (not the
                     # pool's dtype): clients cache it per address so int8
                     # sends can take the device path on later transfers
@@ -265,6 +339,12 @@ class KvTransferServer:
                         )
                     except (MigrationRejected, KvDtypeMismatch,
                             KeyError, ValueError, TypeError) as e:
+                        # KvIntegrityError rides this tuple (it IS a
+                        # ValueError): the nack's code tells the SOURCE its
+                        # pages failed verification — it counts the trip
+                        # against itself and degrades the stream to resume
+                        if isinstance(e, KvIntegrityError):
+                            integrity.note_remote_failure("migrate_stage")
                         await write_frame(writer, TwoPartMessage(
                             json.dumps({
                                 "id": h.get("id"), "ok": False, "int8": True,
@@ -352,6 +432,11 @@ class KvTransferClient:
 
     def __init__(self, device_plane=None):
         self.device_plane = device_plane
+        # label the corrupt-fault gate matches on for OUTBOUND page sets:
+        # defaults to the destination address; owners that model a rotten
+        # SOURCE (the migration coordinator) set it to their own address so
+        # a drill can corrupt one worker's sends regardless of target
+        self.fault_addr = ""
         self._dev_peers: Dict[str, bool] = {}  # addr → peer has a plane
         # addr → peer's binary speaks the int8 scale layout (learned from
         # the "int8" marker new servers stamp on every reply); int8 page
@@ -439,8 +524,23 @@ class KvTransferClient:
             k, v = np.asarray(k), np.asarray(v)
             if scales is not None:
                 scales = (np.asarray(scales[0]), np.asarray(scales[1]))
+            # content checksums travel with the pages (header extension;
+            # receivers without the plane ignore them). Computed BEFORE the
+            # corrupt-fault gate below — the drill models post-checksum
+            # corruption, which is what the receiver's verify must catch.
+            crcs = (
+                integrity.page_checksums(
+                    k, v,
+                    scales[0] if scales is not None else None,
+                    scales[1] if scales is not None else None,
+                ) if integrity.enabled() else None
+            )
             reader, writer = await self._conn(address)
-            header, body = _pack_pages(k, v, scales)
+            header, body = _pack_pages(k, v, scales, crcs=crcs)
+            if _FAULTS.current() is not None:
+                body = _FAULTS.corrupt_pages(
+                    "transfer", self.fault_addr or address, body
+                )
             if tspan is not None:
                 tspan.set_attribute("path", "tcp")
                 tspan.set_attribute("bytes", len(body))
@@ -456,12 +556,24 @@ class KvTransferClient:
                         writer, TwoPartMessage(json.dumps(header).encode(), body)
                     )
                     ack = await read_frame(reader)
-                self._note_caps(address, json.loads(ack.header))
+                ack_h = json.loads(ack.header)
+                self._note_caps(address, ack_h)
             except (ConnectionError, OSError, asyncio.IncompleteReadError):
                 # evict exactly the conn that failed (identity-guarded), so
                 # retries dial fresh without racing concurrent senders
                 self.evict(address, writer)
                 raise
+            if (
+                ack_h.get("ok") is False
+                and ack_h.get("code") == "KvIntegrityError"
+            ):
+                # the receiver rejected OUR pages as corrupt: the trip
+                # belongs to this process (its bytes rotted after the
+                # checksum) — the quarantine window hears about it
+                integrity.note_trip("kv", where="kv_blocks_nack")
+                raise KvIntegrityError(
+                    ack_h.get("error", "peer rejected corrupt pages")
+                )
 
     async def _send_blocks_dev(
         self, address, request_id, first_token, block_ids, k, v, scales=None
@@ -535,6 +647,18 @@ class KvTransferClient:
             if h.get("ok") is False:
                 raise KvDtypeMismatch(h.get("error", "peer refused page read"))
             k, v, scales = _unpack_pages(h, frame.body)
+            if h.get("crcs") is not None and integrity.enabled():
+                # the peer's cached pages must match the checksums sealed
+                # when they were computed: rot in ITS pool/wire surfaces
+                # here as a typed error — callers recompute instead of
+                # seeding corrupt KV into their own prefix cache
+                try:
+                    integrity.verify_pages(
+                        k, v, scales, h["crcs"], where="read_blocks",
+                    )
+                except KvIntegrityError:
+                    integrity.note_remote_failure("read_blocks")
+                    raise
             if tspan is not None:
                 tspan.set_attribute("path", "tcp")
                 tspan.set_attribute("bytes", len(frame.body))
@@ -613,7 +737,15 @@ class KvTransferClient:
                         "request_id": meta.get("request_id", "")},
         ) as tspan:
             reader, writer = await self._conn(address)
+            # meta may carry per-block "crcs" (the coordinator's seal-time
+            # checksums); the corrupt-fault gate below models a source
+            # whose bytes rot AFTER checksumming — the target's staging
+            # verify must nack it
             header, body = _pack_pages(k, v, scales)
+            if _FAULTS.current() is not None:
+                body = _FAULTS.corrupt_pages(
+                    "transfer", self.fault_addr or address, body
+                )
             header.update({"op": "migrate", "migrate": meta})
             if tspan is not None:
                 tspan.set_attribute("path", "tcp")
@@ -643,6 +775,8 @@ class KvTransferClient:
                 msg = ack.get("error", "peer refused migration")
                 if code == "KvDtypeMismatch":
                     raise KvDtypeMismatch(msg)
+                if code == "KvIntegrityError":
+                    raise KvIntegrityError(msg)
                 raise MigrationRejected(msg)
             return ack.get("staged") or {}
 

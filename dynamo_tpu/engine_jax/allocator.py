@@ -69,13 +69,14 @@ class SequenceAllocation:
     tenant: str = ""
     level: int = 0
     # host-tier prefix hits: (logical block index, sequence hash, k, v,
-    # k_scale, v_scale) with the content captured at probe time (a later
-    # offload into the LRU pool can't invalidate them). The scale entries
-    # are None for native-dtype pools and [L, bs] float32 tables for int8
-    # pools — scales travel WITH their pages through every tier. The engine
-    # must inject each into block_ids[index] before any compute touches the
-    # sequence.
-    host_hits: List[Tuple[int, int, Any, Any, Any, Any]] = field(default_factory=list)
+    # k_scale, v_scale, crc) with the content captured at probe time (a
+    # later offload into the LRU pool can't invalidate them). The scale
+    # entries are None for native-dtype pools and [L, bs] float32 tables
+    # for int8 pools — scales travel WITH their pages through every tier;
+    # ``crc`` is the seal-time content checksum (None with integrity off),
+    # already VERIFIED at probe time. The engine must inject each into
+    # block_ids[index] before any compute touches the sequence.
+    host_hits: List[Tuple[int, int, Any, Any, Any, Any, Any]] = field(default_factory=list)
     # full-prompt block hashes this sequence advertised as in-flight (it will
     # compute + seal them); unregistered on free if still unsealed
     pending_hashes: List[int] = field(default_factory=list)
@@ -106,10 +107,13 @@ class HostKvPool:
 
     def __init__(self, max_blocks: int):
         self.max_blocks = max_blocks
-        # hash → (k, v, k_scale, v_scale); scales are None for native-dtype
-        # pools and per-token tables for int8 pools — the pool is payload-
-        # agnostic so both layouts ride the same LRU
-        self._data: "OrderedDict[int, Tuple[Any, Any, Any, Any]]" = OrderedDict()
+        # hash → (k, v, k_scale, v_scale, crc); scales are None for native-
+        # dtype pools and per-token tables for int8 pools — the pool is
+        # payload-agnostic so both layouts ride the same LRU. ``crc`` is the
+        # block's seal-time content checksum (None with the integrity plane
+        # off / from pre-integrity spills): verified at rehit so bad host
+        # RAM surfaces as a prefix miss, never as corrupt device pages.
+        self._data: "OrderedDict[int, Tuple[Any, Any, Any, Any, Any]]" = OrderedDict()
         self.hits = 0
         self.offloaded = 0
 
@@ -119,21 +123,26 @@ class HostKvPool:
     def __len__(self) -> int:
         return len(self._data)
 
-    def put(self, h: int, k, v, k_scale=None, v_scale=None) -> None:
+    def put(self, h: int, k, v, k_scale=None, v_scale=None, crc=None) -> None:
         if h in self._data:
             self._data.move_to_end(h)
             return
         while len(self._data) >= self.max_blocks:
             self._data.popitem(last=False)
-        self._data[h] = (k, v, k_scale, v_scale)
+        self._data[h] = (k, v, k_scale, v_scale, crc)
         self.offloaded += 1
 
-    def get(self, h: int) -> Optional[Tuple[Any, Any, Any, Any]]:
+    def get(self, h: int) -> Optional[Tuple[Any, Any, Any, Any, Any]]:
         item = self._data.get(h)
         if item is not None:
             self._data.move_to_end(h)
             self.hits += 1
         return item
+
+    def discard(self, h: int) -> None:
+        """Drop a poisoned entry (failed its rehit checksum): it must never
+        be served again — the prompt recomputes instead."""
+        self._data.pop(h, None)
 
 
 class _TieredLru:
@@ -208,7 +217,8 @@ class BlockAllocator:
         event_sink: Optional[KvEventSink] = None,
         salt: Optional[bytes] = None,
         host_pool: Optional[HostKvPool] = None,
-        offload: Optional[Callable[[List[Tuple[int, int]]], None]] = None,
+        offload: Optional[Callable[[List[Tuple[int, int, Any]]], None]] = None,
+        checksum: Optional[Callable[[List[int]], List[int]]] = None,
     ):
         self.num_blocks = num_blocks
         self.block_size = block_size
@@ -219,6 +229,14 @@ class BlockAllocator:
         # them into `host_pool` (device_get) before they can be overwritten
         self.host_pool = host_pool
         self._offload = offload
+        # integrity plane (runtime/integrity.py, docs/resilience.md §Silent
+        # corruption): ``checksum([block_ids]) -> [crc32]`` is the engine's
+        # callback computing content checksums of freshly SEALED blocks
+        # (the one point where the bytes are final and the owner can vouch
+        # for them). None = integrity off: no crc is ever computed, stored,
+        # or verified — the exact pre-integrity allocator.
+        self._checksum = checksum
+        self._crc_of: Dict[int, int] = {}  # physical page id → seal crc
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
         self._refcount: Dict[int, int] = {}
         # sequence_hash → block id, for every block whose contents are valid
@@ -285,6 +303,12 @@ class BlockAllocator:
         reused pages have none)."""
         return self._hash_of.get(block_id, -1)
 
+    def crc_of_block(self, block_id: int) -> int:
+        """Seal-time content checksum of a physical page, or -1 (unsealed,
+        or sealed while the integrity plane was off). Ships next to the
+        pages on every transfer tier so receivers can verify them."""
+        return self._crc_of.get(block_id, -1)
+
     def blocks_needed(self, n_tokens: int) -> int:
         return (n_tokens + self.block_size - 1) // self.block_size
 
@@ -322,14 +346,26 @@ class BlockAllocator:
             reused.append(bid)
 
         # host tier continues the chain where the device tier missed; content
-        # is captured now so later evictions from the pool can't invalidate it
-        host_hits: List[Tuple[int, int, Any, Any, Any, Any]] = []
+        # is captured now so later evictions from the pool can't invalidate
+        # it. With the integrity plane on, each entry's bytes are verified
+        # against its seal-time checksum HERE — a corrupted entry (bad host
+        # RAM) is dropped from the pool and treated as a prefix miss: the
+        # chain ends and the prompt recomputes from there, corrupt KV never
+        # reaches the device pool.
+        host_hits: List[Tuple[int, int, Any, Any, Any, Any, Any]] = []
         if self.host_pool is not None:
             j = len(reused)
             while j < max_cacheable:
                 item = self.host_pool.get(seq_hashes[j])
                 if item is None:
                     break
+                if self._checksum is not None and item[4] is not None:
+                    from dynamo_tpu.runtime import integrity
+
+                    if integrity.entry_checksum(*item[:4]) != item[4]:
+                        self.host_pool.discard(seq_hashes[j])
+                        integrity.note_trip("kv", where="host_rehit")
+                        break
                 host_hits.append((j, seq_hashes[j]) + tuple(item))
                 j += 1
 
@@ -357,7 +393,7 @@ class BlockAllocator:
         # host-hit blocks become valid device content once the engine injects
         # them; register their hashes so the next request hits the device tier
         stored: List[Tuple[int, List[int]]] = []
-        for idx, h, *_ in host_hits:
+        for idx, h, *rest in host_hits:
             bid = block_ids[idx]
             prior = self._hash_of.get(bid)
             if prior is not None and prior != h:
@@ -365,6 +401,10 @@ class BlockAllocator:
             if h not in self._by_hash:
                 self._by_hash[h] = bid
                 self._hash_of[bid] = h
+                if self._checksum is not None and rest[4] is not None:
+                    # the (verified) host entry's seal checksum describes
+                    # the bytes about to be injected into this page
+                    self._crc_of[bid] = rest[4]
                 stored.append(
                     (h, list(token_ids[idx * self.block_size : (idx + 1) * self.block_size]))
                 )
@@ -502,6 +542,14 @@ class BlockAllocator:
                 self._hash_of[bid] = blk.block_hash
                 stored.append((blk.block_hash, list(blk.tokens)))
         alloc.sealed_blocks = len(alloc.token_blocks.blocks)
+        if self._checksum is not None and stored:
+            # seal-time content checksums (docs/resilience.md §Silent
+            # corruption): computed exactly once, while the owner can still
+            # vouch for the bytes; they travel with the block through every
+            # later tier (host spill, transfer frames, migration staging)
+            bids = [self._by_hash[h] for h, _ in stored]
+            for bid, crc in zip(bids, self._checksum(bids)):
+                self._crc_of[bid] = crc
         if stored and self._sink is not None:
             self._sink.blocks_stored(parent, stored)
 
@@ -600,7 +648,7 @@ class BlockAllocator:
         Evicted blocks spill to the host tier (offload callback copies their
         still-valid device contents) before their pages are reusable."""
         evicted: List[int] = []
-        spill: List[Tuple[int, int]] = []
+        spill: List[Tuple[int, int, Any]] = []
         while len(self._free) < n:
             bid = self._cached.pop_oldest()  # lowest class tier, then LRU
             if bid is None:
@@ -609,9 +657,12 @@ class BlockAllocator:
             del self._by_hash[h]
             self._block_level.pop(bid, None)
             evicted.append(h)
+            # the seal-time checksum follows the content into the host tier
+            # (verified at rehit); the page itself is being recycled
+            crc = self._crc_of.pop(bid, None)
             if self._offload is not None and self.host_pool is not None:
                 if h not in self.host_pool:
-                    spill.append((h, bid))
+                    spill.append((h, bid, crc))
             self._free.append(bid)
         if spill:
             self._offload(spill)
@@ -625,6 +676,8 @@ class BlockAllocator:
             self._by_hash.pop(h, None)
             if self._sink is not None:
                 self._sink.blocks_removed([h])
+        # content replaced ⇒ its seal checksum no longer describes the page
+        self._crc_of.pop(bid, None)
         self._cached.discard(bid)
         # the block's content is being replaced: its class tag must not
         # survive into the new owner's tier (levels only ever go UP via

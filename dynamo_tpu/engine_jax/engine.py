@@ -81,8 +81,11 @@ from dynamo_tpu.models.llama import (
     quantize_kv,
 )
 from dynamo_tpu.engine_jax.compile_cache import compile_count, record_compile
+from dynamo_tpu.runtime import faults as faults_mod
+from dynamo_tpu.runtime import integrity as integrity_mod
 from dynamo_tpu.runtime import qos as qos_mod
 from dynamo_tpu.runtime import telemetry, tracing
+from dynamo_tpu.runtime.integrity import WATCHDOG_TOKEN
 from dynamo_tpu.runtime.annotated import Annotated
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 from dynamo_tpu.runtime.health import EngineHeartbeat
@@ -498,10 +501,28 @@ class JaxServingEngine(AsyncEngine):
             if engine_config.host_cache_blocks > 0
             else None
         )
+        # integrity plane (runtime/integrity.py, docs/resilience.md §Silent
+        # corruption): block content checksums at seal + the output
+        # watchdog. None with DYN_TPU_KV_INTEGRITY=0 — THE zero-overhead
+        # gate: no checksum callback is installed, no watchdog variant is
+        # built, every jitted program is exactly the pre-integrity one.
+        self._integrity = integrity_mod.maybe_from_env()
+        # the watchdog rides the jitted step functions as one extra scalar
+        # input + a sentinel substitution; sharded/multihost engines keep
+        # the pre-integrity dispatch protocol (followers replay the
+        # leader's opcode stream — an extra input would skew it), so the
+        # watchdog is single-chip for now, like int8 KV.
+        self._watchdog = self._integrity is not None and mesh is None
+        # label the fault gates match on ("corrupt"/"poison" drills target
+        # ONE worker in a fleet); attach_kv_publishing stamps the worker id
+        self._fault_addr = "engine"
         self.allocator = BlockAllocator(
             self.num_blocks, engine_config.kv_block_size, event_sink=event_sink,
             host_pool=self.host_pool,
             offload=self._offload_blocks if self.host_pool is not None else None,
+            checksum=(
+                self._block_checksums if self._integrity is not None else None
+            ),
         )
 
         # attention impl is auto-selected (platform + head-dim rule,
@@ -639,6 +660,11 @@ class JaxServingEngine(AsyncEngine):
         self.migrated_in_requests = 0
         self.migrations_failed = 0
         self.resume_recompute_tokens = 0
+        # output watchdog (docs/resilience.md §Silent corruption): lanes
+        # whose dispatch produced non-finite/exploding logits — each ended
+        # typed and in-band (resume directive) before any token reached a
+        # client, and counted as an integrity trip against this worker
+        self.watchdog_trips = 0
         # speculative decoding (cumulative): drafts handed to verify
         # dispatches and how many matched their sampled targets
         self.spec_drafted_total = 0
@@ -788,9 +814,26 @@ class JaxServingEngine(AsyncEngine):
         max_pos = self.config.max_model_len - 1
         n_top = self.config.top_logprobs
         dense = self._decode_dense
+        # output watchdog (docs/resilience.md §Silent corruption): engine-
+        # wide constant, so the variant cache key is unchanged. When on, the
+        # fn takes one extra scalar (``wdf``: the poison-drill flag) and
+        # substitutes WATCHDOG_TOKEN for any lane whose logits are
+        # non-finite or exploding — the host loop detects the tripped lane
+        # from the fetched tokens alone, zero extra outputs or transfers.
+        wd = self._watchdog
+        wd_limit = self._integrity.logit_limit if wd else 0.0
+
+        def _wd_bad(sel, wdf):
+            # full_like keeps sel's dtype exactly: the watchdog must not
+            # perturb the sampling math of a healthy dispatch in any way
+            sel = jnp.where(wdf > 0, jnp.full_like(sel, jnp.nan), sel)
+            bad = (~jnp.all(jnp.isfinite(sel), axis=-1)) | (
+                jnp.max(jnp.abs(sel), axis=-1) > wd_limit
+            )
+            return sel, bad
 
         def decode(params, cache, counts, tokens, positions, tables, step_ctr,
-                   ipack, fpack):
+                   ipack, fpack, wdf=None):
             # ipack [2,S] int32 = (seeds, topk); fpack [4,S] f32 =
             # (temp, topp, freqp, presp). Packed so a dispatch uploads at
             # most two small host arrays (each upload is a fixed-latency
@@ -842,12 +885,19 @@ class JaxServingEngine(AsyncEngine):
                     else:
                         keys = None
                     sel = logits[:, 0]
+                    if wd:
+                        sel, bad = _wd_bad(sel, wdf)
                     sampled_from = (
                         apply_penalties(sel, counts, freqp, presp)
                         if with_pen else sel
                     )
                     nxt = sample_tokens(sampled_from, keys, temp, topk, topp,
                                         greedy_only=not with_sample)
+                    if wd:
+                        nxt = jnp.where(
+                            bad & (pos >= 0),
+                            jnp.int32(WATCHDOG_TOKEN), nxt,
+                        )
                     if with_pen:
                         counts = update_counts(counts, nxt, pos >= 0)
                     new_pos = jnp.where((pos >= 0) & (pos < max_pos), pos + 1, -1)
@@ -892,6 +942,8 @@ class JaxServingEngine(AsyncEngine):
                 sel, wk, wv = forward_window(
                     params, cfg, toks, pos, history, base, wk, wv, k,
                 )
+                if wd:
+                    sel, bad = _wd_bad(sel, wdf)
                 if with_sample:
                     kk = jax.random.fold_in(step_key, k)
                     keys = jax.vmap(lambda s: jax.random.fold_in(kk, s))(seeds)
@@ -903,6 +955,10 @@ class JaxServingEngine(AsyncEngine):
                 )
                 nxt = sample_tokens(sampled_from, keys, temp, topk, topp,
                                     greedy_only=not with_sample)
+                if wd:
+                    nxt = jnp.where(
+                        bad & (pos >= 0), jnp.int32(WATCHDOG_TOKEN), nxt
+                    )
                 if with_pen:
                     counts = update_counts(counts, nxt, pos >= 0)
                 new_pos = jnp.where((pos >= 0) & (pos < max_pos), pos + 1, -1)
@@ -974,9 +1030,11 @@ class JaxServingEngine(AsyncEngine):
         cfg = self.model_config
         S = self.config.max_slots
         n_top = self.config.top_logprobs
+        wd = self._watchdog
+        wd_limit = self._integrity.logit_limit if wd else 0.0
 
         def chunk(params, cache, counts, tokens, positions, tables, sample_at,
-                  step_ctr, ipack, fpack):
+                  step_ctr, ipack, fpack, wdf=None):
             step_key = jax.random.fold_in(jax.random.PRNGKey(0), step_ctr)
             seeds, topk = ipack[0], ipack[1]
             temp, topp, freqp, presp = fpack[0], fpack[1], fpack[2], fpack[3]
@@ -1011,6 +1069,13 @@ class JaxServingEngine(AsyncEngine):
                 )
             hs = h[jnp.arange(S), jnp.clip(sample_at, 0)]  # [S, E]
             sel = lm_head(params, cfg, hs)  # [S, V]
+            if wd:
+                # output watchdog: poison-drill substitution + per-lane
+                # non-finite/exploding flag → WATCHDOG_TOKEN sentinel
+                sel = jnp.where(wdf > 0, jnp.full_like(sel, jnp.nan), sel)
+                bad = (~jnp.all(jnp.isfinite(sel), axis=-1)) | (
+                    jnp.max(jnp.abs(sel), axis=-1) > wd_limit
+                )
             if with_sample:
                 keys = jax.vmap(lambda s: jax.random.fold_in(step_key, s))(seeds)
             else:
@@ -1020,6 +1085,10 @@ class JaxServingEngine(AsyncEngine):
             )
             nxt = sample_tokens(sampled_from, keys, temp, topk, topp,
                                 greedy_only=not with_sample)
+            if wd:
+                nxt = jnp.where(
+                    bad & (sample_at >= 0), jnp.int32(WATCHDOG_TOKEN), nxt
+                )
             if with_pen:
                 counts = update_counts(counts, nxt, sample_at >= 0)
             if with_lp:
@@ -1063,9 +1132,11 @@ class JaxServingEngine(AsyncEngine):
         this path replaces."""
         cfg = self.model_config
         n_top = self.config.top_logprobs
+        wd = self._watchdog
+        wd_limit = self._integrity.logit_limit if wd else 0.0
 
         def verify(params, cache, counts, tokens, positions, tables, step_ctr,
-                   ipack, fpack):
+                   ipack, fpack, wdf=None):
             step_key = jax.random.fold_in(jax.random.PRNGKey(0), step_ctr)
             seeds, topk = ipack[0], ipack[1]
             temp, topp, freqp, presp = fpack[0], fpack[1], fpack[2], fpack[3]
@@ -1079,6 +1150,14 @@ class JaxServingEngine(AsyncEngine):
                 hidden_only=True, with_history=True,
             )
             logits_all = lm_head(params, cfg, h)  # [S, K1, V] f32
+            if wd:
+                logits_all = jnp.where(
+                    wdf > 0, jnp.full_like(logits_all, jnp.nan), logits_all
+                )
+                bad_pos = (~jnp.all(jnp.isfinite(logits_all), axis=-1)) | (
+                    jnp.max(jnp.abs(logits_all), axis=-1) > wd_limit
+                )  # [S, K1]
+                bad = jnp.any(bad_pos & (positions >= 0), axis=-1)  # [S]
             outs = speculative_targets(
                 logits_all, counts, positions >= 0, step_key, seeds,
                 temp, topk, topp, freqp, presp,
@@ -1087,13 +1166,40 @@ class JaxServingEngine(AsyncEngine):
             )
             if with_lp:
                 tgt, lp, tids, tlps, counts = outs
+                if wd:
+                    tgt = jnp.where(
+                        bad[:, None], jnp.int32(WATCHDOG_TOKEN), tgt
+                    )
                 return tgt, lp, tids, tlps, cache, counts
             tgt, counts = outs
+            if wd:
+                tgt = jnp.where(bad[:, None], jnp.int32(WATCHDOG_TOKEN), tgt)
             return tgt, cache, counts
 
         return jax.jit(verify, donate_argnums=(1, 2))
 
     # -- penalty-count buffer -------------------------------------------------
+
+    def _wd_args(self) -> tuple:
+        """Extra dispatch args for the output watchdog: empty with the
+        integrity plane off (the jitted programs then take exactly the
+        pre-integrity signature), else one scalar — 0 normally, 1 when the
+        ``poison`` fault action fires for this dispatch (the injected-SDC
+        drill: the fn overwrites its logits with NaN in-jit, and the
+        watchdog must catch every affected lane before a token escapes).
+        The steady-state 0 is uploaded ONCE and reused — on a tunneled
+        chip every fresh upload is a fixed-latency transfer, and the hot
+        path must not pay one per dispatch for a drill flag."""
+        if not self._watchdog:
+            return ()
+        if faults_mod.current() is not None and faults_mod.poison_gate(
+            "engine", self._fault_addr
+        ):
+            return (self._put(np.int32(1)),)
+        wd0 = getattr(self, "_wd_zero", None)
+        if wd0 is None:
+            wd0 = self._wd_zero = self._put(np.int32(0))
+        return (wd0,)
 
     def _counts_sync_fn(self, rbucket: int, pbucket: int):
         """Tiny jitted reset+rebuild of penalty-count rows. Bucketed shapes
@@ -1284,6 +1390,8 @@ class JaxServingEngine(AsyncEngine):
         ip = sd((2, S), jnp.int32)
         fp = sd((4, S), jnp.float32)
         svec = sd((S,), jnp.int32)
+        # watchdog variants take one extra scalar (the poison flag)
+        wd_tail = (sd((), jnp.int32),) if self._watchdog else ()
 
         jobs = []
         for want_sample in sample_set:
@@ -1292,13 +1400,14 @@ class JaxServingEngine(AsyncEngine):
                     f"chunk(sample={want_sample},history={want_history})",
                     self._chunk(False, False, want_sample, want_history),
                     (p_sd, cache_sd, counts_sd, sd((S, C), jnp.int32),
-                     sd((S, C), jnp.int32), tbl, svec, ctr, ip, fp),
+                     sd((S, C), jnp.int32), tbl, svec, ctr, ip, fp) + wd_tail,
                     ("chunk", False, False, want_sample, want_history),
                 ))
             jobs.append((
                 f"decode(sample={want_sample})",
                 self._decode(False, False, want_sample),
-                (pd_sd, cache_sd, counts_sd, svec, svec, tbl, ctr, ip, fp),
+                (pd_sd, cache_sd, counts_sd, svec, svec, tbl, ctr, ip, fp)
+                + wd_tail,
                 ("decode", False, False, want_sample),
             ))
             if self._spec_k > 0:
@@ -1306,7 +1415,8 @@ class JaxServingEngine(AsyncEngine):
                 jobs.append((
                     f"verify(sample={want_sample})",
                     self._verify(False, False, want_sample),
-                    (pd_sd, cache_sd, counts_sd, sk1, sk1, tbl, ctr, ip, fp),
+                    (pd_sd, cache_sd, counts_sd, sk1, sk1, tbl, ctr, ip, fp)
+                    + wd_tail,
                     ("verify", False, False, want_sample),
                 ))
 
@@ -2043,7 +2153,7 @@ class JaxServingEngine(AsyncEngine):
             self._put(np.int32(self._step_counter)),
             self._m_ipack.get(ipack_np),
             self._m_fpack.get(fpack_np),
-        )
+        ) + self._wd_args()
         # copy_to_host_async right after dispatch: the host-fetch path has a
         # ~100 ms fixed latency on a tunneled chip when started cold at get
         # time; started here it overlaps the chunk's own compute (measured
@@ -2083,6 +2193,7 @@ class JaxServingEngine(AsyncEngine):
                 if lp_np is not None
                 else None
             )
+            tok = int(sampled_np[i])
             if seq.prefill_pos is not None:
                 if self._fair is not None and seq.tenant:
                     # prefill progress bills the tenant's virtual clock
@@ -2090,11 +2201,20 @@ class JaxServingEngine(AsyncEngine):
                     self._fair.charge(seq.tenant, len(consumed[i]), seq.weight)
                 seq.prefill_pos += len(consumed[i])
                 if seq.prefill_pos >= len(seq.prompt):
+                    if self._watchdog and tok < 0:
+                        # watchdog sentinel on the lane's FIRST token: no
+                        # token has reached the client yet, but the stream
+                        # still ends typed + in-band so the caller re-homes
+                        self._watchdog_trip(seq)
+                        continue
                     seq.prefill_pos = None
                     seq.first_token_t = time.perf_counter()
-                    self._emit_token(seq, int(sampled_np[i]), lpinfo=lpinfo)
+                    self._emit_token(seq, tok, lpinfo=lpinfo)
             else:
-                self._emit_token(seq, int(sampled_np[i]), lpinfo=lpinfo)
+                if self._watchdog and tok < 0:
+                    self._watchdog_trip(seq)
+                    continue
+                self._emit_token(seq, tok, lpinfo=lpinfo)
 
     def _decode_step(self) -> None:
         """Pipelined decode: dispatch chunk N+1 off the previous dispatch's
@@ -2245,7 +2365,7 @@ class JaxServingEngine(AsyncEngine):
             self._put(np.int32(self._step_counter)),
             self._m_ipack.get(ipack_np),
             self._m_fpack.get(fpack_np),
-        )
+        ) + self._wd_args()
         if want_lp:
             out, lps, tids, tlps, toks2, pos2, self.cache, counts_out = (
                 self._decode(True, want_pen, want_sample)(*args)
@@ -2288,6 +2408,14 @@ class JaxServingEngine(AsyncEngine):
         lanes × 64-step chunks that Python overhead rivals the decode step's
         device time), and finishes the lane on a terminal cut. Returns the
         number of tokens actually emitted."""
+        if self._watchdog and any(t < 0 for t in cand):
+            # output watchdog sentinel: this dispatch produced non-finite /
+            # exploding logits for the lane. NOTHING from the run is
+            # emitted or sealed — the whole run is suspect — and the lane
+            # ends typed + in-band (resume directive) so the client
+            # re-admits on a sibling (docs/resilience.md §Silent corruption)
+            self._watchdog_trip(seq, defer_free=defer_free)
+            return 0
         cfg = self.config
         n_take = min(
             len(cand),
@@ -2498,7 +2626,7 @@ class JaxServingEngine(AsyncEngine):
             self._put(positions), self._m_tables.get(self._tables),
             self._put(np.int32(self._step_counter)),
             self._m_ipack.get(ipack_np), self._m_fpack.get(fpack_np),
-        )
+        ) + self._wd_args()
         if want_lp:
             tgt, lps, tids, tlps, self.cache, counts_out = self._verify(
                 True, want_pen, want_sample
@@ -2730,6 +2858,48 @@ class JaxServingEngine(AsyncEngine):
         seq.emit(Annotated.from_data(LLMEngineOutput.final(reason).to_dict(), id=seq.ctx.id))
         seq.emit(_FINISHED)
 
+    def _watchdog_trip(self, seq: _Seq, defer_free: bool = False) -> None:
+        """Output watchdog (docs/resilience.md §Silent corruption): the
+        lane's dispatch produced non-finite or exploding logits. The lane
+        dies HERE, typed and in-band — the PR10 contract (never raise past
+        delivered tokens) means the stream ends with an explicit resume
+        directive: a journaled client re-admits on a sibling and the
+        caller sees an unbroken, byte-correct stream; a journal-less
+        client gets an explicit in-band error, never silent garbage.
+        Nothing from the tripped dispatch is emitted or sealed (the KV it
+        wrote is suspect too); the lane's UNSEALED tail blocks free with
+        the allocation, its pre-trip sealed blocks were computed by
+        healthy dispatches and stay cached. The trip counts against this
+        worker's quarantine window. Engine thread only."""
+        self.watchdog_trips += 1
+        integrity_mod.note_trip("watchdog", where="engine")
+        logger.error(
+            "output watchdog tripped for request %s: non-finite or "
+            "exploding logits — ending the stream with a resume directive",
+            seq.ctx.id,
+        )
+        if tracing.enabled():
+            self._record_phase_spans(seq, FinishReason.ERROR)
+        if seq.slot is not None:
+            self._slots[seq.slot] = None
+            seq.slot = None
+        if seq.alloc is not None:
+            if defer_free:
+                # the in-flight speculative chunk may still write into
+                # these blocks; park them until it has been fetched
+                self._zombie_allocs.append(seq.alloc)
+            else:
+                self.allocator.free_sequence(seq.alloc)
+            seq.alloc = None
+        seq.emit(Annotated.from_data(
+            {"migrating": {
+                "resume": True,
+                "error": "output watchdog: non-finite or exploding logits",
+            }},
+            id=seq.ctx.id,
+        ))
+        seq.emit(_FINISHED)
+
     def _preempt(self, seq: _Seq) -> None:
         """Out of KV blocks mid-decode: recompute-preempt — free pages, requeue
         with prompt := prompt + generated, prefix cache softens the recompute.
@@ -2790,6 +2960,23 @@ class JaxServingEngine(AsyncEngine):
         remote reader verify pages still hold the content it expects; MUST
         run on the engine thread."""
         return [self.allocator.hash_of_block(bid) for bid in block_ids]
+
+    def block_crcs_of(self, block_ids: List[int]) -> List[int]:
+        """Seal-time content checksums per physical page (-1 when unsealed
+        or sealed before the integrity plane was on). Transfer tiers ship
+        these next to the pages; a -1 entry means "sender can't vouch" and
+        receivers fall back to extract-time (wire-only) checksums. MUST run
+        on the engine thread."""
+        return [self.allocator.crc_of_block(bid) for bid in block_ids]
+
+    def _block_checksums(self, block_ids: List[int]) -> List[int]:
+        """The allocator's seal-time checksum callback: pull the freshly
+        sealed pages' bytes and crc them (runtime/integrity.py). This is
+        the integrity plane's steady-state cost — one small device→host
+        copy per sealed block, knob-gated by DYN_TPU_KV_INTEGRITY. MUST
+        run on the engine thread (note_tokens_computed call sites)."""
+        k, v, ks, vs = self.extract_blocks(block_ids)
+        return integrity_mod.page_checksums(k, v, ks, vs)
 
     def seed_external_prefix(
         self, token_ids: List[int], k_pages, v_pages,
@@ -2909,12 +3096,31 @@ class JaxServingEngine(AsyncEngine):
     def extract_for_migration(self, request_id: str):
         """Copy a frozen sequence's computed-history pages out of the pool:
         blocks covering positions 0..N-2 (the last sampled token was never
-        fed, so its position has no KV anywhere). MUST run on the engine
+        fed, so its position has no KV anywhere). Returns ``(k, v,
+        k_scale, v_scale, crcs)`` — ``crcs`` is the per-block content
+        checksum list the migrate frame ships (seal-time registry values
+        where the block is sealed, extract-time values for the partial
+        tail; None with the integrity plane off). MUST run on the engine
         thread."""
         seq = self._migrating_out[request_id]  # KeyError → coordinator aborts
         n_hist = len(seq.prompt) + len(seq.generated) - 1
         n_blocks = (n_hist + self.config.kv_block_size - 1) // self.config.kv_block_size
-        return self.extract_blocks(seq.alloc.block_ids[:n_blocks])
+        bids = seq.alloc.block_ids[:n_blocks]
+        k, v, ks, vs = self.extract_blocks(bids)
+        crcs = None
+        if self._integrity is not None:
+            # seal-time checksums where the owner can vouch for the block
+            # (catches HBM rot between seal and drain); the unsealed tail
+            # gets extract-time checksums — wire-scope protection only
+            crcs = self.block_crcs_of(bids)
+            for i, c in enumerate(crcs):
+                if c < 0:
+                    crcs[i] = integrity_mod.entry_checksum(
+                        k[:, i], v[:, i],
+                        ks[:, i] if ks is not None else None,
+                        vs[:, i] if vs is not None else None,
+                    )
+        return k, v, ks, vs, crcs
 
     def finish_migrated(self, request_id: str, target_instance: str,
                         target_worker: str, mid: str) -> None:
@@ -3060,6 +3266,17 @@ class JaxServingEngine(AsyncEngine):
         level = int(meta.get("level") or 0)
         mid = str(meta["mid"])  # parse BEFORE allocating: a malformed
         # checkpoint must not cost pool state
+        if self._integrity is not None and meta.get("crcs") is not None:
+            # content verification BEFORE any pool state changes: a page
+            # set corrupted after the source sealed it (bad HBM there, bad
+            # wire hop) raises typed — the nack degrades the stream to the
+            # resume path and the SOURCE counts the trip against itself.
+            # Never a torn staged entry: nothing was allocated yet.
+            integrity_mod.verify_pages(
+                k_np, v_np,
+                (k_scale, v_scale) if k_scale is not None else None,
+                meta["crcs"], where="migrate_stage",
+            )
         alloc = self.allocator.allocate_sequence(
             toks, wait_inflight=False, tenant=tenant, level=level
         )
@@ -3221,7 +3438,7 @@ class JaxServingEngine(AsyncEngine):
 
     # -- host KV tier ---------------------------------------------------------
 
-    def _offload_blocks(self, pairs: List[Tuple[int, int]]) -> None:
+    def _offload_blocks(self, pairs: List[Tuple[int, int, Any]]) -> None:
         """Spill evicted device blocks to the host pool — WITHOUT stalling the
         eviction path (which runs inside admission: a synchronous device_get
         here stalls every decode lane for a host-transfer round trip, W4 of
@@ -3232,8 +3449,10 @@ class JaxServingEngine(AsyncEngine):
         BEFORE any subsequent dispatch that could overwrite the freed pages
         (single device stream executes in order), so the snapshot is
         consistent; the host copy then rides along asynchronously and is
-        harvested by :meth:`_harvest_spills` once ready."""
-        idx = jnp.asarray([bid for _, bid in pairs], jnp.int32)
+        harvested by :meth:`_harvest_spills` once ready. ``pairs`` entries
+        are ``(hash, block_id, crc)`` — the seal-time content checksum
+        rides into the host tier with its block (None with integrity off)."""
+        idx = jnp.asarray([bid for _, bid, _ in pairs], jnp.int32)
         k = self.cache["k"][:, idx]
         v = self.cache["v"][:, idx]
         k.copy_to_host_async()
@@ -3272,7 +3491,14 @@ class JaxServingEngine(AsyncEngine):
                 # dynlint: allow-host-sync(scale tables ride the same spill)
                 ks_np = np.asarray(jax.device_get(ks))
                 vs_np = np.asarray(jax.device_get(vs))  # dynlint: allow-host-sync(ditto)
-            for i, (h, _) in enumerate(pairs):
+            if faults_mod.current() is not None:
+                # host-tier leg of the silent-corruption drill: the
+                # "corrupt" action bit-flips the spilled copy — bad host
+                # RAM; the seal-time crc must catch it at rehit
+                k_np = faults_mod.corrupt_array(
+                    "engine", self._fault_addr, k_np
+                )
+            for i, (h, _, crc) in enumerate(pairs):
                 # copies, not views: a view would pin the whole batch array
                 # in host RAM for as long as any one entry stays in the pool
                 self.host_pool.put(
@@ -3281,6 +3507,7 @@ class JaxServingEngine(AsyncEngine):
                     np.ascontiguousarray(v_np[:, i]),
                     np.ascontiguousarray(ks_np[:, i]) if ks is not None else None,
                     np.ascontiguousarray(vs_np[:, i]) if ks is not None else None,
+                    crc=crc,
                 )
 
     def _inject_host_hits(self, alloc: SequenceAllocation) -> None:
@@ -3437,6 +3664,10 @@ class JaxServingEngine(AsyncEngine):
             "migrated_in_requests": self.migrated_in_requests,
             "migrate_staged": len(self._staged_migrations),
             "resume_recompute_tokens": self.resume_recompute_tokens,
+            # integrity plane (docs/resilience.md §Silent corruption):
+            # engine-local watchdog trips (the process-global trip/
+            # quarantine counters ride attach_kv_publishing)
+            "watchdog_trips": self.watchdog_trips,
         }
         if self._perf is not None:
             m["decode_tokens_per_s"] = round(self._perf.decode_tps, 3)

@@ -211,6 +211,14 @@ class ForwardPassMetrics:
     migrations_total: int = 0
     migrations_failed_total: int = 0
     migrate_kv_blocks_moved_total: int = 0
+    # integrity plane (runtime/integrity.py, docs/resilience.md §Silent
+    # corruption): cumulative self-attributable KV checksum failures and
+    # output-watchdog lane trips for this process. The aggregator sums
+    # them into dynamo_cluster_kv_integrity_failures_total /
+    # _watchdog_trips_total; health_state carries "quarantined" when the
+    # trip window latched.
+    kv_integrity_failures_total: int = 0
+    watchdog_trips_total: int = 0
     # process identity for cluster attribution + dashboards
     uptime_s: float = 0.0
     model: Optional[str] = None

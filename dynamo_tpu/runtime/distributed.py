@@ -38,7 +38,16 @@ from dynamo_tpu.runtime.control_plane import ControlPlaneUnavailable
 from dynamo_tpu.runtime.annotated import Annotated
 from dynamo_tpu.runtime.bus import MessageBusClient
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
-from dynamo_tpu.runtime.health import UNHEALTHY, HealthMonitor, HealthPolicy
+from dynamo_tpu.runtime.health import (
+    QUARANTINED,
+    UNHEALTHY,
+    HealthMonitor,
+    HealthPolicy,
+)
+
+# health states routers must never dispatch to: unhealthy (wedged/stalled)
+# and quarantined (integrity plane latched — outputs untrusted)
+EXCLUDED_HEALTH = (UNHEALTHY, QUARANTINED)
 from dynamo_tpu.runtime.resilience import (
     DEADLINE_ERROR,
     AllInstancesFailed,
@@ -443,6 +452,16 @@ class Endpoint:
         return f"{self.component.base_key}/endpoints/{self.name}/drain/"
 
     @property
+    def quarantine_prefix(self) -> str:
+        """Operator quarantine control keys (``llmctl worker quarantine``),
+        same shape as drain keys: present ⇒ the named worker latches
+        quarantine (integrity plane, docs/resilience.md §Silent
+        corruption); an observed DELETE is the operator unquarantine — it
+        clears every quarantine source including self-tripped ones and
+        resets the trip window (the operator is vouching for the host)."""
+        return f"{self.component.base_key}/endpoints/{self.name}/quarantine/"
+
+    @property
     def rpc_name(self) -> str:
         ns = self.component.namespace.name
         return f"{ns}.{self.component.name}.{self.name}"
@@ -500,6 +519,9 @@ class Endpoint:
             asyncio.create_task(self._load_report_loop(rt, server, info))
         )
         rt._background.append(asyncio.create_task(self._drain_control_loop(rt)))
+        rt._background.append(
+            asyncio.create_task(self._quarantine_control_loop(rt))
+        )
         return info
 
     async def _load_report_loop(self, rt: "DistributedRuntime", server, info: InstanceInfo) -> None:
@@ -610,6 +632,94 @@ class Endpoint:
                         raise
                     except Exception:
                         logger.debug("drain watcher cancel failed", exc_info=True)
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 10.0)
+
+    async def _quarantine_control_loop(self, rt: "DistributedRuntime") -> None:
+        """Apply operator quarantine keys (``llmctl worker quarantine``).
+
+        Semantics (docs/resilience.md §Silent corruption runbook):
+
+        - key present (put event, or present at a watch (re)sync) ⇒ latch
+          the ``store`` quarantine source — the health monitor flips the
+          worker to ``quarantined`` on its next check;
+        - an observed DELETE event ⇒ the operator unquarantine: clears
+          EVERY source (including a self-tripped latch) and resets the trip
+          window — this is the only way a trip-quarantined worker
+          re-admits itself;
+        - key absent at a (re)sync ⇒ only the ``store`` source clears: a
+          watch reconnect must not silently lift a self-tripped quarantine
+          nobody vouched for.
+
+        The loop shares the drain loop's reconnect discipline; with the
+        integrity plane disabled it still applies operator orders (an
+        operator quarantining a DYN_TPU_KV_INTEGRITY=0 worker is making a
+        call the knob must not veto)."""
+        from dynamo_tpu.runtime import integrity
+
+        def _mine(key: str) -> bool:
+            return key.rsplit("/", 1)[-1] in (rt.worker_id, "all")
+
+        async def _apply_key_set() -> None:
+            present = any(_mine(k) for k in
+                          await rt.store.get_prefix(self.quarantine_prefix))
+            if present:
+                integrity.tracker().quarantine(
+                    "store", reason="operator quarantine key"
+                )
+            else:
+                integrity.clear_quarantine(source="store")
+
+        backoff = 0.5
+        while True:
+            watcher = None
+            try:
+                try:
+                    await rt.store.get("__ping__")
+                except (ConnectionError, RuntimeError):
+                    await rt.reconnect_store()
+                watcher = await rt.store.watch_prefix(
+                    self.quarantine_prefix, include_existing=True
+                )
+                await _apply_key_set()
+                backoff = 0.5
+                async for ev in watcher:
+                    if not _mine(ev.key):
+                        continue
+                    if ev.type == "put":
+                        integrity.tracker().quarantine(
+                            "store", reason="operator quarantine key"
+                        )
+                    elif getattr(ev, "resync", False):
+                        # a resync-synthesized delete is the store failing
+                        # to vouch for the key, NOT an operator order:
+                        # reconcile conservatively from the current set
+                        await _apply_key_set()
+                    else:
+                        # observed operator unquarantine: full clear + trip
+                        # window reset — then reconcile against the keys
+                        # that REMAIN (deleting the per-worker key while
+                        # `.../all` still stands must re-latch the store
+                        # source, not free the worker)
+                        integrity.clear_quarantine()
+                        await _apply_key_set()
+            except asyncio.CancelledError:
+                raise
+            except (ConnectionError, RuntimeError, OSError):
+                logger.warning(
+                    "quarantine watch for %s lost; retrying", self.path,
+                    exc_info=True,
+                )
+            finally:
+                if watcher is not None:
+                    try:
+                        await watcher.cancel()
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        logger.debug(
+                            "quarantine watcher cancel failed", exc_info=True
+                        )
             await asyncio.sleep(backoff)
             backoff = min(backoff * 2, 10.0)
 
@@ -1128,14 +1238,17 @@ class EndpointClient(AsyncEngine):
         return snap is not None and snap.draining
 
     def _is_unhealthy(self, iid: str) -> bool:
-        """Worker-self-reported unhealthy (instance-key heartbeat or reply
-        piggyback). Unhealthy workers also self-drain, but the piggyback can
-        land a heartbeat interval earlier — honor whichever arrives first."""
+        """Worker-self-reported unhealthy OR quarantined (instance-key
+        heartbeat or reply piggyback). Unhealthy/quarantined workers also
+        self-drain, but the piggyback can land a heartbeat interval earlier
+        — honor whichever arrives first. Quarantine (docs/resilience.md
+        §Silent corruption) excludes harder than unhealthy: the worker's
+        *outputs* are untrusted, not merely its latency."""
         info = self._instances.get(iid)
-        if info is not None and info.health == UNHEALTHY:
+        if info is not None and info.health in EXCLUDED_HEALTH:
             return True
         snap = self._loads.get(iid)
-        return snap is not None and snap.health == UNHEALTHY
+        return snap is not None and snap.health in EXCLUDED_HEALTH
 
     def _load_score(self, iid: str) -> float:
         snap = self._loads.get(iid)
@@ -1321,9 +1434,10 @@ class EndpointClient(AsyncEngine):
             await self._evict_conn(iid, conn or self._conns.get(iid))
             return
         self._last_rpc_seen[iid] = time.monotonic()
-        if pong.get("health") == UNHEALTHY:
+        if pong.get("health") in EXCLUDED_HEALTH:
             # the worker answered (liveness proven — no breaker penalty)
-            # but diagnosed itself unhealthy: keep it out of rotation
+            # but diagnosed itself unhealthy/quarantined: keep it out of
+            # rotation
             self.stats["probe_failures"] += 1
             self._probe_failed[iid] = time.monotonic()
             return
@@ -1949,6 +2063,10 @@ async def attach_kv_publishing(
     bridge = KvPublishBridge(ns, worker_id)
     if bind_events and hasattr(engine, "set_event_sink"):
         engine.set_event_sink(bridge)
+    if getattr(engine, "_fault_addr", None) == "engine":
+        # label the engine's corrupt/poison fault gates with the stable
+        # worker id so a drill can target ONE worker in a fleet
+        engine._fault_addr = worker_id
     server = ns.runtime._rpc_server
     if (
         bind_admission and server is not None
@@ -2070,6 +2188,19 @@ async def attach_kv_publishing(
                     snap.setdefault("migrations_total", m_ok)
                     snap.setdefault("migrations_failed_total", m_bad)
                     snap.setdefault("migrate_kv_blocks_moved_total", m_blocks)
+                # integrity plane (docs/resilience.md §Silent corruption):
+                # process-global trip counters — zeros until anything ever
+                # tripped, constructor-free (the zero-overhead guard)
+                integ = _sys.modules.get("dynamo_tpu.runtime.integrity")
+                if integ is not None:
+                    ic = integ.counters()
+                    snap.setdefault(
+                        "kv_integrity_failures_total",
+                        ic["kv_integrity_failures_total"],
+                    )
+                    snap.setdefault(
+                        "watchdog_trips_total", ic["watchdog_trips_total"]
+                    )
                 if server is not None and bind_admission:
                     # the co-hosted RPC server's counters belong to the
                     # publisher that OWNS it; a bind_admission=False

@@ -39,20 +39,37 @@ import json
 import logging
 import os
 import random
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
-PLANES = ("statestore", "bus", "rpc", "transfer")
+PLANES = ("statestore", "bus", "rpc", "transfer", "engine")
 ACTIONS = ("refuse", "delay", "reset", "stall", "wedge", "cut", "blackout",
-           "migrate_stall")
-POINTS = ("connect", "read", "write", "serve", "item", "migrate")
+           "migrate_stall", "corrupt", "poison")
+POINTS = ("connect", "read", "write", "serve", "item", "migrate", "pages",
+          "dispatch")
+
+# the decision log is bounded (PR8 decision-ring pattern): a soak run with
+# a high-frequency rule fires millions of decisions — the replay log must
+# stay a window, not a leak
+FAULT_LOG_MAX = 256
 
 # the planes a bare "blackout" kills: the whole control plane at once
 # (discovery + events), leaving the RPC/transfer data planes alive — the
 # docs/resilience.md §Control-plane blackout drill
 CONTROL_PLANES = ("statestore", "bus")
+
+
+class _BoundedLog(deque):
+    """Bounded decision log that still answers the list idioms chaos tests
+    use in their failure messages (``log[-10:]``)."""
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return list(self)[i]
+        return deque.__getitem__(self, i)
 
 
 class StreamCut(ConnectionResetError):
@@ -142,9 +159,13 @@ class FaultInjector:
         self.rules: List[FaultRule] = list(rules or [])
         self.seed = seed
         self.rng = random.Random(seed)
-        self.log: List[FaultDecision] = []
+        # bounded: one entry per FIRED decision, forever, was a leak under
+        # soak-length runs with per-frame rules; the newest FAULT_LOG_MAX
+        # decisions are plenty to replay a failure (plus the seed)
+        self.log: "deque[FaultDecision]" = _BoundedLog(maxlen=FAULT_LOG_MAX)
         self._connect_ops: Dict[Tuple[str, str], int] = {}
         self._serve_ops: Dict[Tuple[str, str], int] = {}
+        self._sync_ops: Dict[Tuple[str, str, str], int] = {}
         self._stall_release = asyncio.Event()
         self._wedge_release = asyncio.Event()
         # blackout machinery: the refuse/reset rules currently simulating a
@@ -327,6 +348,38 @@ class FaultInjector:
         rule = self.decide(plane, addr, "item", index)
         if rule is not None:
             await self._apply(rule, f"item {plane} {addr} #{index}")
+
+    def decide_sync(self, plane: str, addr: str, point: str,
+                    action: str) -> bool:
+        """Synchronous decision for data-mutating faults (``corrupt`` /
+        ``poison``): returns True when a matching rule of exactly that
+        action fired. Matching filters on the action BEFORE consuming the
+        rule — a differently-actioned rule at the same point must neither
+        burn its max_fires budget nor log a decision it never applied.
+        Counted on a per-(plane, addr, point) op counter so ``after_ops``
+        reads "let N page sets / dispatches through". Safe from any thread
+        that owns its call site (the engine thread for ``dispatch``/
+        host-tier ``pages``; the event loop for wire ``pages``) — rule
+        bookkeeping is GIL-atomic appends/increments."""
+        key = (plane, addr, point)
+        op = self._sync_ops.get(key, 0)
+        self._sync_ops[key] = op + 1
+        for rule in self.rules:
+            if rule.action != action:
+                continue
+            if not rule.matches(plane, addr, point, op):
+                continue
+            if (
+                rule.probability < 1.0
+                and self.rng.random() >= rule.probability
+            ):
+                continue
+            rule.fired += 1
+            self.log.append(
+                FaultDecision(plane, addr, point, op, rule.action)
+            )
+            return True
+        return False
 
     async def before_migrate(self, plane: str, addr: str) -> None:
         """Per-migration gate (drain coordinator, once per stream shipped):
@@ -547,6 +600,56 @@ async def item_gate(plane: str, addr: str, index: int) -> None:
     inj = current()
     if inj is not None:
         await inj.before_item(plane, addr, index)
+
+
+def corrupt_pages(plane: str, addr: str, body: bytes) -> bytes:
+    """Silent-corruption drill (docs/resilience.md §Silent corruption): the
+    ``corrupt`` action at point ``pages`` bit-flips one byte in the middle
+    of a packed KV page body — deterministic (fixed offset, fixed bit), so
+    a replayed schedule corrupts the same block. Applied AFTER the sender
+    computed its content checksums, which is exactly the post-seal SDC the
+    checksum plane exists to catch; the receiver's verify turns the flip
+    into a typed :class:`~dynamo_tpu.runtime.integrity.KvIntegrityError`
+    instead of corrupt pool pages. No injector ⇒ the caller pre-checks
+    :func:`current` (one None-check)."""
+    inj = current()
+    if inj is None or not body:
+        return body
+    if not inj.decide_sync(plane, addr, "pages", "corrupt"):
+        return body
+    i = len(body) // 2
+    return body[:i] + bytes([body[i] ^ 0x01]) + body[i + 1:]
+
+
+def corrupt_array(plane: str, addr: str, arr):
+    """Host-tier form of :func:`corrupt_pages`: bit-flips one byte of a
+    numpy page array (the host KV pool's copy of an evicted block) — the
+    "bad host RAM" leg of the silent-corruption drill. Returns the (copied)
+    corrupted array when the rule fires, the original otherwise."""
+    inj = current()
+    if inj is None:
+        return arr
+    if not inj.decide_sync(plane, addr, "pages", "corrupt"):
+        return arr
+    import numpy as np
+
+    out = np.array(arr)  # device_get views may be read-only
+    flat = out.view(np.uint8).reshape(-1)
+    flat[len(flat) // 2] ^= 0x01
+    return out
+
+
+def poison_gate(plane: str, addr: str) -> bool:
+    """Engine-dispatch gate for the ``poison`` action at point
+    ``dispatch``: True ⇒ this dispatch's logits are overwritten with NaN
+    in-jit (the engine's watchdog input), modelling a core that computes
+    garbage — the output watchdog must catch the lane before any token
+    reaches a client. Synchronous: called from the engine thread once per
+    dispatch, one None-check when no injector is installed."""
+    inj = current()
+    if inj is None:
+        return False
+    return inj.decide_sync(plane, addr, "dispatch", "poison")
 
 
 async def open_connection(host: str, port: int, plane: str = "rpc"):

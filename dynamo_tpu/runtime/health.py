@@ -54,12 +54,22 @@ logger = logging.getLogger(__name__)
 HEALTHY = "healthy"
 DEGRADED = "degraded"
 UNHEALTHY = "unhealthy"
-STATES = (HEALTHY, DEGRADED, UNHEALTHY)
+# quarantined (docs/resilience.md §Silent corruption): the integrity plane
+# latched — this worker's outputs/pages are untrusted. Routers exclude it
+# like unhealthy, it self-drains, and UNLIKE unhealthy it never recovers by
+# passing checks: only `llmctl worker unquarantine` (or the trips source
+# clearing) re-admits it, because a host that silently corrupts data does
+# not become trustworthy by being briefly quiet.
+QUARANTINED = "quarantined"
+STATES = (HEALTHY, DEGRADED, UNHEALTHY, QUARANTINED)
 
 # drain source the monitor uses with DistributedRuntime.set_draining — kept
 # distinct from "local" (SIGUSR1) and "store" (llmctl) so a self-heal never
 # cancels an operator's drain and vice versa
 DRAIN_SOURCE = "health"
+# quarantine uses its OWN drain source: an unquarantine must not cancel a
+# health/operator drain, and a health recovery must not undo a quarantine
+QUARANTINE_SOURCE = "quarantine"
 
 
 @dataclass
@@ -264,7 +274,15 @@ class HealthMonitor:
                 "> %.1fs", self.policy.stall_timeout,
             )
         self._stalled = stalled
-        if stalled or sub_unhealthy:
+        # the quarantine latch (runtime/integrity.py) outranks everything:
+        # a worker producing corrupt KV/logits must not look merely
+        # "degraded" — and must not recover by passing ordinary checks.
+        # Constructor-free read: one module-global check per tick.
+        from dynamo_tpu.runtime import integrity
+
+        if integrity.quarantined():
+            candidate = QUARANTINED
+        elif stalled or sub_unhealthy:
             candidate = UNHEALTHY
         elif lag > self.policy.loop_lag_threshold:
             candidate = DEGRADED
@@ -274,7 +292,13 @@ class HealthMonitor:
         return self.state
 
     def _transition(self, new: str) -> None:
-        if self.state == UNHEALTHY and new != UNHEALTHY:
+        if new == QUARANTINED or self.state == QUARANTINED:
+            # no hysteresis either way: latching quarantine is immediate
+            # (every check until the latch clears re-candidates it), and
+            # LEAVING it is an operator decision already made — the
+            # integrity tracker was explicitly cleared
+            self._healthy_streak = 0
+        elif self.state == UNHEALTHY and new != UNHEALTHY:
             # hysteresis: one good check must not flap an unhealthy worker
             # back into rotation — require a full recovery streak
             self._healthy_streak += 1
@@ -288,6 +312,14 @@ class HealthMonitor:
         log = logger.warning if new != HEALTHY else logger.info
         log("worker health: %s -> %s", old, new)
         if self.set_draining is not None:
+            if new == QUARANTINED:
+                # quarantine self-drain: routers stop dispatching, and the
+                # migration coordinator sees the latch and degrades the
+                # drain to resume directives — untrusted pages never
+                # replicate into healthy siblings
+                self.set_draining(True, source=QUARANTINE_SOURCE)
+            elif old == QUARANTINED:
+                self.set_draining(False, source=QUARANTINE_SOURCE)
             if new == UNHEALTHY:
                 # self-drain: routers stop dispatching here, in-flight
                 # streams finish; the statestore registration stays (the

@@ -89,6 +89,20 @@ def _no_leaked_migrations():
 
 
 @pytest.fixture(autouse=True)
+def _reset_integrity_state():
+    """Drop the process-global integrity tracker after each test: one
+    test's corruption trips or quarantine latch must not leave a later
+    test's health checks reading 'quarantined' (imported lazily — the
+    control-plane reset pattern above)."""
+    yield
+    import sys
+
+    integ = sys.modules.get("dynamo_tpu.runtime.integrity")
+    if integ is not None:
+        integ.reset_for_tests()
+
+
+@pytest.fixture(autouse=True)
 def _no_leaked_health_monitors():
     """Fail any test that leaves a HealthMonitor check task running past
     teardown: a leaked monitor keeps reaping/draining state in the

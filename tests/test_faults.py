@@ -365,3 +365,77 @@ class TestRecoveryLoops:
             await ss.stop()
 
         run(go())
+
+
+class TestBoundedLogAndDataFaults:
+    def test_decision_log_is_bounded(self):
+        """ISSUE 14 satellite: the decision log is a bounded ring (the PR8
+        decision-ring pattern) — a soak run with a per-frame rule must not
+        grow one entry per fired decision forever."""
+        from dynamo_tpu.runtime.faults import FAULT_LOG_MAX
+
+        inj = FaultInjector([FaultRule(plane="rpc", point="connect",
+                                       action="delay", delay=0.0)])
+        for i in range(FAULT_LOG_MAX * 3):
+            assert inj.decide("rpc", "a:1", "connect", i) is not None
+        assert len(inj.log) == FAULT_LOG_MAX
+        # newest entries retained; list idioms (slices) still answer
+        assert inj.log[-1].op_index == FAULT_LOG_MAX * 3 - 1
+        assert len(inj.log[-10:]) == 10
+
+    def test_corrupt_pages_flips_one_bit_deterministically(self):
+        body = bytes(range(64))
+        inj = FaultInjector([FaultRule(
+            plane="transfer", point="pages", action="corrupt",
+            match_addr="w0", after_ops=1,
+        )])
+        with faults.active(inj):
+            # op 0 skipped (after_ops=1), op 1 fires, wrong addr never
+            assert faults.corrupt_pages("transfer", "w0", body) == body
+            out = faults.corrupt_pages("transfer", "w0", body)
+            assert out != body and len(out) == len(body)
+            assert sum(a != b for a, b in zip(out, body)) == 1
+            assert faults.corrupt_pages("transfer", "other", body) == body
+        # no injector ⇒ identity
+        assert faults.corrupt_pages("transfer", "w0", body) == body
+
+    def test_corrupt_array_copies_and_flips(self):
+        import numpy as np
+
+        arr = np.zeros((4, 8), np.float32)
+        arr.setflags(write=False)  # device_get views may be read-only
+        inj = FaultInjector([FaultRule(
+            plane="engine", point="pages", action="corrupt",
+        )])
+        with faults.active(inj):
+            out = faults.corrupt_array("engine", "w0", arr)
+        assert out is not arr
+        assert (out != arr).sum() >= 1
+        assert (arr == 0).all()  # original untouched
+
+    def test_sync_decide_filters_on_action(self):
+        """A differently-actioned rule at the same point must neither fire
+        nor burn its max_fires budget when a corrupt/poison gate consults
+        the injector (review hardening: decide_sync matches on action)."""
+        body = bytes(range(16))
+        delay_rule = FaultRule(plane="transfer", point="pages",
+                               action="delay", delay=0.5, max_fires=2)
+        corrupt_rule = FaultRule(plane="transfer", point="pages",
+                                 action="corrupt")
+        inj = FaultInjector([delay_rule, corrupt_rule])
+        with faults.active(inj):
+            out = faults.corrupt_pages("transfer", "w0", body)
+        assert out != body            # the corrupt rule (listed second) fired
+        assert delay_rule.fired == 0  # the delay rule kept its budget
+        assert corrupt_rule.fired == 1
+        assert [d.action for d in inj.log] == ["corrupt"]
+
+    def test_poison_gate_counts_dispatches(self):
+        inj = FaultInjector([FaultRule(
+            plane="engine", point="dispatch", action="poison",
+            after_ops=2, max_fires=1,
+        )])
+        with faults.active(inj):
+            fired = [faults.poison_gate("engine", "w0") for _ in range(5)]
+        assert fired == [False, False, True, False, False]
+        assert not faults.poison_gate("engine", "w0")  # uninstalled

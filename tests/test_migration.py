@@ -760,6 +760,72 @@ class TestClientReHome:
         run(go())
 
 
+class TestCorruptDuringDrain:
+    def test_corrupt_pages_mid_drain_degrade_to_resume_untorn(
+        self, tiny, run, monkeypatch
+    ):
+        """ISSUE 14 satellite: the ``corrupt`` fault fired DURING a PR12
+        drain — the in-flight migration must abort with the typed
+        KvIntegrityError, degrade to resume, stay byte-equal, and leave NO
+        torn staged entry on the target (its pool is untouched)."""
+        from dynamo_tpu.runtime import integrity
+
+        # keep the quarantine latch out of this focused regression: the
+        # trip threshold is a separate concern (tests/test_integrity.py)
+        monkeypatch.setenv("DYN_TPU_INTEGRITY_TRIPS", "1000")
+
+        async def go():
+            integrity.reset_for_tests()
+            mig_mod.reset_migration_counters()
+            ss, rts, engines, coords, fe, client = await _mig_cluster(tiny)
+            [golden] = await _goldens(tiny, [list(range(6, 30))], 24)
+            target_free = {
+                i: engines[i].allocator.free_blocks for i in range(2)
+            }
+            inj = FaultInjector([FaultRule(
+                plane="transfer", point="pages", action="corrupt",
+            )])
+            with faults.active(inj):
+                task = asyncio.create_task(
+                    _stream(client, list(range(6, 30)), 24)
+                )
+                while not any(e.live_request_count() for e in engines):
+                    await asyncio.sleep(0.02)
+                await asyncio.sleep(0.25)
+                victim = _victim_of(rts, engines)
+                rts[victim].set_draining(True)
+                toks, errs, ctx = await asyncio.wait_for(task, 60)
+            assert errs == []
+            assert toks == golden, "corrupt bytes reached the client"
+            # planned degradation: rides journal.migrations, typed all the way
+            j = ctx.context.journal
+            assert j is not None and j.migrations == 1 and j.resumes == 0
+            assert client.stats["migration_resumes"] == 1
+            assert client.stats["migrations"] == 0
+            m_ok, m_bad, _ = mig_mod.migration_counters()
+            assert m_ok == 0 and m_bad >= 1
+            # the SOURCE counted the trip against itself (nack teaches it)
+            assert integrity.counters()["kv_integrity_failures_total"] >= 1
+            # no torn staged entry: the target staged nothing, its pool is
+            # exactly where it started once the stream finished
+            other = 1 - victim
+            snap = engines[other].metrics_snapshot()
+            assert snap["migrate_staged"] == 0
+            assert snap["migrated_in_requests"] == 0
+            await _wait_drained(rts, engines, victim, timeout=10)
+            deadline = asyncio.get_running_loop().time() + 10.0
+            while (engines[other].live_request_count()
+                   and asyncio.get_running_loop().time() < deadline):
+                await asyncio.sleep(0.05)
+            # nothing left hard-held: no leaked staged allocation anywhere
+            assert engines[other].allocator.active_blocks == 0
+            assert target_free[other] > 0  # sanity: the pool existed
+            await _teardown(ss, rts, engines, fe, client)
+            integrity.reset_for_tests()
+
+        run(go())
+
+
 # -- THE chaos gate ------------------------------------------------------------
 
 

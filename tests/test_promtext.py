@@ -241,6 +241,8 @@ def test_worker_aggregator_exposition_valid():
         "dynamo_worker_kv_peak_occupancy_perc",
         "dynamo_worker_requests_total",
         "dynamo_worker_requests_errored",
+        "dynamo_worker_kv_integrity_failures_total",
+        "dynamo_worker_watchdog_trips_total",
         "dynamo_worker_phase_latency_ms",
         "dynamo_worker_uptime_seconds",
         "dynamo_worker_up",
@@ -266,6 +268,9 @@ def test_cluster_telemetry_exposition_valid():
         "dynamo_cluster_slo_compliance",
         "dynamo_cluster_slo_burn_rate",
         "dynamo_cluster_slo_alert",
+        "dynamo_cluster_kv_integrity_failures_total",
+        "dynamo_cluster_watchdog_trips_total",
+        "dynamo_cluster_workers_quarantined",
     ):
         assert family in fams, f"missing family {family}"
 
@@ -288,3 +293,33 @@ def test_frontend_with_cluster_section_still_valid():
         telemetry.set_cluster(None)
     assert "dynamo_cluster_workers" in fams
     assert "dynamo_frontend_requests_total" in fams
+
+
+def test_quarantined_worker_exposition_valid():
+    """A quarantined mock worker (the TPU-less drill: --health-state
+    quarantined --integrity-failures N) renders grammar-valid worker AND
+    cluster expositions with the integrity families populated."""
+    agg = MetricsAggregator("ns")
+    stats = MockWorkerStats(
+        seed=4, integrity_failures=7, watchdog_trips=2,
+        health_state="quarantined",
+    )
+    stats.tick(requests=3)
+    m = ForwardPassMetrics.from_dict(stats.metrics("m1").to_dict())
+    agg.update("w-bad", m)
+    text = agg.render()
+    fams = parse_prometheus_text(text)
+    assert fams["dynamo_worker_kv_integrity_failures_total"]["samples"]
+    # quarantined maps to health_state 3 (graver than unhealthy=2)
+    assert 'dynamo_worker_health_state{namespace="ns",worker="w-bad"} 3' \
+        in text
+
+    ct = ClusterTelemetry(
+        "ns", policy=telemetry.TelemetryPolicy(
+            fast_window=10, mid_window=20, slow_window=40,
+        ),
+    )
+    ct.ingest("w-bad", m)
+    cfams = parse_prometheus_text(ct.render_prometheus())
+    assert cfams["dynamo_cluster_workers_quarantined"]["samples"]
+    assert cfams["dynamo_cluster_kv_integrity_failures_total"]["samples"]
