@@ -1526,6 +1526,278 @@ def bench_blackout() -> dict:
     }
 
 
+def bench_profiling() -> dict:
+    """The profiling plane's tax and its books (docs/observability.md
+    §Profiling; tiny REAL engine on the host platform). Legs 1/2:
+    identical decode load with DYN_TPU_PROFILE off vs on (default
+    sampling) — the on/off tok/s ratio IS the steady-state overhead the
+    acceptance bounds at <2% on chips. Leg 3: a sample-every-dispatch
+    capture whose decode device+host split must cover the sampled wall
+    span (the ±10% books check `llmctl profile capture` relies on).
+    BENCH_PROFILING=0 skips."""
+    import asyncio
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+    from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+    from dynamo_tpu.runtime import profiling as profiling_mod
+    from dynamo_tpu.runtime.engine import Context
+
+    n_requests = int(os.environ.get("BENCH_PROFILING_REQUESTS", "8"))
+    gen_tokens = int(os.environ.get("BENCH_PROFILING_TOKENS", "96"))
+    prompt_len = int(os.environ.get("BENCH_PROFILING_PROMPT", "64"))
+    # restore the CALLER's knobs afterwards (the bench_integrity pattern):
+    # a user benching with DYN_TPU_PROFILE=1 must not have later sections
+    # silently lose their profiling because this one popped the var
+    prior = {
+        k: os.environ.get(k)
+        for k in ("DYN_TPU_PROFILE", "DYN_TPU_PROFILE_SAMPLE")
+    }
+
+    cfg = dataclasses.replace(LLAMA_PRESETS["tiny"], dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompts = [
+        [(7 * i + 3 + j) % 101 for j in range(prompt_len)]
+        for i in range(n_requests)
+    ]
+
+    async def collect(eng, toks):
+        out = []
+        async for item in eng.generate(Context({
+            "token_ids": list(toks),
+            "stop_conditions": {"max_tokens": gen_tokens,
+                                "ignore_eos": True},
+            "sampling_options": {"temperature": 0.0},
+        })):
+            if item.is_error:
+                raise RuntimeError(item.error_message())
+            out.extend((item.data or {}).get("token_ids", []))
+        return out
+
+    def leg(profile: bool, sample: str = "") -> tuple:
+        if profile:
+            os.environ["DYN_TPU_PROFILE"] = "1"
+        else:
+            os.environ.pop("DYN_TPU_PROFILE", None)
+        if sample:
+            os.environ["DYN_TPU_PROFILE_SAMPLE"] = sample
+        else:
+            os.environ.pop("DYN_TPU_PROFILE_SAMPLE", None)
+        profiling_mod.reset_for_tests()
+        eng = JaxServingEngine(cfg, params, EngineConfig(
+            max_slots=4, kv_block_size=8,
+            max_model_len=prompt_len + gen_tokens + 16,
+        ))
+
+        async def run_all():
+            await collect(eng, prompts[0])  # warm the compiles out
+            t0 = time.perf_counter()
+            outs = await asyncio.gather(
+                *[collect(eng, p) for p in prompts]
+            )
+            return outs, time.perf_counter() - t0
+
+        outs, wall = asyncio.run(run_all())
+        eng.close()
+        toks = sum(len(o) for o in outs)
+        return round(toks / wall, 1), round(wall, 3)
+
+    try:
+        tps_off, wall_off = leg(False)
+        tps_on, wall_on = leg(True)  # default sampling stride
+
+        # books leg: sample EVERY dispatch, then audit the decode split
+        tps_full, _ = leg(True, sample="1")
+        tl = profiling_mod.maybe_timeline()
+        summary = tl.summary() if tl is not None else {}
+        recs = [
+            r for r in (tl.records() if tl is not None else [])
+            if r["phase"] == "decode"
+        ]
+        coverage = None
+        if len(recs) >= 8:
+            # consecutive-step pairs: the split must fill the gap between
+            # adjacent sampled dispatches (the ±10% acceptance check)
+            recs.sort(key=lambda r: r["ts"])
+            spans = busy = 0.0
+            for a, b in zip(recs, recs[1:]):
+                if b["step"] - a["step"] != 1:
+                    continue
+                gap = b["ts"] - a["ts"]
+                if gap <= 0:
+                    continue
+                spans += gap
+                busy += (a["host_us"] + a["device_us"] + a["post_us"]) / 1e6
+            coverage = round(busy / spans, 4) if spans > 0 else None
+        dec = (summary.get("phases") or {}).get("decode") or {}
+        return {
+            "decode_tps_profile_off": tps_off,
+            "decode_tps_profile_on": tps_on,
+            "overhead_ratio": round(tps_off / max(tps_on, 1e-9), 3),
+            "decode_tps_sample_every": tps_full,
+            "wall_off_s": wall_off, "wall_on_s": wall_on,
+            "device_us_p95": dec.get("device_us_p95"),
+            "host_us_p95": dec.get("host_us_p95"),
+            "device_idle_frac": summary.get("device_idle_frac"),
+            # host+device+post split over adjacent sampled dispatch gaps —
+            # MUST sit in [0.9, 1.02] for the capture to be trustworthy
+            "split_wall_coverage": coverage,
+        }
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        profiling_mod.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# machine-readable summary + CI regression gate (BENCH_SUMMARY.json)
+# ---------------------------------------------------------------------------
+
+# tracked metrics: (summary name, path into the bench JSON, direction).
+# Only metrics PRESENT in both baseline and current runs are compared, so
+# skipped sections (BENCH_*=0) never fail the gate.
+SUMMARY_SPECS = [
+    ("tok_s_per_chip", ("value",), "higher"),
+    ("roofline_fraction", ("roofline_fraction",), "higher"),
+    ("overall_fraction", ("overall_fraction",), "higher"),
+    ("mfu", ("mfu",), "higher"),
+    ("ttft_p50_ms", ("ttft_p50_ms",), "lower"),
+    ("ttft_p95_ms", ("ttft_p95_ms",), "lower"),
+    ("itl_p95_ms", ("itl_p95_ms",), "lower"),
+    ("frontend_tok_s", ("frontend", "frontend_tok_s"), "higher"),
+    ("frontend_cpu_us_per_token",
+     ("frontend", "frontend_cpu_us_per_token"), "lower"),
+    ("spec_speedup", ("spec_decode", "speedup"), "higher"),
+    ("integrity_overhead_ratio",
+     ("integrity", "overhead_ratio"), "lower"),
+    ("profiling_overhead_ratio",
+     ("profiling", "overhead_ratio"), "lower"),
+    ("profiling_split_coverage",
+     ("profiling", "split_wall_coverage"), "higher"),
+    ("migration_kv_blocks_moved",
+     ("migration", "migrate", "kv_blocks_moved"), "higher"),
+    ("blackout_outage_tok_s_ratio",
+     ("blackout", "outage_tok_s_ratio"), "higher"),
+]
+
+
+def build_bench_summary(out: dict) -> dict:
+    """Flatten a bench JSON into the tracked-metric summary shape
+    ``bench.py --check`` compares (written beside the full output as
+    BENCH_SUMMARY.json)."""
+    metrics = {}
+    for name, path, better in SUMMARY_SPECS:
+        node = out
+        for key in path:
+            if not isinstance(node, dict) or key not in node:
+                node = None
+                break
+            node = node[key]
+        if isinstance(node, bool) or not isinstance(node, (int, float)):
+            continue
+        metrics[name] = {"value": float(node), "better": better}
+    return {
+        "schema": 1,
+        "model": out.get("model"),
+        "quantize": out.get("quantize"),
+        "chips": out.get("chips"),
+        "metrics": metrics,
+    }
+
+
+def check_bench_summary(
+    baseline: dict, current: dict, tolerance: float = 0.15
+) -> list:
+    """Compare two summaries; returns the regressions as
+    ``[(metric, base, cur, ratio)]``. A tracked metric regressed when it
+    moved past ``tolerance`` in its bad direction; metrics missing from
+    either side are skipped (a section the baseline never ran can't
+    regress)."""
+    base_m = baseline.get("metrics") or {}
+    cur_m = current.get("metrics") or {}
+    regressions = []
+    for name, base in base_m.items():
+        cur = cur_m.get(name)
+        if cur is None:
+            continue
+        bv, cv = float(base["value"]), float(cur["value"])
+        if bv == 0:
+            continue
+        ratio = cv / bv
+        better = base.get("better", "higher")
+        if better == "higher" and ratio < 1.0 - tolerance:
+            regressions.append((name, bv, cv, round(ratio, 4)))
+        elif better == "lower" and ratio > 1.0 + tolerance:
+            regressions.append((name, bv, cv, round(ratio, 4)))
+    return regressions
+
+
+def write_bench_summary(out: dict) -> str:
+    path = os.environ.get("BENCH_SUMMARY_PATH", "BENCH_SUMMARY.json")
+    with open(path, "w") as f:
+        json.dump(build_bench_summary(out), f, indent=2, sort_keys=True)
+    return path
+
+
+def run_check(argv: list) -> int:
+    """``bench.py --check BASELINE.json [--summary BENCH_SUMMARY.json]
+    [--tolerance 0.15]``: the CI-scriptable perf gate — compares an
+    existing summary against a baseline WITHOUT running the bench (no
+    jax import), exit 2 on any tracked metric regressing past the
+    tolerance, 1 on unreadable inputs. A baseline holding a full bench
+    JSON (no "metrics" key) is summarized on the fly, so any historical
+    BENCH_rNN.json works as a baseline."""
+    try:
+        baseline_path = argv[argv.index("--check") + 1]
+        summary_path = "BENCH_SUMMARY.json"
+        if "--summary" in argv:
+            summary_path = argv[argv.index("--summary") + 1]
+        tolerance = float(os.environ.get("BENCH_CHECK_TOLERANCE", "0.15"))
+        if "--tolerance" in argv:
+            tolerance = float(argv[argv.index("--tolerance") + 1])
+    except (IndexError, ValueError) as e:
+        # a malformed invocation must exit 1 like unreadable inputs — a CI
+        # script keying on exit 2 = regression must not see a traceback
+        print(
+            f"bench --check usage: bench.py --check BASELINE.json "
+            f"[--summary BENCH_SUMMARY.json] [--tolerance 0.15] ({e})",
+            file=sys.stderr,
+        )
+        return 1
+    try:
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+        with open(summary_path) as f:
+            current = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench --check: cannot read inputs: {e}", file=sys.stderr)
+        return 1
+    if "metrics" not in baseline:
+        baseline = build_bench_summary(baseline)
+    if "metrics" not in current:
+        current = build_bench_summary(current)
+    regressions = check_bench_summary(baseline, current, tolerance)
+    compared = sorted(
+        set(baseline.get("metrics") or {}) & set(current.get("metrics") or {})
+    )
+    if regressions:
+        print(f"REGRESSION: {len(regressions)} tracked metric(s) moved "
+              f">{tolerance:.0%} the wrong way (of {len(compared)} "
+              f"compared):")
+        for name, bv, cv, ratio in regressions:
+            print(f"  {name:32s} {bv:g} -> {cv:g}  (x{ratio})")
+        return 2
+    print(f"ok: {len(compared)} tracked metric(s) within {tolerance:.0%} "
+          f"of {baseline_path}")
+    return 0
+
+
 def main() -> None:
     from dynamo_tpu.engine_jax.compile_cache import enable_compile_cache
 
@@ -1781,6 +2053,11 @@ def main() -> None:
             out["integrity"] = bench_integrity()
         except Exception as e:
             out["integrity"] = {"error": str(e)[:200]}
+    if os.environ.get("BENCH_PROFILING", "1") == "1":
+        try:
+            out["profiling"] = bench_profiling()
+        except Exception as e:
+            out["profiling"] = {"error": str(e)[:200]}
     # LAST: pays minutes of first-boot remote compilation on the tunneled
     # runtime — must not eat the other sections' budget if it times out
     if os.environ.get("BENCH_MODEL_8B", "1") == "1":
@@ -1790,7 +2067,15 @@ def main() -> None:
             out["model_8b"] = {"error": str(e)[:200]}
         _release_device_memory()
     print(json.dumps(out))
+    # machine-readable summary for the CI perf gate (bench.py --check):
+    # written beside the full JSON, never allowed to kill the bench
+    try:
+        write_bench_summary(out)
+    except OSError as e:
+        print(f"(bench summary not written: {e})", file=sys.stderr)
 
 
 if __name__ == "__main__":
+    if "--check" in sys.argv:
+        sys.exit(run_check(sys.argv))
     main()

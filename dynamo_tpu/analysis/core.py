@@ -24,6 +24,7 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tupl
 #                                        host syncs in engine hot paths
 _DISABLE_RE = re.compile(r"#\s*dynlint:\s*disable=([\w\-*]+(?:\s*,\s*[\w\-*]+)*)")
 _ALLOW_HOST_SYNC_RE = re.compile(r"#\s*dynlint:\s*allow-host-sync\b")
+_ALLOW_WALL_CLOCK_RE = re.compile(r"#\s*dynlint:\s*allow-wall-clock\b")
 
 
 @dataclass(frozen=True)
@@ -56,6 +57,9 @@ class Module:
     suppressions: Dict[int, Set[str]] = field(default_factory=dict)
     # lines carrying the allow-host-sync marker
     host_sync_allowed: Set[int] = field(default_factory=set)
+    # lines carrying the allow-wall-clock marker (intentional epoch reads
+    # in hot-path modules; see rules_jax.WallClockInHotPathRule)
+    wall_clock_allowed: Set[int] = field(default_factory=set)
 
     @property
     def dotted_name(self) -> str:
@@ -81,8 +85,13 @@ class Module:
     def allows_host_sync(self, line: int) -> bool:
         return line in self.host_sync_allowed
 
+    def allows_wall_clock(self, line: int) -> bool:
+        return line in self.wall_clock_allowed
 
-def _scan_comments(source: str) -> Tuple[Dict[int, Set[str]], Set[int]]:
+
+def _scan_comments(
+    source: str,
+) -> Tuple[Dict[int, Set[str]], Set[int], Set[int]]:
     """A trailing directive covers its own line; a directive on a standalone
     comment line covers the next non-blank, non-comment line (so multi-line
     annotation comments above a call work naturally).
@@ -103,7 +112,7 @@ def _scan_comments(source: str) -> Tuple[Dict[int, Set[str]], Set[int]]:
     except (tokenize.TokenError, IndentationError, SyntaxError):
         # ast.parse accepted the file, so this is near-unreachable; err on
         # the side of enforcement (no suppressions) rather than a bypass
-        return {}, set()
+        return {}, set(), set()
 
     standalone_rows = {row for row, _, standalone in comments if standalone}
 
@@ -117,6 +126,7 @@ def _scan_comments(source: str) -> Tuple[Dict[int, Set[str]], Set[int]]:
 
     suppressions: Dict[int, Set[str]] = {}
     allowed: Set[int] = set()
+    wall_clock: Set[int] = set()
     for lineno, text, standalone in comments:
         if "dynlint" not in text:
             continue
@@ -128,7 +138,10 @@ def _scan_comments(source: str) -> Tuple[Dict[int, Set[str]], Set[int]]:
         if _ALLOW_HOST_SYNC_RE.search(text):
             allowed.add(lineno)
             allowed.add(target)
-    return suppressions, allowed
+        if _ALLOW_WALL_CLOCK_RE.search(text):
+            wall_clock.add(lineno)
+            wall_clock.add(target)
+    return suppressions, allowed, wall_clock
 
 
 def load_module(abspath: str, root: str) -> Optional[Module]:
@@ -141,8 +154,10 @@ def load_module(abspath: str, root: str) -> Optional[Module]:
     except (SyntaxError, UnicodeDecodeError, OSError):
         return None
     relpath = os.path.relpath(abspath, root).replace(os.sep, "/")
-    suppressions, allowed = _scan_comments(source)
-    return Module(abspath, relpath, source, tree, suppressions, allowed)
+    suppressions, allowed, wall_clock = _scan_comments(source)
+    return Module(
+        abspath, relpath, source, tree, suppressions, allowed, wall_clock
+    )
 
 
 @dataclass
@@ -202,6 +217,7 @@ def all_rules() -> List[Rule]:
         ImportTimeJaxComputeRule,
         JitHostSyncRule,
         UnmarkedHostSyncRule,
+        WallClockInHotPathRule,
     )
     from dynamo_tpu.analysis.rules_metrics import MetricNameValidRule
     from dynamo_tpu.analysis.rules_protocol import EndpointProtocolDriftRule
@@ -215,6 +231,7 @@ def all_rules() -> List[Rule]:
         JitHostSyncRule(),
         UnmarkedHostSyncRule(),
         ImportTimeJaxComputeRule(),
+        WallClockInHotPathRule(),
         EndpointProtocolDriftRule(),
         MetricNameValidRule(),
     ]

@@ -62,6 +62,21 @@ _HOT_MODULE_SUFFIXES = ("engine_jax/engine.py",)
 _HOT_SYNC_EXACT = {"jax.device_get", "jax.block_until_ready"}
 _HOT_SYNC_METHODS = {"block_until_ready"}
 
+# hot-path modules where durations must come from the monotonic clocks:
+# time.time() is NTP-steppable (a slew mid-measurement makes a negative or
+# wildly wrong latency) and costs a vDSO epoch read the hot loop doesn't
+# need. Legitimate epoch reads (cross-process trace alignment, wire
+# timestamps) carry `# dynlint: allow-wall-clock(reason)`.
+_WALL_CLOCK_MODULE_SUFFIXES = (
+    "engine_jax/engine.py",
+    "engine_jax/allocator.py",
+    "llm/http/service.py",
+    "llm/http/metrics.py",
+    "llm/preprocessor.py",
+    "runtime/rpc.py",
+    "runtime/profiling.py",
+)
+
 _IMPORT_TIME_PREFIXES = ("jax.numpy.", "jax.random.", "jax.lax.")
 _IMPORT_TIME_EXACT = {
     "jax.device_put",
@@ -359,6 +374,38 @@ class UnmarkedHostSyncRule(Rule):
                     f"(reason)` if intentional, or hoist it off the decode "
                     f"loop",
                 )
+
+
+class WallClockInHotPathRule(Rule):
+    name = "wall-clock-in-hot-path"
+    description = (
+        "time.time() in a hot-path module where time.monotonic()/"
+        "perf_counter() is required: the wall clock is NTP-steppable, so "
+        "a duration measured across a step yields garbage latencies; "
+        "annotate intentional epoch reads (wire timestamps, cross-process "
+        "trace alignment) with `# dynlint: allow-wall-clock(reason)`"
+    )
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not module.relpath.endswith(_WALL_CLOCK_MODULE_SUFFIXES):
+            return
+        imports = collect_imports(ast.walk(module.tree), module.package)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if resolve_call(node.func, imports) != "time.time":
+                continue
+            if module.allows_wall_clock(node.lineno):
+                continue
+            yield Finding(
+                module.relpath,
+                node.lineno,
+                self.name,
+                "time.time() in a hot-path module; use time.monotonic()/"
+                "time.perf_counter() for durations, or annotate an "
+                "intentional epoch read with `# dynlint: "
+                "allow-wall-clock(reason)`",
+            )
 
 
 class ImportTimeJaxComputeRule(Rule):

@@ -12,6 +12,7 @@
     python -m dynamo_tpu.cli.llmctl worker undrain <dyn://ns.comp.ep> <worker_id|all>
     python -m dynamo_tpu.cli.llmctl trace dump [--limit N] [--worker ID] <dyn://ns.comp.ep>
     python -m dynamo_tpu.cli.llmctl trace show <dyn://ns.comp.ep> <trace_id>
+    python -m dynamo_tpu.cli.llmctl profile capture [--seconds N] [--json | --trace out.json] <dyn://ns.comp.ep>
     python -m dynamo_tpu.cli.llmctl slo status [--json] [dyn://ns.telemetry.status]
     python -m dynamo_tpu.cli.llmctl cluster status [--json] [dyn://ns.telemetry.status]
     python -m dynamo_tpu.cli.llmctl tenant status [--json] [dyn://ns.telemetry.status]
@@ -131,6 +132,29 @@ def build_parser() -> argparse.ArgumentParser:
     pst.add_argument("--json", action="store_true", dest="as_json")
     pst.add_argument("--limit", type=int, default=20,
                      help="newest N ring decisions to show (0 = all)")
+
+    prof = sub.add_parser(
+        "profile",
+        help="capture the fleet's performance-attribution timeline "
+             "(docs/observability.md §Profiling)",
+    )
+    pfv = prof.add_subparsers(dest="verb", required=True)
+    pcap = pfv.add_parser(
+        "capture",
+        help="wait a capture window, then pull every live worker's "
+             "dispatch timeline (DYN_TPU_PROFILE must be armed on the "
+             "workers); --trace writes a Perfetto-loadable Chrome-trace "
+             "JSON, --json prints the merged summaries",
+    )
+    pcap.add_argument("endpoint", help="dyn://ns.comp.ep")
+    pcap.add_argument("--seconds", type=float, default=2.0,
+                      help="capture window in seconds (default 2)")
+    pcap.add_argument("--json", action="store_true", dest="as_json")
+    pcap.add_argument("--trace", default=None, metavar="OUT.json",
+                      help="write the window as Chrome-trace JSON "
+                           "(load in ui.perfetto.dev or chrome://tracing)")
+    pcap.add_argument("--worker", default=None,
+                      help="only this worker id (from `worker list`)")
 
     trace = sub.add_parser("trace", help="dump/show worker request traces")
     tverbs = trace.add_subparsers(dest="verb", required=True)
@@ -364,6 +388,8 @@ async def amain(argv: list) -> int:
     try:
         if args.plane == "trace":
             return await _trace_cmd(args, store)
+        if args.plane == "profile":
+            return await _profile_cmd(args, store)
         if args.plane in ("slo", "cluster", "tenant", "control-plane"):
             return await _telemetry_cmd(args, store)
         if args.plane == "planner":
@@ -837,6 +863,133 @@ async def _planner_cmd(args, store) -> int:
                   f'status={d.get("status")} error={d.get("error", "")}')
         return 2
     return 0
+
+
+async def _profile_cmd(args, store) -> int:
+    """``profile capture``: sleep the capture window so the fleet records
+    live dispatches, then dial each live instance's RPC port and pull its
+    profiling state (the ``profile_dump`` verb). ``--trace`` merges every
+    worker's records into ONE Perfetto-loadable Chrome-trace JSON (one
+    process per worker, one track per engine phase); ``--json`` prints the
+    merged summaries; the default renders a per-worker table — read
+    ``device_idle_frac`` first (docs/observability.md §Profiling runbook).
+    Exit 1 when no worker is reachable; workers that answer with the
+    profiling plane off are listed so the operator knows to arm
+    DYN_TPU_PROFILE, not to distrust an empty capture."""
+    import asyncio
+
+    from dynamo_tpu.runtime import profiling
+    from dynamo_tpu.runtime.distributed import InstanceInfo, parse_endpoint_path
+    from dynamo_tpu.runtime.rpc import RpcClient, WorkerStalled
+
+    import time as _time
+
+    ns, comp, ep = parse_endpoint_path(args.endpoint)
+    base = f"{ns}/components/{comp}/endpoints/{ep}"
+    window = max(float(args.seconds), 0.0)
+    # anchor BEFORE the sleep: each dial computes its since_s from real
+    # elapsed time, so an unreachable earlier worker burning its connect
+    # timeout can't push a later worker's window filter past the records
+    # it made during the capture
+    t0 = _time.monotonic()
+    if window > 0:
+        await asyncio.sleep(window)
+    entries = await store.get_prefix(f"{base}/instances/")
+    want_worker = getattr(args, "worker", None)
+    captures: dict = {}   # worker_id → profile_dump payload
+    disarmed: list = []
+    for key in sorted(entries):
+        try:
+            info = InstanceInfo.from_json(entries[key])
+        except (ValueError, KeyError):
+            continue
+        if want_worker is not None and info.worker_id != want_worker:
+            continue
+        if info.worker_id in captures:
+            continue  # one dump per worker (chat+completions twins)
+        try:
+            client = await RpcClient.connect(info.address, timeout=5.0)
+        except (ConnectionError, OSError) as e:
+            print(f"(worker {info.worker_id} at {info.address} unreachable: "
+                  f"{e})", file=sys.stderr)
+            continue
+        try:
+            # elapsed-so-far + margin: records made just before the sleep
+            # started must not fall off the edge, however long earlier
+            # dials took
+            state = await client.profile_dump(
+                since_s=(_time.monotonic() - t0) + 0.5
+                if window > 0 else None
+            )
+        except (ConnectionError, OSError, WorkerStalled) as e:
+            print(f"(profile dump from {info.worker_id} failed: {e})",
+                  file=sys.stderr)
+            continue
+        finally:
+            await client.close()
+        if not state.get("enabled"):
+            disarmed.append(info.worker_id)
+        captures[info.worker_id] = state
+    if not captures:
+        print(f"(no reachable workers at {args.endpoint})", file=sys.stderr)
+        return 1
+    if args.trace:
+        trace = profiling.to_chrome_trace([
+            (wid, st.get("records", []), st.get("events", []))
+            for wid, st in sorted(captures.items())
+        ])
+        await asyncio.to_thread(_write_text, args.trace, json.dumps(trace))
+        n_slices = sum(
+            1 for e in trace["traceEvents"] if e.get("ph") == "X"
+        )
+        print(f"wrote {args.trace}: {len(captures)} worker(s), "
+              f"{n_slices} slices over ~{window:.1f}s — load it at "
+              f"ui.perfetto.dev")
+    if args.as_json:
+        print(json.dumps({
+            wid: {
+                "enabled": st.get("enabled", False),
+                "summary": st.get("summary", {}),
+                "frontend_cpu_us_per_token":
+                    st.get("frontend_cpu_us_per_token"),
+                "event_loop_lag_ms": st.get("event_loop_lag_ms"),
+            }
+            for wid, st in sorted(captures.items())
+        }, indent=2))
+    elif not args.trace:
+        for wid, st in sorted(captures.items()):
+            s = st.get("summary") or {}
+            if not st.get("enabled"):
+                print(f"{wid:14s} profiling OFF (set DYN_TPU_PROFILE=1)")
+                continue
+            idle = s.get("device_idle_frac", 0.0)
+            print(
+                f"{wid:14s} idle_frac={idle:.3f} "
+                f"dispatches={s.get('dispatches_total', 0)} "
+                f"sampled={s.get('sampled_total', 0)} "
+                f"recompiles={s.get('jit_compiles_total', 0)}"
+            )
+            for phase, p in sorted((s.get("phases") or {}).items()):
+                print(
+                    f"  {phase:8s} n={p['count']:5d} "
+                    f"device p50={p['device_us_p50']:>9.1f}us "
+                    f"p95={p['device_us_p95']:>9.1f}us | "
+                    f"host p50={p['host_us_p50']:>8.1f}us "
+                    f"p95={p['host_us_p95']:>8.1f}us "
+                    f"(alloc p95={p['alloc_us_p95']:.1f}us)"
+                )
+    if disarmed:
+        print(
+            f"note: {len(disarmed)} worker(s) have profiling off: "
+            + ", ".join(disarmed), file=sys.stderr,
+        )
+    return 0
+
+
+def _write_text(path: str, payload: str) -> None:
+    """Sync file write, run off the event loop via asyncio.to_thread."""
+    with open(path, "w") as f:
+        f.write(payload)
 
 
 async def _trace_cmd(args, store) -> int:

@@ -84,6 +84,12 @@ GAUGES = [
     # the quarantine plane's raw signal
     ("kv_integrity_failures_total", "KV blocks that failed content checksums, attributable to this worker (cumulative)"),
     ("watchdog_trips_total", "Lanes ended by the output watchdog for non-finite/exploding logits (cumulative)"),
+    # performance attribution plane (docs/observability.md §Profiling):
+    # decode-dispatch device/host p95 split + device idle fraction from the
+    # worker's DYN_TPU_PROFILE timeline (zeros with profiling off)
+    ("dispatch_device_us_p95", "Decode dispatch block-until-ready device time p95 (us)"),
+    ("dispatch_host_overhead_us_p95", "Decode dispatch host-side overhead p95 (us)"),
+    ("device_idle_frac", "Fraction of the sampled window the device sat idle between dispatches"),
 ]
 
 # health_state is a string on the wire; Prometheus wants a number. Unknown

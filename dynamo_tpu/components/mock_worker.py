@@ -110,6 +110,9 @@ class MockWorkerStats:
         integrity_failures: int = 0,
         watchdog_trips: int = 0,
         health_state: str = "healthy",
+        dispatch_device_us: float = 0.0,
+        jit_recompiles: int = 6,
+        device_idle_frac: float = 0.0,
     ):
         from dynamo_tpu.runtime.tracing import PHASE_BUCKETS
 
@@ -170,6 +173,15 @@ class MockWorkerStats:
                                 "quarantined")
             else "healthy"
         )
+        # profiling-plane drill (docs/observability.md §Profiling): report
+        # a nonzero dispatch device-time p95 / idle fraction / recompile
+        # count so the dynamo_{worker,cluster}_dispatch_* gauges and
+        # `llmctl profile` aggregation render TPU-less. A healthy engine
+        # compiles its variants once at boot — jit_recompiles defaults to
+        # that shape; raise it to drill the recompile-storm dashboards.
+        self.dispatch_device_us = max(float(dispatch_device_us), 0.0)
+        self.jit_recompiles = max(int(jit_recompiles), 0)
+        self.device_idle_frac = min(max(float(device_idle_frac), 0.0), 1.0)
         # multi-tenant QoS drill (docs/qos.md): tenant → per-tick request
         # share. Each tick splits its requests across tenants by share and
         # grows per-tenant counters + occupancy splits, so aggregator /
@@ -326,7 +338,13 @@ class MockWorkerStats:
             decode_tokens_per_s=round(self.active / itl_s, 1),
             step_time_ms=round(self.itl_ms * (0.9 + 0.2 * self.rng.random()), 2),
             batch_slot_util=round(self.active / self.slots_total, 3),
-            jit_recompiles=6,  # a healthy engine compiles its variants once
+            jit_recompiles=self.jit_recompiles,
+            dispatch_device_us_p95=round(self.dispatch_device_us, 1),
+            # host overhead rides the drill at a realistic ~15% of device
+            dispatch_host_overhead_us_p95=round(
+                self.dispatch_device_us * 0.15, 1
+            ),
+            device_idle_frac=round(self.device_idle_frac, 4),
             kv_peak_occupancy_perc=round(
                 max(blocks / self.blocks_total, 0.5), 3
             ),
@@ -409,6 +427,9 @@ async def run_mock_worker(
     integrity_failures: int = 0,
     watchdog_trips: int = 0,
     health_state: str = "healthy",
+    dispatch_device_us: float = 0.0,
+    jit_recompiles: int = 6,
+    device_idle_frac: float = 0.0,
 ) -> None:
     from dynamo_tpu.runtime.distributed import KV_METRICS_SUBJECT
 
@@ -426,6 +447,9 @@ async def run_mock_worker(
         integrity_failures=integrity_failures,
         watchdog_trips=watchdog_trips,
         health_state=health_state,
+        dispatch_device_us=dispatch_device_us,
+        jit_recompiles=jit_recompiles,
+        device_idle_frac=device_idle_frac,
     )
     tick_no = 0
     while True:
@@ -505,6 +529,16 @@ def main() -> None:
                         "control-plane status` exit-2 and the "
                         "dynamo_*_control_plane gauges without killing a "
                         "statestore)")
+    p.add_argument("--dispatch-device-us", type=float, default=0.0,
+                   help="report this decode-dispatch device-time p95 "
+                        "(drills the dynamo_*_dispatch_* profiling gauges "
+                        "and `llmctl profile` aggregation TPU-lessly)")
+    p.add_argument("--jit-recompiles", type=int, default=6,
+                   help="report this cumulative jit-compile count (raise "
+                        "it to drill recompile-storm dashboards)")
+    p.add_argument("--device-idle-frac", type=float, default=0.0,
+                   help="report this device idle fraction (the profiling "
+                        "runbook's read-first gauge)")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
     profile = (
@@ -534,6 +568,9 @@ def main() -> None:
             integrity_failures=args.integrity_failures,
             watchdog_trips=args.watchdog_trips,
             health_state=args.health_state,
+            dispatch_device_us=args.dispatch_device_us,
+            jit_recompiles=args.jit_recompiles,
+            device_idle_frac=args.device_idle_frac,
         )
 
     asyncio.run(run())

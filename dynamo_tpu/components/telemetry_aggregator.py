@@ -83,6 +83,13 @@ CLUSTER_GAUGES = [
     ("kv_integrity_failures_total", "KV blocks that failed content checksums (fleet sum)"),
     ("watchdog_trips_total", "Lanes ended by the output watchdog (fleet sum)"),
     ("workers_quarantined", "Workers quarantined by the integrity plane"),
+    # performance attribution plane (docs/observability.md §Profiling):
+    # fleet WORST dispatch split / idle fraction (p95s are not summable —
+    # the slowest worker is the one to profile) + summed jit recompiles
+    ("dispatch_device_us_p95", "Worst per-worker decode dispatch device-time p95 (us)"),
+    ("dispatch_host_overhead_us_p95", "Worst per-worker decode dispatch host-overhead p95 (us)"),
+    ("device_idle_frac", "Worst per-worker device idle fraction between dispatches"),
+    ("jit_recompiles_total", "Jitted step-function compilations since boot (fleet sum)"),
     ("worst_worker_load", "Highest per-worker load score"),
     ("median_worker_load", "Median per-worker load score"),
 ]
@@ -371,6 +378,10 @@ class ClusterTelemetry:
                 "watchdog_trips_total": 0,
                 "workers_quarantined": 0,
                 "quarantined_worker_ids": [],
+                "dispatch_device_us_p95": 0.0,
+                "dispatch_host_overhead_us_p95": 0.0,
+                "device_idle_frac": 0.0,
+                "jit_recompiles_total": 0,
                 "control_plane_impaired": 0,
                 "bus_dropped_events": 0,
                 "control_plane": {
@@ -447,6 +458,23 @@ class ClusterTelemetry:
             )
             entry["watchdog_trips_total"] += int(
                 getattr(m, "watchdog_trips_total", 0) or 0
+            )
+            # profiling plane: worst-worker p95s / idle fraction (max, not
+            # sum — see the CLUSTER_GAUGES note) + summed jit recompiles
+            entry["dispatch_device_us_p95"] = max(
+                entry["dispatch_device_us_p95"],
+                float(getattr(m, "dispatch_device_us_p95", 0.0) or 0.0),
+            )
+            entry["dispatch_host_overhead_us_p95"] = max(
+                entry["dispatch_host_overhead_us_p95"],
+                float(getattr(m, "dispatch_host_overhead_us_p95", 0.0) or 0.0),
+            )
+            entry["device_idle_frac"] = max(
+                entry["device_idle_frac"],
+                float(getattr(m, "device_idle_frac", 0.0) or 0.0),
+            )
+            entry["jit_recompiles_total"] += int(
+                getattr(m, "jit_recompiles", 0) or 0
             )
             # control-plane view per worker: count by state, name the
             # impaired ones (bounded like unhealthy_worker_ids) so `llmctl

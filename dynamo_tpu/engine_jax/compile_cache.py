@@ -10,6 +10,7 @@ reuse is the compiled executable).
 from __future__ import annotations
 
 import os
+import sys
 import threading
 
 _DEFAULT = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))), ".jax_cache")
@@ -23,11 +24,22 @@ _COMPILE_LOCK = threading.Lock()
 _COMPILES: dict[str, int] = {}
 
 
-def record_compile(kind: str = "step") -> None:
+def record_compile(kind: str = "step", detail: str = "") -> None:
     """Count one jitted-program build (called where engines create a new
-    compiled variant — cache misses in their per-shape fn tables)."""
+    compiled variant — cache misses in their per-shape fn tables).
+    ``detail`` carries the triggering variant key / abstract shapes; it
+    lands on the profiling timeline (docs/observability.md §Profiling) as
+    a ``jit_compile`` event when that plane is armed — a recompile storm
+    mid-traffic then shows up ON the capture that measured the stall."""
     with _COMPILE_LOCK:
         _COMPILES[kind] = _COMPILES.get(kind, 0) + 1
+    # lazy + constructor-free: processes that never armed DYN_TPU_PROFILE
+    # never even import the profiling module from here
+    prof = sys.modules.get("dynamo_tpu.runtime.profiling")
+    if prof is not None:
+        prof.note_event(
+            "jit_compile", detail=f"{kind} {detail}".strip(), phase=kind
+        )
 
 
 def compile_count() -> int:

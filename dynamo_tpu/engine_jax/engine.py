@@ -83,6 +83,7 @@ from dynamo_tpu.models.llama import (
 from dynamo_tpu.engine_jax.compile_cache import compile_count, record_compile
 from dynamo_tpu.runtime import faults as faults_mod
 from dynamo_tpu.runtime import integrity as integrity_mod
+from dynamo_tpu.runtime import profiling as profiling_mod
 from dynamo_tpu.runtime import qos as qos_mod
 from dynamo_tpu.runtime import telemetry, tracing
 from dynamo_tpu.runtime.integrity import WATCHDOG_TOKEN
@@ -682,6 +683,21 @@ class JaxServingEngine(AsyncEngine):
             _EnginePerf() if telemetry.enabled() else None
         )
 
+        # performance attribution plane (runtime/profiling.py,
+        # docs/observability.md §Profiling): per-dispatch device/host/alloc
+        # timing into the process-global StepTimeline ring. None with
+        # DYN_TPU_PROFILE off — the step loop then pays one None-check per
+        # dispatch and no timeline is ever constructed (the zero-overhead
+        # guard in tests/test_profiling.py monkeypatches the constructor).
+        self._profile = profiling_mod.maybe_from_env()
+        self._timeline = (
+            profiling_mod.timeline() if self._profile is not None else None
+        )
+        # allocator microseconds (alloc/grow/evict/seal-checksum) accrued
+        # since the last dispatch record — admission allocs between
+        # dispatches charge the NEXT dispatch's record
+        self._prof_alloc_us = 0.0
+
         # multi-tenant QoS (runtime/qos.py, docs/qos.md): policy + weighted
         # fair-queue bookkeeping, built ONLY when DYN_TPU_TENANT_* knobs are
         # set — the single-tenant step loop pays one None-check (asserted by
@@ -1006,7 +1022,10 @@ class JaxServingEngine(AsyncEngine):
         key = (want_lp, want_pen, want_sample)
         fn = self._decode_fns.get(key)
         if fn is None:
-            record_compile("decode")
+            record_compile("decode", detail=(
+                f"lp={want_lp} pen={want_pen} sample={want_sample} "
+                f"[S={self.config.max_slots},k={self.config.decode_steps}]"
+            ))
             fn = self._decode_fns[key] = self._build_decode_fn(
                 want_lp, want_pen, want_sample
             )
@@ -1019,7 +1038,11 @@ class JaxServingEngine(AsyncEngine):
         key = (want_lp, want_pen, want_sample, want_history)
         fn = self._chunk_fns.get(key)
         if fn is None:
-            record_compile("chunk")
+            record_compile("chunk", detail=(
+                f"lp={want_lp} pen={want_pen} sample={want_sample} "
+                f"history={want_history} [S={self.config.max_slots},"
+                f"C={self.config.prefill_chunk}]"
+            ))
             fn = self._chunk_fns[key] = self._build_chunk_fn(
                 want_lp, want_pen, want_sample, want_history
             )
@@ -1111,7 +1134,10 @@ class JaxServingEngine(AsyncEngine):
         key = (want_lp, want_pen, want_sample)
         fn = self._verify_fns.get(key)
         if fn is None:
-            record_compile("verify")
+            record_compile("verify", detail=(
+                f"lp={want_lp} pen={want_pen} sample={want_sample} "
+                f"[S={self.config.max_slots},k1={self._spec_k + 1}]"
+            ))
             fn = self._verify_fns[key] = self._build_verify_fn(
                 want_lp, want_pen, want_sample
             )
@@ -1818,9 +1844,7 @@ class JaxServingEngine(AsyncEngine):
                 # keeps admitting other tenants past it
                 deferred.append(seq)
                 continue
-            alloc = self.allocator.allocate_sequence(
-                seq.prompt, tenant=seq.tenant, level=seq.level
-            )
+            alloc = self._alloc_seq_timed(seq)
             if isinstance(alloc, InflightPrefix):
                 # another lane is prefilling this prompt's prefix right now:
                 # park until it seals (then these become ordinary prefix
@@ -1833,9 +1857,7 @@ class JaxServingEngine(AsyncEngine):
             if alloc is None and (self._inflight is not None or self._zombie_allocs):
                 # blocks may be parked behind the in-flight speculative chunk
                 self._drain_inflight()
-                alloc = self.allocator.allocate_sequence(
-                    seq.prompt, tenant=seq.tenant, level=seq.level
-                )
+                alloc = self._alloc_seq_timed(seq)
                 if isinstance(alloc, InflightPrefix):
                     seq.joined_inflight = True
                     seq.wait_hash = alloc.seq_hash
@@ -1850,9 +1872,7 @@ class JaxServingEngine(AsyncEngine):
                 if victim is not seq:
                     self._drain_inflight()
                     self._preempt(victim)
-                    alloc = self.allocator.allocate_sequence(
-                        seq.prompt, tenant=seq.tenant, level=seq.level
-                    )
+                    alloc = self._alloc_seq_timed(seq)
                     if isinstance(alloc, InflightPrefix):
                         seq.joined_inflight = True
                         seq.wait_hash = alloc.seq_hash
@@ -1941,6 +1961,71 @@ class JaxServingEngine(AsyncEngine):
             # so every admitted sequence computes at least one position
             seq.prefill_pos = seq.alloc.cached_tokens
 
+    # -- performance attribution (runtime/profiling.py) ----------------------
+
+    def _alloc_seq_timed(self, seq: "_Seq"):
+        """allocate_sequence with the allocator time accrued into the next
+        dispatch record (profiling armed) — the bare call otherwise."""
+        tl = self._timeline
+        if tl is None:
+            return self.allocator.allocate_sequence(
+                seq.prompt, tenant=seq.tenant, level=seq.level
+            )
+        t = time.perf_counter()
+        alloc = self.allocator.allocate_sequence(
+            seq.prompt, tenant=seq.tenant, level=seq.level
+        )
+        self._prof_alloc_us += (time.perf_counter() - t) * 1e6
+        return alloc
+
+    def _seal_timed(self, alloc, toks) -> None:
+        """note_tokens_computed (block seal + integrity checksum) with the
+        time accrued to the allocator share of the dispatch record."""
+        tl = self._timeline
+        if tl is None:
+            self.allocator.note_tokens_computed(alloc, toks)
+            return
+        t = time.perf_counter()
+        self.allocator.note_tokens_computed(alloc, toks)
+        self._prof_alloc_us += (time.perf_counter() - t) * 1e6
+
+    def _note_dispatch(
+        self, tl, phase: str, t_step: float, t_disp: float, t_fetch: float,
+        t_end: float, batch: int, tokens: int,
+    ) -> None:
+        """One sampled dispatch into the timeline: host build / device /
+        host emit split, the accrued allocator share, queue depths, and the
+        PR5 request/trace ids riding the batch."""
+        alloc_us, self._prof_alloc_us = self._prof_alloc_us, 0.0
+        reqs: List[str] = []
+        traces: List[str] = []
+        for s in self._slots:
+            if s is None or len(reqs) >= 8:
+                continue
+            reqs.append(str(s.ctx.id))
+            tr = getattr(s.ctx.context, "trace", None)
+            tid = getattr(tr, "trace_id", None)
+            if tid:
+                traces.append(str(tid))
+        # epoch-align the perf_counter anchors so captures from different
+        # workers merge onto one Perfetto timeline
+        now_wall = time.time()  # dynlint: allow-wall-clock(cross-process trace alignment)
+        now_perf = time.perf_counter()
+        tl.note_dispatch(
+            phase,
+            ts=now_wall - (now_perf - t_step),
+            step=self._step_counter,
+            batch=batch,
+            tokens=tokens,
+            host_us=(t_disp - t_step) * 1e6,
+            device_us=(t_fetch - t_disp) * 1e6,
+            post_us=(t_end - t_fetch) * 1e6,
+            alloc_us=alloc_us,
+            queue=len(self._pending) + len(self._awaiting),
+            reqs=reqs,
+            traces=traces,
+        )
+
     def _dispatch_step(self) -> None:
         active = [s for s in self._slots if s is not None]
         if not active:
@@ -2018,6 +2103,8 @@ class JaxServingEngine(AsyncEngine):
         following dispatches pure-decode."""
         cfg = self.config
         S, C = cfg.max_slots, cfg.prefill_chunk
+        tl = self._timeline
+        t_step = time.perf_counter() if tl is not None else 0.0
         for seq in [s for s in self._slots if s is not None]:
             if seq.slot is None:
                 # an earlier lane's class-aware reclaim preempted this one
@@ -2038,6 +2125,10 @@ class JaxServingEngine(AsyncEngine):
                         seq.alloc, need
                     ):
                         self._preempt(seq)
+        if tl is not None:
+            # the loop above is grow/evict work: the allocator share of
+            # this dispatch's host overhead
+            self._prof_alloc_us += (time.perf_counter() - t_step) * 1e6
         if not any(self._slots):
             return
 
@@ -2154,6 +2245,8 @@ class JaxServingEngine(AsyncEngine):
             self._m_ipack.get(ipack_np),
             self._m_fpack.get(fpack_np),
         ) + self._wd_args()
+        prof = tl is not None and tl.should_sample()
+        t_disp = time.perf_counter() if prof else 0.0
         # copy_to_host_async right after dispatch: the host-fetch path has a
         # ~100 ms fixed latency on a tunneled chip when started cold at get
         # time; started here it overlaps the chunk's own compute (measured
@@ -2177,6 +2270,7 @@ class JaxServingEngine(AsyncEngine):
             # dynlint: allow-host-sync(leader sync: one fetch per chunk dispatch)
             sampled_np = jax.device_get(sampled)
             lp_np = tids_np = tlps_np = None
+        t_fetch = time.perf_counter() if prof else 0.0
         if want_pen:
             self._counts = counts_out
         else:
@@ -2187,7 +2281,7 @@ class JaxServingEngine(AsyncEngine):
             seq = self._slots[i]
             if seq is None or consumed[i] is None:
                 continue
-            self.allocator.note_tokens_computed(seq.alloc, consumed[i])
+            self._seal_timed(seq.alloc, consumed[i])
             lpinfo = (
                 (float(lp_np[i]), tids_np[i], tlps_np[i])
                 if lp_np is not None
@@ -2215,6 +2309,16 @@ class JaxServingEngine(AsyncEngine):
                     self._watchdog_trip(seq)
                     continue
                 self._emit_token(seq, tok, lpinfo=lpinfo)
+        if prof:
+            self._note_dispatch(
+                tl, "chunk", t_step, t_disp, t_fetch, time.perf_counter(),
+                batch=sum(1 for c in consumed if c is not None),
+                tokens=sum(len(c) for c in consumed if c),
+            )
+        elif tl is not None:
+            # unsampled dispatch: drop the accrued allocator share so it
+            # can't pile up across the sampling stride and misattribute
+            self._prof_alloc_us = 0.0
 
     def _decode_step(self) -> None:
         """Pipelined decode: dispatch chunk N+1 off the previous dispatch's
@@ -2226,6 +2330,8 @@ class JaxServingEngine(AsyncEngine):
         freed only once the in-flight chunk has been fetched."""
         cfg = self.config
         S, k = cfg.max_slots, cfg.decode_steps
+        tl = self._timeline
+        t_step = time.perf_counter() if tl is not None else 0.0
 
         stopped = [s for s in self._slots if s is not None and s.ctx.context.is_stopped]
         if stopped:
@@ -2238,6 +2344,7 @@ class JaxServingEngine(AsyncEngine):
         # and the next (speculative) chunk another k past that. Prefilling
         # lanes (paced duty cycle: they sit decode dispatches out) neither
         # grow nor dispatch here.
+        t_grow = time.perf_counter() if tl is not None else 0.0
         while True:
             ok = True
             for seq in [s for s in self._slots if s is not None]:
@@ -2264,6 +2371,10 @@ class JaxServingEngine(AsyncEngine):
                     break
             if ok:
                 break
+        if tl is not None:
+            # grow/evict/preempt work: the allocator share of this
+            # dispatch's host overhead
+            self._prof_alloc_us += (time.perf_counter() - t_grow) * 1e6
         active = [
             s for s in self._slots
             if s is not None and s.prefill_pos is None
@@ -2366,6 +2477,8 @@ class JaxServingEngine(AsyncEngine):
             self._m_ipack.get(ipack_np),
             self._m_fpack.get(fpack_np),
         ) + self._wd_args()
+        prof = tl is not None and tl.should_sample()
+        t_disp = time.perf_counter() if prof else 0.0
         if want_lp:
             out, lps, tids, tlps, toks2, pos2, self.cache, counts_out = (
                 self._decode(True, want_pen, want_sample)(*args)
@@ -2375,6 +2488,13 @@ class JaxServingEngine(AsyncEngine):
                 False, want_pen, want_sample
             )(*args)
             lps = tids = tlps = None
+        if prof:
+            # the profiling contract: block-until-ready device time for the
+            # SAMPLED dispatch (serializes this one dispatch of the
+            # pipeline; sample_every bounds the tax)
+            # dynlint: allow-host-sync(sampled profiling dispatch: device-time measurement)
+            jax.block_until_ready(out)
+            t_fetch = time.perf_counter()
         if want_pen:
             self._counts = counts_out
         else:
@@ -2391,6 +2511,13 @@ class JaxServingEngine(AsyncEngine):
                 arr.copy_to_host_async()
         if prev is not None:
             self._process_chunk(prev, defer_free=True)
+        if prof:
+            self._note_dispatch(
+                tl, "decode", t_step, t_disp, t_fetch, time.perf_counter(),
+                batch=len(active), tokens=len(active) * k,
+            )
+        elif tl is not None:
+            self._prof_alloc_us = 0.0
 
     def _emit_token_run(
         self,
@@ -2444,7 +2571,7 @@ class JaxServingEngine(AsyncEngine):
         # last token plus every emitted token bar the final one (in the
         # verify dispatch, matched drafts ARE the emitted prefix)
         fed0 = seq.generated[-1] if seq.generated else seq.prompt[-1]
-        self.allocator.note_tokens_computed(seq.alloc, [fed0] + toks[:-1])
+        self._seal_timed(seq.alloc, [fed0] + toks[:-1])
 
         log_probs = top_logprobs = None
         if lp_rows is not None and seq.logprobs is not None:
@@ -2534,6 +2661,8 @@ class JaxServingEngine(AsyncEngine):
         workloads keep the non-speculative fast path."""
         cfg = self.config
         S = cfg.max_slots
+        tl = self._timeline
+        t_step = time.perf_counter() if tl is not None else 0.0
         # host needs every lane's true last token and the drafters need the
         # emitted suffix up to date before proposing
         self._drain_inflight()
@@ -2627,6 +2756,8 @@ class JaxServingEngine(AsyncEngine):
             self._put(np.int32(self._step_counter)),
             self._m_ipack.get(ipack_np), self._m_fpack.get(fpack_np),
         ) + self._wd_args()
+        prof = tl is not None and tl.should_sample()
+        t_disp = time.perf_counter() if prof else 0.0
         if want_lp:
             tgt, lps, tids, tlps, self.cache, counts_out = self._verify(
                 True, want_pen, want_sample
@@ -2647,6 +2778,7 @@ class JaxServingEngine(AsyncEngine):
             # dynlint: allow-host-sync(leader sync: one fetch per verify dispatch)
             tgt_np = np.asarray(jax.device_get(tgt))
             lp_np = tids_np = tlps_np = None
+        t_fetch = time.perf_counter() if prof else 0.0
         if want_pen:
             self._counts = counts_out
         else:
@@ -2711,6 +2843,16 @@ class JaxServingEngine(AsyncEngine):
                 self.total_generated_tokens - tokens_before, 1
             )
             self._perf.note_spec(drafted_total, accepted_total)
+        if prof:
+            self._note_dispatch(
+                tl, "verify", t_step, t_disp, t_fetch, time.perf_counter(),
+                batch=sum(1 for s in self._slots if s is not None),
+                tokens=accepted_total + sum(
+                    1 for s in self._slots if s is not None
+                ),
+            )
+        elif tl is not None:
+            self._prof_alloc_us = 0.0
 
     def _drain_inflight(self) -> None:
         """Fetch + process any in-flight chunk, then release zombie blocks
@@ -3674,6 +3816,11 @@ class JaxServingEngine(AsyncEngine):
             m["step_time_ms"] = round(self._perf.step_time_ms, 3)
             m["batch_slot_util"] = round(self._perf.slot_util, 4)
             m["spec_accept_rate"] = round(self._perf.spec_accept_rate, 4)
+        if self._timeline is not None:
+            # performance attribution plane (docs/observability.md
+            # §Profiling): decode-phase device/host p95 split + device idle
+            # fraction, from the process-global dispatch timeline
+            m.update(self._timeline.gauges())
         if self.host_pool is not None:
             m["host_cache_blocks"] = len(self.host_pool)
             m["host_cache_hits"] = self.host_pool.hits

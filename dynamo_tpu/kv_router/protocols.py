@@ -219,6 +219,16 @@ class ForwardPassMetrics:
     # trip window latched.
     kv_integrity_failures_total: int = 0
     watchdog_trips_total: int = 0
+    # performance attribution plane (runtime/profiling.py,
+    # docs/observability.md §Profiling): decode-dispatch p95 split into
+    # block-until-ready device time vs host-side dispatch overhead, and
+    # the fraction of the sampled window the device sat idle between
+    # dispatches. Zeros from workers without DYN_TPU_PROFILE armed; the
+    # aggregator takes the fleet WORST (max) — a p95/idle fraction is not
+    # summable and the slowest worker is the one to look at.
+    dispatch_device_us_p95: float = 0.0
+    dispatch_host_overhead_us_p95: float = 0.0
+    device_idle_frac: float = 0.0
     # process identity for cluster attribution + dashboards
     uptime_s: float = 0.0
     model: Optional[str] = None

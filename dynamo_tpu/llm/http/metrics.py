@@ -315,6 +315,15 @@ class ServiceMetrics:
             out += control_plane.render_prometheus()
         except Exception:  # must never break /metrics
             pass
+        try:
+            from dynamo_tpu.runtime import profiling
+
+            # frontend hot-path attribution (docs/observability.md
+            # §Profiling): per-token CPU split + event-loop lag gauges —
+            # empty string until the profiling plane recorded anything
+            out += profiling.render_frontend_prometheus()
+        except Exception:  # must never break /metrics
+            pass
         return out
 
 

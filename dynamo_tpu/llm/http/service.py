@@ -15,11 +15,12 @@ import asyncio
 import contextlib
 import json
 import logging
+import time
 from typing import Optional
 
 from aiohttp import web
 
-from ...runtime import tracing
+from ...runtime import profiling, tracing
 from ...runtime.admission import OVERLOAD_ERROR, OverloadedError
 from ...runtime.annotated import Annotated
 from ...runtime.engine import AsyncEngine, Context
@@ -124,6 +125,15 @@ class HttpService:
             else None
         )
         self._runner: Optional[web.AppRunner] = None
+        # performance attribution plane (runtime/profiling.py): with
+        # DYN_TPU_PROFILE armed, the stream loop attributes per-token CPU
+        # to serialize/transport-write and an event-loop lag sampler runs
+        # beside the server. None/off costs one None-check per chunk (the
+        # zero-overhead guard in tests/test_profiling.py).
+        self._fcpu = (
+            profiling.frontend_cpu() if profiling.enabled() else None
+        )
+        self._lag_sampler = None
         self.app = web.Application()
         self.app.add_routes(
             [
@@ -135,6 +145,7 @@ class HttpService:
                 web.get("/live", self._live),
                 web.get("/debug/traces", self._debug_traces),
                 web.get("/debug/slo", self._debug_slo),
+                web.get("/debug/profile", self._debug_profile),
             ]
         )
 
@@ -151,9 +162,18 @@ class HttpService:
             self.port = sock.getsockname()[1]
             break
         logger.info("HTTP service listening on %s:%d", self.host, self.port)
+        if self._fcpu is not None and self._lag_sampler is None:
+            # event-loop lag: the direct saturation signal of a frontend
+            # process (docs/observability.md §Profiling); one sampler per
+            # process, shared by co-hosted services on the same loop
+            self._lag_sampler = profiling.lag_sampler()
+            self._lag_sampler.start()
         return self.port
 
     async def stop(self) -> None:
+        if self._lag_sampler is not None:
+            self._lag_sampler.stop()
+            self._lag_sampler = None
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
@@ -246,6 +266,31 @@ class HttpService:
         )
         return web.Response(text=body + ("\n" if body else ""),
                             content_type="application/jsonl")
+
+    async def _debug_profile(self, request: web.Request) -> web.Response:
+        """Performance-attribution export (docs/observability.md
+        §Profiling): the process's dispatch timeline summary, frontend
+        per-token CPU split, and event-loop lag gauges. ``?trace=1``
+        returns the same window as a Perfetto-loadable Chrome-trace JSON
+        (one track per engine phase, one for the event loop);
+        ``?seconds=N`` restricts to the last N seconds. Works — with
+        empty sections — even when ``DYN_TPU_PROFILE`` is off, so a
+        dashboard probing the wrong process gets an explicit
+        ``enabled: false`` instead of a 404."""
+        try:
+            since = float(request.query.get("seconds", "0")) or None
+        except ValueError:
+            since = None
+        state = profiling.dump_state(since)
+        if request.query.get("trace", "") not in ("", "0", "false"):
+            trace = profiling.to_chrome_trace([(
+                "frontend", state.get("records", []),
+                state.get("events", []),
+            )])
+            return web.json_response(trace)
+        state.pop("records", None)  # summary view: keep the payload small
+        state.pop("events", None)
+        return web.json_response(state)
 
     async def _debug_slo(self, _request: web.Request) -> web.Response:
         """SLO / burn-rate report: the edge's own objectives (fed from the
@@ -454,14 +499,37 @@ class HttpService:
                         for k in ("id", "object", "created", "model")
                         if k in payload
                     }
-                if _chunk_has_content(payload):
+                has_content = _chunk_has_content(payload)
+                if has_content:
                     guard.mark_chunk()  # TTFT on first, inter-token gap after
                     guard.count_tokens()
-                fast = tmpl.encode(payload)
-                if fast is not None:
-                    await resp.write(fast)
+                if self._fcpu is None:
+                    fast = tmpl.encode(payload)
+                    if fast is not None:
+                        await resp.write(fast)
+                    else:
+                        await resp.write((f"data: {json.dumps(payload)}\n\n").encode())
                 else:
-                    await resp.write((f"data: {json.dumps(payload)}\n\n").encode())
+                    # per-token CPU attribution (profiling plane): split
+                    # the SSE hot path into serialize vs transport-write
+                    # so the µs/token residue decomposes
+                    t0 = time.perf_counter()
+                    data = tmpl.encode(payload)
+                    if data is None:
+                        data = (f"data: {json.dumps(payload)}\n\n").encode()
+                    t1 = time.perf_counter()
+                    await resp.write(data)
+                    t2 = time.perf_counter()
+                    self._fcpu.note(
+                        "serialize", (t1 - t0) * 1e6,
+                        tokens=1 if has_content else 0,
+                    )
+                    self._fcpu.note(
+                        "transport_write", (t2 - t1) * 1e6,
+                        tokens=1 if has_content else 0,
+                    )
+                    if tracing.enabled():
+                        tracing.observe_phase("serialize", t1 - t0)
             else:
                 guard.mark_ok()
             await resp.write(f"data: {DONE_SENTINEL}\n\n".encode())

@@ -17,6 +17,7 @@ import asyncio
 import datetime
 import json
 import logging
+import time
 from dataclasses import replace
 from typing import AsyncIterator, Optional, Union
 
@@ -444,6 +445,15 @@ class DetokenizeOperator(Operator):
     def __init__(self, card: ModelDeploymentCard, tokenizer: Optional[HFTokenizer] = None):
         self.card = card
         self.tokenizer = tokenizer or HFTokenizer.from_file(card.tokenizer_file)
+        # performance attribution (runtime/profiling.py): per-token CPU of
+        # incremental detokenization — the frontend-residue part the PR5
+        # phase histograms couldn't see. None with DYN_TPU_PROFILE off
+        # (one None-check per stream item, zero objects constructed).
+        from ..runtime import profiling
+
+        self._fcpu = (
+            profiling.frontend_cpu() if profiling.enabled() else None
+        )
 
     async def generate(
         self, request: Context[PreprocessedRequest], next_engine: AsyncEngine
@@ -476,6 +486,7 @@ class DetokenizeOperator(Operator):
             finish: Optional[FinishReason] = out.finish_reason
             stop_hit = False
             kept_tokens: list[int] = []
+            t_detok = time.perf_counter() if self._fcpu is not None else 0.0
             for tok in out.token_ids:
                 decision = decoder.step(tok)
                 if decision.text:
@@ -486,6 +497,15 @@ class DetokenizeOperator(Operator):
                     finish = FinishReason.STOP if not decision.stop_token else FinishReason.EOS
                     stop_hit = True
                     break
+            if self._fcpu is not None and out.token_ids:
+                dt = time.perf_counter() - t_detok
+                self._fcpu.note(
+                    "detokenize", dt * 1e6, tokens=len(out.token_ids)
+                )
+                from ..runtime import tracing
+
+                if tracing.enabled():
+                    tracing.observe_phase("detokenize", dt)
             emitted += len(kept_tokens)
 
             max_t = pre.stop_conditions.max_tokens

@@ -2201,6 +2201,14 @@ async def attach_kv_publishing(
                     snap.setdefault(
                         "watchdog_trips_total", ic["watchdog_trips_total"]
                     )
+                # profiling plane (docs/observability.md §Profiling): the
+                # process-global dispatch timeline's gauges, for engines
+                # whose own snapshot doesn't carry them — constructor-free,
+                # empty until anything armed DYN_TPU_PROFILE here
+                prof = _sys.modules.get("dynamo_tpu.runtime.profiling")
+                if prof is not None:
+                    for k, v in prof.gauges().items():
+                        snap.setdefault(k, v)
                 if server is not None and bind_admission:
                     # the co-hosted RPC server's counters belong to the
                     # publisher that OWNS it; a bind_admission=False

@@ -15,12 +15,15 @@ Wire protocol (header JSON + body):
                  {id, op:"ping"}               (liveness probe, ``__ping__``)
                  {id, op:"trace_dump", limit?, trace_id?}  (flight recorder)
                  {id, op:"telemetry_dump"}     (SLO/perf state, llmctl slo)
+                 {id, op:"profile_dump", since_s?}  (dispatch timeline,
+                                                llmctl profile capture)
   worker→client: {id, op:"item"}  body=one Annotated dict JSON
                  {id, op:"done"}
                  {id, op:"error", message, code?, retryable?}
                  {id, op:"pong", health, load} (probe reply)
                  {id, op:"trace_data", count}  body=JSON list of traces
                  {id, op:"telemetry_data"}     body=JSON telemetry state
+                 {id, op:"profile_data", count}  body=JSON profiling state
 
 ``traceparent`` (W3C wire form, runtime/tracing.py) threads the caller's
 trace context through so the worker's serve/engine spans join the same
@@ -378,6 +381,12 @@ class RpcServer:
                     )
                     conn_tasks.add(t)
                     t.add_done_callback(conn_tasks.discard)
+                elif op == "profile_dump":
+                    t = asyncio.create_task(
+                        self._profile_dump(h, writer, write_lock)
+                    )
+                    conn_tasks.add(t)
+                    t.add_done_callback(conn_tasks.discard)
                 elif op in ("stop", "kill"):
                     ctx = contexts.get(h.get("id"))
                     if ctx is not None:
@@ -451,6 +460,35 @@ class RpcServer:
             pass  # requester gone
         except Exception:
             logger.exception("telemetry_dump failed")
+
+    async def _profile_dump(self, h, writer, write_lock) -> None:
+        """Answer a ``profile_dump`` with this process's performance-
+        attribution state (runtime/profiling.py: dispatch timeline records,
+        jit-compile events, summary, frontend CPU/lag when present).
+        Pure local-memory read like ``trace_dump`` — safe while the engine
+        is wedged, which is exactly when an operator runs ``llmctl profile
+        capture``. ``since_s`` bounds the window; a process that never
+        armed DYN_TPU_PROFILE answers ``enabled: false`` with empty
+        sections (never an error — the CLI tells the operator which
+        workers have the knob off)."""
+        try:
+            from dynamo_tpu.runtime import profiling
+
+            since = h.get("since_s")
+            state = profiling.dump_state(
+                float(since) if since is not None else None
+            )
+            body = json.dumps(state).encode()
+            header = {"id": h.get("id"), "op": "profile_data",
+                      "count": len(state.get("records", []))}
+            async with write_lock:
+                await write_frame(
+                    writer, TwoPartMessage(json.dumps(header).encode(), body)
+                )
+        except (ConnectionError, OSError):
+            pass  # requester gone
+        except Exception:
+            logger.exception("profile_dump failed")
 
     async def reap_expired(self, grace: float) -> int:
         """Abort in-flight requests whose deadline expired more than
@@ -785,6 +823,8 @@ class RpcClient:
                     item = ("trace_data", frame.body)
                 elif op == "telemetry_data":
                     item = ("telemetry_data", frame.body)
+                elif op == "profile_data":
+                    item = ("profile_data", frame.body)
                 elif op == "error":
                     item = ("error", {
                         "message": h.get("message", "remote error"),
@@ -920,6 +960,35 @@ class RpcClient:
                 info = data if isinstance(data, dict) else {}
                 raise ConnectionError(
                     f"telemetry_dump failed: {info.get('message', kind)}"
+                )
+            return json.loads(data) if data else {}
+        finally:
+            self._streams.pop(req_id, None)
+
+    async def profile_dump(
+        self, since_s: Optional[float] = None, timeout: float = 5.0
+    ) -> dict:
+        """Fetch the worker's performance-attribution state
+        (``llmctl profile capture``)."""
+        req_id = next(self._ids)
+        q: asyncio.Queue = asyncio.Queue(maxsize=1)
+        self._streams[req_id] = q
+        try:
+            header: Dict[str, Any] = {"id": req_id, "op": "profile_dump"}
+            if since_s is not None:
+                header["since_s"] = float(since_s)
+            await self._send(header)
+            try:
+                kind, data = await asyncio.wait_for(q.get(), timeout)
+            except asyncio.TimeoutError:
+                raise WorkerStalled(
+                    f"no profile_data from {self.host}:{self.port} within "
+                    f"{timeout:.1f}s"
+                ) from None
+            if kind != "profile_data":
+                info = data if isinstance(data, dict) else {}
+                raise ConnectionError(
+                    f"profile_dump failed: {info.get('message', kind)}"
                 )
             return json.loads(data) if data else {}
         finally:

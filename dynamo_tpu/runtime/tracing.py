@@ -58,6 +58,12 @@ PHASES = (
     "decode",
     "inter_token",
     "kv_transfer",
+    # frontend hot-path decomposition (docs/observability.md §Profiling):
+    # incremental detokenization and SSE-chunk JSON serialization — the
+    # two host-CPU parts of the per-token residue the PR5 histograms
+    # couldn't see
+    "detokenize",
+    "serialize",
 )
 
 # span terminal statuses (free-form strings are allowed; these are the ones

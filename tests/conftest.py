@@ -103,6 +103,20 @@ def _reset_integrity_state():
 
 
 @pytest.fixture(autouse=True)
+def _reset_profiling_state():
+    """Drop the process-global profiling timeline / frontend CPU
+    accumulator / lag sampler after each test: one test's dispatch
+    records must not bleed into another's summary or zero-overhead
+    assertions (imported lazily — the control-plane reset pattern)."""
+    yield
+    import sys
+
+    prof = sys.modules.get("dynamo_tpu.runtime.profiling")
+    if prof is not None:
+        prof.reset_for_tests()
+
+
+@pytest.fixture(autouse=True)
 def _no_leaked_health_monitors():
     """Fail any test that leaves a HealthMonitor check task running past
     teardown: a leaked monitor keeps reaping/draining state in the

@@ -623,6 +623,47 @@ def test_host_sync_outside_hot_modules_not_flagged(tmp_path):
     assert "unmarked-host-sync" not in rules_fired(findings)
 
 
+# -- wall-clock-in-hot-path --------------------------------------------------
+
+def test_wall_clock_in_hot_module_flagged(tmp_path):
+    src = "import time\ndef lat():\n    return time.time()\n"
+    findings = lint_tree(tmp_path, {"llm/http/service.py": src})
+    assert "wall-clock-in-hot-path" in rules_fired(findings)
+
+
+def test_wall_clock_from_import_flagged(tmp_path):
+    src = "from time import time\ndef lat():\n    return time()\n"
+    findings = lint_tree(tmp_path, {"engine_jax/engine.py": src})
+    assert "wall-clock-in-hot-path" in rules_fired(findings)
+
+
+def test_monotonic_clocks_not_flagged(tmp_path):
+    src = (
+        "import time\n"
+        "def lat():\n"
+        "    return time.perf_counter() + time.monotonic()\n"
+    )
+    findings = lint_tree(tmp_path, {"engine_jax/engine.py": src})
+    assert "wall-clock-in-hot-path" not in rules_fired(findings)
+
+
+def test_wall_clock_marker_allows(tmp_path):
+    src = (
+        "import time\n"
+        "def stamp():\n"
+        "    # dynlint: allow-wall-clock(wire timestamp, not a duration)\n"
+        "    return time.time()\n"
+    )
+    findings = lint_tree(tmp_path, {"runtime/rpc.py": src})
+    assert "wall-clock-in-hot-path" not in rules_fired(findings)
+
+
+def test_wall_clock_outside_hot_modules_not_flagged(tmp_path):
+    src = "import time\ndef stamp():\n    return time.time()\n"
+    findings = lint_tree(tmp_path, {"runtime/statestore.py": src})
+    assert "wall-clock-in-hot-path" not in rules_fired(findings)
+
+
 # -- import-time-jax-compute ------------------------------------------------
 
 IMPORT_TIME_CASES = [
