@@ -67,7 +67,21 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--json", action="store_true", help="machine-readable output")
     p.add_argument(
+        "--sarif",
+        default=None,
+        metavar="FILE",
+        help="also write the NEW (non-baselined) findings as SARIF 2.1.0 "
+        "for code-scanning upload ('-' for stdout)",
+    )
+    p.add_argument(
         "--list-rules", action="store_true", help="print the rule catalogue"
+    )
+    p.add_argument(
+        "--list-knobs",
+        action="store_true",
+        help="print every DYN_TPU_* env knob the code reads (name, parser, "
+        "site) and cross-check the names against the knob tables in "
+        "docs/*.md; exits 1 on undocumented knobs",
     )
     return p
 
@@ -77,6 +91,110 @@ def _default_paths(root: str) -> List[str]:
     if os.path.isdir(pkg):
         return [pkg]
     return [root]
+
+
+def _sarif_payload(findings, rules, root: str) -> dict:
+    """SARIF 2.1.0 (stdlib-only): one run, one result per finding."""
+    by_name = {}
+    for f in findings:
+        by_name.setdefault(f.rule, None)
+    rule_meta = [
+        {
+            "id": r.name,
+            "shortDescription": {"text": r.description},
+        }
+        for r in rules
+        if r.name in by_name
+    ]
+    # parse-error style findings have rules outside the catalogue
+    known = {r["id"] for r in rule_meta}
+    rule_meta.extend(
+        {"id": name, "shortDescription": {"text": name}}
+        for name in sorted(by_name)
+        if name not in known
+    )
+    index = {r["id"]: i for i, r in enumerate(rule_meta)}
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "dynlint",
+                        "informationUri": "docs/static_analysis.md",
+                        "rules": rule_meta,
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "ruleIndex": index[f.rule],
+                        "level": "warning",
+                        "message": {"text": f.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {"uri": f.path},
+                                    "region": {"startLine": f.line},
+                                }
+                            }
+                        ],
+                    }
+                    for f in findings
+                ],
+            }
+        ],
+    }
+
+
+def _documented_knob_names(root: str) -> set:
+    """Every DYN_TPU_* name mentioned verbatim anywhere under docs/."""
+    import re
+
+    names: set = set()
+    docs = os.path.join(root, "docs")
+    if not os.path.isdir(docs):
+        return names
+    for entry in sorted(os.listdir(docs)):
+        if not entry.endswith(".md"):
+            continue
+        try:
+            with open(os.path.join(docs, entry), encoding="utf-8") as fh:
+                names.update(re.findall(r"DYN_TPU_[A-Z0-9_]+", fh.read()))
+        except OSError:
+            continue
+    return names
+
+
+def _run_list_knobs(paths, root, context) -> int:
+    from dynamo_tpu.analysis.core import build_project
+    from dynamo_tpu.analysis.rules_knobs import collect_knobs
+
+    project, _ = build_project(paths, root=root, context_paths=context)
+    knobs = collect_knobs(project)
+    documented = _documented_knob_names(root)
+    undocumented = []
+    width = max((len(k.name) for k in knobs), default=0)
+    seen = set()
+    for k in knobs:
+        flag = "" if k.name in documented else "  [UNDOCUMENTED]"
+        print(f"{k.name:<{width}}  {k.helper:<18} {k.relpath}:{k.lineno}{flag}")
+        if k.name not in documented and k.name not in seen:
+            undocumented.append(k.name)
+            seen.add(k.name)
+    print(
+        f"dynlint: {len({k.name for k in knobs})} knob(s), "
+        f"{len(undocumented)} undocumented"
+    )
+    if undocumented:
+        print(
+            "dynlint: undocumented knobs (add them to the knob tables in "
+            "docs/*.md): " + ", ".join(undocumented),
+            file=sys.stderr,
+        )
+        return 1
+    return 0
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -100,6 +218,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # miss jit roots. build_project dedupes, so this is free when the
     # targets already cover the package.
     context = list(args.context) or _default_paths(root)
+
+    if args.list_knobs:
+        return _run_list_knobs(paths, root, context)
 
     baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE_PATH)
     if args.write_baseline:
@@ -133,6 +254,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         new, old = list(findings), []
     else:
         new, old = filter_baselined(findings, load_baseline(baseline_path))
+
+    if args.sarif:
+        payload = _sarif_payload(new, all_rules(), root)
+        text = json.dumps(payload, indent=2, sort_keys=True)
+        if args.sarif == "-":
+            print(text)
+        else:
+            with open(args.sarif, "w", encoding="utf-8") as fh:
+                fh.write(text + "\n")
 
     if args.json:
         print(

@@ -14,8 +14,20 @@ import io
 import os
 import re
 import tokenize
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+from typing import (
+    Any,
+    Dict,
+    FrozenSet,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+)
 
 # line comments understood by the analyzer:
 #   # dynlint: disable=rule-a,rule-b     suppress those rules on this line
@@ -175,12 +187,32 @@ class Project:
     targets: List[Module]
 
     _by_dotted: Dict[str, Module] = field(default_factory=dict)
+    _call_graph: Optional["CallGraph"] = field(
+        default=None, repr=False, compare=False
+    )
+    _lock_analysis: Optional["LockAnalysis"] = field(
+        default=None, repr=False, compare=False
+    )
 
     def __post_init__(self) -> None:
         self._by_dotted = {m.dotted_name: m for m in self.modules}
 
     def module_named(self, dotted: str) -> Optional[Module]:
         return self._by_dotted.get(dotted)
+
+    def call_graph(self) -> "CallGraph":
+        """The project call graph, built once and shared across rules
+        (the jax reachability pack and the concurrency pack both need it,
+        and indexing every module twice per run would double lint time)."""
+        if self._call_graph is None:
+            self._call_graph = CallGraph(self)
+        return self._call_graph
+
+    def lock_analysis(self) -> "LockAnalysis":
+        """Lock identities + per-function lock-set facts, built once."""
+        if self._lock_analysis is None:
+            self._lock_analysis = LockAnalysis(self, self.call_graph())
+        return self._lock_analysis
 
 
 class Rule:
@@ -219,6 +251,14 @@ def all_rules() -> List[Rule]:
         UnmarkedHostSyncRule,
         WallClockInHotPathRule,
     )
+    from dynamo_tpu.analysis.rules_concurrency import (
+        AwaitUnderThreadingLockRule,
+        BlockingUnderLockRule,
+        LockLeakRule,
+        LockOrderInversionRule,
+        LockSelfDeadlockRule,
+    )
+    from dynamo_tpu.analysis.rules_knobs import KnobDisciplineRule
     from dynamo_tpu.analysis.rules_metrics import MetricNameValidRule
     from dynamo_tpu.analysis.rules_protocol import EndpointProtocolDriftRule
 
@@ -234,6 +274,12 @@ def all_rules() -> List[Rule]:
         WallClockInHotPathRule(),
         EndpointProtocolDriftRule(),
         MetricNameValidRule(),
+        LockSelfDeadlockRule(),
+        LockOrderInversionRule(),
+        BlockingUnderLockRule(),
+        AwaitUnderThreadingLockRule(),
+        LockLeakRule(),
+        KnobDisciplineRule(),
     ]
 
 
@@ -436,3 +482,657 @@ def iter_functions(
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 yield child, chain
             stack.append((child, chain))
+
+
+# --------------------------------------------------------------------------
+# project call graph (shared by the jax and concurrency rule packs)
+# --------------------------------------------------------------------------
+
+JIT_NAMES = {"jax.jit", "jax.pjit", "pjit", "jit"}
+TRANSFORM_WRAPPERS = {
+    # f in jax.jit(transform(f)) is still traced; treat these as transparent
+    "functools.partial",
+    "partial",
+    "jax.vmap",
+    "jax.pmap",
+    "jax.checkpoint",
+    "jax.remat",
+}
+
+
+class FuncNode:
+    """One function (or jitted lambda) in the project call graph."""
+
+    __slots__ = ("module", "qualname", "node", "scope", "imports", "owner_class")
+
+    def __init__(
+        self,
+        module: Module,
+        qualname: str,
+        node: ast.AST,
+        scope,
+        imports,
+        owner_class: Optional[str] = None,
+    ):
+        self.module = module
+        self.qualname = qualname
+        self.node = node  # FunctionDef | AsyncFunctionDef | Lambda
+        self.scope = scope  # list of dicts name → FuncNode, innermost last
+        self.imports = imports  # Dict[str, str] visible at the def site
+        # nearest enclosing class (dotted for nested classes); inherited by
+        # functions nested inside methods, whose closures capture `self`
+        self.owner_class = owner_class
+
+    @property
+    def display(self) -> str:
+        return f"{self.module.relpath}:{self.qualname}"
+
+
+class CallGraph:
+    """Project-wide call graph over every def, with jax.jit roots on top.
+
+    Grown out of the jit reachability graph (rules_jax): the same index —
+    scope chains, import maps, self/cls resolution — now serves two
+    consumers. Trace reachability uses :meth:`edges` (name references:
+    every referenced name resolving to a function is an edge, so a
+    function passed to ``jax.lax.scan`` is reachable though never called
+    by name). The concurrency pack uses resolved ``ast.Call`` sites
+    instead (see :class:`LockAnalysis`), where "referenced" would be too
+    coarse: passing a callback does not run it under the caller's locks.
+    """
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.functions: List[FuncNode] = []  # every def, all modules
+        self.jit_roots: List[FuncNode] = []
+        # (module_dotted, top_level_name) → node, for import resolution
+        self.top_level: Dict[Tuple[str, str], FuncNode] = {}
+        self._anon = 0
+        for module in project.modules:
+            self._index_module(module)
+
+    # -- indexing -----------------------------------------------------------
+
+    def _index_module(self, module: Module) -> None:
+        mod_imports = collect_imports(module.tree.body, module.package)
+        mod_scope: Dict[str, FuncNode] = {}
+        self._visit_body(
+            module, module.tree.body, [mod_scope], mod_imports, prefix="",
+            register_top=True,
+        )
+
+    def _visit_body(
+        self,
+        module: Module,
+        body: List[ast.stmt],
+        scope_chain,
+        imports: Dict[str, str],
+        prefix: str,
+        register_top: bool = False,
+        owner_class: Optional[str] = None,
+    ) -> None:
+        local_scope = scope_chain[-1]
+        # pass 1: register defs so forward references resolve
+        funcs: List[Tuple[str, ast.AST]] = []
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{stmt.name}"
+                node = FuncNode(
+                    module, qual, stmt, list(scope_chain), dict(imports),
+                    owner_class,
+                )
+                local_scope[stmt.name] = node
+                self.functions.append(node)
+                funcs.append((stmt.name, stmt))
+                if register_top:
+                    self.top_level[(module.dotted_name, stmt.name)] = node
+                if self._is_jit_decorated(stmt, imports):
+                    self.jit_roots.append(node)
+            elif isinstance(stmt, ast.ClassDef):
+                # methods get their own scope dict ON the chain, so
+                # jax.jit(self.method) inside a sibling method resolves
+                # (see the self/cls branch in resolve_name)
+                self._visit_body(
+                    module, stmt.body, scope_chain + [{}], imports,
+                    prefix=f"{prefix}{stmt.name}.",
+                    owner_class=(
+                        f"{owner_class}.{stmt.name}" if owner_class else stmt.name
+                    ),
+                )
+        # pass 2: descend into each function with its own scope + imports
+        for name, stmt in funcs:
+            node = local_scope[name]
+            fn_imports = dict(imports)
+            fn_imports.update(collect_imports(walk_scope(stmt), module.package))
+            node.imports = fn_imports
+            inner_scope: Dict[str, FuncNode] = {}
+            self._visit_body(
+                module, stmt.body, node.scope + [inner_scope], fn_imports,
+                prefix=f"{node.qualname}.", owner_class=owner_class,
+            )
+            node.scope = node.scope + [inner_scope]
+            self._find_jit_calls_in(module, walk_scope(stmt), node.scope, fn_imports)
+        # jit calls at this level (module body / class body)
+        stmts_here = [
+            s for s in body
+            if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))
+        ]
+        for stmt in stmts_here:
+            self._find_jit_calls_in(module, walk_scope(stmt), scope_chain, imports)
+
+    def _is_jit_decorated(self, stmt: ast.AST, imports: Dict[str, str]) -> bool:
+        for dec in getattr(stmt, "decorator_list", []):
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            qual = resolve_call(target, imports) or ""
+            if qual in JIT_NAMES:
+                return True
+            if qual in TRANSFORM_WRAPPERS and isinstance(dec, ast.Call):
+                # @partial(jax.jit, ...) — jit appears among the args
+                for arg in dec.args:
+                    if (resolve_call(arg, imports) or "") in JIT_NAMES:
+                        return True
+        return False
+
+    def _find_jit_calls_in(self, module, nodes, scope_chain, imports) -> None:
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            qual = resolve_call(node.func, imports) or ""
+            if qual not in JIT_NAMES or not node.args:
+                continue
+            self._seed_root(module, node.args[0], scope_chain, imports)
+
+    def _seed_root(self, module, arg: ast.AST, scope_chain, imports) -> None:
+        if isinstance(arg, ast.Lambda):
+            self._anon += 1
+            self.jit_roots.append(
+                FuncNode(
+                    module, f"<lambda#{self._anon}>", arg, list(scope_chain),
+                    dict(imports),
+                )
+            )
+            return
+        if isinstance(arg, ast.Call):
+            # jax.jit(partial(f, ...)) / jax.jit(vmap(f)) — unwrap
+            inner_qual = resolve_call(arg.func, imports) or ""
+            if inner_qual in TRANSFORM_WRAPPERS and arg.args:
+                self._seed_root(module, arg.args[0], scope_chain, imports)
+            return
+        name = dotted_name(arg)
+        if name is None:
+            return
+        target = self.resolve_name(name, scope_chain, imports)
+        if target is not None:
+            self.jit_roots.append(target)
+
+    # -- resolution ---------------------------------------------------------
+
+    def resolve_name(
+        self, name: str, scope_chain, imports: Dict[str, str]
+    ) -> Optional[FuncNode]:
+        head, _, rest = name.partition(".")
+        # innermost scope wins
+        if not rest:
+            for scope in reversed(scope_chain):
+                if head in scope:
+                    return scope[head]
+        # self.method / cls.method: the enclosing class's scope dict is on
+        # the chain, so jax.jit(self._step) seeds the method as a root
+        if head in ("self", "cls") and rest and "." not in rest:
+            for scope in reversed(scope_chain):
+                if rest in scope:
+                    return scope[rest]
+        qual = imports.get(head)
+        if qual is not None:
+            full = f"{qual}.{rest}" if rest else qual
+            mod_name, _, sym = full.rpartition(".")
+            node = self.top_level.get((mod_name, sym))
+            if node is not None:
+                return node
+        return None
+
+    # -- reachability -------------------------------------------------------
+
+    def reachable(self) -> Dict[FuncNode, str]:
+        """BFS from jit roots → {function node: name of the seeding root}."""
+        reached: Dict[FuncNode, str] = {}
+        queue = deque()
+        for root in self.jit_roots:
+            if root not in reached:
+                reached[root] = root.qualname
+                queue.append(root)
+        while queue:
+            u = queue.popleft()
+            for v in self.edges(u):
+                if v not in reached:
+                    reached[v] = reached[u]
+                    queue.append(v)
+        return reached
+
+    def edges(self, u: FuncNode) -> Iterator[FuncNode]:
+        """Name-reference edges (over-approximates calls; right for trace
+        reachability, too coarse for lock-set propagation)."""
+        seen: Set[FuncNode] = set()
+        for node in walk_scope(u.node):
+            name: Optional[str] = None
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                name = node.id
+            elif isinstance(node, ast.Attribute):
+                name = dotted_name(node)
+            if name is None:
+                continue
+            target = self.resolve_name(name, u.scope, u.imports)
+            if target is not None and target is not u and target not in seen:
+                seen.add(target)
+                yield target
+
+
+# --------------------------------------------------------------------------
+# lock-set analysis (shared by the concurrency rule pack)
+# --------------------------------------------------------------------------
+
+# constructors whose result is a lock we track, → (kind, reentrant)
+_LOCK_FACTORIES = {
+    "threading.Lock": ("threading", False),
+    "threading.RLock": ("threading", True),
+    "multiprocessing.Lock": ("threading", False),
+    "multiprocessing.RLock": ("threading", True),
+    "asyncio.Lock": ("asyncio", False),
+}
+
+
+@dataclass(frozen=True)
+class LockInfo:
+    """One lock the project creates, resolved to a stable identity:
+    ``pkg.module.NAME`` for module globals, ``pkg.module.Class.attr`` for
+    instance/class attributes (every instance of the class shares the
+    identity — sound for self-deadlock and ordering, which are per-object
+    properties that the per-class approximation over-reports never
+    under-reports on the patterns dynlint targets)."""
+
+    id: str
+    kind: str  # "threading" | "asyncio"
+    reentrant: bool
+    relpath: str
+    lineno: int
+
+
+@dataclass(frozen=True)
+class LockAcquire:
+    """One ``with lock:`` (or guaranteed-release ``lock.acquire()``) site."""
+
+    lock: str
+    lineno: int
+    held: FrozenSet[str]  # lock ids already held when this one is taken
+
+
+@dataclass(frozen=True)
+class LockCallSite:
+    """One ``ast.Call`` in a function body, with the held lock set."""
+
+    qual: Optional[str]  # import-expanded dotted target ("time.sleep")
+    callee: Optional[FuncNode]  # project function, when resolvable
+    lineno: int
+    held: FrozenSet[str]
+    method: Optional[str]  # trailing attribute for obj.method() calls
+    nargs: int
+
+
+@dataclass(frozen=True)
+class BareAcquire:
+    """A ``lock.acquire()`` statement (as opposed to a ``with`` block)."""
+
+    lock: str
+    lineno: int
+    guarded: bool  # immediately followed by try/finally that releases it
+
+
+@dataclass
+class LockFacts:
+    """Everything the lock walker learned about one function."""
+
+    func: FuncNode
+    acquires: List[LockAcquire] = field(default_factory=list)
+    calls: List[LockCallSite] = field(default_factory=list)
+    # (lineno, held) for every ``await`` expression
+    awaits: List[Tuple[int, FrozenSet[str]]] = field(default_factory=list)
+    bare_acquires: List[BareAcquire] = field(default_factory=list)
+
+
+class LockAnalysis:
+    """Lock identities + per-function lock-set facts + may-acquire closure.
+
+    The walker is flow-aware inside a function (a ``with`` body holds the
+    lock, statements after it do not; ``with a, b:`` acquires in order;
+    an alias ``l = self._lock`` resolves through the assignment) and
+    call-graph-transitive across functions (``may_acquire`` is the
+    fixpoint of "locks I take directly ∪ locks anything I call may
+    take"). It deliberately does NOT model conditional acquisition —
+    a lock taken under ``if`` counts as taken — because every rule built
+    on it wants the may-approximation.
+    """
+
+    def __init__(self, project: Project, graph: CallGraph):
+        self.project = project
+        self.graph = graph
+        self.locks: Dict[str, LockInfo] = {}
+        for module in project.modules:
+            self._discover_locks(module)
+        self.facts: Dict[FuncNode, LockFacts] = {}
+        for fn in graph.functions:
+            self.facts[fn] = self._analyze_function(fn)
+        self.may_acquire: Dict[FuncNode, FrozenSet[str]] = self._fixpoint()
+
+    def lock(self, lock_id: str) -> Optional[LockInfo]:
+        return self.locks.get(lock_id)
+
+    def is_reentrant(self, lock_id: str) -> bool:
+        info = self.locks.get(lock_id)
+        return info is not None and info.reentrant
+
+    # -- lock discovery -----------------------------------------------------
+
+    def _discover_locks(self, module: Module) -> None:
+        imports = collect_imports(ast.walk(module.tree), module.package)
+
+        def factory_of(value: ast.AST) -> Optional[Tuple[str, bool]]:
+            if not isinstance(value, ast.Call):
+                return None
+            return _LOCK_FACTORIES.get(resolve_call(value.func, imports) or "")
+
+        def scan_body(body, class_prefix: str) -> None:
+            for stmt in body:
+                if isinstance(stmt, ast.ClassDef):
+                    scan_body(
+                        stmt.body,
+                        f"{class_prefix}{stmt.name}.",
+                    )
+                    continue
+                target: Optional[str] = None
+                value: Optional[ast.AST] = None
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                    if isinstance(stmt.targets[0], ast.Name):
+                        target, value = stmt.targets[0].id, stmt.value
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                    if isinstance(stmt.target, ast.Name):
+                        target, value = stmt.target.id, stmt.value
+                if target is None or value is None:
+                    continue
+                hit = factory_of(value)
+                if hit is None:
+                    continue
+                kind, reentrant = hit
+                lid = f"{module.dotted_name}.{class_prefix}{target}"
+                self.locks.setdefault(
+                    lid,
+                    LockInfo(lid, kind, reentrant, module.relpath, stmt.lineno),
+                )
+
+        scan_body(module.tree.body, "")
+
+        # self.X = threading.Lock() inside any method → Class-attribute lock
+        for fn in self.graph.functions:
+            if fn.module is not module or fn.owner_class is None:
+                continue
+            for node in walk_scope(fn.node):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)
+                    and isinstance(node.targets[0].value, ast.Name)
+                    and node.targets[0].value.id in ("self", "cls")
+                ):
+                    continue
+                hit = factory_of(node.value)
+                if hit is None:
+                    continue
+                kind, reentrant = hit
+                attr = node.targets[0].attr
+                lid = f"{module.dotted_name}.{fn.owner_class}.{attr}"
+                self.locks.setdefault(
+                    lid,
+                    LockInfo(lid, kind, reentrant, module.relpath, node.lineno),
+                )
+
+    # -- lock reference resolution ------------------------------------------
+
+    def resolve_lock_expr(
+        self, expr: ast.AST, fn: FuncNode, aliases: Dict[str, str]
+    ) -> Optional[str]:
+        """Lock id a ``with X:`` / ``X.acquire()`` receiver refers to, or
+        None when the expression is not a tracked lock."""
+        name = dotted_name(expr)
+        if name is None:
+            return None
+        if name in aliases:
+            return aliases[name]
+        head, _, rest = name.partition(".")
+        if head in ("self", "cls") and rest and "." not in rest:
+            if fn.owner_class is not None:
+                lid = f"{fn.module.dotted_name}.{fn.owner_class}.{rest}"
+                if lid in self.locks:
+                    return lid
+            return None
+        if not rest:
+            lid = f"{fn.module.dotted_name}.{name}"
+            if lid in self.locks:
+                return lid
+            mapped = fn.imports.get(name)
+            if mapped is not None and mapped in self.locks:
+                return mapped
+            return None
+        # dotted: expand the head through imports (mod._LOCK), else try a
+        # same-module qualified reference (ClassName._lock)
+        mapped = fn.imports.get(head)
+        if mapped is not None:
+            lid = f"{mapped}.{rest}"
+            if lid in self.locks:
+                return lid
+        lid = f"{fn.module.dotted_name}.{name}"
+        if lid in self.locks:
+            return lid
+        return None
+
+    # -- per-function walk --------------------------------------------------
+
+    def _analyze_function(self, fn: FuncNode) -> LockFacts:
+        facts = LockFacts(fn)
+        if not isinstance(fn.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return facts  # jitted lambdas carry no statements
+        aliases: Dict[str, str] = {}
+        self._walk_body(fn.node.body, frozenset(), fn, aliases, facts)
+        return facts
+
+    def _acquire_stmt_target(
+        self, stmt: ast.stmt, fn: FuncNode, aliases: Dict[str, str]
+    ) -> Optional[str]:
+        """Lock id when ``stmt`` is a bare ``X.acquire()`` statement."""
+        if not (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Call)
+            and isinstance(stmt.value.func, ast.Attribute)
+            and stmt.value.func.attr == "acquire"
+        ):
+            return None
+        return self.resolve_lock_expr(stmt.value.func.value, fn, aliases)
+
+    def _releases_in(
+        self, stmts: List[ast.stmt], lock_id: str, fn: FuncNode,
+        aliases: Dict[str, str],
+    ) -> bool:
+        for stmt in stmts:
+            for node in walk_scope(stmt):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "release"
+                    and self.resolve_lock_expr(node.func.value, fn, aliases)
+                    == lock_id
+                ):
+                    return True
+        return False
+
+    def _walk_body(
+        self,
+        stmts: List[ast.stmt],
+        held: FrozenSet[str],
+        fn: FuncNode,
+        aliases: Dict[str, str],
+        facts: LockFacts,
+    ) -> None:
+        i = 0
+        stmts = list(stmts)
+        while i < len(stmts):
+            stmt = stmts[i]
+            lid = self._acquire_stmt_target(stmt, fn, aliases)
+            if lid is not None:
+                nxt = stmts[i + 1] if i + 1 < len(stmts) else None
+                guarded = (
+                    isinstance(nxt, ast.Try)
+                    and bool(nxt.finalbody)
+                    and self._releases_in(nxt.finalbody, lid, fn, aliases)
+                )
+                facts.bare_acquires.append(
+                    BareAcquire(lid, stmt.lineno, guarded)
+                )
+                facts.acquires.append(LockAcquire(lid, stmt.lineno, held))
+                if guarded:
+                    self._walk_stmt(nxt, held | {lid}, fn, aliases, facts)
+                    i += 2
+                else:
+                    # no guaranteed release: treat as held for the rest of
+                    # this suite (best effort for the downstream rules)
+                    held = held | {lid}
+                    i += 1
+                continue
+            # explicit release drops the lock for the rest of the suite
+            if (
+                isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)
+                and stmt.value.func.attr == "release"
+            ):
+                rid = self.resolve_lock_expr(
+                    stmt.value.func.value, fn, aliases
+                )
+                if rid is not None and rid in held:
+                    held = held - {rid}
+                    i += 1
+                    continue
+            self._walk_stmt(stmt, held, fn, aliases, facts)
+            i += 1
+
+    def _walk_stmt(
+        self,
+        stmt: ast.stmt,
+        held: FrozenSet[str],
+        fn: FuncNode,
+        aliases: Dict[str, str],
+        facts: LockFacts,
+    ) -> None:
+        if isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            return  # nested scope: analyzed as its own FuncNode
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in stmt.items:
+                self._scan_expr(item.context_expr, new_held, fn, aliases, facts)
+                lid = self.resolve_lock_expr(item.context_expr, fn, aliases)
+                if lid is not None:
+                    facts.acquires.append(
+                        LockAcquire(lid, item.context_expr.lineno, new_held)
+                    )
+                    new_held = new_held | {lid}
+            self._walk_body(stmt.body, new_held, fn, aliases, facts)
+            return
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+        ):
+            lid = self.resolve_lock_expr(stmt.value, fn, aliases)
+            if lid is not None:
+                aliases[stmt.targets[0].id] = lid
+                return
+        # compound statements: their suites keep the current held set
+        for field_name in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field_name, None)
+            if isinstance(sub, list) and sub and isinstance(sub[0], ast.stmt):
+                self._walk_body(sub, held, fn, aliases, facts)
+        for handler in getattr(stmt, "handlers", None) or []:
+            if handler.type is not None:
+                self._scan_expr(handler.type, held, fn, aliases, facts)
+            self._walk_body(handler.body, held, fn, aliases, facts)
+        for case in getattr(stmt, "cases", None) or []:
+            if case.guard is not None:
+                self._scan_expr(case.guard, held, fn, aliases, facts)
+            self._walk_body(case.body, held, fn, aliases, facts)
+        # the statement's own expressions (test, iter, targets, value, ...)
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                continue
+            if child.__class__.__name__ == "match_case":
+                continue
+            self._scan_expr(child, held, fn, aliases, facts)
+
+    def _scan_expr(
+        self,
+        expr: ast.AST,
+        held: FrozenSet[str],
+        fn: FuncNode,
+        aliases: Dict[str, str],
+        facts: LockFacts,
+    ) -> None:
+        stack: List[ast.AST] = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue  # deferred execution: not under the caller's locks
+            if isinstance(node, ast.Await):
+                facts.awaits.append((node.lineno, held))
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                callee = (
+                    self.graph.resolve_name(name, fn.scope, fn.imports)
+                    if name is not None
+                    else None
+                )
+                facts.calls.append(
+                    LockCallSite(
+                        qual=resolve_call(node.func, fn.imports),
+                        callee=callee,
+                        lineno=node.lineno,
+                        held=held,
+                        method=(
+                            node.func.attr
+                            if isinstance(node.func, ast.Attribute)
+                            else None
+                        ),
+                        nargs=len(node.args) + len(node.keywords),
+                    )
+                )
+            for child in ast.iter_child_nodes(node):
+                stack.append(child)
+
+    # -- interprocedural closure --------------------------------------------
+
+    def _fixpoint(self) -> Dict[FuncNode, FrozenSet[str]]:
+        may: Dict[FuncNode, Set[str]] = {
+            fn: {a.lock for a in f.acquires}
+            for fn, f in self.facts.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for fn, f in self.facts.items():
+                cur = may[fn]
+                for cs in f.calls:
+                    if cs.callee is not None and cs.callee in may:
+                        extra = may[cs.callee] - cur
+                        if extra:
+                            cur |= extra
+                            changed = True
+        return {fn: frozenset(s) for fn, s in may.items()}

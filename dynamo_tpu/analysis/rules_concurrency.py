@@ -1,0 +1,414 @@
+"""Concurrency-soundness rules: lock-set tracking over the project call
+graph (core.LockAnalysis).
+
+The runtime mixes threading locks (telemetry rings, profiling buffers,
+the KV indexer) with a single-threaded asyncio control plane, and the
+failure modes are exactly the classics:
+
+- ``lock-self-deadlock`` — re-acquiring a non-reentrant lock the thread
+  already holds, directly or through a callee (the PR14 shape: the lag
+  sampler called ``timeline()`` — which takes the module lock — while
+  holding that same lock; first sample deadlocked the process).
+- ``lock-order-inversion`` — two locks acquired in opposite orders on
+  different paths (cycle in the acquires-while-holding graph); each
+  order works alone, together they deadlock under contention.
+- ``blocking-under-lock`` — blocking IO, ``time.sleep``, subprocesses,
+  ``.result()``, or a JAX host sync while holding a lock: every other
+  thread touching that lock stalls behind one slow syscall, and on the
+  engine path that serializes the TPU pipeline behind the lock.
+- ``await-under-threading-lock`` — ``await`` inside a ``with`` on a
+  *threading* lock: the coroutine parks while the OS lock stays held,
+  so any other thread (or any other task resumed on a thread that
+  touches the lock) deadlocks the loop.
+- ``lock-leak`` — a bare ``lock.acquire()`` with no guaranteed release
+  (no try/finally, no context manager): the first exception between
+  acquire and release leaves the lock held forever.
+
+All five build on the shared lock-set facts: lock identities resolved
+to module/class-attribute names, per-function held-sets (flow-aware
+within a function), and the ``may_acquire`` fixpoint across resolved
+call sites. The analysis is a may-approximation — a lock taken under
+``if`` counts as taken — so intentional patterns get a line-level
+``# dynlint: disable=<rule>`` with a reason, never a baseline entry.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from dynamo_tpu.analysis.core import (
+    Finding,
+    FuncNode,
+    LockAnalysis,
+    Module,
+    Project,
+    Rule,
+)
+from dynamo_tpu.analysis.rules_async import (
+    _BLOCKING_EXACT,
+    _BLOCKING_METHODS,
+    _BLOCKING_PREFIXES,
+)
+
+# device→host syncs block the calling thread until the TPU drains; under a
+# lock they serialize every sibling thread behind device latency
+_JAX_SYNC_EXACT = {"jax.device_get", "jax.block_until_ready"}
+_JAX_SYNC_METHODS = {"block_until_ready"}
+# future.result() blocks the thread until another worker finishes — the
+# canonical lock-ordering trap when that worker needs the same lock
+_FUTURE_METHODS = {"result"}
+
+# lock-wrapper classes implement the context-manager protocol across
+# methods: acquire in __enter__, release in __exit__. Flagging those
+# acquires would outlaw writing a lock wrapper at all.
+_LOCK_LEAK_EXEMPT_METHODS = {
+    "__enter__",
+    "__exit__",
+    "__aenter__",
+    "__aexit__",
+    "acquire",
+    "release",
+    "locked",
+}
+
+
+def _threading_held(
+    held: FrozenSet[str], analysis: LockAnalysis
+) -> List[str]:
+    """The threading-kind locks in a held set, sorted for determinism."""
+    out = []
+    for lid in held:
+        info = analysis.lock(lid)
+        if info is not None and info.kind == "threading":
+            out.append(lid)
+    return sorted(out)
+
+
+def _blocking_hit(cs) -> Optional[str]:
+    """Human-readable name of the blocking operation a call site performs
+    directly, or None. Mirrors rules_async's blocking-call detection plus
+    the JAX host syncs and ``future.result()``."""
+    qual = cs.qual or ""
+    if qual in _BLOCKING_EXACT or qual in _JAX_SYNC_EXACT:
+        return qual
+    if qual.startswith(_BLOCKING_PREFIXES):
+        return qual
+    if cs.method in _BLOCKING_METHODS or cs.method in _JAX_SYNC_METHODS:
+        return f".{cs.method}"
+    if cs.method in _FUTURE_METHODS and cs.nargs == 0:
+        # zero-arg .result() — the concurrent.futures blocking wait shape
+        # (request.result / dict.result name collisions all take args)
+        return f".{cs.method}"
+    return None
+
+
+class _LockRule(Rule):
+    """Shared prepare: pull the memoized lock analysis off the project and
+    let the subclass index its findings per module."""
+
+    def prepare(self, project: Project) -> None:
+        self._findings: Dict[str, List[Finding]] = {}
+        analysis = project.lock_analysis()
+        self._collect(project, analysis)
+
+    def _collect(self, project: Project, analysis: LockAnalysis) -> None:
+        raise NotImplementedError
+
+    def _add(self, relpath: str, finding: Finding) -> None:
+        self._findings.setdefault(relpath, []).append(finding)
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        yield from self._findings.get(module.relpath, [])
+
+
+class LockSelfDeadlockRule(_LockRule):
+    name = "lock-self-deadlock"
+    project_wide = True  # an edit to a callee can deadlock unchanged callers
+    description = (
+        "re-acquisition of a non-reentrant lock the thread already holds, "
+        "directly or through a called function; threading.Lock/asyncio.Lock "
+        "do not re-enter, so this deadlocks on first execution"
+    )
+
+    def _collect(self, project: Project, analysis: LockAnalysis) -> None:
+        for fn, facts in analysis.facts.items():
+            # direct: with lock: ... with lock: (or a nested bare acquire)
+            for acq in facts.acquires:
+                if acq.lock in acq.held and not analysis.is_reentrant(acq.lock):
+                    self._add(
+                        fn.module.relpath,
+                        Finding(
+                            fn.module.relpath,
+                            acq.lineno,
+                            self.name,
+                            f"{fn.qualname} re-acquires non-reentrant lock "
+                            f"{acq.lock} it already holds; this deadlocks "
+                            f"the thread (use threading.RLock only if "
+                            f"re-entry is truly intended)",
+                        ),
+                    )
+            # via a callee: f holds L and calls g, and g may acquire L
+            for cs in facts.calls:
+                if cs.callee is None or not cs.held:
+                    continue
+                may = analysis.may_acquire.get(cs.callee, frozenset())
+                clashes = sorted(
+                    lid
+                    for lid in cs.held
+                    if lid in may and not analysis.is_reentrant(lid)
+                )
+                if clashes:
+                    self._add(
+                        fn.module.relpath,
+                        Finding(
+                            fn.module.relpath,
+                            cs.lineno,
+                            self.name,
+                            f"{fn.qualname} calls "
+                            f"{cs.callee.qualname}() while holding "
+                            f"{', '.join(clashes)}, which that callee may "
+                            f"re-acquire; this deadlocks the thread — "
+                            f"resolve the value before taking the lock",
+                        ),
+                    )
+
+
+class LockOrderInversionRule(_LockRule):
+    name = "lock-order-inversion"
+    project_wide = True  # the conflicting order usually lives in another file
+    description = (
+        "two locks acquired in opposite orders on different code paths "
+        "(a cycle in the acquires-while-holding graph); each order works "
+        "alone, together they deadlock under contention"
+    )
+
+    def _collect(self, project: Project, analysis: LockAnalysis) -> None:
+        # edge (a, b) = "b acquired while holding a", with one witness site
+        # per edge (first in deterministic fn/lineno order)
+        edges: Dict[Tuple[str, str], Tuple[FuncNode, int]] = {}
+
+        def note(a: str, b: str, fn: FuncNode, lineno: int) -> None:
+            if a != b and (a, b) not in edges:
+                edges[(a, b)] = (fn, lineno)
+
+        for fn, facts in analysis.facts.items():
+            for acq in facts.acquires:
+                for h in sorted(acq.held):
+                    note(h, acq.lock, fn, acq.lineno)
+            for cs in facts.calls:
+                if cs.callee is None or not cs.held:
+                    continue
+                for lid in sorted(
+                    analysis.may_acquire.get(cs.callee, frozenset())
+                ):
+                    for h in sorted(cs.held):
+                        note(h, lid, fn, cs.lineno)
+
+        adj: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        sccs = _strongly_connected(adj)
+        in_cycle = {
+            node: frozenset(scc)
+            for scc in sccs
+            if len(scc) > 1
+            for node in scc
+        }
+        for (a, b), (fn, lineno) in sorted(
+            edges.items(), key=lambda kv: (kv[0][0], kv[0][1])
+        ):
+            scc = in_cycle.get(a)
+            if scc is None or b not in scc:
+                continue
+            cycle = ", ".join(sorted(scc))
+            self._add(
+                fn.module.relpath,
+                Finding(
+                    fn.module.relpath,
+                    lineno,
+                    self.name,
+                    f"{fn.qualname} acquires {b} while holding {a}, but "
+                    f"another path acquires them in the opposite order "
+                    f"(deadlock cycle: {cycle}); pick one global order",
+                ),
+            )
+
+
+def _strongly_connected(adj: Dict[str, Set[str]]) -> List[List[str]]:
+    """Iterative Tarjan SCC over the lock-order digraph (deterministic:
+    nodes visited in sorted order)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for start in sorted(adj):
+        if start in index:
+            continue
+        work: List[Tuple[str, Iterator[str]]] = [
+            (start, iter(sorted(adj[start])))
+        ]
+        index[start] = low[start] = counter[0]
+        counter[0] += 1
+        stack.append(start)
+        on_stack.add(start)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for child in it:
+                if child not in index:
+                    index[child] = low[child] = counter[0]
+                    counter[0] += 1
+                    stack.append(child)
+                    on_stack.add(child)
+                    work.append((child, iter(sorted(adj[child]))))
+                    advanced = True
+                    break
+                if child in on_stack:
+                    low[node] = min(low[node], index[child])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc: List[str] = []
+                while True:
+                    top = stack.pop()
+                    on_stack.discard(top)
+                    scc.append(top)
+                    if top == node:
+                        break
+                sccs.append(sorted(scc))
+    return sccs
+
+
+class BlockingUnderLockRule(_LockRule):
+    name = "blocking-under-lock"
+    project_wide = True  # new blocking in a callee hits unchanged callers
+    description = (
+        "blocking operation (file/socket IO, time.sleep, subprocess, "
+        "future.result(), JAX device sync) while holding a threading "
+        "lock — every other thread touching that lock stalls behind one "
+        "slow syscall"
+    )
+
+    def _collect(self, project: Project, analysis: LockAnalysis) -> None:
+        # may_block fixpoint: function → witness ("time.sleep" or a chain
+        # through callees), so the finding can say WHAT blocks
+        may_block: Dict[FuncNode, str] = {}
+        for fn, facts in analysis.facts.items():
+            for cs in facts.calls:
+                hit = _blocking_hit(cs)
+                if hit is not None:
+                    may_block.setdefault(fn, hit)
+                    break
+        changed = True
+        while changed:
+            changed = False
+            for fn, facts in analysis.facts.items():
+                if fn in may_block:
+                    continue
+                for cs in facts.calls:
+                    if cs.callee is not None and cs.callee in may_block:
+                        may_block[fn] = (
+                            f"{may_block[cs.callee]} via "
+                            f"{cs.callee.qualname}()"
+                        )
+                        changed = True
+                        break
+
+        for fn, facts in analysis.facts.items():
+            for cs in facts.calls:
+                locks = _threading_held(cs.held, analysis)
+                if not locks:
+                    continue
+                hit = _blocking_hit(cs)
+                if hit is not None:
+                    self._add(
+                        fn.module.relpath,
+                        Finding(
+                            fn.module.relpath,
+                            cs.lineno,
+                            self.name,
+                            f"{fn.qualname} performs blocking {hit}() "
+                            f"while holding {', '.join(locks)}; move the "
+                            f"blocking work outside the locked region",
+                        ),
+                    )
+                    continue
+                if cs.callee is not None and cs.callee in may_block:
+                    self._add(
+                        fn.module.relpath,
+                        Finding(
+                            fn.module.relpath,
+                            cs.lineno,
+                            self.name,
+                            f"{fn.qualname} calls {cs.callee.qualname}() "
+                            f"— which may block ({may_block[cs.callee]}) "
+                            f"— while holding {', '.join(locks)}; move "
+                            f"the call outside the locked region",
+                        ),
+                    )
+
+
+class AwaitUnderThreadingLockRule(_LockRule):
+    name = "await-under-threading-lock"
+    description = (
+        "`await` inside a `with` block on a threading lock: the coroutine "
+        "suspends with the OS lock held, blocking every thread (and any "
+        "loop callback) that touches the lock until the task resumes; use "
+        "asyncio.Lock, or release before awaiting"
+    )
+
+    def _collect(self, project: Project, analysis: LockAnalysis) -> None:
+        for fn, facts in analysis.facts.items():
+            for lineno, held in facts.awaits:
+                locks = _threading_held(held, analysis)
+                if locks:
+                    self._add(
+                        fn.module.relpath,
+                        Finding(
+                            fn.module.relpath,
+                            lineno,
+                            self.name,
+                            f"{fn.qualname} awaits while holding threading "
+                            f"lock {', '.join(locks)}; the lock stays held "
+                            f"across the suspension — use asyncio.Lock or "
+                            f"release before awaiting",
+                        ),
+                    )
+
+
+class LockLeakRule(_LockRule):
+    name = "lock-leak"
+    description = (
+        "bare lock.acquire() without a guaranteed release (no with-block, "
+        "no immediate try/finally): the first exception between acquire "
+        "and release leaves the lock held forever"
+    )
+
+    def _collect(self, project: Project, analysis: LockAnalysis) -> None:
+        for fn, facts in analysis.facts.items():
+            simple_name = fn.qualname.rpartition(".")[2]
+            if simple_name in _LOCK_LEAK_EXEMPT_METHODS:
+                continue
+            for ba in facts.bare_acquires:
+                if ba.guarded:
+                    continue
+                self._add(
+                    fn.module.relpath,
+                    Finding(
+                        fn.module.relpath,
+                        ba.lineno,
+                        self.name,
+                        f"{fn.qualname} acquires {ba.lock} without a "
+                        f"guaranteed release; use `with {ba.lock.rpartition('.')[2]}:` "
+                        f"or follow the acquire with try/finally that "
+                        f"releases it",
+                    ),
+                )

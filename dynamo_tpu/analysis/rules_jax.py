@@ -25,8 +25,7 @@ invariant statically:
 from __future__ import annotations
 
 import ast
-from collections import deque
-from typing import Dict, Iterator, List, Optional, Set, Tuple
+from typing import Dict, Iterator, List, Optional
 
 from dynamo_tpu.analysis.core import (
     Finding,
@@ -34,21 +33,10 @@ from dynamo_tpu.analysis.core import (
     Project,
     Rule,
     collect_imports,
-    dotted_name,
     resolve_call,
     walk_scope,
 )
 
-_JIT_NAMES = {"jax.jit", "jax.pjit", "pjit", "jit"}
-_TRANSFORM_WRAPPERS = {
-    # f in jax.jit(transform(f)) is still traced; treat these as transparent
-    "functools.partial",
-    "partial",
-    "jax.vmap",
-    "jax.pmap",
-    "jax.checkpoint",
-    "jax.remat",
-}
 _HOST_SYNC_EXACT = {
     "jax.device_get",
     "jax.block_until_ready",
@@ -87,212 +75,6 @@ _IMPORT_TIME_EXACT = {
 }
 
 
-class _FuncNode:
-    """One function (or jitted lambda) in the project call graph."""
-
-    __slots__ = ("module", "qualname", "node", "scope", "imports")
-
-    def __init__(self, module: Module, qualname: str, node: ast.AST, scope, imports):
-        self.module = module
-        self.qualname = qualname
-        self.node = node  # FunctionDef | AsyncFunctionDef | Lambda
-        self.scope = scope  # list of dicts name → _FuncNode, innermost last
-        self.imports = imports  # Dict[str, str] visible at the def site
-
-    @property
-    def display(self) -> str:
-        return f"{self.module.relpath}:{self.qualname}"
-
-
-class _CallGraph:
-    """Project call graph seeded at jax.jit sites.
-
-    Edges are name references: within a function's own scope, every
-    referenced name that resolves to a function — nested def, sibling,
-    module-level def, or a cross-module import of a project function —
-    is an edge. This over-approximates calls (a function passed to
-    jax.lax.scan/vmap is reachable even though never called by name),
-    which is exactly right for trace reachability.
-    """
-
-    def __init__(self, project: Project):
-        self.project = project
-        self.roots: List[_FuncNode] = []
-        # (module_dotted, top_level_name) → node, for import resolution
-        self.top_level: Dict[Tuple[str, str], _FuncNode] = {}
-        self._anon = 0
-        for module in project.modules:
-            self._index_module(module)
-
-    # -- indexing -----------------------------------------------------------
-
-    def _index_module(self, module: Module) -> None:
-        mod_imports = collect_imports(module.tree.body, module.package)
-        mod_scope: Dict[str, _FuncNode] = {}
-        self._visit_body(
-            module, module.tree.body, [mod_scope], mod_imports, prefix="",
-            register_top=True,
-        )
-
-    def _visit_body(
-        self,
-        module: Module,
-        body: List[ast.stmt],
-        scope_chain,
-        imports: Dict[str, str],
-        prefix: str,
-        register_top: bool = False,
-    ) -> None:
-        local_scope = scope_chain[-1]
-        # pass 1: register defs so forward references resolve
-        funcs: List[Tuple[str, ast.AST]] = []
-        for stmt in body:
-            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                qual = f"{prefix}{stmt.name}"
-                node = _FuncNode(module, qual, stmt, list(scope_chain), dict(imports))
-                local_scope[stmt.name] = node
-                funcs.append((stmt.name, stmt))
-                if register_top:
-                    self.top_level[(module.dotted_name, stmt.name)] = node
-                if self._is_jit_decorated(stmt, imports):
-                    self.roots.append(node)
-            elif isinstance(stmt, ast.ClassDef):
-                # methods get their own scope dict ON the chain, so
-                # jax.jit(self.method) inside a sibling method resolves
-                # (see the self/cls branch in _resolve_name)
-                self._visit_body(
-                    module, stmt.body, scope_chain + [{}], imports,
-                    prefix=f"{prefix}{stmt.name}.",
-                )
-        # pass 2: descend into each function with its own scope + imports
-        for name, stmt in funcs:
-            node = local_scope[name]
-            fn_imports = dict(imports)
-            fn_imports.update(collect_imports(walk_scope(stmt), module.package))
-            node.imports = fn_imports
-            inner_scope: Dict[str, _FuncNode] = {}
-            self._visit_body(
-                module, stmt.body, node.scope + [inner_scope], fn_imports,
-                prefix=f"{node.qualname}.",
-            )
-            node.scope = node.scope + [inner_scope]
-            self._find_jit_calls(module, stmt, node.scope, fn_imports)
-        # jit calls at this level (module body / class body)
-        stmts_here = [
-            s for s in body
-            if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))
-        ]
-        for stmt in stmts_here:
-            self._find_jit_calls_in(module, walk_scope(stmt), scope_chain, imports)
-
-    def _is_jit_decorated(self, stmt: ast.AST, imports: Dict[str, str]) -> bool:
-        for dec in getattr(stmt, "decorator_list", []):
-            target = dec.func if isinstance(dec, ast.Call) else dec
-            qual = resolve_call(target, imports) or ""
-            if qual in _JIT_NAMES:
-                return True
-            if qual in _TRANSFORM_WRAPPERS and isinstance(dec, ast.Call):
-                # @partial(jax.jit, ...) — jit appears among the args
-                for arg in dec.args:
-                    if (resolve_call(arg, imports) or "") in _JIT_NAMES:
-                        return True
-        return False
-
-    def _find_jit_calls(self, module, func_stmt, scope_chain, imports) -> None:
-        self._find_jit_calls_in(module, walk_scope(func_stmt), scope_chain, imports)
-
-    def _find_jit_calls_in(self, module, nodes, scope_chain, imports) -> None:
-        for node in nodes:
-            if not isinstance(node, ast.Call):
-                continue
-            qual = resolve_call(node.func, imports) or ""
-            if qual not in _JIT_NAMES or not node.args:
-                continue
-            self._seed_root(module, node.args[0], scope_chain, imports)
-
-    def _seed_root(self, module, arg: ast.AST, scope_chain, imports) -> None:
-        if isinstance(arg, ast.Lambda):
-            self._anon += 1
-            self.roots.append(
-                _FuncNode(
-                    module, f"<lambda#{self._anon}>", arg, list(scope_chain),
-                    dict(imports),
-                )
-            )
-            return
-        if isinstance(arg, ast.Call):
-            # jax.jit(partial(f, ...)) / jax.jit(vmap(f)) — unwrap
-            inner_qual = resolve_call(arg.func, imports) or ""
-            if inner_qual in _TRANSFORM_WRAPPERS and arg.args:
-                self._seed_root(module, arg.args[0], scope_chain, imports)
-            return
-        name = dotted_name(arg)
-        if name is None:
-            return
-        target = self._resolve_name(name, scope_chain, imports)
-        if target is not None:
-            self.roots.append(target)
-
-    # -- resolution ---------------------------------------------------------
-
-    def _resolve_name(
-        self, name: str, scope_chain, imports: Dict[str, str]
-    ) -> Optional[_FuncNode]:
-        head, _, rest = name.partition(".")
-        # innermost scope wins
-        if not rest:
-            for scope in reversed(scope_chain):
-                if head in scope:
-                    return scope[head]
-        # self.method / cls.method: the enclosing class's scope dict is on
-        # the chain, so jax.jit(self._step) seeds the method as a root
-        if head in ("self", "cls") and rest and "." not in rest:
-            for scope in reversed(scope_chain):
-                if rest in scope:
-                    return scope[rest]
-        qual = imports.get(head)
-        if qual is not None:
-            full = f"{qual}.{rest}" if rest else qual
-            mod_name, _, sym = full.rpartition(".")
-            node = self.top_level.get((mod_name, sym))
-            if node is not None:
-                return node
-        return None
-
-    # -- reachability -------------------------------------------------------
-
-    def reachable(self) -> Dict[_FuncNode, str]:
-        """BFS from jit roots → {function node: name of the seeding root}."""
-        reached: Dict[_FuncNode, str] = {}
-        queue = deque()
-        for root in self.roots:
-            if root not in reached:
-                reached[root] = root.qualname
-                queue.append(root)
-        while queue:
-            u = queue.popleft()
-            for v in self._edges(u):
-                if v not in reached:
-                    reached[v] = reached[u]
-                    queue.append(v)
-        return reached
-
-    def _edges(self, u: _FuncNode) -> Iterator[_FuncNode]:
-        seen: Set[_FuncNode] = set()
-        for node in walk_scope(u.node):
-            name: Optional[str] = None
-            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
-                name = node.id
-            elif isinstance(node, ast.Attribute):
-                name = dotted_name(node)
-            if name is None:
-                continue
-            target = self._resolve_name(name, u.scope, u.imports)
-            if target is not None and target is not u and target not in seen:
-                seen.add(target)
-                yield target
-
-
 class JitHostSyncRule(Rule):
     name = "jit-host-sync"
     project_wide = True  # a changed jit root can make UNCHANGED helpers hot
@@ -304,7 +86,10 @@ class JitHostSyncRule(Rule):
     )
 
     def prepare(self, project: Project) -> None:
-        graph = _CallGraph(project)
+        # the shared project call graph (core.CallGraph): this rule grew
+        # the graph originally; it now lives in core so the concurrency
+        # pack's lock-set analysis shares one index per run
+        graph = project.call_graph()
         reached = graph.reachable()
         self._findings: Dict[str, List[Finding]] = {}
         for func, root in reached.items():

@@ -1,0 +1,204 @@
+"""Knob discipline: every ``DYN_TPU_*`` env read goes through envknobs.
+
+The runtime's operational surface is its env knobs, and the PR3 contract
+("malformed/out-of-range degrades to the documented default, never to a
+surprise policy") only holds where the shared parsers in
+``runtime/envknobs.py`` are used. A raw ``os.environ.get("DYN_TPU_X")``
+silently opts the knob out of clamping AND out of the knob catalog that
+``dynlint --list-knobs`` cross-checks against the docs — so the rule
+flags every raw read of a ``DYN_TPU_*`` name outside the one shared
+home.
+
+Knob names are resolved like a constant folder: string literals,
+module-level ``ENV_X = "DYN_TPU_X"`` constants, parameter defaults
+(``def from_env(cls, prefix="DYN_TPU_ADMIT_")``), and ``+`` / f-string
+composition of those — the idioms this codebase actually uses.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional
+
+from dynamo_tpu.analysis.core import (
+    Finding,
+    Module,
+    Project,
+    Rule,
+    dotted_name,
+    resolve_call,
+)
+
+KNOB_PREFIX = "DYN_TPU_"
+
+# the one shared home; raw reads are legal only here (plus the helper
+# modules that merely re-export the parsers)
+_KNOB_HOME_SUFFIXES = ("runtime/envknobs.py",)
+
+_RAW_READ_QUALS = {"os.environ.get", "os.getenv"}
+
+# callee names that count as knob parsers for catalog discovery: the
+# canonical env_* helpers and their historical _env_* aliases
+_HELPER_NAME_RE = re.compile(r"^_?env_[a-z_]+$")
+
+
+def _module_consts(tree: ast.Module) -> Dict[str, str]:
+    """name → string value for module/class-level constants and string
+    parameter defaults, the building blocks knob names are composed of."""
+    consts: Dict[str, str] = {}
+    # pass 1: assignments, so pass 2 can resolve defaults that NAME a
+    # constant (def from_env(cls, prefix=ENV_PREFIX) — the qos idiom)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant):
+            if isinstance(node.value.value, str):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        consts.setdefault(tgt.id, node.value.value)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            for arg, default in zip(
+                args.args[len(args.args) - len(args.defaults):], args.defaults
+            ):
+                if isinstance(default, ast.Constant) and isinstance(
+                    default.value, str
+                ):
+                    consts.setdefault(arg.arg, default.value)
+                elif (
+                    isinstance(default, ast.Name)
+                    and default.id in consts
+                ):
+                    consts.setdefault(arg.arg, consts[default.id])
+    return consts
+
+
+def _fold_str(node: ast.AST, consts: Dict[str, str]) -> Optional[str]:
+    """Best-effort constant fold of a knob-name expression."""
+    if isinstance(node, ast.Constant):
+        return node.value if isinstance(node.value, str) else None
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _fold_str(node.left, consts)
+        right = _fold_str(node.right, consts)
+        if left is not None and right is not None:
+            return left + right
+        return None
+    if isinstance(node, ast.JoinedStr):
+        parts: List[str] = []
+        for value in node.values:
+            if isinstance(value, ast.Constant):
+                if not isinstance(value.value, str):
+                    return None
+                parts.append(value.value)
+            elif isinstance(value, ast.FormattedValue):
+                folded = _fold_str(value.value, consts)
+                if folded is None:
+                    return None
+                parts.append(folded)
+            else:
+                return None
+        return "".join(parts)
+    return None
+
+
+def _raw_read_name(
+    node: ast.AST, imports: Dict[str, str], consts: Dict[str, str]
+) -> Optional[str]:
+    """The knob name a raw environment read refers to, or None if the
+    node is not a raw read / not resolvable to a DYN_TPU_* name."""
+    name_expr: Optional[ast.AST] = None
+    if isinstance(node, ast.Call):
+        qual = resolve_call(node.func, imports) or ""
+        if qual in _RAW_READ_QUALS and node.args:
+            name_expr = node.args[0]
+    elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+        base = dotted_name(node.value)
+        if base is not None:
+            head, _, rest = base.partition(".")
+            mapped = imports.get(head, head)
+            full = f"{mapped}.{rest}" if rest else mapped
+            if full == "os.environ":
+                name_expr = node.slice
+    if name_expr is None:
+        return None
+    folded = _fold_str(name_expr, consts)
+    if folded is not None and folded.startswith(KNOB_PREFIX):
+        return folded
+    return None
+
+
+class KnobDisciplineRule(Rule):
+    name = "knob-discipline"
+    description = (
+        "raw os.environ/os.getenv read of a DYN_TPU_* knob outside "
+        "runtime/envknobs.py: it skips the PR3 clamping contract "
+        "(malformed values must degrade to the documented default) and "
+        "hides the knob from `dynlint --list-knobs`"
+    )
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if module.relpath.endswith(_KNOB_HOME_SUFFIXES):
+            return
+        from dynamo_tpu.analysis.core import collect_imports
+
+        imports = collect_imports(ast.walk(module.tree), module.package)
+        consts = _module_consts(module.tree)
+        for node in ast.walk(module.tree):
+            knob = _raw_read_name(node, imports, consts)
+            if knob is not None:
+                yield Finding(
+                    module.relpath,
+                    node.lineno,
+                    self.name,
+                    f"raw environment read of {knob}; route it through the "
+                    f"shared parsers in runtime/envknobs.py so the "
+                    f"clamping contract and the knob catalog cover it",
+                )
+
+
+# --------------------------------------------------------------------------
+# knob catalog (dynlint --list-knobs)
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One discovered DYN_TPU_* knob read."""
+
+    name: str
+    helper: str  # the envknobs parser (or raw read) it goes through
+    relpath: str
+    lineno: int
+
+
+def collect_knobs(project: Project) -> List[Knob]:
+    """Every DYN_TPU_* knob the project reads, discovered from calls into
+    the envknobs parsers (and any remaining raw reads, so an undisciplined
+    knob still shows up in the catalog rather than vanishing)."""
+    from dynamo_tpu.analysis.core import collect_imports
+
+    knobs: Dict[tuple, Knob] = {}
+    for module in project.modules:
+        imports = collect_imports(ast.walk(module.tree), module.package)
+        consts = _module_consts(module.tree)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                callee = dotted_name(node.func) or ""
+                simple = callee.rpartition(".")[2]
+                if _HELPER_NAME_RE.match(simple) and node.args:
+                    folded = _fold_str(node.args[0], consts)
+                    if folded is not None and folded.startswith(KNOB_PREFIX):
+                        k = Knob(folded, simple.lstrip("_"), module.relpath,
+                                 node.lineno)
+                        knobs.setdefault((k.name, k.relpath, k.lineno), k)
+                        continue
+            raw = _raw_read_name(node, imports, consts)
+            if raw is not None:
+                k = Knob(raw, "raw", module.relpath, node.lineno)
+                knobs.setdefault((k.name, k.relpath, k.lineno), k)
+    return sorted(
+        knobs.values(), key=lambda k: (k.name, k.relpath, k.lineno)
+    )

@@ -360,12 +360,11 @@ async def _wait_quarantined(store, base: str, args) -> int:
 async def amain(argv: list) -> int:
     args = build_parser().parse_args(argv)
 
-    import os
-
     from dynamo_tpu.runtime.distributed import parse_endpoint_path
+    from dynamo_tpu.runtime.envknobs import env_str
     from dynamo_tpu.runtime.statestore import StateStoreClient
 
-    url = args.statestore or os.environ.get("DYN_TPU_STATESTORE", "127.0.0.1:37901")
+    url = args.statestore or env_str("DYN_TPU_STATESTORE", "127.0.0.1:37901")
     try:
         store = await StateStoreClient.connect(url)
     except (ConnectionError, OSError) as e:

@@ -35,7 +35,9 @@ from typing import Optional
 # DYN_TPU_PLATFORM=cpu lets auxiliary processes (frontends, prefill workers on
 # a host without a free chip) run on CPU even when the environment pins a TPU
 # plugin. Must be applied before any model/engine import touches jax.
-_platform = os.environ.get("DYN_TPU_PLATFORM")
+from dynamo_tpu.runtime.envknobs import env_raw
+
+_platform = env_raw("DYN_TPU_PLATFORM")
 if _platform:
     import jax
 
@@ -430,12 +432,16 @@ async def run_batch(engine, model_name: str, batch_file: str) -> None:
 
     Reference: input/batch.rs:289.
     """
-    prompts = []
-    with open(batch_file) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                prompts.append(json.loads(line))
+    def _read_prompts() -> list:
+        out = []
+        with open(batch_file) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+        return out
+
+    prompts = await asyncio.to_thread(_read_prompts)
 
     ttfts, itls, counts = [], [], []
     t_start = time.perf_counter()

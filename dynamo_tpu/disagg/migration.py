@@ -42,7 +42,6 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import logging
-import os
 import threading
 import time
 import weakref
@@ -62,20 +61,12 @@ ENV_MIGRATE_TIMEOUT = "DYN_TPU_MIGRATE_TIMEOUT"
 ENV_MIGRATE_TTL = "DYN_TPU_MIGRATE_TTL"
 
 
-def _env_pos_float(name: str, default: float, lo: float, hi: float) -> float:
-    """Positive-float knob with clamping (PR3 contract): malformed or
-    non-positive values fall back to the default; in-range values clamp
-    into [lo, hi]."""
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        v = float(raw)
-    except ValueError:
-        return default
-    if v <= 0:
-        return default
-    return min(max(v, lo), hi)
+# the knob parsers (PR3 clamping contract) live in the one shared home
+# (runtime/envknobs.py)
+from dynamo_tpu.runtime.envknobs import (  # noqa: E402
+    env_clamped_float as _env_pos_float,
+    env_flag as _env_flag,
+)
 
 
 @dataclass(frozen=True)
@@ -103,12 +94,8 @@ class MigrationPolicy:
     @classmethod
     def from_env(cls) -> "MigrationPolicy":
         d = cls()
-        raw = os.environ.get(ENV_MIGRATE, "")
-        enabled = d.enabled
-        if raw != "":
-            enabled = raw.strip() not in ("0", "false", "off", "no")
         return cls(
-            enabled=enabled,
+            enabled=_env_flag(ENV_MIGRATE, d.enabled),
             drain_deadline=_env_pos_float(
                 ENV_DRAIN_DEADLINE, d.drain_deadline, 1.0, 600.0
             ),

@@ -13,6 +13,8 @@ import os
 import sys
 import threading
 
+from dynamo_tpu.runtime.envknobs import env_raw
+
 _DEFAULT = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))), ".jax_cache")
 
 # process-global count of jitted-program builds (engine step-fn variants,
@@ -58,7 +60,7 @@ def enable_compile_cache(path: str | None = None) -> str:
     Call before the first jit dispatch. DYN_TPU_COMPILE_CACHE overrides the
     location; setting it to "0" disables the cache entirely.
     """
-    env = os.environ.get("DYN_TPU_COMPILE_CACHE")
+    env = env_raw("DYN_TPU_COMPILE_CACHE")
     if env == "0":
         return ""
     target = path or env or _DEFAULT

@@ -37,8 +37,9 @@ defaults, never to a crash or an accidental always-on):
 
 from __future__ import annotations
 
-import os
 from typing import Dict, List, Optional, Sequence, Tuple
+
+from dynamo_tpu.runtime.envknobs import env_raw
 
 # hard bound on draft length: each draft position adds a verified lm_head
 # column and a KV write; past ~16 the acceptance tail can't pay for the
@@ -56,8 +57,8 @@ DORMANT_ACCEPT_FLOOR = 0.08
 def env_spec_k(default: int = 0) -> int:
     """``DYN_TPU_SPEC_K`` with clamping: unset/malformed → default, negative
     → 0 (off), oversized → MAX_SPEC_K."""
-    raw = os.environ.get("DYN_TPU_SPEC_K")
-    if raw is None or raw == "":
+    raw = env_raw("DYN_TPU_SPEC_K")
+    if raw is None:
         return default
     try:
         v = int(raw)
@@ -68,8 +69,8 @@ def env_spec_k(default: int = 0) -> int:
 
 def env_spec_ngram(default: int = 3) -> int:
     """``DYN_TPU_SPEC_NGRAM`` clamped to [1, 8]."""
-    raw = os.environ.get("DYN_TPU_SPEC_NGRAM")
-    if raw is None or raw == "":
+    raw = env_raw("DYN_TPU_SPEC_NGRAM")
+    if raw is None:
         return default
     try:
         v = int(raw)
@@ -82,7 +83,7 @@ def env_kv_dtype(default: str = "bf16") -> str:
     """``DYN_TPU_KV_DTYPE``: only ``int8`` activates quantized pages; any
     other value (including malformed) is the native-dtype default — a typo
     must never silently quantize a serving fleet's KV."""
-    raw = (os.environ.get("DYN_TPU_KV_DTYPE") or "").strip().lower()
+    raw = (env_raw("DYN_TPU_KV_DTYPE") or "").strip().lower()
     return "int8" if raw == "int8" else default
 
 

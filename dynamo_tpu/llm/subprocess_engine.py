@@ -409,7 +409,10 @@ class SubprocessEngine(AsyncEngine):
 
 
 async def _child_main(user_path: str) -> None:
-    fd = int(os.environ[_ENGINE_FD_ENV])
+    # not an operator knob: the parent hands the socket fd to the child it
+    # just spawned, and a missing value is a launch-protocol bug that MUST
+    # raise (KeyError) rather than degrade to a default
+    fd = int(os.environ[_ENGINE_FD_ENV])  # dynlint: disable=knob-discipline
     sock = socket.socket(fileno=fd)
     sock.setblocking(False)
     reader, writer = await asyncio.open_connection(sock=sock)

@@ -26,6 +26,8 @@ import subprocess
 import threading
 from typing import Optional
 
+from dynamo_tpu.runtime.envknobs import env_flag
+
 logger = logging.getLogger(__name__)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
@@ -42,14 +44,19 @@ def load(name: str) -> Optional[ctypes.CDLL]:
     Set DYN_TPU_NO_NATIVE=1 to force the fallbacks (used in tests to cover
     both paths).
     """
-    if os.environ.get("DYN_TPU_NO_NATIVE") == "1":
+    if env_flag("DYN_TPU_NO_NATIVE", False):
         return None
     with _lock:
         if name in _cache:
             return _cache[name]
-        lib = _build_and_load(name)
-        _cache[name] = lib
-        return lib
+    # build OUTSIDE the lock: the compile can run for two minutes, and the
+    # output path is already safe against concurrent builders (per-pid tmp
+    # + atomic os.replace below) — a lost race costs one redundant compile,
+    # while holding the lock would stall every other component's load()
+    # behind this one's g++
+    lib = _build_and_load(name)
+    with _lock:
+        return _cache.setdefault(name, lib)
 
 
 def _build_and_load(name: str) -> Optional[ctypes.CDLL]:

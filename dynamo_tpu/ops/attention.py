@@ -13,12 +13,13 @@ max-blocks-per-seq, batch is padded to fixed slot count, masks do the rest.
 
 from __future__ import annotations
 
-import os
 from functools import lru_cache
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from dynamo_tpu.runtime.envknobs import env_str
 
 
 @lru_cache(maxsize=1)
@@ -45,7 +46,7 @@ def _select_pallas(head_dim: int) -> bool:
     pass ``mesh=`` so the kernel runs under shard_map (Mosaic kernels have
     no GSPMD partitioning rule; shard_map sidesteps auto-partitioning).
     """
-    mode = os.environ.get("DYN_TPU_ATTENTION", "auto")
+    mode = env_str("DYN_TPU_ATTENTION", "auto")
     if mode == "pallas":
         return True
     if mode == "jnp":
@@ -104,7 +105,7 @@ def decode_uses_pallas(
     the per-page-grid v1 schedule, which has no DMA-slice alignment
     constraint.
     """
-    mode = os.environ.get("DYN_TPU_ATTENTION", "auto")
+    mode = env_str("DYN_TPU_ATTENTION", "auto")
     if mode == "jnp":
         return False
     if mesh is not None and not _tp_divisible(mesh, num_heads, num_kv_heads):

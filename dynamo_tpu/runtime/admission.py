@@ -27,7 +27,6 @@ admission at the worker so a router with a stale view cannot overrun it.
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional
 
@@ -133,43 +132,14 @@ class LoadSnapshot:
             return cls()
 
 
-def _env_pos_int(name: str, default: int) -> int:
-    """Positive-int env knob: unset, malformed, zero, or negative values all
-    clamp to the default — a bad value must degrade to sane behavior, never
-    to an admission gate that rejects everything (0) or admits everything
-    (negative treated as unbounded)."""
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        v = int(raw)
-    except ValueError:
-        return default
-    return v if v > 0 else default
-
-
-def _env_pos_float(name: str, default: float) -> float:
-    """Positive-float env knob with the same clamping contract."""
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        v = float(raw)
-    except ValueError:
-        return default
-    return v if v > 0 else default
-
-
-def _env_nonneg_int(name: str, default: int) -> int:
-    """Non-negative int knob (0 is a meaningful 'disabled' value)."""
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        v = int(raw)
-    except ValueError:
-        return default
-    return v if v >= 0 else default
+# knob parsers live in the one shared home (runtime/envknobs.py): a bad
+# value must degrade to sane behavior, never to an admission gate that
+# rejects everything (0) or admits everything (negative as unbounded)
+from dynamo_tpu.runtime.envknobs import (  # noqa: E402
+    env_nonneg_int as _env_nonneg_int,
+    env_pos_float as _env_pos_float,
+    env_pos_int as _env_pos_int,
+)
 
 
 @dataclass

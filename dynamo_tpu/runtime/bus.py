@@ -345,15 +345,21 @@ class MessageBusServer:
             except Exception:
                 logger.exception("async snapshot failed during stop")
         if self._wal is not None:
-            # graceful stop: compact so restart replays a snapshot, not a log
-            # (sync file IO on the one-shot shutdown path — dynlint baseline)
-            self._dump_snapshot(self._state_copy())
-            self._wal.close()
-            self._wal = open(self._wal_path, "w")
-            self._wal.close()
+            # graceful stop: compact so restart replays a snapshot, not a
+            # log; the file IO runs off-loop so a slow disk can't stall
+            # sibling servers sharing this event loop during shutdown
+            state = self._state_copy()
+
+            def _compact() -> None:
+                self._dump_snapshot(state)
+                self._wal.close()
+                wal = open(self._wal_path, "w")
+                wal.close()
+                if os.path.exists(self._wal_old_path):
+                    os.remove(self._wal_old_path)
+
+            await asyncio.to_thread(_compact)
             self._wal = None
-            if os.path.exists(self._wal_old_path):
-                os.remove(self._wal_old_path)
 
     @property
     def url(self) -> str:
@@ -531,13 +537,51 @@ class MessageBusServer:
 
 
 class Subscription:
-    """Async iterator over messages for one subject subscription."""
+    """Async iterator over messages for one subject subscription.
+
+    The delivery queue is bounded (``MAX_QUEUE``): a consumer that stops
+    iterating while the publisher keeps firing sheds the *oldest* buffered
+    message instead of growing without bound (same drop-oldest policy as
+    the KV-event publish bridge in runtime/distributed.py). Bus subjects
+    carry event-plane traffic where the latest message supersedes older
+    ones, so a slow consumer loses history, not liveness; ``dropped``
+    counts the shed messages for observability."""
+
+    MAX_QUEUE = 2048
 
     def __init__(self, client: "MessageBusClient", subject: str, sub_id: str):
         self.client = client
         self.subject = subject
         self.sub_id = sub_id
-        self.queue: asyncio.Queue = asyncio.Queue()
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=self.MAX_QUEUE)
+        self.dropped = 0
+
+    def _offer(self, body: bytes) -> None:
+        """Enqueue for the consumer, evicting oldest on overflow."""
+        while self.queue.full():
+            try:
+                self.queue.get_nowait()
+                self.dropped += 1
+            except asyncio.QueueEmpty:  # pragma: no cover - racy full()
+                break
+        try:
+            self.queue.put_nowait(body)
+        except asyncio.QueueFull:  # pragma: no cover - single-threaded loop
+            self.dropped += 1
+
+    def _close(self) -> None:
+        """Wake the consumer with the end-of-stream sentinel; on a full
+        queue one data item is shed so the sentinel always fits."""
+        while True:
+            try:
+                self.queue.put_nowait(None)
+                return
+            except asyncio.QueueFull:
+                try:
+                    self.queue.get_nowait()
+                    self.dropped += 1
+                except asyncio.QueueEmpty:  # pragma: no cover
+                    pass
 
     def __aiter__(self) -> AsyncIterator[bytes]:
         return self._iter()
@@ -555,7 +599,7 @@ class Subscription:
             await self.client._call({"op": "unsub", "subject": self.subject, "sub_id": self.sub_id})
         except ConnectionError:
             pass
-        self.queue.put_nowait(None)
+        self._close()
 
 
 class MessageBusClient:
@@ -612,7 +656,7 @@ class MessageBusClient:
         if self._writer:
             self._writer.close()
         for s in self._subs.values():
-            s.queue.put_nowait(None)
+            s._close()
 
     def _fail_all(self) -> None:
         for fut in self._pending.values():
@@ -620,7 +664,7 @@ class MessageBusClient:
                 fut.set_exception(ConnectionError("bus connection lost"))
         self._pending_reqs.clear()
         for s in self._subs.values():
-            s.queue.put_nowait(None)
+            s._close()
 
     async def _reconnect(self) -> bool:
         delay = 0.05
@@ -664,7 +708,7 @@ class MessageBusClient:
                     if h.get("push") == "msg":
                         sub = self._subs.get(h["sub_id"])
                         if sub is not None:
-                            sub.queue.put_nowait(frame.body)
+                            sub._offer(frame.body)
                         continue
                     rid = h.get("id")
                     fut = self._pending.pop(rid, None)

@@ -73,45 +73,16 @@ class ControlPlaneUnavailable(ConnectionError):
     nothing to serve from" from transient dial errors."""
 
 
-def _env_pos_float(name: str, default: float) -> float:
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        v = float(raw)
-    except ValueError:
-        return default
-    return v if v > 0 else default
-
-
-def _env_nonneg_float(name: str, default: float) -> float:
-    """0 is a policy (feature off), malformed/negative clamp to default."""
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        v = float(raw)
-    except ValueError:
-        return default
-    return v if v >= 0 else default
-
-
-def _env_nonneg_int(name: str, default: int) -> int:
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        v = int(raw)
-    except ValueError:
-        return default
-    return v if v >= 0 else default
-
-
-def _env_flag(name: str, default: bool) -> bool:
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    return raw.strip().lower() not in ("0", "false", "no", "off")
+# knob parsers live in the one shared home (runtime/envknobs.py); for the
+# nonneg variants 0 is a policy (feature off), malformed/negative clamp
+# to the default
+from dynamo_tpu.runtime.envknobs import (  # noqa: E402
+    env_flag as _env_flag,
+    env_nonneg_float as _env_nonneg_float,
+    env_nonneg_int as _env_nonneg_int,
+    env_pos_float as _env_pos_float,
+    env_str as _env_str,
+)
 
 
 @dataclass
@@ -166,7 +137,7 @@ class ControlPlanePolicy:
                 prefix + "COLD_START_DEADLINE", d.cold_start_deadline
             ),
             bus_buffer=_env_nonneg_int(prefix + "BUS_BUFFER", d.bus_buffer),
-            cache_dir=os.environ.get(ENV_CACHE, d.cache_dir) or "",
+            cache_dir=_env_str(ENV_CACHE, d.cache_dir),
         )
 
 
@@ -463,7 +434,7 @@ def maybe_cache(
     ``DYN_TPU_DISCOVERY_CACHE`` names a directory."""
     root = (
         policy.cache_dir if policy is not None
-        else os.environ.get(ENV_CACHE, "")
+        else _env_str(ENV_CACHE, "")
     )
     return DiscoveryCache(root) if root else None
 

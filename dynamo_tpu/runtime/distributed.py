@@ -25,12 +25,13 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
-import os
 import random
 import time
 import uuid
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional
+
+from dynamo_tpu.runtime.envknobs import env_str
 
 from dynamo_tpu.runtime import control_plane, telemetry, tracing
 from dynamo_tpu.runtime.admission import LoadSnapshot, OverloadedError
@@ -230,15 +231,15 @@ class DistributedRuntime:
         bus_url: Optional[str] = None,
         advertise_host: Optional[str] = None,
     ) -> "DistributedRuntime":
-        store_url = statestore_url or os.environ.get("DYN_TPU_STATESTORE", "127.0.0.1:37901")
-        b_url = bus_url or os.environ.get("DYN_TPU_BUS", "127.0.0.1:37902")
+        store_url = statestore_url or env_str("DYN_TPU_STATESTORE", "127.0.0.1:37901")
+        b_url = bus_url or env_str("DYN_TPU_BUS", "127.0.0.1:37902")
         store = await cls._connect_store(store_url)
         bus: Optional[MessageBusClient] = None
         try:
             bus = await MessageBusClient.connect(b_url)
         except OSError:
             logger.warning("message bus unavailable at %s (events disabled)", b_url)
-        rt = cls(store, bus, advertise_host or os.environ.get("DYN_TPU_ADVERTISE_HOST", "127.0.0.1"))
+        rt = cls(store, bus, advertise_host or env_str("DYN_TPU_ADVERTISE_HOST", "127.0.0.1"))
         rt._store_url = store_url
         return rt
 

@@ -1,0 +1,154 @@
+"""The one shared home for ``DYN_TPU_*`` env-knob parsing.
+
+Every knob bundle in the tree (admission, resilience, qos, tracing,
+integrity, profiling, control-plane, migration) follows the same PR3
+clamping contract: a malformed, out-of-range, or nonsensical value must
+degrade to the documented default — never to a surprise policy the
+operator didn't ask for (an admission gate that rejects everything, an
+unbounded ring, a disabled integrity plane). The parsers used to be
+copied per module; dynlint's ``knob-discipline`` rule now points every
+raw ``os.environ`` read of a ``DYN_TPU_*`` name here instead, and
+``dynlint --list-knobs`` builds the knob catalog from calls into this
+module (plus the per-bundle wrappers), cross-checked against the knob
+tables in ``docs/*.md``.
+
+Semantics, by helper:
+
+====================  ======================================================
+``env_raw``           raw optional string; empty string counts as unset
+``env_str``           non-empty string or the default
+``env_flag``          unset → default; "0"/"false"/"no"/"off" (any case) →
+                      False; anything else → True
+``env_pos_int``       > 0 or the default (0 and negatives are misconfigs)
+``env_nonneg_int``    >= 0 or the default (0 is a *policy*, e.g. "off")
+``env_pos_float``     > 0 or the default
+``env_nonneg_float``  >= 0 or the default
+``env_opt_pos_float`` > 0, or None for unset/<= 0 (a disabled deadline)
+``env_clamped_int``   > 0 clamped into [lo, hi], else the default
+``env_clamped_float`` > 0 clamped into [lo, hi], else the default
+====================  ======================================================
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_FALSY = ("0", "false", "no", "off")
+
+
+def env_raw(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Free-form knob (paths, URLs, fault specs): the raw value, with the
+    empty string treated as unset."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    return raw
+
+
+def env_str(name: str, default: str) -> str:
+    raw = os.environ.get(name)
+    return raw if raw else default
+
+
+def env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    return raw.strip().lower() not in _FALSY
+
+
+def env_pos_int(name: str, default: int) -> int:
+    """Positive-int knob: unset, malformed, zero, or negative → default —
+    a bad value must degrade to sane behavior, never to a gate that
+    rejects everything (0) or a bound of -1."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def env_nonneg_int(name: str, default: int) -> int:
+    """Like :func:`env_pos_int` but ``0`` is a *policy*, not a misconfig
+    (``DYN_TPU_RESUME=0`` = resume off); only malformed or negative
+    values clamp to the default."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v >= 0 else default
+
+
+def env_pos_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def env_nonneg_float(name: str, default: float) -> float:
+    """Non-negative float knob (0 is a meaningful 'disabled' value)."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v >= 0 else default
+
+
+def env_opt_pos_float(
+    name: str, default: Optional[float]
+) -> Optional[float]:
+    """Optional positive float: unset/malformed → default, <= 0 → None
+    (an explicitly disabled deadline/budget)."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return None if v <= 0 else v
+
+
+def env_clamped_int(name: str, default: int, lo: int, hi: int) -> int:
+    """Positive-int knob clamped into [lo, hi]; malformed or non-positive
+    values fall back to the default."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    if v <= 0:
+        return default
+    return min(max(v, lo), hi)
+
+
+def env_clamped_float(
+    name: str, default: float, lo: float, hi: float
+) -> float:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    if v <= 0:
+        return default
+    return min(max(v, lo), hi)

@@ -37,11 +37,12 @@ import asyncio
 import contextlib
 import json
 import logging
-import os
 import random
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Tuple
+
+from dynamo_tpu.runtime.envknobs import env_nonneg_int, env_raw
 
 logger = logging.getLogger(__name__)
 
@@ -539,11 +540,11 @@ def current() -> Optional[FaultInjector]:
     global _active, _env_checked
     if _active is None and not _env_checked:
         _env_checked = True
-        spec = os.environ.get("DYN_TPU_FAULTS")
+        spec = env_raw("DYN_TPU_FAULTS")
         if spec:
             try:
                 _active = injector_from_spec(
-                    spec, seed=int(os.environ.get("DYN_TPU_FAULT_SEED", "0"))
+                    spec, seed=env_nonneg_int("DYN_TPU_FAULT_SEED", 0)
                 )
                 logger.warning(
                     "fault injection ACTIVE from DYN_TPU_FAULTS (%d rules, seed=%d)",

@@ -44,7 +44,6 @@ self-consistent checksum; that failure mode is what the output watchdog
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 import zlib
@@ -77,37 +76,14 @@ class KvIntegrityError(ValueError):
     the trip against itself (the quarantine signal)."""
 
 
-def _env_flag(name: str, default: bool = True) -> bool:
-    raw = os.environ.get(name, "")
-    if raw == "":
-        return default
-    return raw.strip() not in ("0", "false", "off", "no")
-
-
-def _env_clamped_int(name: str, default: int, lo: int, hi: int) -> int:
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        v = int(raw)
-    except ValueError:
-        return default
-    if v <= 0:
-        return default
-    return min(max(v, lo), hi)
-
-
-def _env_clamped_float(name: str, default: float, lo: float, hi: float) -> float:
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        v = float(raw)
-    except ValueError:
-        return default
-    if v <= 0:
-        return default
-    return min(max(v, lo), hi)
+# PR3 clamping helpers live in the one shared home (runtime/envknobs.py);
+# the local names are kept for the modules that historically imported the
+# clamping contract from here (the tracing-imports-admission precedent)
+from dynamo_tpu.runtime.envknobs import (  # noqa: E402
+    env_clamped_float as _env_clamped_float,
+    env_clamped_int as _env_clamped_int,
+    env_flag as _env_flag,
+)
 
 
 @dataclass(frozen=True)

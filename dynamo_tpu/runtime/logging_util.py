@@ -15,8 +15,9 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import sys
+
+from dynamo_tpu.runtime.envknobs import env_str
 
 _INITIALIZED = False
 
@@ -87,7 +88,7 @@ def init(level: str | None = None) -> None:
     if _INITIALIZED:
         return
 
-    spec = level or os.environ.get("DYN_TPU_LOG", "info")
+    spec = level or env_str("DYN_TPU_LOG", "info")
     parts = [p.strip() for p in spec.split(",") if p.strip()]
     root_level = "info"
     overrides: dict[str, str] = {}

@@ -62,13 +62,12 @@ ENV_LAG_MS = "DYN_TPU_PROFILE_LAG_MS"
 # track names); free-form phases still record — these are the documented set
 PHASES = ("chunk", "decode", "verify", "loop_lag")
 
-# the PR3 clamping helpers are shared with the integrity knob bundle (the
-# tracing-imports-admission precedent) rather than copied a fifth time —
-# one clamping contract, one implementation
-from dynamo_tpu.runtime.integrity import (  # noqa: E402
-    _env_clamped_float,
-    _env_clamped_int,
-    _env_flag,
+# the PR3 clamping helpers live in the one shared home rather than being
+# copied a fifth time — one clamping contract, one implementation
+from dynamo_tpu.runtime.envknobs import (  # noqa: E402
+    env_clamped_float as _env_clamped_float,
+    env_clamped_int as _env_clamped_int,
+    env_flag as _env_flag,
 )
 
 

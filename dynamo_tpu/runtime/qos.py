@@ -60,44 +60,14 @@ ENV_PREFIX = "DYN_TPU_TENANT_"
 DEFAULT_TENANT = "default"
 
 
-def _env_str(name: str, default: str) -> str:
-    raw = os.environ.get(name)
-    return raw if raw else default
-
-
-def _env_pos_float(name: str, default: float) -> float:
-    """Positive-float knob: unset/malformed/zero/negative → default."""
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        v = float(raw)
-    except ValueError:
-        return default
-    return v if v > 0 else default
-
-
-def _env_nonneg_float(name: str, default: float) -> float:
-    """Non-negative float knob (0 is a meaningful 'disabled' value)."""
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        v = float(raw)
-    except ValueError:
-        return default
-    return v if v >= 0 else default
-
-
-def _env_pos_int(name: str, default: int) -> int:
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        v = int(raw)
-    except ValueError:
-        return default
-    return v if v > 0 else default
+# knob parsers live in the one shared home (runtime/envknobs.py)
+from dynamo_tpu.runtime.envknobs import (  # noqa: E402
+    env_nonneg_float as _env_nonneg_float,
+    env_nonneg_int as _env_nonneg_int,
+    env_pos_float as _env_pos_float,
+    env_pos_int as _env_pos_int,
+    env_str as _env_str,
+)
 
 
 def env_prefill_budget(default: int = 0) -> int:
@@ -106,14 +76,7 @@ def env_prefill_budget(default: int = 0) -> int:
     behavior). Malformed/negative values clamp to the default — a bad
     value must degrade to "no budget", never to a budget of 0 tokens
     that would livelock every prefill."""
-    raw = os.environ.get("DYN_TPU_PREFILL_BUDGET")
-    if raw is None or raw == "":
-        return default
-    try:
-        v = int(raw)
-    except ValueError:
-        return default
-    return v if v >= 0 else default
+    return _env_nonneg_int("DYN_TPU_PREFILL_BUDGET", default)
 
 
 def _parse_classes(raw: str) -> "OrderedDict[str, float]":
@@ -230,8 +193,8 @@ class QosPolicy:
             classes=_parse_classes(
                 _env_str(prefix + "CLASSES", _DEFAULT_CLASSES)
             ),
-            tenant_map=_parse_map(os.environ.get(prefix + "MAP", "")),
-            key_map=_parse_map(os.environ.get(prefix + "KEYS", "")),
+            tenant_map=_parse_map(_env_str(prefix + "MAP", "")),
+            key_map=_parse_map(_env_str(prefix + "KEYS", "")),
             default_class=_env_str(prefix + "DEFAULT_CLASS", d.default_class),
             rate_rps=_env_nonneg_float(prefix + "RATE", d.rate_rps),
             burst=_env_pos_float(prefix + "BURST", d.burst),

@@ -33,7 +33,6 @@ direct-dial data plane.
 
 from __future__ import annotations
 
-import os
 import random
 import threading
 import time
@@ -122,38 +121,14 @@ class Deadline:
             )
 
 
-def _env_float(name: str, default: Optional[float]) -> Optional[float]:
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        v = float(raw)
-    except ValueError:
-        return default
-    return None if v <= 0 else v
-
-
-def _env_int(name: str, default: int) -> int:
-    """Count knobs (attempts, breaker threshold): malformed, zero, or
-    negative values clamp to the default — "0 retries" or "-1 failures to
-    trip" are misconfigurations, not policies (same contract as the
-    ``DYN_TPU_ADMIT_*`` parsers in runtime/admission.py)."""
-    try:
-        v = int(os.environ.get(name, default))
-    except ValueError:
-        return default
-    return v if v > 0 else default
-
-
-def _env_count(name: str, default: int) -> int:
-    """Like :func:`_env_int` but ``0`` is a *policy*, not a misconfiguration
-    (``DYN_TPU_RESUME=0`` = resume off, exact pre-resume behavior); only
-    malformed or negative values clamp to the default."""
-    try:
-        v = int(os.environ.get(name, default))
-    except ValueError:
-        return default
-    return v if v >= 0 else default
+# knob parsers live in the one shared home (runtime/envknobs.py): _env_int
+# is the count contract where 0 is a misconfig, _env_count the one where 0
+# is a policy (DYN_TPU_RESUME=0 = resume off)
+from dynamo_tpu.runtime.envknobs import (  # noqa: E402
+    env_nonneg_int as _env_count,
+    env_opt_pos_float as _env_float,
+    env_pos_int as _env_int,
+)
 
 
 @dataclass

@@ -39,7 +39,7 @@ import os
 import time
 import uuid
 from dataclasses import dataclass, field
-from typing import AsyncIterator, Dict, List, Optional, Set, Tuple
+from typing import Any, AsyncIterator, Dict, List, Optional, Set, Tuple
 
 from dynamo_tpu.runtime import control_plane, faults
 from dynamo_tpu.runtime.codec import TwoPartMessage, read_frame, write_frame
@@ -327,11 +327,16 @@ class StateStoreServer:
         from dynamo_tpu.runtime.netutil import TrackedServer
 
         if self.data_dir is not None and not self._skip_restore:
-            os.makedirs(self.data_dir, exist_ok=True)
-            self._restore()
-            # startup path, runs once before serving; async file IO isn't
-            # worth a dependency here — tracked in the dynlint baseline
-            self._wal = open(self._wal_path, "a")
+            # startup path, runs once before serving — but off-loop, so a
+            # large WAL replay or slow disk can't stall siblings sharing
+            # this event loop (embedded deployments run several servers)
+
+            def _restore_and_open():
+                os.makedirs(self.data_dir, exist_ok=True)
+                self._restore()
+                return open(self._wal_path, "a")
+
+            self._wal = await asyncio.to_thread(_restore_and_open)
         self._server = TrackedServer(self._handle, self.host, self.port)
         self.port = await self._server.start()
         self._expiry_task = asyncio.create_task(self._expire_loop())
@@ -620,6 +625,12 @@ class Lease:
             pass
 
 
+# marks a key whose delete event was shed by a Watcher overflow: compares
+# unequal to every real value hash, so the overflow resync re-emits the key
+# as a synthetic delete (still gone) or a changed put (re-created)
+_EVICTED = object()
+
+
 class Watcher:
     """Async iterator of WatchEvents for a prefix.
 
@@ -629,20 +640,113 @@ class Watcher:
     only for keys that are new or whose value changed — consumers building
     incremental views (live endpoint sets, model registries) stay consistent
     without ever seeing the outage, and edge-triggered consumers
-    (``include_existing=False``) never get spurious snapshot replays."""
+    (``include_existing=False``) never get spurious snapshot replays.
+
+    The delivery queue is bounded (``MAX_QUEUE``). A consumer that stops
+    draining while writers keep mutating sheds the *oldest* buffered event;
+    because shed events would silently corrupt an incremental view, every
+    eviction repairs the tracked view (so the shed event looks "unseen")
+    and schedules a client-initiated re-watch — the same resync machinery
+    that heals a server bounce then replays exactly what the consumer
+    missed. Slow consumers trade a bounded snapshot replay for unbounded
+    memory; ``dropped`` counts shed events for observability."""
+
+    MAX_QUEUE = 4096
 
     def __init__(self, client: "StateStoreClient", watch_id: str, prefix: str = ""):
         self.client = client
         self.watch_id = watch_id
         self.prefix = prefix
-        self.queue: asyncio.Queue = asyncio.Queue()
-        self.live: Dict[str, int] = {}  # key → hash(value)
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=self.MAX_QUEUE)
+        self.live: Dict[str, Any] = {}  # key → hash(value) (or _EVICTED)
         self._resync: Optional[Dict[str, int]] = None  # view forming during a snapshot
         self._silent_round = False  # prime `live` without emitting (include_existing=False)
+        self.dropped = 0
+        self._overflow = False  # an eviction happened; a resync is owed
+        self._resync_task: Optional[asyncio.Task] = None  # strong ref
 
     @property
     def live_keys(self) -> Set[str]:
         return set(self.live)
+
+    def _offer(self, ev: WatchEvent) -> None:
+        """Enqueue for the consumer, shedding oldest on overflow.
+
+        Each shed event repairs the tracked view so the overflow resync
+        re-emits what the consumer missed: a shed put forgets the key
+        (resync sees it as new-or-changed); a shed delete resurrects it
+        with :data:`_EVICTED` (resync emits a synthetic delete, or a
+        changed put if the key was re-created meanwhile)."""
+        while self.queue.full():
+            try:
+                old = self.queue.get_nowait()
+            except asyncio.QueueEmpty:  # pragma: no cover - racy full()
+                break
+            if old is None:
+                # never shed the end-of-stream sentinel: put it back and
+                # drop the new event instead (the stream is over anyway)
+                self.queue.put_nowait(None)
+                self.dropped += 1
+                return
+            self.dropped += 1
+            self._overflow = True
+            for view in (self.live, self._resync):
+                if view is None:
+                    continue
+                if old.event == "put":
+                    view.pop(old.key, None)
+                else:
+                    view[old.key] = _EVICTED
+        try:
+            self.queue.put_nowait(ev)
+        except asyncio.QueueFull:  # pragma: no cover - single-threaded loop
+            self.dropped += 1
+        if self._overflow and self._resync is None:
+            # not mid-snapshot: start the repair resync now (mid-snapshot
+            # overflows are picked up by the sync handler instead, so two
+            # replays never interleave on one watch_id)
+            self._schedule_resync()
+
+    def _close(self) -> None:
+        """Wake the consumer with the end-of-stream sentinel; on a full
+        queue one event is shed so the sentinel always fits."""
+        if self._resync_task is not None:
+            self._resync_task.cancel()
+        while True:
+            try:
+                self.queue.put_nowait(None)
+                return
+            except asyncio.QueueFull:
+                try:
+                    self.queue.get_nowait()
+                    self.dropped += 1
+                except asyncio.QueueEmpty:  # pragma: no cover
+                    pass
+
+    def _schedule_resync(self) -> None:
+        if self._resync_task is not None and not self._resync_task.done():
+            return
+        self._resync_task = asyncio.get_running_loop().create_task(
+            self._overflow_resync()
+        )
+
+    async def _overflow_resync(self) -> None:
+        """Client-initiated re-watch after an overflow: the server treats a
+        ``watch`` with an existing watch_id as an atomic re-subscribe (old
+        watch closed, snapshot + sync replayed), and the normal resync
+        diffing then emits exactly the events the shed made the consumer
+        miss."""
+        self._overflow = False
+        self._resync = {}
+        try:
+            await self.client._call(
+                {"op": "watch", "prefix": self.prefix,
+                 "watch_id": self.watch_id, "include_existing": True}
+            )
+        except (ConnectionError, RuntimeError):
+            # connection died: the reconnect path owns re-establishing the
+            # watch (with its own resync), which supersedes this one
+            self._resync = None
 
     def __aiter__(self) -> AsyncIterator[WatchEvent]:
         return self._iter()
@@ -660,7 +764,7 @@ class Watcher:
             await self.client._call({"op": "unwatch", "watch_id": self.watch_id})
         except ConnectionError:
             pass
-        self.queue.put_nowait(None)
+        self._close()
 
 
 class StateStoreClient:
@@ -752,7 +856,7 @@ class StateStoreClient:
         if self._writer:
             self._writer.close()
         for w in self._watchers.values():
-            w.queue.put_nowait(None)
+            w._close()
 
     async def _read_loop(self) -> None:
         try:
@@ -776,7 +880,7 @@ class StateStoreClient:
             self._pending.clear()
             if self._closed or not self.reconnect:
                 for w in self._watchers.values():
-                    w.queue.put_nowait(None)
+                    w._close()
             else:
                 # keep a strong reference: asyncio only weakly refs tasks and
                 # a GC'd reconnect task would strand the client forever
@@ -795,12 +899,16 @@ class StateStoreClient:
             if w._resync is not None:
                 if not w._silent_round:
                     for k in sorted(set(w.live) - set(w._resync)):
-                        w.queue.put_nowait(
+                        w._offer(
                             WatchEvent("delete", k, resync=True)
                         )
                 w.live = dict(w._resync)
                 w._resync = None
                 w._silent_round = False
+                if w._overflow:
+                    # events were shed while this snapshot replayed: the
+                    # repaired view needs one more replay to converge
+                    w._schedule_resync()
             return
         if ev == "put":
             hv = hash(body)
@@ -815,7 +923,7 @@ class StateStoreClient:
                 w.live[h["key"]] = hv
         elif ev == "delete":
             w.live.pop(h["key"], None)
-        w.queue.put_nowait(WatchEvent(ev, h["key"], body))
+        w._offer(WatchEvent(ev, h["key"], body))
 
     async def _reconnect_loop(self) -> None:
         """Re-dial a bounced server with backoff, then re-establish every
@@ -833,7 +941,7 @@ class StateStoreClient:
                         self.reconnect_timeout,
                     )
                     for w in self._watchers.values():
-                        w.queue.put_nowait(None)
+                        w._close()
                     return
                 await asyncio.sleep(delay)
                 delay = min(delay * 2, 1.0)

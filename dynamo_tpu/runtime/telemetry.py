@@ -731,9 +731,14 @@ def store() -> MetricStore:
 def slo_engine() -> SloEngine:
     global _ENGINE
     if _ENGINE is None:
+        # resolve the store BEFORE taking the module lock: store() takes
+        # the same non-reentrant lock, so calling it under _LOCK deadlocks
+        # on the first slo_engine() call of a process that never touched
+        # the store (the profiling lag_sampler/timeline shape)
+        s = store()
         with _LOCK:
             if _ENGINE is None:
-                _ENGINE = SloEngine(store(), _POLICY)
+                _ENGINE = SloEngine(s, _POLICY)
     return _ENGINE
 
 

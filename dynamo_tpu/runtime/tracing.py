@@ -89,11 +89,7 @@ PHASE_BUCKETS = (
 )
 
 
-def _env_flag(name: str, default: bool) -> bool:
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    return raw.strip().lower() not in ("0", "false", "no", "off")
+from dynamo_tpu.runtime.envknobs import env_flag as _env_flag  # noqa: E402
 
 
 class TracePolicy:

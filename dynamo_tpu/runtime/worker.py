@@ -42,6 +42,8 @@ import os
 import signal
 import sys
 
+from dynamo_tpu.runtime.envknobs import env_pos_float
+
 logger = logging.getLogger(__name__)
 
 EXIT_OK = 0
@@ -54,11 +56,7 @@ def graceful_timeout() -> float:
     """Drain window before the hard exit. Malformed, zero, or negative env
     values clamp to the default — honoring ``0`` would turn every graceful
     shutdown into an instant 911, and a negative value is never meaningful."""
-    try:
-        v = float(os.environ.get("DYN_TPU_GRACEFUL_SHUTDOWN_TIMEOUT", DEFAULT_TIMEOUT))
-    except ValueError:
-        return DEFAULT_TIMEOUT
-    return v if v > 0 else DEFAULT_TIMEOUT
+    return env_pos_float("DYN_TPU_GRACEFUL_SHUTDOWN_TIMEOUT", DEFAULT_TIMEOUT)
 
 
 async def serve_until_shutdown(drt, engine=None) -> None:

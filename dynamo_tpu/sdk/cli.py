@@ -20,6 +20,8 @@ import signal
 import sys
 from typing import Dict, List, Optional
 
+from dynamo_tpu.runtime.envknobs import env_raw
+
 from dynamo_tpu.sdk.config import ServiceConfig
 from dynamo_tpu.sdk.serve_service import resolve_graph
 
@@ -80,7 +82,7 @@ async def serve_cmd(args) -> None:
             host="127.0.0.1", port=args.bus_port,
             # durable work queues when a data dir is configured (the
             # statestore reads the equivalent env in its own entrypoint)
-            data_dir=os.environ.get("DYN_TPU_BUS_DATA_DIR") or None,
+            data_dir=env_raw("DYN_TPU_BUS_DATA_DIR"),
         )
         await ss_server.start()
         await bus_server.start()

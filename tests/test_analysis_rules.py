@@ -1054,3 +1054,769 @@ def test_metric_name_valid_clean_on_real_metric_modules():
     )
     metric_findings = [f for f in findings if f.rule == "metric-name-valid"]
     assert metric_findings == [], [f.render() for f in metric_findings]
+
+
+# ==========================================================================
+# concurrency rule pack (lock-set tracking over the call graph)
+# ==========================================================================
+
+_T = "import threading\n"
+
+
+# -- lock-self-deadlock ------------------------------------------------------
+
+SELF_DEADLOCK_CASES = [
+    (
+        "direct_reacquire",
+        _T + "_LOCK = threading.Lock()\n"
+        "def f():\n"
+        "    with _LOCK:\n"
+        "        with _LOCK:\n"
+        "            pass\n",
+        True,
+    ),
+    (
+        "via_callee",
+        _T + "_LOCK = threading.Lock()\n"
+        "def store():\n"
+        "    with _LOCK:\n"
+        "        return 1\n"
+        "def sample():\n"
+        "    with _LOCK:\n"
+        "        return store()\n",
+        True,
+    ),
+    (
+        "via_two_hop_callee",
+        _T + "_LOCK = threading.Lock()\n"
+        "def inner():\n"
+        "    with _LOCK:\n"
+        "        return 1\n"
+        "def mid():\n"
+        "    return inner()\n"
+        "def outer():\n"
+        "    with _LOCK:\n"
+        "        return mid()\n",
+        True,
+    ),
+    (
+        "instance_lock_method_call",
+        _T + "class Store:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def get(self):\n"
+        "        with self._lock:\n"
+        "            return 1\n"
+        "    def snapshot(self):\n"
+        "        with self._lock:\n"
+        "            return self.get()\n",
+        True,
+    ),
+    (
+        "rlock_reentry_ok",
+        _T + "_LOCK = threading.RLock()\n"
+        "def f():\n"
+        "    with _LOCK:\n"
+        "        with _LOCK:\n"
+        "            pass\n",
+        False,
+    ),
+    (
+        "sequential_ok",
+        _T + "_LOCK = threading.Lock()\n"
+        "def f():\n"
+        "    with _LOCK:\n"
+        "        pass\n"
+        "    with _LOCK:\n"
+        "        pass\n",
+        False,
+    ),
+    (
+        "different_locks_ok",
+        _T + "_A = threading.Lock()\n"
+        "_B = threading.Lock()\n"
+        "def f():\n"
+        "    with _A:\n"
+        "        with _B:\n"
+        "            pass\n",
+        False,
+    ),
+    (
+        "callee_after_release_ok",
+        _T + "_LOCK = threading.Lock()\n"
+        "def store():\n"
+        "    with _LOCK:\n"
+        "        return 1\n"
+        "def sample():\n"
+        "    with _LOCK:\n"
+        "        pass\n"
+        "    return store()\n",
+        False,
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "name,src,expect",
+    SELF_DEADLOCK_CASES,
+    ids=[c[0] for c in SELF_DEADLOCK_CASES],
+)
+def test_lock_self_deadlock(tmp_path, name, src, expect):
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    fired = "lock-self-deadlock" in rules_fired(findings)
+    assert fired == expect, [f.render() for f in findings]
+
+
+def test_lock_self_deadlock_suppressed(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "mod.py": _T + "_LOCK = threading.Lock()\n"
+        "def store():\n"
+        "    with _LOCK:\n"
+        "        return 1\n"
+        "def sample():\n"
+        "    with _LOCK:\n"
+        "        return store()  # dynlint: disable=lock-self-deadlock\n"
+    })
+    assert "lock-self-deadlock" not in rules_fired(findings)
+
+
+def test_lock_self_deadlock_cross_module(tmp_path):
+    """The callee lives in another module; the held lock is imported."""
+    findings = lint_tree(tmp_path, {
+        "locks.py": _T + "_LOCK = threading.Lock()\n"
+        "def store():\n"
+        "    with _LOCK:\n"
+        "        return 1\n",
+        "user.py": "from locks import _LOCK, store\n"
+        "def sample():\n"
+        "    with _LOCK:\n"
+        "        return store()\n",
+    })
+    hits = [f for f in findings if f.rule == "lock-self-deadlock"]
+    assert hits and hits[0].path == "user.py", [f.render() for f in findings]
+
+
+def test_lag_sampler_regression_shape(tmp_path):
+    """Named historical fixture: the PR14 profiling bug. ``_lag_sampler``
+    called ``timeline()`` — which takes the module ring lock — while already
+    holding that lock; the first armed sample deadlocked the process. The
+    concurrency pack exists to make this shape impossible to reintroduce."""
+    findings = lint_tree(tmp_path, {
+        "profiling.py": _T + "_RING_LOCK = threading.Lock()\n"
+        "_RING = []\n"
+        "def timeline():\n"
+        "    with _RING_LOCK:\n"
+        "        return list(_RING)\n"
+        "def _lag_sampler():\n"
+        "    with _RING_LOCK:\n"
+        "        events = timeline()\n"
+        "        _RING.append(len(events))\n",
+    })
+    hits = [f for f in findings if f.rule == "lock-self-deadlock"]
+    assert len(hits) == 1, [f.render() for f in findings]
+    assert "timeline" in hits[0].message
+
+
+def test_coordinator_stop_regression_shape(tmp_path):
+    """Named historical fixture: the PR12 coordinator bug. ``stop()``
+    swallowed ``asyncio.CancelledError`` around task teardown, so a
+    cancelled shutdown hung the drain path. Guarded by cancelled-swallow."""
+    findings = lint_tree(tmp_path, {
+        "coordinator.py": "import asyncio\n"
+        "class Coordinator:\n"
+        "    async def stop(self):\n"
+        "        self._task.cancel()\n"
+        "        try:\n"
+        "            await self._task\n"
+        "        except Exception:\n"
+        "            pass\n",
+    })
+    assert "cancelled-swallow" in rules_fired(findings), [
+        f.render() for f in findings
+    ]
+
+
+# -- lock-order-inversion ----------------------------------------------------
+
+ORDER_INVERSION_CASES = [
+    (
+        "ab_ba",
+        _T + "_A = threading.Lock()\n"
+        "_B = threading.Lock()\n"
+        "def f():\n"
+        "    with _A:\n"
+        "        with _B:\n"
+        "            pass\n"
+        "def g():\n"
+        "    with _B:\n"
+        "        with _A:\n"
+        "            pass\n",
+        True,
+    ),
+    (
+        "inversion_via_callee",
+        _T + "_A = threading.Lock()\n"
+        "_B = threading.Lock()\n"
+        "def take_a():\n"
+        "    with _A:\n"
+        "        return 1\n"
+        "def f():\n"
+        "    with _A:\n"
+        "        with _B:\n"
+        "            pass\n"
+        "def g():\n"
+        "    with _B:\n"
+        "        return take_a()\n",
+        True,
+    ),
+    (
+        "consistent_order_ok",
+        _T + "_A = threading.Lock()\n"
+        "_B = threading.Lock()\n"
+        "def f():\n"
+        "    with _A:\n"
+        "        with _B:\n"
+        "            pass\n"
+        "def g():\n"
+        "    with _A:\n"
+        "        with _B:\n"
+        "            pass\n",
+        False,
+    ),
+    (
+        "disjoint_pairs_ok",
+        _T + "_A = threading.Lock()\n"
+        "_B = threading.Lock()\n"
+        "_C = threading.Lock()\n"
+        "def f():\n"
+        "    with _A:\n"
+        "        with _B:\n"
+        "            pass\n"
+        "def g():\n"
+        "    with _C:\n"
+        "        pass\n",
+        False,
+    ),
+    (
+        "rlock_still_orders",
+        # reentrancy exempts SELF-deadlock only: an RLock pair acquired in
+        # opposite orders across two threads still deadlocks
+        _T + "_A = threading.RLock()\n"
+        "_B = threading.RLock()\n"
+        "def f():\n"
+        "    with _A:\n"
+        "        with _B:\n"
+        "            pass\n"
+        "def g():\n"
+        "    with _B:\n"
+        "        with _A:\n"
+        "            pass\n",
+        True,
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "name,src,expect",
+    ORDER_INVERSION_CASES,
+    ids=[c[0] for c in ORDER_INVERSION_CASES],
+)
+def test_lock_order_inversion(tmp_path, name, src, expect):
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    fired = "lock-order-inversion" in rules_fired(findings)
+    assert fired == expect, [f.render() for f in findings]
+
+
+def test_lock_order_inversion_suppressed(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "mod.py": _T + "_A = threading.Lock()\n"
+        "_B = threading.Lock()\n"
+        "def f():\n"
+        "    with _A:\n"
+        "        with _B:  # dynlint: disable=lock-order-inversion\n"
+        "            pass\n"
+        "def g():\n"
+        "    with _B:\n"
+        "        with _A:  # dynlint: disable=lock-order-inversion\n"
+        "            pass\n"
+    })
+    assert "lock-order-inversion" not in rules_fired(findings)
+
+
+def test_lock_order_inversion_cross_module(tmp_path):
+    """The two conflicting orders live in different files; both sides of
+    the cycle are reported in their own module."""
+    findings = lint_tree(tmp_path, {
+        "locks.py": _T + "A = threading.Lock()\nB = threading.Lock()\n",
+        "one.py": "from locks import A, B\n"
+        "def f():\n"
+        "    with A:\n"
+        "        with B:\n"
+        "            pass\n",
+        "two.py": "from locks import A, B\n"
+        "def g():\n"
+        "    with B:\n"
+        "        with A:\n"
+        "            pass\n",
+    })
+    hits = {f.path for f in findings if f.rule == "lock-order-inversion"}
+    assert hits == {"one.py", "two.py"}, [f.render() for f in findings]
+
+
+# -- blocking-under-lock -----------------------------------------------------
+
+BLOCKING_UNDER_LOCK_CASES = [
+    (
+        "sleep_under_lock",
+        _T + "import time\n"
+        "_LOCK = threading.Lock()\n"
+        "def f():\n"
+        "    with _LOCK:\n"
+        "        time.sleep(1)\n",
+        True,
+    ),
+    (
+        "subprocess_under_lock",
+        _T + "import subprocess\n"
+        "_LOCK = threading.Lock()\n"
+        "def f(cmd):\n"
+        "    with _LOCK:\n"
+        "        subprocess.run(cmd)\n",
+        True,
+    ),
+    (
+        "open_under_lock",
+        _T + "_LOCK = threading.Lock()\n"
+        "def f(p):\n"
+        "    with _LOCK:\n"
+        "        return open(p).read()\n",
+        True,
+    ),
+    (
+        "jax_sync_under_lock",
+        _T + "import jax\n"
+        "_LOCK = threading.Lock()\n"
+        "def f(x):\n"
+        "    with _LOCK:\n"
+        "        return jax.device_get(x)\n",
+        True,
+    ),
+    (
+        "future_result_under_lock",
+        _T + "_LOCK = threading.Lock()\n"
+        "def f(fut):\n"
+        "    with _LOCK:\n"
+        "        return fut.result()\n",
+        True,
+    ),
+    (
+        "blocking_via_callee",
+        _T + "import time\n"
+        "_LOCK = threading.Lock()\n"
+        "def slow():\n"
+        "    time.sleep(1)\n"
+        "def f():\n"
+        "    with _LOCK:\n"
+        "        slow()\n",
+        True,
+    ),
+    (
+        "sleep_outside_lock_ok",
+        _T + "import time\n"
+        "_LOCK = threading.Lock()\n"
+        "def f():\n"
+        "    with _LOCK:\n"
+        "        pass\n"
+        "    time.sleep(1)\n",
+        False,
+    ),
+    (
+        "result_with_timeout_ok",
+        # .result(timeout) is a bounded wait — the zero-arg shape is the
+        # unbounded one the rule targets
+        _T + "_LOCK = threading.Lock()\n"
+        "def f(fut):\n"
+        "    with _LOCK:\n"
+        "        return fut.result(0.1)\n",
+        False,
+    ),
+    (
+        "asyncio_lock_not_counted",
+        # asyncio.Lock is single-threaded cooperative; blocking under it
+        # stalls the loop, which blocking-call-in-async already covers
+        "import asyncio, time\n"
+        "_LOCK = asyncio.Lock()\n"
+        "def f():\n"
+        "    with _LOCK:\n"
+        "        time.sleep(1)\n",
+        False,
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "name,src,expect",
+    BLOCKING_UNDER_LOCK_CASES,
+    ids=[c[0] for c in BLOCKING_UNDER_LOCK_CASES],
+)
+def test_blocking_under_lock(tmp_path, name, src, expect):
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    fired = "blocking-under-lock" in rules_fired(findings)
+    assert fired == expect, [f.render() for f in findings]
+
+
+def test_blocking_under_lock_suppressed(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "mod.py": _T + "import time\n"
+        "_LOCK = threading.Lock()\n"
+        "def f():\n"
+        "    with _LOCK:\n"
+        "        time.sleep(1)  # dynlint: disable=blocking-under-lock\n"
+    })
+    assert "blocking-under-lock" not in rules_fired(findings)
+
+
+def test_blocking_under_lock_names_the_witness(tmp_path):
+    """The transitive finding says WHAT blocks and THROUGH WHOM, so the fix
+    doesn't require re-running the analysis by hand."""
+    findings = lint_tree(tmp_path, {
+        "mod.py": _T + "import time\n"
+        "_LOCK = threading.Lock()\n"
+        "def slow():\n"
+        "    time.sleep(1)\n"
+        "def f():\n"
+        "    with _LOCK:\n"
+        "        slow()\n",
+    })
+    hits = [f for f in findings if f.rule == "blocking-under-lock"]
+    assert len(hits) == 1
+    assert "time.sleep" in hits[0].message and "slow" in hits[0].message
+
+
+# -- await-under-threading-lock ----------------------------------------------
+
+AWAIT_UNDER_LOCK_CASES = [
+    (
+        "await_in_with",
+        _T + "import asyncio\n"
+        "_LOCK = threading.Lock()\n"
+        "async def f():\n"
+        "    with _LOCK:\n"
+        "        await asyncio.sleep(0)\n",
+        True,
+    ),
+    (
+        "await_after_with_ok",
+        _T + "import asyncio\n"
+        "_LOCK = threading.Lock()\n"
+        "async def f():\n"
+        "    with _LOCK:\n"
+        "        pass\n"
+        "    await asyncio.sleep(0)\n",
+        False,
+    ),
+    (
+        "asyncio_lock_ok",
+        "import asyncio\n"
+        "_LOCK = asyncio.Lock()\n"
+        "async def f():\n"
+        "    async with _LOCK:\n"
+        "        await asyncio.sleep(0)\n",
+        False,
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "name,src,expect",
+    AWAIT_UNDER_LOCK_CASES,
+    ids=[c[0] for c in AWAIT_UNDER_LOCK_CASES],
+)
+def test_await_under_threading_lock(tmp_path, name, src, expect):
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    fired = "await-under-threading-lock" in rules_fired(findings)
+    assert fired == expect, [f.render() for f in findings]
+
+
+def test_await_under_threading_lock_suppressed(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "mod.py": _T + "import asyncio\n"
+        "_LOCK = threading.Lock()\n"
+        "async def f():\n"
+        "    with _LOCK:\n"
+        "        await asyncio.sleep(0)  # dynlint: disable=await-under-threading-lock\n"
+    })
+    assert "await-under-threading-lock" not in rules_fired(findings)
+
+
+# -- lock-leak ---------------------------------------------------------------
+
+LOCK_LEAK_CASES = [
+    (
+        "bare_acquire",
+        _T + "_LOCK = threading.Lock()\n"
+        "def f():\n"
+        "    _LOCK.acquire()\n"
+        "    do_work()\n"
+        "    _LOCK.release()\n",
+        True,
+    ),
+    (
+        "guarded_try_finally_ok",
+        _T + "_LOCK = threading.Lock()\n"
+        "def f():\n"
+        "    _LOCK.acquire()\n"
+        "    try:\n"
+        "        do_work()\n"
+        "    finally:\n"
+        "        _LOCK.release()\n",
+        False,
+    ),
+    (
+        "with_block_ok",
+        _T + "_LOCK = threading.Lock()\n"
+        "def f():\n"
+        "    with _LOCK:\n"
+        "        do_work()\n",
+        False,
+    ),
+    (
+        "enter_exit_wrapper_ok",
+        # a lock wrapper acquires in __enter__ and releases in __exit__ by
+        # design; flagging it would outlaw writing lock wrappers at all
+        _T + "class Guard:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def __enter__(self):\n"
+        "        self._lock.acquire()\n"
+        "        return self\n"
+        "    def __exit__(self, *exc):\n"
+        "        self._lock.release()\n",
+        False,
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "name,src,expect", LOCK_LEAK_CASES, ids=[c[0] for c in LOCK_LEAK_CASES]
+)
+def test_lock_leak(tmp_path, name, src, expect):
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    fired = "lock-leak" in rules_fired(findings)
+    assert fired == expect, [f.render() for f in findings]
+
+
+def test_lock_leak_suppressed(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "mod.py": _T + "_LOCK = threading.Lock()\n"
+        "def f():\n"
+        "    _LOCK.acquire()  # dynlint: disable=lock-leak\n"
+        "    do_work()\n"
+        "    _LOCK.release()\n"
+    })
+    assert "lock-leak" not in rules_fired(findings)
+
+
+# -- lock-set facts (core.LockAnalysis unit coverage) ------------------------
+
+
+def _lock_analysis(tmp_path, files):
+    from dynamo_tpu.analysis.core import build_project
+
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    project, errors = build_project([str(tmp_path)], root=str(tmp_path))
+    assert errors == []
+    return project.lock_analysis()
+
+
+def _facts_for(analysis, qualname):
+    for fn, facts in analysis.facts.items():
+        if fn.qualname == qualname:
+            return facts
+    raise AssertionError(f"no facts for {qualname}")
+
+
+def test_lockset_alias_resolves(tmp_path):
+    """``l = self._lock; with l:`` tracks the same identity as the attr."""
+    analysis = _lock_analysis(tmp_path, {
+        "mod.py": _T + "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def f(self):\n"
+        "        l = self._lock\n"
+        "        with l:\n"
+        "            pass\n",
+    })
+    facts = _facts_for(analysis, "S.f")
+    assert [a.lock for a in facts.acquires] == ["mod.S._lock"]
+
+
+def test_lockset_multi_acquire_with_statement(tmp_path):
+    """``with a, b:`` acquires in order: b's held-set contains a."""
+    analysis = _lock_analysis(tmp_path, {
+        "mod.py": _T + "_A = threading.Lock()\n"
+        "_B = threading.Lock()\n"
+        "def f():\n"
+        "    with _A, _B:\n"
+        "        pass\n",
+    })
+    facts = _facts_for(analysis, "f")
+    acquires = {a.lock: a for a in facts.acquires}
+    assert set(acquires) == {"mod._A", "mod._B"}
+    assert acquires["mod._A"].held == frozenset()
+    assert acquires["mod._B"].held == frozenset({"mod._A"})
+
+
+def test_lockset_released_after_with(tmp_path):
+    """Statements after the with-block run with an empty held-set."""
+    analysis = _lock_analysis(tmp_path, {
+        "mod.py": _T + "import time\n"
+        "_LOCK = threading.Lock()\n"
+        "def f():\n"
+        "    with _LOCK:\n"
+        "        pass\n"
+        "    time.sleep(1)\n",
+    })
+    facts = _facts_for(analysis, "f")
+    sleeps = [c for c in facts.calls if c.qual == "time.sleep"]
+    assert sleeps and sleeps[0].held == frozenset()
+
+
+def test_lockset_may_acquire_fixpoint(tmp_path):
+    """may_acquire is transitive through resolved call sites."""
+    analysis = _lock_analysis(tmp_path, {
+        "mod.py": _T + "_LOCK = threading.Lock()\n"
+        "def leaf():\n"
+        "    with _LOCK:\n"
+        "        return 1\n"
+        "def mid():\n"
+        "    return leaf()\n"
+        "def top():\n"
+        "    return mid()\n",
+    })
+    by_name = {fn.qualname: fn for fn in analysis.facts}
+    assert "mod._LOCK" in analysis.may_acquire[by_name["leaf"]]
+    assert "mod._LOCK" in analysis.may_acquire[by_name["mid"]]
+    assert "mod._LOCK" in analysis.may_acquire[by_name["top"]]
+
+
+def test_lockset_rlock_marked_reentrant(tmp_path):
+    analysis = _lock_analysis(tmp_path, {
+        "mod.py": _T + "_R = threading.RLock()\n_L = threading.Lock()\n",
+    })
+    assert analysis.is_reentrant("mod._R")
+    assert not analysis.is_reentrant("mod._L")
+    assert analysis.lock("mod._L").kind == "threading"
+
+
+# -- knob-discipline ---------------------------------------------------------
+
+KNOB_CASES = [
+    (
+        "environ_get",
+        'import os\ndef f():\n    return os.environ.get("DYN_TPU_FOO")\n',
+        True,
+    ),
+    (
+        "getenv",
+        'import os\ndef f():\n    return os.getenv("DYN_TPU_FOO", "1")\n',
+        True,
+    ),
+    (
+        "environ_subscript",
+        'import os\ndef f():\n    return os.environ["DYN_TPU_FOO"]\n',
+        True,
+    ),
+    (
+        "name_via_module_const",
+        'import os\nENV = "DYN_TPU_FOO"\ndef f():\n    return os.environ.get(ENV)\n',
+        True,
+    ),
+    (
+        "name_via_prefix_default",
+        "import os\n"
+        'def f(prefix="DYN_TPU_ADMIT_"):\n'
+        '    return os.environ.get(prefix + "MAX")\n',
+        True,
+    ),
+    (
+        "name_via_fstring",
+        'import os\nPREFIX = "DYN_TPU_"\n'
+        "def f():\n"
+        '    return os.environ.get(f"{PREFIX}QUEUE")\n',
+        True,
+    ),
+    (
+        "non_dyn_tpu_ok",
+        'import os\ndef f():\n    return os.environ.get("HOME")\n',
+        False,
+    ),
+    (
+        "helper_call_ok",
+        "from dynamo_tpu.runtime.envknobs import env_flag\n"
+        "def f():\n"
+        '    return env_flag("DYN_TPU_FOO", False)\n',
+        False,
+    ),
+    (
+        "dynamic_name_uncheckable",
+        "import os\ndef f(name):\n    return os.environ.get(name)\n",
+        False,
+    ),
+    (
+        "environ_items_ok",
+        "import os\ndef f():\n"
+        '    return [k for k in os.environ if k.startswith("DYN_TPU_")]\n',
+        False,
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "name,src,expect", KNOB_CASES, ids=[c[0] for c in KNOB_CASES]
+)
+def test_knob_discipline(tmp_path, name, src, expect):
+    findings = lint_tree(tmp_path, {"mod.py": src})
+    fired = "knob-discipline" in rules_fired(findings)
+    assert fired == expect, [f.render() for f in findings]
+
+
+def test_knob_discipline_suppressed(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "mod.py": "import os\n"
+        "def f():\n"
+        '    return os.environ["DYN_TPU_FD"]  # dynlint: disable=knob-discipline\n'
+    })
+    assert "knob-discipline" not in rules_fired(findings)
+
+
+def test_knob_discipline_allows_the_shared_home(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "dynamo_tpu/runtime/envknobs.py": "import os\n"
+        "def env_raw(name, default=None):\n"
+        "    return os.environ.get(name, default)\n",
+    })
+    assert "knob-discipline" not in rules_fired(findings)
+
+
+def test_collect_knobs_catalog(tmp_path):
+    from dynamo_tpu.analysis.core import build_project
+    from dynamo_tpu.analysis.rules_knobs import collect_knobs
+
+    for rel, src in {
+        "a.py": "from dynamo_tpu.runtime.envknobs import env_flag\n"
+        'X = env_flag("DYN_TPU_ALPHA", False)\n',
+        "b.py": "import os\n"
+        'Y = os.environ.get("DYN_TPU_BETA")\n',
+    }.items():
+        (tmp_path / rel).write_text(src)
+    project, _ = build_project([str(tmp_path)], root=str(tmp_path))
+    knobs = collect_knobs(project)
+    by_name = {k.name: k for k in knobs}
+    assert by_name["DYN_TPU_ALPHA"].helper == "env_flag"
+    # an undisciplined read still lands in the catalog (as "raw") so it
+    # can't vanish from the documented surface
+    assert by_name["DYN_TPU_BETA"].helper == "raw"
