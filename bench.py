@@ -1655,6 +1655,252 @@ def bench_profiling() -> dict:
         profiling_mod.reset_for_tests()
 
 
+def bench_straggler() -> dict:
+    """Fail-slow defense: the detector's tax and the defense's payoff
+    (docs/resilience.md §Fail-slow; tiny REAL engines on the host
+    platform). Overhead legs: identical single-engine decode load with
+    DYN_TPU_STRAGGLER off vs on — the off/on tok/s ratio is the
+    detector's steady-state tax (two perf_counter reads + one EWMA
+    update per dispatch; the acceptance pins it ~1.0). Defense legs: a
+    3-worker fleet with one worker dragged by an injected "slow"
+    dispatch fault, undefended (plane off) vs defended (the telemetry
+    aggregator's arbiter judges the worker suspect and clients
+    soft-demote it); reports each leg's post-verdict fleet p95
+    inter-token gap and their ratio. BENCH_STRAGGLER=0 skips."""
+    import asyncio
+    import contextlib
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.components.telemetry_aggregator import (
+        run_telemetry_aggregator,
+    )
+    from dynamo_tpu.engine_jax.engine import EngineConfig, JaxServingEngine
+    from dynamo_tpu.models.llama import LLAMA_PRESETS, init_params
+    from dynamo_tpu.runtime import faults as faults_mod
+    from dynamo_tpu.runtime import straggler as straggler_mod
+    from dynamo_tpu.runtime.bus import MessageBusServer
+    from dynamo_tpu.runtime.distributed import (
+        DistributedRuntime,
+        attach_kv_publishing,
+    )
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.runtime.faults import FaultInjector, FaultRule
+    from dynamo_tpu.runtime.statestore import StateStoreServer
+
+    n_requests = int(os.environ.get("BENCH_STRAGGLER_REQUESTS", "6"))
+    gen_tokens = int(os.environ.get("BENCH_STRAGGLER_TOKENS", "64"))
+    prompt_len = int(os.environ.get("BENCH_STRAGGLER_PROMPT", "32"))
+    # per-dispatch fixed delay on the victim: ~3-6x a tiny engine's host
+    # decode step, a clean differential signal without minutes of wall
+    slow_s = float(os.environ.get("BENCH_STRAGGLER_SLOW_S", "0.03"))
+    prior = {
+        k: os.environ.get(k)
+        for k in (
+            straggler_mod.ENV_STRAGGLER, straggler_mod.ENV_FACTOR,
+            straggler_mod.ENV_WINDOW, straggler_mod.ENV_MIN_PEERS,
+            straggler_mod.ENV_TRIPS, "DYN_TPU_HEALTH_CHECK_INTERVAL",
+            "DYN_TPU_LOAD_REPORT_INTERVAL",
+        )
+    }
+
+    cfg = dataclasses.replace(LLAMA_PRESETS["tiny"], dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompts = [
+        [(11 * i + 5 + j) % 97 for j in range(prompt_len)]
+        for i in range(2 * n_requests + 1)
+    ]
+
+    def _ctx(toks) -> Context:
+        return Context({
+            "token_ids": list(toks),
+            "stop_conditions": {"max_tokens": gen_tokens,
+                                "ignore_eos": True},
+            "sampling_options": {"temperature": 0.0},
+        })
+
+    async def collect(gen_fn, toks, gaps=None):
+        out, last = [], None
+        async for item in gen_fn(_ctx(toks)):
+            if item.is_error:
+                raise RuntimeError(item.error_message())
+            ids = (item.data or {}).get("token_ids", [])
+            if ids:
+                now = time.perf_counter()
+                if gaps is not None and last is not None:
+                    gaps.append(now - last)
+                last = now
+                out.extend(ids)
+        return out
+
+    # -- overhead legs: the detector's per-dispatch tax --------------------
+
+    def overhead_leg(on: bool) -> float:
+        if on:
+            os.environ[straggler_mod.ENV_STRAGGLER] = "1"
+        else:
+            os.environ.pop(straggler_mod.ENV_STRAGGLER, None)
+        straggler_mod.reset_for_tests()
+        eng = JaxServingEngine(cfg, params, EngineConfig(
+            max_slots=4, kv_block_size=8,
+            max_model_len=prompt_len + gen_tokens + 16,
+        ))
+
+        async def run_all():
+            await collect(eng.generate, prompts[0])  # warm the compiles
+            t0 = time.perf_counter()
+            outs = await asyncio.gather(
+                *[collect(eng.generate, p) for p in prompts[1:n_requests + 1]]
+            )
+            return outs, time.perf_counter() - t0
+
+        outs, wall = asyncio.run(run_all())
+        eng.close()
+        return round(sum(len(o) for o in outs) / wall, 1)
+
+    # -- defense legs: one dragged worker, soft-demotion on vs off ---------
+
+    async def fleet_leg(defended: bool) -> dict:
+        if defended:
+            os.environ[straggler_mod.ENV_STRAGGLER] = "1"
+            os.environ[straggler_mod.ENV_FACTOR] = "3.0"
+            os.environ[straggler_mod.ENV_WINDOW] = "0.5"
+            # park the verdict at suspect: the bench measures the
+            # soft-demotion payoff; the confirmed-tier migrate-off drill
+            # is the chaos gate's job (tests/test_straggler.py)
+            os.environ[straggler_mod.ENV_TRIPS] = "99"
+        else:
+            os.environ.pop(straggler_mod.ENV_STRAGGLER, None)
+        os.environ["DYN_TPU_HEALTH_CHECK_INTERVAL"] = "0.1"
+        os.environ["DYN_TPU_LOAD_REPORT_INTERVAL"] = "0.1"
+        straggler_mod.reset_for_tests()
+        ss = StateStoreServer(port=0)
+        await ss.start()
+        bus = MessageBusServer(port=0)
+        await bus.start()
+        agg = await DistributedRuntime.create(ss.url, bus.url)
+        ready = asyncio.Event()
+        agg_task = asyncio.create_task(run_telemetry_aggregator(
+            agg, "bstrag", port=0, host="127.0.0.1", ready=ready,
+            register=False,
+        ))
+        await asyncio.wait_for(ready.wait(), 10)
+        rts, engines = [], []
+        for _ in range(3):
+            rt = await DistributedRuntime.create(ss.url, bus.url)
+            eng = JaxServingEngine(cfg, params, EngineConfig(
+                max_slots=4, kv_block_size=8,
+                max_model_len=prompt_len + gen_tokens + 16,
+            ))
+            if defended:
+                # one process hosts the whole bench fleet, but the
+                # detector is process-global (one engine per process in
+                # production): give each worker a private detector so the
+                # victim's EWMA actually diverges from its peers'
+                eng._straggler = straggler_mod.StragglerDetector()
+            ep = rt.namespace("bstrag").component("w").endpoint("gen")
+            await ep.serve(eng)
+            await attach_kv_publishing(ep, eng, interval=0.1)
+            rts.append(rt)
+            engines.append(eng)
+        if defended:
+            # the verdict latch is process-global too: freeze the
+            # siblings' monitors (at healthy) so only the victim's health
+            # plane mirrors the latched verdict
+            for rt in rts[1:]:
+                await rt._health_monitor.stop()
+        fe = await DistributedRuntime.create(ss.url, bus.url)
+        client = await fe.namespace("bstrag").component("w").endpoint(
+            "gen"
+        ).client("round_robin")
+        await client.wait_for_instances(3, timeout=10)
+        victim = rts[0].worker_id
+        inj = FaultInjector([FaultRule(
+            plane="engine", point="dispatch", action="slow",
+            match_addr=victim, delay=slow_s, jitter=slow_s / 3,
+        )])
+        gaps: list = []
+        try:
+            # warm every engine's compiles before the fault lands
+            await asyncio.gather(
+                *[collect(e.generate, prompts[0]) for e in engines]
+            )
+            faults_mod.install(inj)
+            # load wave: spreads over all three workers, feeds the
+            # victim's dragged EWMA into the metrics stream
+            load = [
+                asyncio.create_task(collect(client.generate, p))
+                for p in prompts[1:n_requests + 1]
+            ]
+            if defended:
+                deadline = asyncio.get_running_loop().time() + 10.0
+                while (straggler_mod.verdict() == straggler_mod.OK
+                       and asyncio.get_running_loop().time() < deadline):
+                    await asyncio.sleep(0.05)
+            else:
+                await asyncio.sleep(1.5)  # the defended leg's verdict wait
+            # measured wave: post-verdict admissions — the defended router
+            # soft-demotes the victim, the undefended one keeps feeding it
+            t0 = time.perf_counter()
+            await asyncio.gather(*[
+                collect(client.generate, p, gaps=gaps)
+                for p in prompts[n_requests + 1:2 * n_requests + 1]
+            ])
+            wall = time.perf_counter() - t0
+            await asyncio.gather(*load)
+            return {
+                "itl_p95_ms": round(float(np.percentile(
+                    np.asarray(gaps or [0.0]) * 1e3, 95
+                )), 2),
+                "wall_s": round(wall, 3),
+                "verdict_seen": straggler_mod.verdict(),
+            }
+        finally:
+            faults_mod.uninstall()
+            await client.close()
+            for rt in rts + [fe]:
+                await rt.shutdown()
+            for e in engines:
+                e.close()
+            agg_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await agg_task
+            await agg.shutdown()
+            await bus.stop()
+            await ss.stop()
+
+    try:
+        tps_off = overhead_leg(False)
+        tps_on = overhead_leg(True)
+        undefended = asyncio.run(fleet_leg(defended=False))
+        defended = asyncio.run(fleet_leg(defended=True))
+        return {
+            "decode_tps_straggler_off": tps_off,
+            "decode_tps_straggler_on": tps_on,
+            "overhead_ratio": round(tps_off / max(tps_on, 1e-9), 3),
+            "undefended": undefended,
+            "defended": defended,
+            # defended/undefended post-verdict fleet p95 ITL: the payoff
+            # headline (<1 means the soft-demotion actually routed load
+            # off the dragged worker)
+            "defense_itl_p95_ratio": round(
+                defended["itl_p95_ms"]
+                / max(undefended["itl_p95_ms"], 1e-9), 3,
+            ),
+            "slow_fault_s": slow_s,
+        }
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        straggler_mod.reset_for_tests()
+
+
 # ---------------------------------------------------------------------------
 # machine-readable summary + CI regression gate (BENCH_SUMMARY.json)
 # ---------------------------------------------------------------------------
@@ -1684,6 +1930,10 @@ SUMMARY_SPECS = [
      ("migration", "migrate", "kv_blocks_moved"), "higher"),
     ("blackout_outage_tok_s_ratio",
      ("blackout", "outage_tok_s_ratio"), "higher"),
+    ("straggler_overhead_ratio",
+     ("straggler", "overhead_ratio"), "lower"),
+    ("straggler_defense_itl_ratio",
+     ("straggler", "defense_itl_p95_ratio"), "lower"),
 ]
 
 
@@ -2058,6 +2308,11 @@ def main() -> None:
             out["profiling"] = bench_profiling()
         except Exception as e:
             out["profiling"] = {"error": str(e)[:200]}
+    if os.environ.get("BENCH_STRAGGLER", "1") == "1":
+        try:
+            out["straggler"] = bench_straggler()
+        except Exception as e:
+            out["straggler"] = {"error": str(e)[:200]}
     # LAST: pays minutes of first-boot remote compilation on the tunneled
     # runtime — must not eat the other sections' budget if it times out
     if os.environ.get("BENCH_MODEL_8B", "1") == "1":

@@ -766,6 +766,13 @@ async def _telemetry_cmd(args, store) -> int:
             if e.get("workers_quarantined") or quar_trips
             else ""
         )
+        # fail-slow column only when the arbiter currently suspects
+        # someone (docs/resilience.md §Fail-slow; the spec=/migr=/quar=
+        # noise-free pattern); named stragglers print below the table
+        slow = (
+            f' slow={e.get("workers_suspect", 0)}'
+            if e.get("workers_suspect") else ""
+        )
         print(
             f'{model:20s} workers={e.get("workers", 0)} '
             f'(unhealthy={e.get("workers_unhealthy", 0)}) '
@@ -774,11 +781,15 @@ async def _telemetry_cmd(args, store) -> int:
             f'kv_free {e.get("kv_blocks_free", 0)}/{e.get("kv_blocks_total", 0)} '
             f'headroom={e.get("headroom_frac", 0.0):.2f} '
             f'decode={e.get("decode_tokens_per_s", 0.0):.0f} tok/s'
-            f'{spec}{migr}{quar}'
+            f'{spec}{migr}{quar}{slow}'
         )
         for wid in e.get("quarantined_worker_ids") or []:
             print(f'  QUARANTINED: {wid} (model {model}) — unquarantine '
                   f'after hardware repair/replacement')
+        for wid in e.get("straggler_worker_ids") or []:
+            print(f'  SLOW: {wid} (model {model}) — soft-demoted by the '
+                  f'fail-slow arbiter; recovers automatically one clean '
+                  f'window after the latency returns to the peer envelope')
     worst = roll.get("worst_worker")
     if worst:
         print(f'worst worker: {worst.get("worker_id")} '

@@ -90,13 +90,31 @@ GAUGES = [
     ("dispatch_device_us_p95", "Decode dispatch block-until-ready device time p95 (us)"),
     ("dispatch_host_overhead_us_p95", "Decode dispatch host-side overhead p95 (us)"),
     ("device_idle_frac", "Fraction of the sampled window the device sat idle between dispatches"),
+    # fail-slow plane (docs/resilience.md §Fail-slow): normalized dispatch
+    # latency EWMA the aggregator compares against the peer median, and the
+    # detector's cumulative sample counter (the freshness signal)
+    ("dispatch_us_per_token_ewma", "Step-loop wall us per token, EWMA (straggler detector)"),
+    ("straggler_samples_total", "Dispatches fed to the straggler detector (cumulative)"),
 ]
 
 # health_state is a string on the wire; Prometheus wants a number. Unknown
 # states map to the unhealthy value so a future state is never read as fine.
 # quarantined (integrity plane) is graver than unhealthy: outputs untrusted.
+# suspect (fail-slow plane) gets its own value: it is SOFTER than unhealthy
+# (the worker still serves, outputs trusted) — before it was mapped here, a
+# suspect worker fell through the unknown→2 default and dashboards read a
+# merely-slow worker as down. Values are stable identifiers, not a severity
+# scale; 4 was simply the next free slot.
 HEALTH_STATE_VALUES = {
     "healthy": 0, "degraded": 1, "unhealthy": 2, "quarantined": 3,
+    "suspect": 4,
+}
+
+# straggler_state likewise ("" / missing from pre-fail-slow workers = ok;
+# anything unknown renders as suspect so a future verdict is never read as
+# clean)
+STRAGGLER_STATE_VALUES = {
+    "": 0, "ok": 0, "suspect": 1, "confirmed": 2,
 }
 
 # control_plane_state likewise ("" from pre-blackout workers = connected;
@@ -163,12 +181,26 @@ class MetricsAggregator:
         full = f"{self.prefix}_health_state"
         lines.append(
             f"# HELP {full} Worker health state "
-            f"(0=healthy, 1=degraded, 2=unhealthy, 3=quarantined)"
+            f"(0=healthy, 1=degraded, 2=unhealthy, 3=quarantined, 4=suspect)"
         )
         lines.append(f"# TYPE {full} gauge")
         for worker_id, m in sorted(live.items()):
             value = HEALTH_STATE_VALUES.get(
                 getattr(m, "health_state", "healthy"), 2
+            )
+            lines.append(
+                f'{full}{{namespace="{_escape_label(self.namespace)}",'
+                f'worker="{_escape_label(str(worker_id))}"}} {value}'
+            )
+        full = f"{self.prefix}_straggler_state"
+        lines.append(
+            f"# HELP {full} Fail-slow verdict latched by the worker "
+            f"(0=ok, 1=suspect, 2=confirmed)"
+        )
+        lines.append(f"# TYPE {full} gauge")
+        for worker_id, m in sorted(live.items()):
+            value = STRAGGLER_STATE_VALUES.get(
+                getattr(m, "straggler_state", "") or "", 1
             )
             lines.append(
                 f'{full}{{namespace="{_escape_label(self.namespace)}",'

@@ -113,6 +113,8 @@ class MockWorkerStats:
         dispatch_device_us: float = 0.0,
         jit_recompiles: int = 6,
         device_idle_frac: float = 0.0,
+        dispatch_us_per_token: float = 0.0,
+        straggler_state: str = "ok",
     ):
         from dynamo_tpu.runtime.tracing import PHASE_BUCKETS
 
@@ -170,9 +172,23 @@ class MockWorkerStats:
         self.health_state = (
             health_state
             if health_state in ("healthy", "degraded", "unhealthy",
-                                "quarantined")
+                                "quarantined", "suspect")
             else "healthy"
         )
+        # fail-slow drill (docs/resilience.md §Fail-slow): report a nonzero
+        # normalized dispatch EWMA and/or a latched verdict so the
+        # dynamo_*_dispatch_us_per_token / straggler gauges, the rollup's
+        # suspect counts, and the `llmctl cluster status` slow= column
+        # render without actually slowing a worker. The sample counter
+        # grows per tick (see tick()) so the arbiter's freshness check can
+        # be drilled too.
+        self.dispatch_us_per_token = max(float(dispatch_us_per_token), 0.0)
+        self.straggler_state = (
+            straggler_state
+            if straggler_state in ("ok", "suspect", "confirmed")
+            else "ok"
+        )
+        self.straggler_samples = 0
         # profiling-plane drill (docs/observability.md §Profiling): report
         # a nonzero dispatch device-time p95 / idle fraction / recompile
         # count so the dynamo_{worker,cluster}_dispatch_* gauges and
@@ -244,6 +260,11 @@ class MockWorkerStats:
         self.active = max(
             0, min(self.slots_total, self.active + self.rng.randint(-3, 3))
         )
+        if self.dispatch_us_per_token > 0.0:
+            # a live detector's sample counter grows every dispatch (~1
+            # prefill + 16 decode steps per request here) — fresh tick
+            # over tick, which is what the arbiter's freshness gate needs
+            self.straggler_samples += requests * 17
 
     def observe_request(
         self,
@@ -364,6 +385,11 @@ class MockWorkerStats:
             watchdog_trips_total=self.watchdog_trips,
             control_plane_state=self.control_plane_state,
             bus_dropped_events=self.bus_dropped_events,
+            # fail-slow plane drill fields (zeros/"ok" = plane off, like a
+            # real DYN_TPU_STRAGGLER=0 worker)
+            dispatch_us_per_token_ewma=round(self.dispatch_us_per_token, 1),
+            straggler_samples_total=self.straggler_samples,
+            straggler_state=self.straggler_state,
             uptime_s=round(time.monotonic() - self.started, 3),
             model=model,
             role=self.role,
@@ -430,6 +456,8 @@ async def run_mock_worker(
     dispatch_device_us: float = 0.0,
     jit_recompiles: int = 6,
     device_idle_frac: float = 0.0,
+    dispatch_us_per_token: float = 0.0,
+    straggler_state: str = "ok",
 ) -> None:
     from dynamo_tpu.runtime.distributed import KV_METRICS_SUBJECT
 
@@ -450,6 +478,8 @@ async def run_mock_worker(
         dispatch_device_us=dispatch_device_us,
         jit_recompiles=jit_recompiles,
         device_idle_frac=device_idle_frac,
+        dispatch_us_per_token=dispatch_us_per_token,
+        straggler_state=straggler_state,
     )
     tick_no = 0
     while True:
@@ -519,10 +549,11 @@ def main() -> None:
                    help="report N output-watchdog lane trips")
     p.add_argument("--health-state", default="healthy",
                    choices=("healthy", "degraded", "unhealthy",
-                            "quarantined"),
+                            "quarantined", "suspect"),
                    help="report this health state (quarantined drills the "
                         "rollup's quarantine counts + planner drain "
-                        "decisions TPU-lessly)")
+                        "decisions TPU-lessly; suspect drills the "
+                        "fail-slow soft-demotion rendering)")
     p.add_argument("--control-plane-state", default="connected",
                    choices=("connected", "stale", "disconnected"),
                    help="report this control-plane view (drills `llmctl "
@@ -539,6 +570,17 @@ def main() -> None:
     p.add_argument("--device-idle-frac", type=float, default=0.0,
                    help="report this device idle fraction (the profiling "
                         "runbook's read-first gauge)")
+    p.add_argument("--dispatch-us-per-token", type=float, default=0.0,
+                   help="report this normalized dispatch-latency EWMA "
+                        "(us/token; drills the fail-slow arbiter and the "
+                        "dynamo_*_dispatch_us_per_token gauges — run N "
+                        "mocks and give one a 10x value to watch it go "
+                        "suspect)")
+    p.add_argument("--straggler-state", default="ok",
+                   choices=("ok", "suspect", "confirmed"),
+                   help="report this latched fail-slow verdict (drills the "
+                        "rollup's suspect counts and the llmctl cluster "
+                        "status slow= column without a live arbiter)")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
     profile = (
@@ -571,6 +613,8 @@ def main() -> None:
             dispatch_device_us=args.dispatch_device_us,
             jit_recompiles=args.jit_recompiles,
             device_idle_frac=args.device_idle_frac,
+            dispatch_us_per_token=args.dispatch_us_per_token,
+            straggler_state=args.straggler_state,
         )
 
     asyncio.run(run())

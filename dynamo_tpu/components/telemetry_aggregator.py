@@ -83,6 +83,9 @@ CLUSTER_GAUGES = [
     ("kv_integrity_failures_total", "KV blocks that failed content checksums (fleet sum)"),
     ("watchdog_trips_total", "Lanes ended by the output watchdog (fleet sum)"),
     ("workers_quarantined", "Workers quarantined by the integrity plane"),
+    # fail-slow defense (docs/resilience.md §Fail-slow): workers currently
+    # under a differential straggler verdict (suspect or confirmed)
+    ("workers_suspect", "Workers under a fail-slow suspect/confirmed verdict"),
     # performance attribution plane (docs/observability.md §Profiling):
     # fleet WORST dispatch split / idle fraction (p95s are not summable —
     # the slowest worker is the one to profile) + summed jit recompiles
@@ -175,6 +178,12 @@ class ClusterTelemetry:
         self.store.declare("tenant_admitted", COUNTER)
         self.store.declare("tenant_rate_limited", COUNTER)
         self.slo_engine = SloEngine(self.store, self.policy, clock=clock)
+        # fail-slow defense (docs/resilience.md §Fail-slow): when
+        # run_telemetry_aggregator arms DYN_TPU_STRAGGLER it installs a
+        # StragglerArbiter here; ingest() then feeds it each worker's
+        # normalized dispatch EWMA + sample counter so the arbiter can make
+        # fleet-relative verdicts. None ⇒ feature off, zero overhead.
+        self.straggler_arbiter = None
         self._workers: Dict[str, _WorkerView] = {}
         # (model, tenant) pairs with at least one post-baseline diff: until
         # then the windowed series has seen nothing and the cumulative
@@ -202,6 +211,18 @@ class ClusterTelemetry:
             and not getattr(metrics, "draining", 0)
         ) else 0.0
         self.store.series("worker_available", model=model).set(available, now)
+
+        # fail-slow: feed the arbiter only workers with a live detector
+        # (samples_total > 0) — a DYN_TPU_STRAGGLER=0 worker publishes
+        # zeros and must neither be judged nor count toward min_peers
+        if self.straggler_arbiter is not None:
+            samples = int(getattr(metrics, "straggler_samples_total", 0) or 0)
+            if samples > 0:
+                self.straggler_arbiter.observe(
+                    worker_id, model,
+                    float(getattr(metrics, "dispatch_us_per_token_ewma", 0.0) or 0.0),
+                    samples, now=now,
+                )
 
         self._ingest_phases(view, metrics, model, now)
         self._ingest_counters(view, metrics, model, now)
@@ -378,6 +399,8 @@ class ClusterTelemetry:
                 "watchdog_trips_total": 0,
                 "workers_quarantined": 0,
                 "quarantined_worker_ids": [],
+                "workers_suspect": 0,
+                "straggler_worker_ids": [],
                 "dispatch_device_us_p95": 0.0,
                 "dispatch_host_overhead_us_p95": 0.0,
                 "device_idle_frac": 0.0,
@@ -409,6 +432,15 @@ class ClusterTelemetry:
                 entry["workers_quarantined"] += 1
                 if len(entry["quarantined_worker_ids"]) < 16:
                     entry["quarantined_worker_ids"].append(wid)
+            # fail-slow (docs/resilience.md §Fail-slow): counted from the
+            # worker-ECHOED verdict, not the arbiter's local state — the
+            # rollup then reflects the closed loop (arbiter → store key →
+            # worker latch → heartbeat), and mock workers can drill the
+            # rendering without a live arbiter
+            if getattr(m, "straggler_state", "ok") in ("suspect", "confirmed"):
+                entry["workers_suspect"] += 1
+                if len(entry["straggler_worker_ids"]) < 16:
+                    entry["straggler_worker_ids"].append(wid)
             slots_total = int(m.request_total_slots or 0)
             slots_free = max(
                 slots_total - int(m.request_active_slots or 0), 0
@@ -754,6 +786,53 @@ async def run_telemetry_aggregator(
         ),
     ))
 
+    # fail-slow arbiter (docs/resilience.md §Fail-slow): with
+    # DYN_TPU_STRAGGLER armed, judge each worker's dispatch EWMA against
+    # the fleet median once per detection window and publish non-ok
+    # verdicts as leased statestore keys ({ns}/straggler/{worker_id} =
+    # b"suspect"|b"confirmed"). Workers watch the prefix and latch the
+    # verdict; the LEASE is the failure-domain boundary — an aggregator
+    # crash expires its verdicts instead of wedging the fleet demoted.
+    from dynamo_tpu.runtime import straggler as straggler_mod
+
+    straggler_task: Optional[asyncio.Task] = None
+    pol = straggler_mod.maybe_from_env()
+    if pol is not None:
+        arbiter = straggler_mod.StragglerArbiter(pol)
+        cluster.straggler_arbiter = arbiter
+
+        async def _straggler_sync_loop() -> None:
+            prefix = f"{namespace}/{straggler_mod.CONTROL_PREFIX}/"
+            published: Dict[str, str] = {}
+            interval = max(pol.window / 4.0, 0.05)
+            lease = await drt.primary_lease()
+            while True:
+                await asyncio.sleep(interval)
+                arbiter.evaluate(time.monotonic())
+                verdicts = arbiter.verdicts()
+                try:
+                    for wid, state in verdicts.items():
+                        if published.get(wid) != state:
+                            await drt.store.put(
+                                prefix + wid, state.encode(), lease=lease
+                            )
+                            published[wid] = state
+                    for wid in [w for w in published if w not in verdicts]:
+                        await drt.store.delete(prefix + wid)
+                        del published[wid]
+                except asyncio.CancelledError:
+                    raise
+                except (ConnectionError, RuntimeError, OSError):
+                    # statestore blip: forget what we think is published so
+                    # the next pass re-puts everything once the store heals
+                    published.clear()
+                    logger.warning(
+                        "straggler verdict sync failed; will retry",
+                        exc_info=True,
+                    )
+
+        straggler_task = asyncio.create_task(_straggler_sync_loop())
+
     if register:
         class _StatusEngine(AsyncEngine):
             """RPC-facing view: one item with the full cluster dump."""
@@ -792,6 +871,8 @@ async def run_telemetry_aggregator(
         await asyncio.Event().wait()
     finally:
         consumer.cancel()
+        if straggler_task is not None:
+            straggler_task.cancel()
         if telemetry.cluster() is cluster:
             telemetry.set_cluster(None)
         await runner.cleanup()

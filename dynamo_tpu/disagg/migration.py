@@ -243,7 +243,8 @@ class MigrationCoordinator:
         migration then degrades to the resume path (stale-but-safe: we never
         ship pages to an address the store can't currently vouch for)."""
         from dynamo_tpu.runtime.admission import LoadSnapshot
-        from dynamo_tpu.runtime.distributed import InstanceInfo
+        from dynamo_tpu.runtime.distributed import EXCLUDED_HEALTH, InstanceInfo
+        from dynamo_tpu.runtime.health import SUSPECT
 
         rt = self.runtime
         try:
@@ -255,6 +256,7 @@ class MigrationCoordinator:
             k.rsplit("/", 1)[-1]: v.decode() for k, v in addrs.items()
         }
         out = []
+        suspects: set = set()
         for key in sorted(entries):
             try:
                 info = InstanceInfo.from_json(entries[key])
@@ -262,7 +264,14 @@ class MigrationCoordinator:
                 continue
             if info.worker_id == rt.worker_id:
                 continue
-            if info.draining or info.health in ("unhealthy", "quarantined"):
+            # hard health cut only (EXCLUDED_HEALTH, shared with the
+            # router — never a local string list that silently drifts when
+            # a state is added): a SUSPECT sibling (fail-slow plane,
+            # docs/resilience.md §Fail-slow) stays ELIGIBLE — its outputs
+            # and KV are trusted, and a slow home beats a cut stream when
+            # it is the only home — but sorts after every brisk sibling
+            # below, so it only receives streams as a last resort
+            if info.draining or info.health in EXCLUDED_HEALTH:
                 continue
             taddr = by_worker.get(info.worker_id)
             if not taddr or taddr == self.address:
@@ -271,8 +280,10 @@ class MigrationCoordinator:
                 LoadSnapshot.from_wire(info.load).utilization()
                 if info.load else 0.0
             )
+            if info.health == SUSPECT:
+                suspects.add(info.instance_id)
             out.append((info.instance_id, info.worker_id, taddr, load))
-        out.sort(key=lambda t: t[3])
+        out.sort(key=lambda t: (t[0] in suspects, t[3]))
         return out
 
     # -- the drain task -----------------------------------------------------

@@ -85,6 +85,7 @@ from dynamo_tpu.runtime import faults as faults_mod
 from dynamo_tpu.runtime import integrity as integrity_mod
 from dynamo_tpu.runtime import profiling as profiling_mod
 from dynamo_tpu.runtime import qos as qos_mod
+from dynamo_tpu.runtime import straggler as straggler_mod
 from dynamo_tpu.runtime import telemetry, tracing
 from dynamo_tpu.runtime.integrity import WATCHDOG_TOKEN
 from dynamo_tpu.runtime.annotated import Annotated
@@ -698,6 +699,17 @@ class JaxServingEngine(AsyncEngine):
         # dispatches charge the NEXT dispatch's record
         self._prof_alloc_us = 0.0
 
+        # fail-slow defense (runtime/straggler.py, docs/resilience.md
+        # §Fail-slow): per-dispatch wall-us-per-token EWMA feeding the
+        # aggregator's differential straggler verdicts. None with
+        # DYN_TPU_STRAGGLER off — the step loop then pays one None-check
+        # per dispatch and no detector is ever constructed (the
+        # zero-overhead guard in tests/test_straggler.py monkeypatches
+        # the constructor). Independent of the profiling plane: the
+        # straggler feed needs EVERY dispatch's coarse wall split, not a
+        # sampled block-until-ready capture.
+        self._straggler = straggler_mod.maybe_detector()
+
         # multi-tenant QoS (runtime/qos.py, docs/qos.md): policy + weighted
         # fair-queue bookkeeping, built ONLY when DYN_TPU_TENANT_* knobs are
         # set — the single-tenant step loop pays one None-check (asserted by
@@ -1205,6 +1217,28 @@ class JaxServingEngine(AsyncEngine):
         return jax.jit(verify, donate_argnums=(1, 2))
 
     # -- penalty-count buffer -------------------------------------------------
+
+    def _slow_fault(self) -> None:
+        """The ``slow`` fault action at the engine dispatch point
+        (docs/resilience.md §Fail-slow): an injected host-side delay —
+        fixed + seeded jitter — right before the jitted call, modelling a
+        worker that passes every probe but drags every dispatch (thermal
+        throttle, sick NIC, noisy co-tenant). Deliberately independent of
+        the straggler/profiling knobs: the chaos gate's *undefended*
+        control leg needs the fault to fire with the defense off."""
+        if faults_mod.current() is not None:
+            d = faults_mod.slow_gate("engine", self._fault_addr)
+            if d > 0:
+                time.sleep(d)
+
+    def _straggler_tick(self, phase: str, t_step: float, tokens: int) -> None:
+        """One dispatch into the fail-slow detector: coarse step-loop wall
+        time per token (fed EVERY dispatch, unlike the sampled profiling
+        timeline — a differential verdict over peers needs the full
+        stream, and two perf_counter reads per dispatch are cheap)."""
+        self._straggler.note_dispatch(
+            phase, (time.perf_counter() - t_step) * 1e6, tokens
+        )
 
     def _wd_args(self) -> tuple:
         """Extra dispatch args for the output watchdog: empty with the
@@ -2104,7 +2138,10 @@ class JaxServingEngine(AsyncEngine):
         cfg = self.config
         S, C = cfg.max_slots, cfg.prefill_chunk
         tl = self._timeline
-        t_step = time.perf_counter() if tl is not None else 0.0
+        t_step = (
+            time.perf_counter()
+            if tl is not None or self._straggler is not None else 0.0
+        )
         for seq in [s for s in self._slots if s is not None]:
             if seq.slot is None:
                 # an earlier lane's class-aware reclaim preempted this one
@@ -2245,6 +2282,7 @@ class JaxServingEngine(AsyncEngine):
             self._m_ipack.get(ipack_np),
             self._m_fpack.get(fpack_np),
         ) + self._wd_args()
+        self._slow_fault()
         prof = tl is not None and tl.should_sample()
         t_disp = time.perf_counter() if prof else 0.0
         # copy_to_host_async right after dispatch: the host-fetch path has a
@@ -2319,6 +2357,10 @@ class JaxServingEngine(AsyncEngine):
             # unsampled dispatch: drop the accrued allocator share so it
             # can't pile up across the sampling stride and misattribute
             self._prof_alloc_us = 0.0
+        if self._straggler is not None:
+            self._straggler_tick(
+                "chunk", t_step, sum(len(c) for c in consumed if c)
+            )
 
     def _decode_step(self) -> None:
         """Pipelined decode: dispatch chunk N+1 off the previous dispatch's
@@ -2331,7 +2373,10 @@ class JaxServingEngine(AsyncEngine):
         cfg = self.config
         S, k = cfg.max_slots, cfg.decode_steps
         tl = self._timeline
-        t_step = time.perf_counter() if tl is not None else 0.0
+        t_step = (
+            time.perf_counter()
+            if tl is not None or self._straggler is not None else 0.0
+        )
 
         stopped = [s for s in self._slots if s is not None and s.ctx.context.is_stopped]
         if stopped:
@@ -2477,6 +2522,7 @@ class JaxServingEngine(AsyncEngine):
             self._m_ipack.get(ipack_np),
             self._m_fpack.get(fpack_np),
         ) + self._wd_args()
+        self._slow_fault()
         prof = tl is not None and tl.should_sample()
         t_disp = time.perf_counter() if prof else 0.0
         if want_lp:
@@ -2518,6 +2564,8 @@ class JaxServingEngine(AsyncEngine):
             )
         elif tl is not None:
             self._prof_alloc_us = 0.0
+        if self._straggler is not None:
+            self._straggler_tick("decode", t_step, len(active) * k)
 
     def _emit_token_run(
         self,
@@ -2662,7 +2710,10 @@ class JaxServingEngine(AsyncEngine):
         cfg = self.config
         S = cfg.max_slots
         tl = self._timeline
-        t_step = time.perf_counter() if tl is not None else 0.0
+        t_step = (
+            time.perf_counter()
+            if tl is not None or self._straggler is not None else 0.0
+        )
         # host needs every lane's true last token and the drafters need the
         # emitted suffix up to date before proposing
         self._drain_inflight()
@@ -2756,6 +2807,7 @@ class JaxServingEngine(AsyncEngine):
             self._put(np.int32(self._step_counter)),
             self._m_ipack.get(ipack_np), self._m_fpack.get(fpack_np),
         ) + self._wd_args()
+        self._slow_fault()
         prof = tl is not None and tl.should_sample()
         t_disp = time.perf_counter() if prof else 0.0
         if want_lp:
@@ -2853,6 +2905,13 @@ class JaxServingEngine(AsyncEngine):
             )
         elif tl is not None:
             self._prof_alloc_us = 0.0
+        if self._straggler is not None:
+            self._straggler_tick(
+                "verify", t_step,
+                accepted_total + sum(
+                    1 for s in self._slots if s is not None
+                ),
+            )
 
     def _drain_inflight(self) -> None:
         """Fetch + process any in-flight chunk, then release zombie blocks
@@ -3821,6 +3880,14 @@ class JaxServingEngine(AsyncEngine):
             # §Profiling): decode-phase device/host p95 split + device idle
             # fraction, from the process-global dispatch timeline
             m.update(self._timeline.gauges())
+        if self._straggler is not None:
+            # fail-slow plane (docs/resilience.md §Fail-slow): normalized
+            # per-token latency + sample freshness for the aggregator's
+            # differential verdict, and this worker's own latched verdict
+            # echoed back so the cluster rollup counts suspects from the
+            # same stream it ingests
+            m.update(self._straggler.gauges())
+            m["straggler_state"] = straggler_mod.verdict()
         if self.host_pool is not None:
             m["host_cache_blocks"] = len(self.host_pool)
             m["host_cache_hits"] = self.host_pool.hits

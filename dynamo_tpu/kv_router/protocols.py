@@ -229,6 +229,18 @@ class ForwardPassMetrics:
     dispatch_device_us_p95: float = 0.0
     dispatch_host_overhead_us_p95: float = 0.0
     device_idle_frac: float = 0.0
+    # fail-slow plane (runtime/straggler.py, docs/resilience.md §Fail-slow):
+    # EWMA of step-loop wall microseconds per generated/prefilled token —
+    # the normalized latency the telemetry aggregator compares against the
+    # peer median for differential straggler verdicts — plus the cumulative
+    # detector sample counter (the aggregator's freshness signal: a worker
+    # paused by a drain stops sampling and must HOLD its verdict, never
+    # earn one) and the worker's own latched verdict ("ok" | "suspect" |
+    # "confirmed") echoed back for the cluster suspects rollup. Zeros/"ok"
+    # from workers without DYN_TPU_STRAGGLER armed.
+    dispatch_us_per_token_ewma: float = 0.0
+    straggler_samples_total: int = 0
+    straggler_state: str = "ok"
     # process identity for cluster attribution + dashboards
     uptime_s: float = 0.0
     model: Optional[str] = None

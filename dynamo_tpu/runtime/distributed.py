@@ -33,7 +33,7 @@ from typing import Any, AsyncIterator, Callable, Dict, List, Optional
 
 from dynamo_tpu.runtime.envknobs import env_str
 
-from dynamo_tpu.runtime import control_plane, telemetry, tracing
+from dynamo_tpu.runtime import control_plane, straggler, telemetry, tracing
 from dynamo_tpu.runtime.admission import LoadSnapshot, OverloadedError
 from dynamo_tpu.runtime.control_plane import ControlPlaneUnavailable
 from dynamo_tpu.runtime.annotated import Annotated
@@ -41,13 +41,20 @@ from dynamo_tpu.runtime.bus import MessageBusClient
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 from dynamo_tpu.runtime.health import (
     QUARANTINED,
+    STRAGGLER_SOURCE,
+    SUSPECT,
     UNHEALTHY,
     HealthMonitor,
     HealthPolicy,
 )
 
 # health states routers must never dispatch to: unhealthy (wedged/stalled)
-# and quarantined (integrity plane latched — outputs untrusted)
+# and quarantined (integrity plane latched — outputs untrusted). SUSPECT
+# (fail-slow plane, docs/resilience.md §Fail-slow) is deliberately NOT
+# here: a suspect worker still serves correct bytes, merely slowly — it is
+# soft-demoted in _pick (route of last resort), never hard-cut, so an
+# all-slow fleet keeps serving. Consumers must compare against this tuple
+# (or _is_unhealthy), never string-match health states themselves.
 EXCLUDED_HEALTH = (UNHEALTHY, QUARANTINED)
 from dynamo_tpu.runtime.resilience import (
     DEADLINE_ERROR,
@@ -463,6 +470,17 @@ class Endpoint:
         return f"{self.component.base_key}/endpoints/{self.name}/quarantine/"
 
     @property
+    def straggler_prefix(self) -> str:
+        """Fail-slow verdict keys (docs/resilience.md §Fail-slow):
+        ``{ns}/straggler/{worker_id}`` = ``b"suspect"|b"confirmed"``,
+        written under the telemetry aggregator's lease by its arbiter
+        sync loop (so a dead arbiter's verdicts expire rather than wedge
+        the fleet demoted). Namespace-scoped, not endpoint-scoped: a
+        verdict is about the WORKER (its host is slow), not any one
+        endpoint it serves."""
+        return f"{self.component.namespace.name}/{straggler.CONTROL_PREFIX}/"
+
+    @property
     def rpc_name(self) -> str:
         ns = self.component.namespace.name
         return f"{ns}.{self.component.name}.{self.name}"
@@ -523,6 +541,13 @@ class Endpoint:
         rt._background.append(
             asyncio.create_task(self._quarantine_control_loop(rt))
         )
+        # fail-slow verdict latch (docs/resilience.md §Fail-slow): gated on
+        # the knob — with DYN_TPU_STRAGGLER unset no loop, no watch, no
+        # overhead (the zero-overhead contract)
+        if straggler.enabled():
+            rt._background.append(
+                asyncio.create_task(self._straggler_control_loop(rt))
+            )
         return info
 
     async def _load_report_loop(self, rt: "DistributedRuntime", server, info: InstanceInfo) -> None:
@@ -723,6 +748,136 @@ class Endpoint:
                         )
             await asyncio.sleep(backoff)
             backoff = min(backoff * 2, 10.0)
+
+    async def _straggler_drain_pulse(self, rt: "DistributedRuntime") -> None:
+        """Migrate-off-the-straggler (docs/resilience.md §Fail-slow): a
+        CONFIRMED verdict fires one bounded drain PULSE under the
+        dedicated ``straggler`` source. Entering drain kicks the PR12
+        migration coordinator (when attached): in-flight streams re-home
+        to faster siblings over the atomic migrate frame — zero recompute,
+        byte-equal — and routers stop sending new work. Once the inflight
+        set is empty (or the pulse deadline passes) the worker UNDRAINS:
+        unlike quarantine its KV and outputs are trusted, so it stays in
+        the pool as the soft-demoted route of last resort while the
+        verdict stands, and auto-recovers fully when the arbiter clears
+        it."""
+        rt.set_draining(True, source=STRAGGLER_SOURCE)
+        try:
+            window = straggler.StragglerPolicy.from_env().window
+            deadline = time.monotonic() + max(window, 1.0)
+            while time.monotonic() < deadline:
+                server = rt._rpc_server
+                if server is not None and server.inflight_count == 0:
+                    break
+                await asyncio.sleep(0.05)
+        finally:
+            rt.set_draining(False, source=STRAGGLER_SOURCE)
+
+    async def _straggler_control_loop(self, rt: "DistributedRuntime") -> None:
+        """Latch fail-slow verdicts pushed by the telemetry aggregator's
+        arbiter (keys under :attr:`straggler_prefix` naming this worker or
+        ``all`` — the latter only for drills; the arbiter itself is
+        strictly per-worker).
+
+        Semantics (docs/resilience.md §Fail-slow):
+
+        - key put ⇒ latch the verdict (health plane reports ``suspect``
+          next check; routers soft-demote on the existing wire paths); a
+          verdict newly reaching ``confirmed`` additionally fires ONE
+          drain pulse (:meth:`_straggler_drain_pulse`) to migrate
+          in-flight streams off;
+        - key delete — observed OR resync-synthesized — ⇒ reconcile from
+          the current key set. Unlike the quarantine loop there is no
+          sticky self-tripped source to protect: verdicts are leased to
+          the arbiter, an expired lease (arbiter death) must FAIL OPEN to
+          ``ok`` — slowness is recoverable and a fleet with no arbiter
+          has no differential evidence against anyone.
+        """
+        severity = {straggler.OK: 0, straggler.SUSPECT: 1,
+                    straggler.CONFIRMED: 2}
+        pulse: Optional[asyncio.Task] = None
+
+        def _mine(key: str) -> bool:
+            return key.rsplit("/", 1)[-1] in (rt.worker_id, "all")
+
+        def _apply(state: str) -> None:
+            nonlocal pulse
+            prev = straggler.verdict()
+            straggler.set_verdict(state)  # unknown states dropped + warned
+            cur = straggler.verdict()
+            if cur == straggler.CONFIRMED:
+                if prev != straggler.CONFIRMED and (
+                    pulse is None or pulse.done()
+                ):
+                    pulse = asyncio.create_task(
+                        self._straggler_drain_pulse(rt)
+                    )
+            else:
+                # demoted below confirmed (recovery, or an operator drill
+                # downgrading): stop any running pulse and make sure the
+                # straggler drain source is released
+                if pulse is not None and not pulse.done():
+                    pulse.cancel()
+                if STRAGGLER_SOURCE in rt._drain_sources:
+                    rt.set_draining(False, source=STRAGGLER_SOURCE)
+
+        async def _apply_key_set() -> None:
+            state = straggler.OK
+            keys = await rt.store.get_prefix(self.straggler_prefix)
+            for k, v in keys.items():
+                if not _mine(k):
+                    continue
+                s = v.decode("utf-8", "replace")
+                if severity.get(s, 0) > severity.get(state, 0):
+                    state = s
+            _apply(state)
+
+        backoff = 0.5
+        try:
+            while True:
+                watcher = None
+                try:
+                    try:
+                        await rt.store.get("__ping__")
+                    except (ConnectionError, RuntimeError):
+                        await rt.reconnect_store()
+                    watcher = await rt.store.watch_prefix(
+                        self.straggler_prefix, include_existing=True
+                    )
+                    await _apply_key_set()
+                    backoff = 0.5
+                    async for ev in watcher:
+                        if not _mine(ev.key):
+                            continue
+                        if ev.type == "put":
+                            _apply(ev.value.decode("utf-8", "replace"))
+                        else:
+                            await _apply_key_set()
+                except asyncio.CancelledError:
+                    raise
+                except (ConnectionError, RuntimeError, OSError):
+                    logger.warning(
+                        "straggler watch for %s lost; retrying", self.path,
+                        exc_info=True,
+                    )
+                finally:
+                    if watcher is not None:
+                        try:
+                            await watcher.cancel()
+                        except asyncio.CancelledError:
+                            raise
+                        except Exception:
+                            logger.debug(
+                                "straggler watcher cancel failed",
+                                exc_info=True,
+                            )
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 10.0)
+        finally:
+            # worker shutdown: don't leave an orphaned pulse holding the
+            # drain source
+            if pulse is not None and not pulse.done():
+                pulse.cancel()
 
     async def add_leased_key(self, key: str, value: bytes) -> None:
         """Register an extra key under the serve lease; it participates in
@@ -1251,6 +1406,20 @@ class EndpointClient(AsyncEngine):
         snap = self._loads.get(iid)
         return snap is not None and snap.health in EXCLUDED_HEALTH
 
+    def _is_suspect(self, iid: str) -> bool:
+        """Fail-slow soft state (docs/resilience.md §Fail-slow): the
+        worker carries a fleet-relative straggler verdict. Its outputs are
+        trusted and it still serves — this is a soft-demotion preference
+        in ``_pick`` (route of last resort), never the hard cut
+        ``_is_unhealthy`` applies. Read from the same two wire paths
+        (instance-key heartbeat, reply piggyback), whichever arrives
+        first."""
+        info = self._instances.get(iid)
+        if info is not None and info.health == SUSPECT:
+            return True
+        snap = self._loads.get(iid)
+        return snap is not None and snap.health == SUSPECT
+
     def _load_score(self, iid: str) -> float:
         snap = self._loads.get(iid)
         # unknown load = assume free: new instances get traffic immediately
@@ -1287,6 +1456,14 @@ class EndpointClient(AsyncEngine):
                 f"{self.endpoint.path} are draining or unhealthy"
             )
         candidates = serving
+        # fail-slow soft demotion (docs/resilience.md §Fail-slow): prefer
+        # workers without a straggler verdict — but unlike the serving cut
+        # above this NEVER empties the pool: an all-suspect fleet keeps
+        # serving (slow everywhere beats down). A minority suspect starved
+        # of traffic recovers via the arbiter's probation decay, not here.
+        brisk = [i for i in candidates if not self._is_suspect(i)]
+        if brisk:
+            candidates = brisk
         # probe-aware: skip instances whose last liveness probe failed
         # (zombie suspects), but — unlike the drain filter — fall back to
         # them when nothing else is left: a suspect beats a guaranteed
@@ -1470,6 +1647,11 @@ class EndpointClient(AsyncEngine):
             "serving": serving,
             "draining": draining,
             "unhealthy": unhealthy,
+            # fail-slow soft-demoted workers: counted SEPARATELY from
+            # unhealthy (they still serve) and not subtracted from
+            # `serving` — a suspect worker is a route of last resort, but
+            # it is a route
+            "suspect": sum(1 for i in ids if self._is_suspect(i)),
             # entries currently held on stale authority (store outage /
             # restart): still routable, probes arbitrating
             "stale": len(self._stale),
@@ -1604,7 +1786,11 @@ class EndpointClient(AsyncEngine):
                 if directed is not None:
                     # one directed attempt at the migration target; any
                     # failure afterwards routes normally (the stale migrate
-                    # id is ignored by other engines — plain resume)
+                    # id is ignored by other engines — plain resume).
+                    # Deliberately only the HARD health cut here: a SUSPECT
+                    # target with this stream's KV already staged is a
+                    # better home than a fast sibling that must recompute —
+                    # suspect is a valid migration target of last resort
                     if (
                         directed in self._instances
                         and directed not in tried
@@ -1636,6 +1822,12 @@ class EndpointClient(AsyncEngine):
                 route.set_attribute("instance", iid)
                 route.set_attribute("attempts", attempt + 1)
                 route.add_event("pick", instance=iid, attempt=attempt + 1)
+                if self._is_suspect(iid):
+                    # landed on a soft-demoted straggler anyway (route of
+                    # last resort): make the trace say so — this event is
+                    # how a slow stream is attributed to the fail-slow
+                    # plane during incident review
+                    route.add_event("soft_demote", instance=iid)
             # exactly-once breaker resolution: every exit that calls neither
             # record_success nor record_failure (deadline expiry, abandoned
             # generator, application-error first item, unexpected raise)

@@ -48,7 +48,7 @@ logger = logging.getLogger(__name__)
 
 PLANES = ("statestore", "bus", "rpc", "transfer", "engine")
 ACTIONS = ("refuse", "delay", "reset", "stall", "wedge", "cut", "blackout",
-           "migrate_stall", "corrupt", "poison")
+           "migrate_stall", "corrupt", "poison", "slow")
 POINTS = ("connect", "read", "write", "serve", "item", "migrate", "pages",
           "dispatch")
 
@@ -105,7 +105,14 @@ class FaultRule:
     ``max_fires``   total firings across the injector (None = unlimited).
     ``probability`` chance to fire when otherwise matching; draws from the
                     injector's seeded RNG (1.0 = always, deterministic).
-    ``delay``       seconds, for action="delay".
+    ``delay``       seconds, for action="delay" — and the FIXED part of a
+                    "slow" dispatch delay (docs/resilience.md §Fail-slow:
+                    the fail-slow drill injects ``delay + U[0, jitter)``
+                    seconds at the engine dispatch point, per-plane
+                    addressable like ``corrupt`` so one worker in a fleet
+                    runs slow while the rest stay crisp).
+    ``jitter``      seconds, for action="slow": uniform random extra delay
+                    drawn from the injector's seeded RNG (replayable).
     """
 
     plane: str = "*"
@@ -116,6 +123,7 @@ class FaultRule:
     max_fires: Optional[int] = None
     probability: float = 1.0
     delay: float = 0.0
+    jitter: float = 0.0
     fired: int = field(default=0, compare=False)
 
     def matches(self, plane: str, addr: str, point: str, op_index: int) -> bool:
@@ -135,7 +143,7 @@ class FaultRule:
     def from_dict(cls, d: dict) -> "FaultRule":
         known = {k: d[k] for k in (
             "plane", "point", "action", "match_addr", "after_ops",
-            "max_fires", "probability", "delay",
+            "max_fires", "probability", "delay", "jitter",
         ) if k in d}
         return cls(**known)
 
@@ -362,6 +370,13 @@ class FaultInjector:
         that owns its call site (the engine thread for ``dispatch``/
         host-tier ``pages``; the event loop for wire ``pages``) — rule
         bookkeeping is GIL-atomic appends/increments."""
+        return self.decide_sync_rule(plane, addr, point, action) is not None
+
+    def decide_sync_rule(self, plane: str, addr: str, point: str,
+                         action: str) -> Optional[FaultRule]:
+        """:meth:`decide_sync` returning the fired rule itself, for gates
+        whose effect is parameterized by the rule (``slow`` reads its
+        ``delay``/``jitter``)."""
         key = (plane, addr, point)
         op = self._sync_ops.get(key, 0)
         self._sync_ops[key] = op + 1
@@ -379,8 +394,8 @@ class FaultInjector:
             self.log.append(
                 FaultDecision(plane, addr, point, op, rule.action)
             )
-            return True
-        return False
+            return rule
+        return None
 
     async def before_migrate(self, plane: str, addr: str) -> None:
         """Per-migration gate (drain coordinator, once per stream shipped):
@@ -638,6 +653,29 @@ def corrupt_array(plane: str, addr: str, arr):
     flat = out.view(np.uint8).reshape(-1)
     flat[len(flat) // 2] ^= 0x01
     return out
+
+
+def slow_gate(plane: str, addr: str) -> float:
+    """Engine-dispatch gate for the ``slow`` action at point ``dispatch``
+    (docs/resilience.md §Fail-slow): seconds of injected host-side delay
+    for THIS dispatch — ``rule.delay`` plus a uniform draw from
+    ``[0, rule.jitter)`` off the injector's seeded RNG, so a replayed
+    schedule slows the same dispatches by the same amounts. 0.0 when no
+    rule fires. Models the gray failures the straggler plane exists for
+    (thermal throttle, sick NIC, noisy co-tenant): the worker stays
+    healthy by every existing probe, it is just *slow*. Synchronous,
+    called from the engine thread once per dispatch; callers pre-check
+    :func:`current` so the uninstrumented path pays one None-check."""
+    inj = current()
+    if inj is None:
+        return 0.0
+    rule = inj.decide_sync_rule(plane, addr, "dispatch", "slow")
+    if rule is None:
+        return 0.0
+    d = max(rule.delay, 0.0)
+    if rule.jitter > 0.0:
+        d += rule.jitter * inj.rng.random()
+    return d
 
 
 def poison_gate(plane: str, addr: str) -> bool:

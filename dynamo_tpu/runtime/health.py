@@ -61,7 +61,15 @@ UNHEALTHY = "unhealthy"
 # clearing) re-admits it, because a host that silently corrupts data does
 # not become trustworthy by being briefly quiet.
 QUARANTINED = "quarantined"
-STATES = (HEALTHY, DEGRADED, UNHEALTHY, QUARANTINED)
+# suspect (docs/resilience.md §Fail-slow): the telemetry aggregator judged
+# this worker slow relative to its live peers (runtime/straggler.py). A
+# SOFT state between healthy and unhealthy: the worker still serves —
+# clients soft-demote it to route-of-last-resort instead of excluding it
+# (an all-slow fleet must keep serving), its KV stays trusted (unlike
+# quarantine, so inflight streams migrate off with their pages), and it
+# recovers on its own when the aggregator clears the verdict.
+SUSPECT = "suspect"
+STATES = (HEALTHY, DEGRADED, SUSPECT, UNHEALTHY, QUARANTINED)
 
 # drain source the monitor uses with DistributedRuntime.set_draining — kept
 # distinct from "local" (SIGUSR1) and "store" (llmctl) so a self-heal never
@@ -70,6 +78,11 @@ DRAIN_SOURCE = "health"
 # quarantine uses its OWN drain source: an unquarantine must not cancel a
 # health/operator drain, and a health recovery must not undo a quarantine
 QUARANTINE_SOURCE = "quarantine"
+# the straggler plane's drain pulse (distributed.py _straggler_control_loop
+# migrates a CONFIRMED straggler's inflight streams off) also keeps its own
+# source: a straggler recovery must not cancel a health/operator/quarantine
+# drain, and vice versa
+STRAGGLER_SOURCE = "straggler"
 
 
 @dataclass
@@ -280,10 +293,19 @@ class HealthMonitor:
         # Constructor-free read: one module-global check per tick.
         from dynamo_tpu.runtime import integrity
 
+        # the straggler verdict latch (runtime/straggler.py) sits BETWEEN
+        # unhealthy and degraded: fleet-relative slowness is softer than a
+        # wedged engine (the worker still serves, last-resort) but graver
+        # than local loop lag. Constructor-free module-global read, same
+        # zero-overhead contract as the quarantine latch.
+        from dynamo_tpu.runtime import straggler
+
         if integrity.quarantined():
             candidate = QUARANTINED
         elif stalled or sub_unhealthy:
             candidate = UNHEALTHY
+        elif straggler.verdict() != straggler.OK:
+            candidate = SUSPECT
         elif lag > self.policy.loop_lag_threshold:
             candidate = DEGRADED
         else:
@@ -292,6 +314,13 @@ class HealthMonitor:
         return self.state
 
     def _transition(self, new: str) -> None:
+        # suspect needs no hysteresis of its own: the aggregator's window
+        # machinery (runtime/straggler.py StragglerArbiter) already owns
+        # the flap damping, so the worker mirrors the latched verdict
+        # immediately both ways. It also does not self-drain here — the
+        # straggler control loop (distributed.py) drives the migrate-off
+        # drain pulse under its own source; soft-demotion in the clients
+        # handles routing for plain suspects.
         if new == QUARANTINED or self.state == QUARANTINED:
             # no hysteresis either way: latching quarantine is immediate
             # (every check until the latch clears re-candidates it), and

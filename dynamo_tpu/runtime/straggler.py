@@ -1,0 +1,527 @@
+"""Fail-slow defense: differential straggler detection (docs/resilience.md
+§Fail-slow).
+
+The operate-under-failure planes (PR10-13) catch workers that die, drain,
+or lie — but a worker that is merely *slow* (thermal throttle, failing
+NIC, one sick chip in a pod, noisy co-tenant) sails through every one of
+those checks: it heartbeats, it answers ``__ping__``, its checksums
+verify, and it silently drags every stream routed to it. That is the
+classic gray-failure / fail-slow gap ("Gray Failure", HotOS'17;
+"Fail-Slow at Scale", FAST'18), and its fix is *differential*
+observability: judge each worker against its live peers, never against an
+absolute threshold a heterogeneous fleet would trip on day one.
+
+Three pieces live here, split by where they run:
+
+- :class:`StragglerPolicy` — the ``DYN_TPU_STRAGGLER*`` knob bundle (PR3
+  clamping contract). ``DYN_TPU_STRAGGLER`` defaults OFF and is THE
+  zero-overhead gate: with it unset no detector is ever constructed (the
+  test suite monkeypatches the constructor to prove it) and the engine
+  step loop pays one attribute None-check per dispatch.
+- :class:`StragglerDetector` — the *worker*-side half: a process-global,
+  thread-safe EWMA of wall-microseconds-per-token over the engine's
+  per-dispatch timings (ring-buffered for debug dumps). It produces the
+  ``dispatch_us_per_token_ewma`` gauge that rides the ordinary metrics
+  stream — the detector never judges; normalized latency means nothing
+  without peers to compare against.
+- :class:`StragglerArbiter` — the *aggregator*-side half: fleet-relative
+  verdicts. Per model group, once per detection window, a worker whose
+  EWMA exceeds ``factor ×`` the peer median (with ``min_peers`` fresh
+  reporters) takes a window trip: one trip ⇒ ``suspect`` (soft-demoted,
+  route of last resort), ``trips`` consecutive windows ⇒ ``confirmed``
+  (migration donor — the drain pulse ships its inflight streams to
+  faster siblings). A uniformly-loaded fleet produces ZERO false
+  positives, and — unlike PR13's sticky quarantine — the verdict is
+  recoverable: one full window back inside the peer envelope clears it.
+  Workers with no fresh samples in a window HOLD their state (a drained/
+  paused worker stops producing samples; it never produces slow ones —
+  the drain-composition defense), except that a *demoted* worker starved
+  of samples for several consecutive windows decays one severity level
+  per probation period — soft-demotion is what starved it, so held
+  verdicts must expire or a recovered worker could never prove itself.
+
+Verdicts travel worker-ward over the existing control-key channel
+(``{ns}/straggler/{worker_id}``, the quarantine-latch pattern): the
+aggregator puts/deletes keys under ITS lease (a dead arbiter's verdicts
+expire instead of wedging the fleet demoted), each worker's control loop
+watches the prefix and latches the module-global verdict below, and the
+health plane reports the new soft state ``suspect`` on every existing
+wire path (load snapshots, instance keys, ``__ping__`` pongs) with zero
+new plumbing. The latch is deliberately independent of the detector so a
+drill (``llmctl``/tests writing the key by hand) works with the sampling
+plane off.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from dynamo_tpu.runtime.envknobs import (
+    env_clamped_float as _env_clamped_float,
+    env_clamped_int as _env_clamped_int,
+    env_flag as _env_flag,
+)
+
+logger = logging.getLogger(__name__)
+
+ENV_STRAGGLER = "DYN_TPU_STRAGGLER"
+ENV_FACTOR = "DYN_TPU_STRAGGLER_FACTOR"
+ENV_WINDOW = "DYN_TPU_STRAGGLER_WINDOW"
+ENV_MIN_PEERS = "DYN_TPU_STRAGGLER_MIN_PEERS"
+ENV_TRIPS = "DYN_TPU_STRAGGLER_TRIPS"
+
+# verdict states, in severity order. Plain strings: they cross the wire in
+# metrics snapshots and control keys, and read well in logs.
+OK = "ok"
+SUSPECT = "suspect"
+CONFIRMED = "confirmed"
+STATES = (OK, SUSPECT, CONFIRMED)
+
+# store-key prefix segment for verdict distribution (the quarantine-latch
+# channel shape: "{namespace}/straggler/{worker_id}")
+CONTROL_PREFIX = "straggler"
+
+
+@dataclass(frozen=True)
+class StragglerPolicy:
+    """Knob bundle for the fail-slow plane (PR3 clamping contract:
+    malformed / non-positive values fall back to defaults, out-of-range
+    values clamp into the documented bounds).
+
+    ``enabled``    DYN_TPU_STRAGGLER (default OFF — 1 arms the plane;
+                   0/unset is the zero-overhead gate: no detector, no
+                   arbiter, no control loop is ever constructed).
+    ``factor``     DYN_TPU_STRAGGLER_FACTOR: a worker is slow when its
+                   per-token EWMA exceeds ``factor ×`` the peer median
+                   (clamped to [1.1, 100] — at 1.0 ordinary jitter would
+                   flag half the fleet).
+    ``window``     DYN_TPU_STRAGGLER_WINDOW: detection window seconds
+                   (clamped to [0.2, 3600]); verdicts advance/clear at
+                   window boundaries only.
+    ``min_peers``  DYN_TPU_STRAGGLER_MIN_PEERS: fresh reporters required
+                   before any verdict (clamped to [2, 4096] — a fleet of
+                   one has no peers, hence no differential signal).
+    ``trips``      DYN_TPU_STRAGGLER_TRIPS: consecutive slow windows
+                   before suspect escalates to confirmed (migration
+                   donor; clamped to [1, 100]).
+    """
+
+    enabled: bool = False
+    factor: float = 3.0
+    window: float = 30.0
+    min_peers: int = 2
+    trips: int = 3
+
+    @classmethod
+    def from_env(cls) -> "StragglerPolicy":
+        d = cls()
+        return cls(
+            enabled=_env_flag(ENV_STRAGGLER, d.enabled),
+            factor=_env_clamped_float(ENV_FACTOR, d.factor, 1.1, 100.0),
+            window=_env_clamped_float(ENV_WINDOW, d.window, 0.2, 3600.0),
+            min_peers=_env_clamped_int(ENV_MIN_PEERS, d.min_peers, 2, 4096),
+            trips=_env_clamped_int(ENV_TRIPS, d.trips, 1, 100),
+        )
+
+
+def maybe_from_env() -> Optional[StragglerPolicy]:
+    """The gate every integration point None-checks: ``None`` unless the
+    fail-slow plane is armed — with ``DYN_TPU_STRAGGLER`` unset/0 no
+    policy object is ever constructed (the PR9/PR13/PR14 pattern)."""
+    if not _env_flag(ENV_STRAGGLER, False):
+        return None
+    return StragglerPolicy.from_env()
+
+
+def enabled() -> bool:
+    """Cheap boolean form of the gate (one env read, no object)."""
+    return _env_flag(ENV_STRAGGLER, False)
+
+
+# ---------------------------------------------------------------------------
+# worker side: the per-dispatch timing feed
+# ---------------------------------------------------------------------------
+
+
+class StragglerDetector:
+    """Process-global EWMA of wall-us-per-token over engine dispatches.
+
+    Constructed lazily behind :func:`maybe_detector` — with the plane off
+    nothing ever constructs it (the zero-overhead guard monkeypatches this
+    constructor to prove it). Thread-safe: the engine step thread feeds,
+    the metrics/RPC threads read.
+
+    Wall time (not device time) on purpose: the failure modes this plane
+    exists for — thermal throttle, a failing NIC stretching host fetches,
+    a noisy co-tenant stealing the host CPU — can land on either side of
+    the device/host split, and a victim stream experiences their SUM. The
+    per-phase EWMAs are kept for debug dumps; the published gauge is the
+    all-phase blend, which is what peers are compared on.
+    """
+
+    # ring of recent (phase, us_per_token) samples for debug dumps — a
+    # window, never a leak (the decision-log bound pattern)
+    RING = 512
+    # EWMA smoothing: ~weighting the last ~20 dispatches. Fast enough to
+    # cross a detection window, slow enough that one hiccup dispatch
+    # cannot impersonate a sick worker.
+    ALPHA = 0.1
+
+    def __init__(self, alpha: float = ALPHA):
+        self._alpha = float(alpha)
+        self._lock = threading.Lock()
+        self._ewma = 0.0
+        self._phase_ewma: Dict[str, float] = {}
+        self._ring: deque = deque(maxlen=self.RING)
+        self.samples_total = 0
+
+    def note_dispatch(self, phase: str, wall_us: float, tokens: int) -> None:
+        """One dispatch: ``wall_us`` of step-loop wall time advancing
+        ``tokens`` tokens. Token-free dispatches (a cancelled-lane sweep)
+        carry no per-token signal and are skipped."""
+        if tokens <= 0 or wall_us < 0.0:
+            return
+        upt = wall_us / tokens
+        with self._lock:
+            self.samples_total += 1
+            self._ewma = (
+                upt if self.samples_total == 1
+                else self._ewma + self._alpha * (upt - self._ewma)
+            )
+            prev = self._phase_ewma.get(phase)
+            self._phase_ewma[phase] = (
+                upt if prev is None else prev + self._alpha * (upt - prev)
+            )
+            self._ring.append((phase, round(upt, 1)))
+
+    def us_per_token(self) -> float:
+        with self._lock:
+            return self._ewma
+
+    def gauges(self) -> Dict[str, Any]:
+        """The worker-gauge view (ForwardPassMetrics fields), merged into
+        the engine's metrics snapshot: the normalized latency the arbiter
+        compares across peers, plus the cumulative sample counter the
+        arbiter uses for freshness (a stale EWMA from a paused worker must
+        not be judged — see the drain-composition defense)."""
+        with self._lock:
+            return {
+                "dispatch_us_per_token_ewma": round(self._ewma, 1),
+                "straggler_samples_total": self.samples_total,
+            }
+
+    def debug_dump(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "us_per_token_ewma": round(self._ewma, 1),
+                "samples_total": self.samples_total,
+                "phase_ewma": {
+                    k: round(v, 1) for k, v in self._phase_ewma.items()
+                },
+                "recent": list(self._ring)[-32:],
+            }
+
+
+# ---------------------------------------------------------------------------
+# process-global accessors (constructor-free reads, lazy gated writes)
+# ---------------------------------------------------------------------------
+
+_DETECTOR: Optional[StragglerDetector] = None
+_LOCK = threading.Lock()
+
+# the worker's latched fleet-relative verdict, pushed back from the
+# aggregator over the control-key channel. Module-global and independent
+# of the detector ON PURPOSE: the health plane reads it constructor-free
+# every check tick, and a drill that writes the control key by hand must
+# work with the sampling plane off.
+_VERDICT = OK
+
+
+def maybe_detector() -> Optional[StragglerDetector]:
+    """The engine's init-time hook: the process-global detector when the
+    plane is armed, else ``None`` — nothing is ever constructed with
+    ``DYN_TPU_STRAGGLER`` unset (the zero-overhead contract)."""
+    global _DETECTOR
+    if not enabled():
+        return None
+    if _DETECTOR is None:
+        with _LOCK:
+            if _DETECTOR is None:
+                _DETECTOR = StragglerDetector()
+    return _DETECTOR
+
+
+def detector_gauges() -> Dict[str, Any]:
+    """Constructor-free gauge read for the metrics publisher: empty dict
+    until anything armed the plane in this process."""
+    det = _DETECTOR
+    if det is None:
+        return {}
+    return det.gauges()
+
+
+def verdict() -> str:
+    """The worker's current fleet-relative verdict ("ok" | "suspect" |
+    "confirmed"). Constructor-free, one module-global read — the health
+    monitor calls this every check tick with the plane off too."""
+    return _VERDICT
+
+
+def set_verdict(state: str) -> None:
+    """Latch a verdict pushed from the aggregator (control-key loop) or a
+    drill. Unknown states are dropped with a warning rather than raised —
+    a newer aggregator must not crash an older worker's control loop."""
+    global _VERDICT
+    if state not in STATES:
+        logger.warning("ignoring unknown straggler verdict %r", state)
+        return
+    if state != _VERDICT:
+        log = logger.warning if state != OK else logger.info
+        log("straggler verdict: %s -> %s", _VERDICT, state)
+    _VERDICT = state
+
+
+def clear_verdict() -> None:
+    set_verdict(OK)
+
+
+def reset_for_tests() -> None:
+    """Drop the process-global detector and verdict latch (conftest
+    autouse reset: one test's samples or latched verdict must not bleed
+    into another's health checks)."""
+    global _DETECTOR, _VERDICT
+    with _LOCK:
+        _DETECTOR = None
+        _VERDICT = OK
+
+
+# ---------------------------------------------------------------------------
+# aggregator side: fleet-relative verdicts
+# ---------------------------------------------------------------------------
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    if n == 0:
+        return 0.0
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+class _WorkerRecord:
+    __slots__ = (
+        "model", "ewma", "samples", "samples_at_window", "state", "trips",
+        "stale_windows", "last_seen",
+    )
+
+    def __init__(self, model: str):
+        self.model = model
+        self.ewma = 0.0
+        self.samples = 0
+        self.samples_at_window = 0
+        self.state = OK
+        self.trips = 0
+        self.stale_windows = 0
+        self.last_seen = 0.0
+
+
+class StragglerArbiter:
+    """Fleet-relative verdict engine (runs at the telemetry aggregator).
+
+    Pure and clock-injected: callers pass ``now`` (any monotonic source)
+    into :meth:`observe`/:meth:`evaluate`, so tests drive whole detection
+    windows without sleeping. Per model group, at each window boundary:
+
+    - **fresh** workers (≥1 new detector sample since the last boundary,
+      nonzero EWMA) are judged; **stale** workers HOLD their state — a
+      worker paused by a PR12 drain stops producing samples, and a pause
+      is not slowness (the drain-composition regression).
+    - with ≥ ``min_peers`` fresh reporters, a fresh worker whose EWMA
+      exceeds ``factor × median(fresh EWMAs)`` takes a window trip:
+      ``suspect`` at one, ``confirmed`` at ``trips`` consecutive.
+    - a fresh worker back inside the envelope for the FULL window (i.e.
+      judged clean at a boundary) clears straight to ``ok`` — recoverable
+      by design, unlike the integrity quarantine: slowness has benign
+      transient causes; corruption does not.
+
+    The median is taken over *all* fresh workers including the suspect
+    ones: with a majority-healthy fleet the median is a healthy worker,
+    and on an all-slow fleet (thermal event hits the whole pod) nobody
+    exceeds ``factor × median`` — the fleet stays un-demoted and keeps
+    serving, which is the soft-demotion contract.
+
+    **Probation decay** closes the starvation loop: soft-demotion routes
+    traffic AWAY from a suspect, which starves it of dispatches, which
+    means no fresh samples — and a plain HOLD would then pin the verdict
+    forever with no way to prove recovery. So a *demoted* worker that
+    stays stale (heartbeating, but zero fresh samples) for
+    ``PROBATION_WINDOWS`` consecutive windows decays ONE severity level
+    (confirmed → suspect → ok): the demotion is a lease on evidence, and
+    starved of evidence it expires. If the worker is genuinely still
+    slow, its first real window of traffic re-trips it (bounded
+    oscillation: slow exposure is ~1 window in ``PROBATION_WINDOWS+1``);
+    if it recovered, it rejoins silently. Decay only ever *removes*
+    verdicts, so the drain-composition guarantee — a paused worker is
+    never *judged* slow — is untouched.
+    """
+
+    # drop workers not heard from for this many windows (left fleet)
+    EXPIRE_WINDOWS = 10.0
+    # consecutive sample-free windows before a demoted worker's verdict
+    # decays one severity level (the starvation-recovery probe cycle)
+    PROBATION_WINDOWS = 8
+
+    def __init__(self, policy: Optional[StragglerPolicy] = None):
+        self.policy = policy or StragglerPolicy.from_env()
+        self._workers: Dict[str, _WorkerRecord] = {}
+        self._window_start: Optional[float] = None
+        self.windows_total = 0
+        self.trips_total = 0
+
+    def observe(
+        self, worker_id: str, model: str, ewma: float, samples_total: int,
+        now: float,
+    ) -> None:
+        """One metrics-stream observation for ``worker_id``. Cheap and
+        unconditional — judgment happens only at window boundaries."""
+        rec = self._workers.get(worker_id)
+        if rec is None:
+            rec = self._workers[worker_id] = _WorkerRecord(model)
+            # anchor a first-seen worker at its CURRENT counter: it is
+            # judged only once it produces a sample after this point. A
+            # worker that freezes right after first sight (drained, or
+            # seen across an aggregator restart mid-drain) would otherwise
+            # be judged on a stale queue-flush EWMA — the drain-pause
+            # misattribution the freshness gate exists to prevent. Costs
+            # newly-joined workers one extra window of detection latency;
+            # steady-state detection is unaffected.
+            rec.samples_at_window = max(int(samples_total), 0)
+        rec.model = model or rec.model
+        rec.ewma = float(ewma)
+        rec.samples = int(samples_total)
+        rec.last_seen = now
+        if self._window_start is None:
+            self._window_start = now
+
+    def evaluate(self, now: float) -> Dict[str, str]:
+        """Advance the verdict machine if a full window has elapsed.
+        Returns only the CHANGED verdicts ``{worker_id: state}`` (the
+        store-sync loop puts/deletes exactly these keys); ``{}`` when the
+        window hasn't closed or nothing changed."""
+        if self._window_start is None:
+            return {}
+        if now - self._window_start < self.policy.window:
+            return {}
+        self.windows_total += 1
+        changed: Dict[str, str] = {}
+        expire = self.policy.window * self.EXPIRE_WINDOWS
+        by_model: Dict[str, List[str]] = {}
+        for wid, rec in list(self._workers.items()):
+            if now - rec.last_seen > expire:
+                del self._workers[wid]
+                if rec.state != OK:
+                    changed[wid] = OK
+                continue
+            by_model.setdefault(rec.model, []).append(wid)
+        for wids in by_model.values():
+            fresh = [
+                w for w in wids
+                if self._workers[w].samples > self._workers[w].samples_at_window
+                and self._workers[w].ewma > 0.0
+            ]
+            fresh_set = set(fresh)
+            # probation decay runs BEFORE (and regardless of) the
+            # min_peers gate: a starved suspect must be able to shed its
+            # verdict even when the fleet shrank below judging quorum
+            for w in wids:
+                rec = self._workers[w]
+                if w in fresh_set:
+                    rec.stale_windows = 0
+                    continue
+                if rec.state == OK:
+                    continue
+                rec.stale_windows += 1
+                if rec.stale_windows < self.PROBATION_WINDOWS:
+                    continue
+                rec.stale_windows = 0
+                if rec.state == CONFIRMED:
+                    new = SUSPECT
+                    # one more slow window re-confirms: the probe cycle
+                    # must not restart the whole trip ladder
+                    rec.trips = max(self.policy.trips - 1, 0)
+                else:
+                    new = OK
+                    rec.trips = 0
+                logger.warning(
+                    "straggler probation decay for %s (model %s): %s -> %s "
+                    "(%d sample-free windows — demotion starved it of the "
+                    "traffic that could clear it)",
+                    w, rec.model, rec.state, new, self.PROBATION_WINDOWS,
+                )
+                rec.state = new
+                changed[w] = new
+            if len(fresh) < self.policy.min_peers:
+                continue  # no differential signal: everyone holds
+            med = _median([self._workers[w].ewma for w in fresh])
+            if med <= 0.0:
+                continue
+            cut = self.policy.factor * med
+            for w in fresh:
+                rec = self._workers[w]
+                if rec.ewma > cut:
+                    rec.trips += 1
+                    self.trips_total += 1
+                    new = (
+                        CONFIRMED if rec.trips >= self.policy.trips
+                        else SUSPECT
+                    )
+                else:
+                    # one full window back in the peer envelope: clear
+                    rec.trips = 0
+                    new = OK
+                if new != rec.state:
+                    logger.warning(
+                        "straggler verdict for %s (model %s): %s -> %s "
+                        "(ewma %.1f us/tok, peer median %.1f, factor %.1f)",
+                        w, rec.model, rec.state, new, rec.ewma, med,
+                        self.policy.factor,
+                    )
+                    rec.state = new
+                    changed[w] = new
+        for rec in self._workers.values():
+            rec.samples_at_window = rec.samples
+        self._window_start = now
+        return changed
+
+    def verdicts(self) -> Dict[str, str]:
+        """All current non-ok verdicts (re-put fodder for the store-sync
+        loop after a statestore blip loses its leased keys)."""
+        return {
+            w: rec.state for w, rec in self._workers.items()
+            if rec.state != OK
+        }
+
+    def state_of(self, worker_id: str) -> str:
+        rec = self._workers.get(worker_id)
+        return rec.state if rec is not None else OK
+
+    def debug_dump(self) -> Dict[str, Any]:
+        return {
+            "windows_total": self.windows_total,
+            "trips_total": self.trips_total,
+            "workers": {
+                w: {
+                    "model": rec.model,
+                    "ewma": round(rec.ewma, 1),
+                    "samples": rec.samples,
+                    "state": rec.state,
+                    "trips": rec.trips,
+                }
+                for w, rec in self._workers.items()
+            },
+        }

@@ -117,6 +117,20 @@ def _reset_profiling_state():
 
 
 @pytest.fixture(autouse=True)
+def _reset_straggler_state():
+    """Drop the process-global straggler detector and verdict latch after
+    each test: one test's dispatch samples or latched fail-slow verdict
+    must not leave a later test's health checks reading 'suspect'
+    (imported lazily — the control-plane reset pattern)."""
+    yield
+    import sys
+
+    strag = sys.modules.get("dynamo_tpu.runtime.straggler")
+    if strag is not None:
+        strag.reset_for_tests()
+
+
+@pytest.fixture(autouse=True)
 def _no_leaked_health_monitors():
     """Fail any test that leaves a HealthMonitor check task running past
     teardown: a leaked monitor keeps reaping/draining state in the

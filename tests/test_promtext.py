@@ -271,6 +271,7 @@ def test_cluster_telemetry_exposition_valid():
         "dynamo_cluster_kv_integrity_failures_total",
         "dynamo_cluster_watchdog_trips_total",
         "dynamo_cluster_workers_quarantined",
+        "dynamo_cluster_workers_suspect",
     ):
         assert family in fams, f"missing family {family}"
 
@@ -323,3 +324,47 @@ def test_quarantined_worker_exposition_valid():
     cfams = parse_prometheus_text(ct.render_prometheus())
     assert cfams["dynamo_cluster_workers_quarantined"]["samples"]
     assert cfams["dynamo_cluster_kv_integrity_failures_total"]["samples"]
+
+
+def test_suspect_worker_exposition_valid():
+    """A fail-slow-suspect mock worker (the TPU-less drill:
+    --straggler-state suspect --dispatch-us-per-token N --health-state
+    suspect) renders grammar-valid worker AND cluster expositions with
+    the straggler families populated and the exact state values the
+    runbook greps for."""
+    agg = MetricsAggregator("ns")
+    stats = MockWorkerStats(
+        seed=5, dispatch_us_per_token=900.0, straggler_state="suspect",
+        health_state="suspect",
+    )
+    stats.tick(requests=3)
+    m = ForwardPassMetrics.from_dict(stats.metrics("m1").to_dict())
+    agg.update("w-slow", m)
+    text = agg.render()
+    fams = parse_prometheus_text(text)
+    for family in (
+        "dynamo_worker_dispatch_us_per_token_ewma",
+        "dynamo_worker_straggler_samples_total",
+        "dynamo_worker_straggler_state",
+    ):
+        assert family in fams, f"missing family {family}"
+        assert fams[family]["samples"], f"no samples for {family}"
+    # suspect maps to its own health value (4) — the soft state must
+    # never fall through the unknown-state default to unhealthy=2
+    assert 'dynamo_worker_health_state{namespace="ns",worker="w-slow"} 4' \
+        in text
+    assert 'dynamo_worker_straggler_state{namespace="ns",worker="w-slow"} 1' \
+        in text
+
+    ct = ClusterTelemetry(
+        "ns", policy=telemetry.TelemetryPolicy(
+            fast_window=10, mid_window=20, slow_window=40,
+        ),
+    )
+    ct.ingest("w-slow", m)
+    cfams = parse_prometheus_text(ct.render_prometheus())
+    assert cfams["dynamo_cluster_workers_suspect"]["samples"]
+    roll = ct.rollup()
+    entry = roll["models"]["m1"]
+    assert entry["workers_suspect"] == 1
+    assert entry["straggler_worker_ids"] == ["w-slow"]
