@@ -119,6 +119,18 @@ def build_parser() -> argparse.ArgumentParser:
                  "(default dyn://dynamo.telemetry.status)",
         )
         st.add_argument("--json", action="store_true", dest="as_json")
+        if plane == "cluster":
+            chz = tpv.add_parser(
+                "chaos",
+                help="render the last chaos run's schedule + per-invariant "
+                     "pass/fail table from a run directory written by "
+                     "tools/chaos.py (docs/chaos.md)",
+            )
+            chz.add_argument(
+                "run_dir",
+                help="run directory holding schedule.json + result.json",
+            )
+            chz.add_argument("--json", action="store_true", dest="as_json")
 
     plan = sub.add_parser(
         "planner", help="SLA-driven planner decision ring + cooldowns"
@@ -357,8 +369,71 @@ async def _wait_quarantined(store, base: str, args) -> int:
         await asyncio.sleep(min(0.25, args.timeout / 10))
 
 
+def _chaos_cmd(args) -> int:
+    """Render a chaos run directory (tools/chaos.py artifacts): the
+    schedule timeline + per-invariant pass/fail table. Exit mirrors the
+    run's verdict — 0 every invariant held, 2 violations (so a cron
+    wrapper can gate on the LAST run without re-executing it), 1 the
+    directory is unreadable."""
+    import os
+
+    def _load(name):
+        with open(os.path.join(args.run_dir, name)) as f:
+            return json.load(f)
+
+    try:
+        schedule = _load("schedule.json")
+        result = _load("result.json")
+    except (OSError, ValueError) as e:
+        if getattr(args, "as_json", False):
+            print(json.dumps({"ok": False, "run_dir": args.run_dir,
+                              "error": str(e)}))
+        else:
+            print(f"chaos: cannot read run dir {args.run_dir}: {e}")
+        return 1
+    ok = bool(result.get("ok"))
+    if getattr(args, "as_json", False):
+        print(json.dumps({
+            "ok": ok,
+            "run_dir": args.run_dir,
+            "seed": schedule.get("seed"),
+            "schedule": schedule,
+            "invariants": result.get("invariants", {}),
+            "violations": result.get("violations", []),
+            "stats": result.get("stats", {}),
+        }, sort_keys=True))
+        return 0 if ok else 2
+    events = schedule.get("events", [])
+    print(f"chaos run  seed={schedule.get('seed')}  "
+          f"workers={schedule.get('n_workers')}  "
+          f"horizon={schedule.get('horizon')}s  events={len(events)}")
+    for ev in events:
+        dur = ev.get("duration", 0.0)
+        span = f" for {dur:.2f}s" if dur else ""
+        print(f"  t={ev.get('t'):7.3f}  {ev.get('kind'):<14} "
+              f"w{ev.get('worker')}{span}")
+    print()
+    inv = result.get("invariants", {})
+    width = max((len(k) for k in inv), default=10)
+    for name in sorted(inv):
+        print(f"  {name:<{width}}  {'PASS' if inv[name] else 'FAIL'}")
+    for v in result.get("violations", []):
+        print(f"  !! {v.get('invariant')}: {v.get('detail')}")
+    print()
+    print("all invariants held" if ok else
+          f"{len(result.get('violations', []))} violation(s) — replay with: "
+          f"python tools/chaos.py replay "
+          f"{os.path.join(args.run_dir, 'schedule.json')}")
+    return 0 if ok else 2
+
+
 async def amain(argv: list) -> int:
     args = build_parser().parse_args(argv)
+
+    # local-artifact verb: reads files tools/chaos.py wrote, touches no
+    # statestore — must work during the exact outage a chaos run left
+    if args.plane == "cluster" and args.verb == "chaos":
+        return _chaos_cmd(args)
 
     from dynamo_tpu.runtime.distributed import parse_endpoint_path
     from dynamo_tpu.runtime.envknobs import env_str

@@ -42,6 +42,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import logging
+import sys
 import threading
 import time
 import weakref
@@ -128,6 +129,13 @@ def note_migration(blocks: int = 0, failed: bool = False) -> None:
         else:
             _MIGRATIONS += 1
             _KV_BLOCKS_MOVED += blocks
+    # chaos-plane observation hook (docs/chaos.md): reaches the observer
+    # only when runtime/chaos.py is already imported AND armed — serving
+    # deployments never import it, so this is one dict-get. Outside _LOCK:
+    # the observer has its own lock and must not nest under this one.
+    ch = sys.modules.get("dynamo_tpu.runtime.chaos")
+    if ch is not None:
+        ch.note_event("migration", ok=not failed, blocks=blocks)
 
 
 def migration_counters() -> tuple:

@@ -104,17 +104,27 @@ def _sender_crcs(engine, ids, k, v, ks, vs):
 
 
 def _engine_call(engine, fn):
-    """Run ``fn`` on the engine thread, await the result from asyncio."""
+    """Run ``fn`` on the engine thread, await the result from asyncio.
+
+    The resolve callbacks tolerate a future the awaiter already abandoned
+    (``wait_for`` timeout, coordinator drain cancelled): the engine thread
+    can be busy for seconds (compile, a long dispatch) and its late
+    completion must not raise ``InvalidStateError`` into the event loop —
+    first surfaced by the chaos matrix's corrupt×drain composition."""
     loop = asyncio.get_running_loop()
     fut = loop.create_future()
+
+    def _resolve(setter, value):
+        if not fut.done():
+            setter(value)
 
     def run():
         try:
             r = fn()
         except Exception as e:  # delivered to the awaiting caller
-            loop.call_soon_threadsafe(fut.set_exception, e)
+            loop.call_soon_threadsafe(_resolve, fut.set_exception, e)
             return
-        loop.call_soon_threadsafe(fut.set_result, r)
+        loop.call_soon_threadsafe(_resolve, fut.set_result, r)
 
     engine.post(run)
     return fut
@@ -328,6 +338,23 @@ class KvTransferServer:
                     # resume path; nothing is ever partially staged.
                     k, v, scales = _unpack_pages(h, frame.body)
                     meta = h.get("migrate") or {}
+                    # quarantine × migration composition (docs/chaos.md): a
+                    # latch landing mid-ship must abort the in-flight
+                    # transfer TO this process — a quarantined worker's KV
+                    # pool is suspect, so adopting a foreign stream into it
+                    # would hand corrupt pages a clean lineage. Checked at
+                    # the receiver because the source's routing snapshot
+                    # can be a beat stale; the typed nack degrades the
+                    # stream to the resume path, same as any rejection.
+                    if integrity.enabled() and integrity.quarantined():
+                        await write_frame(writer, TwoPartMessage(
+                            json.dumps({
+                                "id": h.get("id"), "ok": False, "int8": True,
+                                "code": "MigrationRejected",
+                                "error": "target quarantined: refusing to "
+                                         "stage migrated KV pages",
+                            }).encode(), b""))
+                        continue
                     try:
                         res = await _engine_call(
                             self.engine,

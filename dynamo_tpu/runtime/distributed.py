@@ -26,6 +26,7 @@ import asyncio
 import json
 import logging
 import random
+import sys
 import time
 import uuid
 from dataclasses import dataclass
@@ -371,6 +372,15 @@ class DistributedRuntime:
                 self._migrator.notify_drain()
             else:
                 self._migrator.cancel_drain()
+        # chaos-plane observation hook (docs/chaos.md): one dict-get unless
+        # runtime/chaos.py is imported and armed — serving code never
+        # imports it
+        ch = sys.modules.get("dynamo_tpu.runtime.chaos")
+        if ch is not None:
+            ch.note_event(
+                "drain", worker=self.worker_id, draining=effective,
+                source=source, flag=flag,
+            )
 
     def set_migrator(self, coordinator) -> None:
         """Attach a live-migration coordinator (disagg/migration.py) —

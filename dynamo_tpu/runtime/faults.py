@@ -155,6 +155,12 @@ class FaultDecision:
     point: str
     op_index: int
     action: str
+    # global draw-order stamp: two same-seed runs produce identical
+    # (seq, ..., detail) logs, which is what makes --replay auditable
+    seq: int = 0
+    # the RNG draw the action consumed (corrupt's byte offset, slow's
+    # jitter), recorded via FaultInjector.note_draw
+    detail: str = ""
 
 
 class FaultInjector:
@@ -172,6 +178,7 @@ class FaultInjector:
         # soak-length runs with per-frame rules; the newest FAULT_LOG_MAX
         # decisions are plenty to replay a failure (plus the seed)
         self.log: "deque[FaultDecision]" = _BoundedLog(maxlen=FAULT_LOG_MAX)
+        self._seq = 0
         self._connect_ops: Dict[Tuple[str, str], int] = {}
         self._serve_ops: Dict[Tuple[str, str], int] = {}
         self._sync_ops: Dict[Tuple[str, str, str], int] = {}
@@ -278,9 +285,21 @@ class FaultInjector:
             if rule.probability < 1.0 and self.rng.random() >= rule.probability:
                 continue
             rule.fired += 1
-            self.log.append(FaultDecision(plane, addr, point, op_index, rule.action))
+            self._seq += 1
+            self.log.append(FaultDecision(
+                plane, addr, point, op_index, rule.action, seq=self._seq,
+            ))
             return rule
         return None
+
+    def note_draw(self, detail: str) -> None:
+        """Annotate the NEWEST logged decision with the RNG draw its action
+        consumed (corrupt's byte offset, slow's jitter). Every seeded draw
+        an action makes lands in the decision log in draw order, so two
+        same-seed runs can be diffed entry-for-entry and a divergence
+        points at the exact first nondeterministic draw."""
+        if self.log:
+            self.log[-1].detail = detail
 
     async def _apply(self, rule: FaultRule, what: str) -> None:
         if rule.action == "delay":
@@ -391,8 +410,10 @@ class FaultInjector:
             ):
                 continue
             rule.fired += 1
+            self._seq += 1
             self.log.append(
-                FaultDecision(plane, addr, point, op, rule.action)
+                FaultDecision(plane, addr, point, op, rule.action,
+                              seq=self._seq)
             )
             return rule
         return None
@@ -620,9 +641,12 @@ async def item_gate(plane: str, addr: str, index: int) -> None:
 
 def corrupt_pages(plane: str, addr: str, body: bytes) -> bytes:
     """Silent-corruption drill (docs/resilience.md §Silent corruption): the
-    ``corrupt`` action at point ``pages`` bit-flips one byte in the middle
-    of a packed KV page body — deterministic (fixed offset, fixed bit), so
-    a replayed schedule corrupts the same block. Applied AFTER the sender
+    ``corrupt`` action at point ``pages`` bit-flips one byte of a packed
+    KV page body at an offset drawn from the injector's seeded RNG (and
+    recorded in the decision log via :meth:`FaultInjector.note_draw`), so
+    a replayed schedule corrupts the same byte of the same block — and the
+    flip lands anywhere in the page, not always mid-body, which is what
+    real SDC looks like. Applied AFTER the sender
     computed its content checksums, which is exactly the post-seal SDC the
     checksum plane exists to catch; the receiver's verify turns the flip
     into a typed :class:`~dynamo_tpu.runtime.integrity.KvIntegrityError`
@@ -633,15 +657,18 @@ def corrupt_pages(plane: str, addr: str, body: bytes) -> bytes:
         return body
     if not inj.decide_sync(plane, addr, "pages", "corrupt"):
         return body
-    i = len(body) // 2
+    i = inj.rng.randrange(len(body))
+    inj.note_draw(f"offset={i}")
     return body[:i] + bytes([body[i] ^ 0x01]) + body[i + 1:]
 
 
 def corrupt_array(plane: str, addr: str, arr):
     """Host-tier form of :func:`corrupt_pages`: bit-flips one byte of a
     numpy page array (the host KV pool's copy of an evicted block) — the
-    "bad host RAM" leg of the silent-corruption drill. Returns the (copied)
-    corrupted array when the rule fires, the original otherwise."""
+    "bad host RAM" leg of the silent-corruption drill. The byte offset is
+    drawn from the injector's seeded RNG and recorded in the decision log,
+    same replay contract as the wire form. Returns the (copied) corrupted
+    array when the rule fires, the original otherwise."""
     inj = current()
     if inj is None:
         return arr
@@ -651,7 +678,11 @@ def corrupt_array(plane: str, addr: str, arr):
 
     out = np.array(arr)  # device_get views may be read-only
     flat = out.view(np.uint8).reshape(-1)
-    flat[len(flat) // 2] ^= 0x01
+    if flat.size == 0:
+        return arr
+    i = inj.rng.randrange(flat.size)
+    inj.note_draw(f"offset={i}")
+    flat[i] ^= 0x01
     return out
 
 
@@ -674,7 +705,9 @@ def slow_gate(plane: str, addr: str) -> float:
         return 0.0
     d = max(rule.delay, 0.0)
     if rule.jitter > 0.0:
-        d += rule.jitter * inj.rng.random()
+        j = rule.jitter * inj.rng.random()
+        inj.note_draw(f"jitter={j:.6f}")
+        d += j
     return d
 
 

@@ -44,6 +44,7 @@ self-consistent checksum; that failure mode is what the output watchdog
 from __future__ import annotations
 
 import logging
+import sys
 import threading
 import time
 import zlib
@@ -307,6 +308,13 @@ class IntegrityTracker:
                 self.quarantine_reason = reason or f"ordered via {source}"
             if fresh:
                 self.quarantines_total += 1
+        # chaos-plane observation hook (docs/chaos.md): one dict-get unless
+        # runtime/chaos.py is imported and armed; outside _lock (the
+        # observer locks itself)
+        ch = sys.modules.get("dynamo_tpu.runtime.chaos")
+        if ch is not None:
+            ch.note_event("quarantine", latched=True, source=source,
+                          reason=reason)
 
     def clear_quarantine(self, source: Optional[str] = None) -> None:
         """``source=None`` is the operator unquarantine: every source is
@@ -321,6 +329,10 @@ class IntegrityTracker:
                 self._quarantine_sources.discard(source)
                 if not self._quarantine_sources:
                     self.quarantine_reason = ""
+            still = bool(self._quarantine_sources)
+        ch = sys.modules.get("dynamo_tpu.runtime.chaos")
+        if ch is not None:
+            ch.note_event("quarantine", latched=still, source=source or "*")
 
     def counters(self) -> Dict[str, int]:
         with self._lock:

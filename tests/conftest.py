@@ -30,6 +30,10 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line("markers", "tpu: requires real TPU hardware")
     config.addinivalue_line("markers", "slow: long-running test")
+    config.addinivalue_line(
+        "markers",
+        "chaos: composition chaos plane (seeded fault-schedule runs)",
+    )
 
 
 @pytest.fixture(scope="session")
@@ -128,6 +132,20 @@ def _reset_straggler_state():
     strag = sys.modules.get("dynamo_tpu.runtime.straggler")
     if strag is not None:
         strag.reset_for_tests()
+
+
+@pytest.fixture(autouse=True)
+def _reset_chaos_state():
+    """Drop the process-global chaos observer and its once-only env probe
+    after each test: one test's armed observer (or noted events) must not
+    bleed into another's invariant or zero-overhead assertions (imported
+    lazily — the control-plane reset pattern)."""
+    yield
+    import sys
+
+    ch = sys.modules.get("dynamo_tpu.runtime.chaos")
+    if ch is not None:
+        ch.reset_for_tests()
 
 
 @pytest.fixture(autouse=True)

@@ -556,6 +556,64 @@ class TestTransferMigrateOp:
 
         run(go())
 
+    def test_quarantine_latch_mid_migration_aborts_ship(self, tiny, run):
+        """Composition regression (ISSUE 19 satellite, first surfaced by
+        the chaos matrix's quarantine×drain pairing): a quarantine latch
+        landing while a migration is in flight must abort the ship TO the
+        quarantined target with a typed error — adopting a stream into a
+        suspect KV pool would hand corrupt pages a clean lineage. The
+        check is receiver-side because the source's routing snapshot can
+        be a beat stale; clearing the latch restores service on the SAME
+        connection (no teardown)."""
+        from dynamo_tpu.disagg.transfer import (
+            KvTransferClient,
+            KvTransferServer,
+        )
+        from dynamo_tpu.engine_jax.allocator import MigrationRejected
+        from dynamo_tpu.runtime import integrity
+
+        async def go():
+            src = _engine(tiny)
+            prompt = list(range(17, 43))
+            cp, got, gen = await _freeze_mid_stream(src, prompt, 10, 4)
+            pages = _call(src, lambda: src.extract_for_migration(
+                cp["request_id"]
+            ))
+            tgt = _engine(tiny)
+            server = KvTransferServer(tgt, host="127.0.0.1", port=0)
+            await server.start()
+            client = KvTransferClient()
+            addr = f"127.0.0.1:{server.port}"
+            meta = {k: cp[k] for k in ("mid", "request_id", "token_ids",
+                                       "emitted", "tenant", "level")}
+            scales = (pages[2], pages[3]) if pages[2] is not None else None
+
+            # the latch lands between freeze and ship — the in-flight
+            # migration must die with the typed rejection, not stage
+            integrity.tracker().quarantine(
+                source="store", reason="operator order mid-migration"
+            )
+            with pytest.raises(MigrationRejected, match="quarantined"):
+                await client.migrate(addr, meta, pages[0], pages[1], scales)
+            assert len(tgt._staged_migrations) == 0
+
+            # unquarantine: the SAME client connection ships it clean
+            integrity.clear_quarantine(None)
+            staged = await client.migrate(
+                addr, meta, pages[0], pages[1], scales
+            )
+            assert staged["cached_tokens"] == len(cp["token_ids"]) - 1
+            assert len(tgt._staged_migrations) == 1
+
+            _call(src, src.cut_for_resume)
+            await gen.aclose()
+            await client.close()
+            await server.stop()
+            src.close()
+            tgt.close()
+
+        run(go())
+
 
 # -- client re-home over real served workers -----------------------------------
 

@@ -422,6 +422,39 @@ class TestArbiter:
         assert arb.state_of("c") == straggler.OK
         assert arb.trips_total == 0
 
+    def test_bus_blackout_stale_ewma_holds_not_convicts(self):
+        """Composition regression (ISSUE 19 satellite, the chaos matrix's
+        slow×blackout pairing): worker c's last load report before a bus
+        blackout carried a queue-spike EWMA — then the bus dies and NOBODY
+        publishes for many windows. The arbiter keeps evaluating on its
+        clock, but a stale number is not a fresh differential signal: c
+        must HOLD at its pre-blackout verdict (one SUSPECT trip), never
+        ladder to CONFIRMED off data the blackout froze. When the bus
+        returns with healthy samples, c clears outright."""
+        arb = StragglerArbiter(_pol(trips=3))
+        s = {"a": 0, "b": 0, "c": 0}
+        t = 0.0
+        base = {"a": 100.0, "b": 100.0}
+        t, ch = self._round(arb, t, s, dict(base, c=100.0))
+        assert ch == {}
+        # last pre-blackout report: c spikes → first trip, SUSPECT
+        t, ch = self._round(arb, t, s, dict(base, c=950.0))
+        assert ch == {"c": straggler.SUSPECT}
+        trips_before = arb.trips_total
+        # bus blackout: zero observe() calls fleetwide; boundaries still
+        # tick. Stale EWMAs must neither trip nor change anything.
+        for _ in range(6):
+            t += 11.0
+            assert arb.evaluate(t) == {}
+        assert arb.state_of("c") == straggler.SUSPECT
+        assert arb.trips_total == trips_before, (
+            "a blackout-frozen EWMA must not accumulate trips"
+        )
+        # bus restored: one healthy fresh window clears c
+        t, ch = self._round(arb, t, s, dict(base, c=105.0))
+        assert ch == {"c": straggler.OK}
+        assert arb.verdicts() == {}
+
     def test_probation_decay_releases_starved_verdict(self):
         """Soft-demotion starves a suspect of the traffic that could clear
         it. A demoted worker with no fresh samples for PROBATION_WINDOWS
